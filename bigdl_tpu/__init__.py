@@ -1,0 +1,23 @@
+"""bigdl_tpu — a TPU-native deep learning framework with the capability
+surface of BigDL (distributed deep learning on Apache Spark), re-designed
+for JAX/XLA on TPU.
+
+Reference: majing921201/BigDL (read-only study copy). This is NOT a port:
+compute lowers to XLA (MXU matmuls/convs, fused elementwise), distribution is
+jax.sharding over a device Mesh with ICI collectives instead of Spark
+block-manager parameter aggregation, and recurrence/attention compile to
+lax.scan / Pallas kernels instead of MKL primitives.
+"""
+
+__version__ = "0.1.0"
+
+from . import utils
+from .utils import Table, T, Shape
+from .utils import engine as Engine
+
+from . import nn
+from . import optim
+from . import dataset
+from . import parallel
+from . import models
+from . import visualization

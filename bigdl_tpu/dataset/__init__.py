@@ -1,0 +1,8 @@
+from .sample import Sample
+from .minibatch import MiniBatch, PaddingParam
+from .transformer import (Transformer, Identity as IdentityTransformer,
+                          SampleToMiniBatch, ChainedTransformer)
+from .dataset import DataSet, LocalDataSet, ShardedDataSet
+from . import mnist
+from . import cifar
+from . import text

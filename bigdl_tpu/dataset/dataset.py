@@ -1,0 +1,119 @@
+"""DataSet abstractions.
+
+Parity: reference ``dataset/DataSet.scala`` — LocalDataSet (single node) and
+DistributedDataSet (RDD). The TPU analog of the RDD partition is the mesh
+data-axis shard: ``ShardedDataSet`` yields global batches laid out so
+``jax.device_put`` with a NamedSharding splits them across the ``data`` axis
+without host copies.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .minibatch import MiniBatch
+from .sample import Sample
+from .transformer import SampleToMiniBatch, Transformer
+
+
+class DataSet:
+    """Factory namespace (parity: DataSet object in dataset/DataSet.scala)."""
+
+    @staticmethod
+    def array(data: Sequence, transformer: Optional[Transformer] = None):
+        ds = LocalDataSet(list(data))
+        return ds.transform(transformer) if transformer else ds
+
+    @staticmethod
+    def from_arrays(features: np.ndarray, labels: Optional[np.ndarray] = None):
+        if labels is None:
+            samples = [Sample(features[i]) for i in range(len(features))]
+        else:
+            samples = [Sample(features[i], labels[i])
+                       for i in range(len(features))]
+        return LocalDataSet(samples)
+
+
+class AbstractDataSet:
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self):
+        return self
+
+    def data(self, train: bool) -> Iterable:
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "AbstractDataSet":
+        return TransformedDataSet(self, transformer)
+
+    # reference arrow alias
+    def arrow(self, transformer):
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    def __init__(self, data: List, seed: int = 1):
+        self._data = list(data)
+        self._rng = np.random.RandomState(seed)
+
+    def size(self):
+        return len(self._data)
+
+    def shuffle(self):
+        self._rng.shuffle(self._data)
+        return self
+
+    def data(self, train: bool = True):
+        if train:
+            idx = self._rng.permutation(len(self._data))
+            return (self._data[i] for i in idx)
+        return iter(self._data)
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base, self.transformer = base, transformer
+
+    def size(self):
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+        return self
+
+    def data(self, train: bool = True):
+        return self.transformer.apply(iter(self.base.data(train)))
+
+
+class ShardedDataSet(AbstractDataSet):
+    """Batch-level dataset for DistriOptimizer: global batches whose leading
+    dim is divisible by the mesh data-axis size (parity with
+    DistributedDataSet's per-partition batching in dataset/DataSet.scala)."""
+
+    def __init__(self, dataset: AbstractDataSet, batch_size: int,
+                 num_shards: int = 1, drop_last: bool = True,
+                 feature_padding=None, label_padding=None):
+        if batch_size % num_shards != 0:
+            raise ValueError(
+                f"global batch size {batch_size} must divide over "
+                f"{num_shards} data shards")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.num_shards = num_shards
+        self.to_batch = SampleToMiniBatch(batch_size, feature_padding,
+                                          label_padding, drop_last=drop_last)
+
+    def size(self):
+        return self.dataset.size()
+
+    def batches_per_epoch(self):
+        return self.dataset.size() // self.batch_size
+
+    def shuffle(self):
+        self.dataset.shuffle()
+        return self
+
+    def data(self, train: bool = True):
+        return self.to_batch.apply(iter(self.dataset.data(train)))

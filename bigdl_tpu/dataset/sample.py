@@ -1,0 +1,36 @@
+"""Sample — one training example.
+
+Parity: reference ``dataset/Sample.scala`` (ArraySample): feature tensor(s) +
+label tensor(s), stored host-side as numpy (device transfer happens at
+MiniBatch boundary, batched, not per-sample).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Sample:
+    def __init__(self, features, labels=None):
+        self.features = features if isinstance(features, (list, tuple)) \
+            else [np.asarray(features)]
+        self.features = [np.asarray(f) for f in self.features]
+        if labels is None:
+            self.labels = []
+        else:
+            labels = labels if isinstance(labels, (list, tuple)) else [labels]
+            self.labels = [np.asarray(l) for l in labels]
+
+    def feature(self, i=0):
+        return self.features[i]
+
+    def label(self, i=0):
+        return self.labels[i] if self.labels else None
+
+    @staticmethod
+    def from_ndarray(features, labels=None):
+        return Sample(features, labels)
+
+    def __repr__(self):
+        fs = [f.shape for f in self.features]
+        ls = [l.shape for l in self.labels]
+        return f"Sample(features={fs}, labels={ls})"

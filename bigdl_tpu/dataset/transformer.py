@@ -1,0 +1,72 @@
+"""Transformer pipeline.
+
+Parity: reference ``dataset/Transformer.scala`` — composable iterators.
+Compose with ``|`` (reference uses ``->``): ``t = A() | B() | C()``.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+
+class Transformer:
+    def apply(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def __call__(self, it: Iterable) -> Iterator:
+        return self.apply(iter(it))
+
+    def __or__(self, other: "Transformer") -> "ChainedTransformer":
+        return ChainedTransformer(self, other)
+
+    # reference-style arrow composition alias
+    def arrow(self, other):
+        return self.__or__(other)
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, first: Transformer, second: Transformer):
+        self.first, self.second = first, second
+
+    def apply(self, it):
+        return self.second.apply(self.first.apply(it))
+
+
+class Identity(Transformer):
+    def apply(self, it):
+        return it
+
+
+class FuncTransformer(Transformer):
+    """Wrap a per-element function."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, it):
+        return (self.fn(x) for x in it)
+
+
+class SampleToMiniBatch(Transformer):
+    """Group Samples into MiniBatches (dataset/SampleToMiniBatch in
+    dataset/Transformer.scala)."""
+
+    def __init__(self, batch_size: int, feature_padding_param=None,
+                 label_padding_param=None, partition_num=None,
+                 drop_last: bool = False):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding_param
+        self.label_padding = label_padding_param
+        self.drop_last = drop_last
+
+    def apply(self, it):
+        from .minibatch import MiniBatch
+        buf = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield MiniBatch.from_samples(buf, self.feature_padding,
+                                             self.label_padding)
+                buf = []
+        if buf and not self.drop_last:
+            yield MiniBatch.from_samples(buf, self.feature_padding,
+                                         self.label_padding)

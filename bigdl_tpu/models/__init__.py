@@ -1,0 +1,2 @@
+from . import lenet
+from .lenet import LeNet5

@@ -1,0 +1,24 @@
+"""LeNet-5 (parity: reference ``models/lenet/LeNet5.scala``)."""
+from __future__ import annotations
+
+from ..nn import (Sequential, Reshape, SpatialConvolution, Tanh,
+                  SpatialMaxPooling, Linear, LogSoftMax)
+
+
+def LeNet5(class_num: int = 10):
+    """models/lenet/LeNet5.scala:30 — conv(1→6,5x5) tanh pool conv(6→12,5x5)
+    tanh pool fc(12*4*4→100) tanh fc(100→classNum) logsoftmax."""
+    model = Sequential()
+    model.add(Reshape([1, 28, 28]))
+    model.add(SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"))
+    model.add(Tanh())
+    model.add(SpatialMaxPooling(2, 2, 2, 2))
+    model.add(SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"))
+    model.add(Tanh())
+    model.add(SpatialMaxPooling(2, 2, 2, 2))
+    model.add(Reshape([12 * 4 * 4]))
+    model.add(Linear(12 * 4 * 4, 100).set_name("fc_1"))
+    model.add(Tanh())
+    model.add(Linear(100, class_num).set_name("fc_2"))
+    model.add(LogSoftMax())
+    return model

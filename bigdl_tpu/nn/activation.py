@@ -1,0 +1,288 @@
+"""Activation layers.
+
+Parity: reference ``nn/ReLU.scala``, ``nn/Tanh.scala``, … (one file per layer
+there; grouped here). All are stateless pure maps — XLA fuses them into the
+surrounding matmul/conv, so none of the reference's in-place buffer tricks are
+needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+
+
+class _Elementwise(Module):
+    def _fn(self, x):
+        raise NotImplementedError
+
+    def _apply(self, params, state, x, training, rng):
+        return self._fn(x)
+
+
+def _default_softmax_axis(x):
+    return 0 if x.ndim == 1 else 1
+
+
+class ReLU(_Elementwise):
+    """nn/ReLU.scala (ip ignored: no in-place on TPU)."""
+
+    def __init__(self, ip: bool = False, name=None):
+        super().__init__(name=name)
+
+    def _fn(self, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(_Elementwise):
+    def __init__(self, ip: bool = False, name=None):
+        super().__init__(name=name)
+
+    def _fn(self, x):
+        return jnp.clip(x, 0.0, 6.0)
+
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, negval: float = 0.01, ip: bool = False, name=None):
+        super().__init__(name=name)
+        self.negval = negval
+
+    def _fn(self, x):
+        return jnp.where(x >= 0, x, self.negval * x)
+
+
+class PReLU(Module):
+    """nn/PReLU.scala — learnable slope; n_output_plane=0 → one shared slope."""
+
+    def __init__(self, n_output_plane: int = 0, name=None):
+        super().__init__(name=name)
+        self.n_output_plane = n_output_plane
+
+    def _init_params(self, rng):
+        n = max(self.n_output_plane, 1)
+        return {"weight": jnp.full((n,), 0.25, jnp.float32)}
+
+    def _apply(self, params, state, x, training, rng):
+        w = params["weight"]
+        if self.n_output_plane > 0 and x.ndim >= 2:
+            # channel dim is dim 1 (NCHW convention, matching reference)
+            shape = [1] * x.ndim
+            shape[1] = self.n_output_plane
+            w = w.reshape(shape)
+        return jnp.where(x >= 0, x, w * x)
+
+
+class RReLU(Module):
+    """nn/RReLU.scala — randomized leaky ReLU (train: slope~U[l,u]; eval: mean)."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 ip: bool = False, name=None):
+        super().__init__(name=name)
+        self.lower, self.upper = lower, upper
+
+    def _apply(self, params, state, x, training, rng):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, x.shape, x.dtype, self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)
+
+
+class SReLU(Module):
+    """nn/SReLU.scala — s-shaped ReLU with 4 learnable per-channel params."""
+
+    def __init__(self, shape, shared_axes=None, name=None):
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+        self.shared_axes = shared_axes
+
+    def _param_shape(self):
+        s = list(self.shape)
+        if self.shared_axes:
+            for ax in self.shared_axes:
+                s[ax - 1] = 1
+        return tuple(s)
+
+    def _init_params(self, rng):
+        s = self._param_shape()
+        return {"t_left": jnp.zeros(s), "a_left": jnp.zeros(s),
+                "t_right": jnp.ones(s), "a_right": jnp.ones(s)}
+
+    def _apply(self, params, state, x, training, rng):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y_left = tl + al * (x - tl)
+        y_right = tr + ar * (x - tr)
+        return jnp.where(x < tl, y_left, jnp.where(x > tr, y_right, x))
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha: float = 1.0, ip: bool = False, name=None):
+        super().__init__(name=name)
+        self.alpha = alpha
+
+    def _fn(self, x):
+        return jnp.where(x > 0, x, self.alpha * (jnp.exp(jnp.minimum(x, 0.0)) - 1))
+
+
+class GELU(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.gelu(x)
+
+
+class SoftPlus(_Elementwise):
+    def __init__(self, beta: float = 1.0, name=None):
+        super().__init__(name=name)
+        self.beta = beta
+
+    def _fn(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    def _fn(self, x):
+        return x / (1.0 + jnp.abs(x))
+
+
+class Sigmoid(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class LogSigmoid(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.log_sigmoid(x)
+
+
+class Tanh(_Elementwise):
+    def _fn(self, x):
+        return jnp.tanh(x)
+
+
+class TanhShrink(_Elementwise):
+    def _fn(self, x):
+        return x - jnp.tanh(x)
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 ip: bool = False, name=None):
+        super().__init__(name=name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def _fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    def __init__(self, min_value, max_value, name=None):
+        super().__init__(min_value, max_value, name=name)
+
+
+class HardSigmoid(_Elementwise):
+    def _fn(self, x):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class HardShrink(_Elementwise):
+    def __init__(self, lambda_: float = 0.5, name=None):
+        super().__init__(name=name)
+        self.lambda_ = lambda_
+
+    def _fn(self, x):
+        return jnp.where(jnp.abs(x) > self.lambda_, x, 0.0)
+
+
+class SoftShrink(_Elementwise):
+    def __init__(self, lambda_: float = 0.5, name=None):
+        super().__init__(name=name)
+        self.lambda_ = lambda_
+
+    def _fn(self, x):
+        return jnp.where(x > self.lambda_, x - self.lambda_,
+                         jnp.where(x < -self.lambda_, x + self.lambda_, 0.0))
+
+
+class SoftMax(_Elementwise):
+    """nn/SoftMax.scala — softmax over class dim (dim 1 for batched input)."""
+
+    def __init__(self, axis=None, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def _fn(self, x):
+        ax = self.axis if self.axis is not None else _default_softmax_axis(x)
+        return jax.nn.softmax(x, axis=ax)
+
+
+class SoftMin(_Elementwise):
+    def __init__(self, axis=None, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def _fn(self, x):
+        ax = self.axis if self.axis is not None else _default_softmax_axis(x)
+        return jax.nn.softmax(-x, axis=ax)
+
+
+class LogSoftMax(_Elementwise):
+    def __init__(self, axis=None, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def _fn(self, x):
+        ax = self.axis if self.axis is not None else _default_softmax_axis(x)
+        return jax.nn.log_softmax(x, axis=ax)
+
+
+class Threshold(_Elementwise):
+    """nn/Threshold.scala: x > th ? x : v."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False,
+                 name=None):
+        super().__init__(name=name)
+        self.th, self.v = th, v
+
+    def _fn(self, x):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(_Elementwise):
+    def __init__(self, th: float = 1e-6, ip: bool = False, name=None):
+        super().__init__(name=name)
+        self.th = th
+
+    def _fn(self, x):
+        return (x > self.th).astype(x.dtype)
+
+
+class Maxout(Module):
+    """nn/Maxout.scala — linear to pool*out features, max over pool groups."""
+
+    def __init__(self, input_size: int, output_size: int, maxout_number: int,
+                 with_bias: bool = True, name=None):
+        super().__init__(name=name)
+        self.input_size, self.output_size = input_size, output_size
+        self.maxout_number = maxout_number
+        self.with_bias = with_bias
+
+    def _init_params(self, rng):
+        import numpy as np
+        k1, k2 = jax.random.split(rng)
+        stdv = 1.0 / np.sqrt(self.input_size)
+        p = {"weight": jax.random.uniform(
+            k1, (self.input_size, self.maxout_number * self.output_size),
+            minval=-stdv, maxval=stdv)}
+        if self.with_bias:
+            p["bias"] = jax.random.uniform(
+                k2, (self.maxout_number * self.output_size,),
+                minval=-stdv, maxval=stdv)
+        return p
+
+    def _apply(self, params, state, x, training, rng):
+        y = x @ params["weight"]
+        if self.with_bias:
+            y = y + params["bias"]
+        y = y.reshape(y.shape[:-1] + (self.maxout_number, self.output_size))
+        return jnp.max(y, axis=-2)

@@ -1,0 +1,271 @@
+"""Attention / transformer layers.
+
+Parity: reference ``nn/Attention.scala`` (multi-head dot-product attention),
+``nn/FeedForwardNetwork.scala``, ``nn/Transformer.scala`` (Vaswani-style,
+LM and translation modes), ``nn/TransformerOperation.scala`` helpers.
+
+TPU-first: attention is computed as two batched einsums (MXU) with an optional
+fused Pallas flash-attention kernel on TPU backends (O(T) memory, tiled over
+sequence); the reference has no fused path at all. Ring attention for
+sequence parallelism lives in ``bigdl_tpu.parallel.ring_attention``.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .module import Module
+from .norm import LayerNormalization
+from ..utils.table import Table
+
+
+def _glorot(rng, shape):
+    fan_in, fan_out = shape[0], shape[-1]
+    s = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, minval=-s, maxval=s)
+
+
+def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
+                          training=False):
+    """q,k,v: (B, H, T, D). mask: additive (broadcastable) or None."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    if mask is not None:
+        logits = logits + mask
+    w = jax.nn.softmax(logits, axis=-1)
+    if training and dropout_p > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_p, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def flash_attention(q, k, v, causal=False):
+    """Fused attention. On TPU uses the Pallas kernel from
+    ``bigdl_tpu.parallel.flash``; elsewhere falls back to the einsum path."""
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    if backend == "tpu":
+        try:
+            from ..parallel.flash import flash_attention as pallas_flash
+            return pallas_flash(q, k, v, causal=causal)
+        except Exception:
+            pass
+    mask = None
+    if causal:
+        t = q.shape[-2]
+        mask = jnp.where(
+            np.tril(np.ones((t, t), np.bool_))[None, None], 0.0, -1e9)
+    return dot_product_attention(q, k, v, mask)
+
+
+def causal_mask(t, dtype=jnp.float32):
+    return jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], 0.0,
+                     jnp.asarray(-1e9, dtype))
+
+
+def padding_mask(lengths_or_mask, t):
+    """Build additive (B,1,1,T) mask from a (B,T) 0/1 keep-mask."""
+    m = lengths_or_mask.astype(jnp.float32)
+    return (m[:, None, None, :] - 1.0) * 1e9
+
+
+class Attention(Module):
+    """Multi-head attention (nn/Attention.scala). Input Table(query_seq,
+    key_value_seq, additive_mask_or_None) or a single tensor (self-attn)."""
+
+    def __init__(self, hidden_size: int, num_heads: int,
+                 attention_dropout: float = 0.0, use_flash: bool = True,
+                 name=None):
+        super().__init__(name=name)
+        assert hidden_size % num_heads == 0
+        self.hidden_size, self.num_heads = hidden_size, num_heads
+        self.attention_dropout = attention_dropout
+        self.use_flash = use_flash
+
+    def _init_params(self, rng):
+        k = jax.random.split(rng, 4)
+        H = self.hidden_size
+        return {"wq": _glorot(k[0], (H, H)), "wk": _glorot(k[1], (H, H)),
+                "wv": _glorot(k[2], (H, H)), "wo": _glorot(k[3], (H, H))}
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, self.num_heads, -1).transpose(0, 2, 1, 3)
+
+    def _apply(self, params, state, x, training, rng):
+        if isinstance(x, Table):
+            qx = x[1]
+            kx = x[2] if len(x) >= 2 else qx
+            mask = x[3] if len(x) >= 3 else None
+        else:
+            qx, kx, mask = x, x, None
+        q = self._split(qx @ params["wq"])
+        k = self._split(kx @ params["wk"])
+        v = self._split(kx @ params["wv"])
+        o = dot_product_attention(q, k, v, mask, self.attention_dropout, rng,
+                                  training)
+        b, h, t, d = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+        return o @ params["wo"]
+
+
+class FeedForwardNetwork(Module):
+    """Position-wise FFN (nn/FeedForwardNetwork.scala)."""
+
+    def __init__(self, hidden_size: int, filter_size: int,
+                 relu_dropout: float = 0.0, name=None):
+        super().__init__(name=name)
+        self.hidden_size, self.filter_size = hidden_size, filter_size
+        self.relu_dropout = relu_dropout
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"w1": _glorot(k1, (self.hidden_size, self.filter_size)),
+                "b1": jnp.zeros((self.filter_size,)),
+                "w2": _glorot(k2, (self.filter_size, self.hidden_size)),
+                "b2": jnp.zeros((self.hidden_size,))}
+
+    def _apply(self, params, state, x, training, rng):
+        h = jax.nn.relu(x @ params["w1"] + params["b1"])
+        if training and self.relu_dropout > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1 - self.relu_dropout, h.shape)
+            h = jnp.where(keep, h / (1 - self.relu_dropout), 0.0)
+        return h @ params["w2"] + params["b2"]
+
+
+def position_encoding(length, hidden_size, dtype=jnp.float32):
+    """Sinusoidal PE (nn/TransformerOperation.scala getPositionEncode)."""
+    pos = np.arange(length)[:, None].astype(np.float64)
+    dim = np.arange(hidden_size // 2)[None, :].astype(np.float64)
+    angle = pos / np.power(10000.0, 2 * dim / hidden_size)
+    pe = np.concatenate([np.sin(angle), np.cos(angle)], axis=-1)
+    return jnp.asarray(pe, dtype)
+
+
+class TransformerBlock(Module):
+    """Pre-LN transformer block: self-attn (+ optional cross-attn) + FFN."""
+
+    def __init__(self, hidden_size: int, num_heads: int, filter_size: int,
+                 attn_dropout: float = 0.0, ffn_dropout: float = 0.0,
+                 with_cross: bool = False, name=None):
+        super().__init__(name=name)
+        self.attn = Attention(hidden_size, num_heads, attn_dropout)
+        self.ffn = FeedForwardNetwork(hidden_size, filter_size, ffn_dropout)
+        self.ln1 = LayerNormalization(hidden_size)
+        self.ln2 = LayerNormalization(hidden_size)
+        self.with_cross = with_cross
+        if with_cross:
+            self.cross = Attention(hidden_size, num_heads, attn_dropout)
+            self.ln3 = LayerNormalization(hidden_size)
+
+    def _init_params(self, rng):
+        k = jax.random.split(rng, 6)
+        p = {"attn": self.attn._init_params(k[0]),
+             "ffn": self.ffn._init_params(k[1]),
+             "ln1": self.ln1._init_params(k[2]),
+             "ln2": self.ln2._init_params(k[3])}
+        if self.with_cross:
+            p["cross"] = self.cross._init_params(k[4])
+            p["ln3"] = self.ln3._init_params(k[5])
+        return p
+
+    def _apply(self, params, state, x, training, rng):
+        if isinstance(x, Table):
+            h, mask = x[1], x[2]
+            enc = x[3] if len(x) >= 3 else None
+            enc_mask = x[4] if len(x) >= 4 else None
+        else:
+            h, mask, enc, enc_mask = x, None, None, None
+        r1 = jax.random.fold_in(rng, 1) if rng is not None else None
+        r2 = jax.random.fold_in(rng, 2) if rng is not None else None
+        n, _ = self.ln1.apply(params["ln1"], {}, h, training, None)
+        a, _ = self.attn.apply(params["attn"], {}, Table(n, n, mask),
+                               training, r1)
+        h = h + a
+        if self.with_cross and enc is not None:
+            n, _ = self.ln3.apply(params["ln3"], {}, h, training, None)
+            c, _ = self.cross.apply(params["cross"], {},
+                                    Table(n, enc, enc_mask), training, r1)
+            h = h + c
+        n, _ = self.ln2.apply(params["ln2"], {}, h, training, None)
+        f, _ = self.ffn.apply(params["ffn"], {}, n, training, r2)
+        return h + f
+
+
+class Transformer(Module):
+    """Transformer (nn/Transformer.scala). ``mode='lm'`` (decoder-only causal
+    LM over token ids) or ``mode='translation'`` (encoder-decoder; input
+    Table(src_ids, tgt_ids)). Returns logits over vocab."""
+
+    def __init__(self, vocab_size: int, hidden_size: int = 256,
+                 num_heads: int = 4, filter_size: int = 1024,
+                 num_hidden_layers: int = 2, postprocess_dropout: float = 0.0,
+                 attention_dropout: float = 0.0, relu_dropout: float = 0.0,
+                 mode: str = "lm", max_len: int = 2048, name=None):
+        super().__init__(name=name)
+        self.vocab_size, self.hidden_size = vocab_size, hidden_size
+        self.mode, self.max_len = mode, max_len
+        self.dropout_p = postprocess_dropout
+        self.blocks = [TransformerBlock(hidden_size, num_heads, filter_size,
+                                        attention_dropout, relu_dropout,
+                                        with_cross=(mode == "translation"))
+                       for _ in range(num_hidden_layers)]
+        if mode == "translation":
+            self.enc_blocks = [TransformerBlock(hidden_size, num_heads,
+                                                filter_size, attention_dropout,
+                                                relu_dropout)
+                               for _ in range(num_hidden_layers)]
+        self.ln_f = LayerNormalization(hidden_size)
+
+    def _init_params(self, rng):
+        k = jax.random.split(rng, 4 + len(self.blocks) * 2)
+        p = {"embed": 0.02 * jax.random.normal(
+                k[0], (self.vocab_size, self.hidden_size)),
+             "ln_f": self.ln_f._init_params(k[1])}
+        for i, blk in enumerate(self.blocks):
+            p[f"block{i}"] = blk._init_params(k[2 + i])
+        if self.mode == "translation":
+            for i, blk in enumerate(self.enc_blocks):
+                p[f"enc_block{i}"] = blk._init_params(
+                    k[2 + len(self.blocks) + i])
+        return p
+
+    def _embed(self, params, ids):
+        h = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
+        h = h * math.sqrt(self.hidden_size)
+        return h + position_encoding(ids.shape[1], self.hidden_size)
+
+    def _stack(self, blocks, prefix, params, h, mask, training, rng,
+               enc=None, enc_mask=None):
+        for i, blk in enumerate(blocks):
+            r = jax.random.fold_in(rng, i) if rng is not None else None
+            arg = Table(h, mask) if enc is None else Table(h, mask, enc,
+                                                           enc_mask)
+            h = blk._apply(params[f"{prefix}{i}"], {}, arg, training, r)
+        return h
+
+    def _apply(self, params, state, x, training, rng):
+        if self.mode == "translation":
+            src, tgt = x[1], x[2]
+            src_mask = padding_mask((src != 0), src.shape[1])
+            enc = self._embed(params, src)
+            enc = self._stack(self.enc_blocks, "enc_block", params, enc,
+                              src_mask, training, rng)
+            h = self._embed(params, tgt)
+            mask = causal_mask(tgt.shape[1])
+            h = self._stack(self.blocks, "block", params, h, mask, training,
+                            rng, enc, src_mask)
+        else:
+            ids = x
+            h = self._embed(params, ids)
+            mask = causal_mask(ids.shape[1])
+            h = self._stack(self.blocks, "block", params, h, mask, training,
+                            rng)
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h, training, None)
+        return h @ params["embed"].T  # tied output projection

@@ -1,0 +1,116 @@
+"""Containers: Sequential, Concat, ConcatTable, ParallelTable, MapTable, Bottle.
+
+Parity: reference ``nn/Sequential.scala``, ``nn/Concat.scala``,
+``nn/ConcatTable.scala``, ``nn/ParallelTable.scala``, ``nn/MapTable.scala``,
+``nn/Bottle.scala``. Pure composition over child ``apply`` calls — XLA fuses
+across children, so a container costs nothing at runtime.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import Container, Module
+from ..utils.table import Table
+
+
+class Sequential(Container):
+    """Chain children in order (nn/Sequential.scala:30)."""
+
+    def _apply(self, params, state, x, training, rng):
+        new_state = dict(state)
+        for i in range(len(self.modules)):
+            x, new_state[str(i)] = self.child_apply(i, params, state, x,
+                                                    training, rng)
+        return x, new_state
+
+
+class Concat(Container):
+    """Run children on the same input, concat outputs on ``dimension``
+    (1-based, matching reference nn/Concat.scala)."""
+
+    def __init__(self, dimension: int, *modules, name=None):
+        super().__init__(*modules, name=name)
+        self.dimension = dimension
+
+    def _apply(self, params, state, x, training, rng):
+        outs = []
+        new_state = dict(state)
+        for i in range(len(self.modules)):
+            o, new_state[str(i)] = self.child_apply(i, params, state, x,
+                                                    training, rng)
+            outs.append(o)
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+
+
+class JoinTableModuleMixin:
+    pass
+
+
+class ConcatTable(Container):
+    """Run children on the same input, return a Table of outputs
+    (nn/ConcatTable.scala)."""
+
+    def _apply(self, params, state, x, training, rng):
+        outs = []
+        new_state = dict(state)
+        for i in range(len(self.modules)):
+            o, new_state[str(i)] = self.child_apply(i, params, state, x,
+                                                    training, rng)
+            outs.append(o)
+        return Table(*outs), new_state
+
+
+class ParallelTable(Container):
+    """i-th child consumes i-th element of the input Table
+    (nn/ParallelTable.scala)."""
+
+    def _apply(self, params, state, x, training, rng):
+        outs = []
+        new_state = dict(state)
+        for i in range(len(self.modules)):
+            o, new_state[str(i)] = self.child_apply(i, params, state, x[i + 1],
+                                                    training, rng)
+            outs.append(o)
+        return Table(*outs), new_state
+
+
+class MapTable(Container):
+    """Apply the single child to every element of the input Table with shared
+    parameters (nn/MapTable.scala)."""
+
+    def __init__(self, module: Module, name=None):
+        super().__init__(module, name=name)
+
+    def _apply(self, params, state, x, training, rng):
+        outs = []
+        new_state = dict(state)
+        for j, item in enumerate(x):
+            o, new_state["0"] = self.child_apply(0, params, state, item,
+                                                 training, rng)
+            outs.append(o)
+        return Table(*outs), new_state
+
+
+class Bottle(Container):
+    """Collapse leading dims, apply child, restore (nn/Bottle.scala).
+
+    Default nInputDim=2: an (d1, d2, ..., dk, feat) input is viewed as
+    (prod(leading), feat) for the child.
+    """
+
+    def __init__(self, module: Module, n_input_dim: int = 2, n_output_dim: int = 2,
+                 name=None):
+        super().__init__(module, name=name)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+
+    def _apply(self, params, state, x, training, rng):
+        in_shape = x.shape
+        keep = self.n_input_dim - 1
+        lead = in_shape[: len(in_shape) - keep]
+        tail = in_shape[len(in_shape) - keep:]
+        flat = x.reshape((-1,) + tail)
+        o, new_sub = self.child_apply(0, params, state, flat, training, rng)
+        out = o.reshape(lead + o.shape[1:])
+        return out, {**state, "0": new_sub}

@@ -1,0 +1,503 @@
+"""Convolution layers.
+
+Parity: reference ``nn/SpatialConvolution.scala``,
+``nn/SpatialDilatedConvolution.scala``, ``nn/SpatialFullConvolution.scala``,
+``nn/SpatialSeparableConvolution.scala``, ``nn/SpatialShareConvolution.scala``,
+``nn/SpatialConvolutionMap.scala``, ``nn/TemporalConvolution.scala``,
+``nn/VolumetricConvolution.scala``, ``nn/VolumetricFullConvolution.scala``,
+``nn/LocallyConnected1D.scala``, ``nn/LocallyConnected2D.scala``.
+
+All lower to a single ``lax.conv_general_dilated`` (one XLA HLO, tiled onto the
+MXU) — none of the reference's im2col + MKL GEMM staging exists here; XLA picks
+the conv algorithm per shape. Data layout is NCHW to match the reference API;
+XLA's layout assignment re-tiles for the MXU internally.
+
+``pad = -1`` means SAME padding (reference convention).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .module import Module
+from .init import Xavier, Zeros
+
+_conv_default_init = Xavier()
+
+
+def _pad_pair(pad, k, stride, dilation=1):
+    """Map reference pad int to lax padding pair. -1 → SAME."""
+    if pad == -1:
+        return "SAME"
+    return (pad, pad)
+
+
+def _resolve_padding(pads):
+    if any(p == "SAME" for p in pads):
+        return "SAME"
+    return list(pads)
+
+
+class SpatialConvolution(Module):
+    """2-D convolution over NCHW (nn/SpatialConvolution.scala:48)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1,
+                 stride_h: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 n_group: int = 1, propagate_back: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None, with_bias: bool = True,
+                 init_method=None, bias_init_method=None,
+                 dilation_w: int = 1, dilation_h: int = 1, name=None):
+        super().__init__(name=name)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.with_bias = with_bias
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        self.init_weight, self.init_bias = init_weight, init_bias
+        self.init_method = init_method or _conv_default_init
+        self.bias_init_method = bias_init_method or Zeros()
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        shape = (self.n_output_plane, self.n_input_plane // self.n_group,
+                 self.kernel_h, self.kernel_w)
+        fan_in = (self.n_input_plane // self.n_group) * self.kernel_h * self.kernel_w
+        fan_out = self.n_output_plane * self.kernel_h * self.kernel_w
+        if self.init_weight is not None:
+            w = jnp.asarray(self.init_weight, jnp.float32).reshape(shape)
+        else:
+            w = self.init_method(k1, shape, fan_in=fan_in, fan_out=fan_out)
+        p = {"weight": w}
+        if self.with_bias:
+            if self.init_bias is not None:
+                b = jnp.asarray(self.init_bias, jnp.float32)
+            else:
+                b = self.bias_init_method(k2, (self.n_output_plane,),
+                                          fan_in=fan_in, fan_out=fan_out)
+            p["bias"] = b
+        return p
+
+    def _regularizers(self):
+        r = {}
+        if self.w_regularizer is not None:
+            r["weight"] = self.w_regularizer
+        if self.b_regularizer is not None and self.with_bias:
+            r["bias"] = self.b_regularizer
+        return r
+
+    def _conv(self, x, w):
+        pads = (_pad_pair(self.pad_h, self.kernel_h, self.stride_h),
+                _pad_pair(self.pad_w, self.kernel_w, self.stride_w))
+        return lax.conv_general_dilated(
+            x, w, window_strides=(self.stride_h, self.stride_w),
+            padding=_resolve_padding(pads),
+            rhs_dilation=(self.dilation_h, self.dilation_w),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group)
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:  # unbatched, reference accepts CHW
+            x, squeeze = x[None], True
+        y = self._conv(x, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None]
+        return y[0] if squeeze else y
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """nn/SpatialShareConvolution.scala — a memory-sharing CPU optimisation of
+    SpatialConvolution; on TPU identical (XLA owns buffers)."""
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """nn/SpatialDilatedConvolution.scala."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
+                 pad_w=0, pad_h=0, dilation_w=1, dilation_h=1,
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(n_input_plane, n_output_plane, kw, kh, dw, dh,
+                         pad_w, pad_h, w_regularizer=w_regularizer,
+                         b_regularizer=b_regularizer,
+                         dilation_w=dilation_w, dilation_h=dilation_h, name=name)
+
+
+class SpatialFullConvolution(Module):
+    """Transposed 2-D convolution (nn/SpatialFullConvolution.scala)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False,
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h, self.adj_w, self.adj_h = pad_w, pad_h, adj_w, adj_h
+        self.n_group, self.no_bias = n_group, no_bias
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        shape = (self.n_input_plane, self.n_output_plane // self.n_group,
+                 self.kh, self.kw)
+        fan_in = self.n_input_plane * self.kh * self.kw
+        w = _conv_default_init(k1, shape, fan_in=fan_in,
+                               fan_out=self.n_output_plane * self.kh * self.kw)
+        p = {"weight": w}
+        if not self.no_bias:
+            p["bias"] = jnp.zeros((self.n_output_plane,))
+        return p
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x, squeeze = x[None], True
+        # transposed conv = lhs-dilated conv with flipped kernel semantics;
+        # lax.conv_transpose handles this directly.
+        w = params["weight"]  # (in, out/g, kh, kw) → IOHW
+        pad_h = (self.kh - 1 - self.pad_h, self.kh - 1 - self.pad_h + self.adj_h)
+        pad_w = (self.kw - 1 - self.pad_w, self.kw - 1 - self.pad_w + self.adj_w)
+        y = lax.conv_general_dilated(
+            x, jnp.flip(w, axis=(-1, -2)).swapaxes(0, 1) if self.n_group == 1
+            else self._group_flip(w),
+            window_strides=(1, 1), padding=[pad_h, pad_w],
+            lhs_dilation=(self.dh, self.dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group)
+        if not self.no_bias:
+            y = y + params["bias"][None, :, None, None]
+        return y[0] if squeeze else y
+
+    def _group_flip(self, w):
+        # (in, out/g, kh, kw) grouped: in = g * in/g; build (out, in/g, kh, kw)
+        g = self.n_group
+        i, og, kh, kw = w.shape
+        wg = w.reshape(g, i // g, og, kh, kw).transpose(0, 2, 1, 3, 4)
+        wg = wg.reshape(g * og, i // g, kh, kw)
+        return jnp.flip(wg, axis=(-1, -2))
+
+
+class SpatialSeparableConvolution(Module):
+    """Depthwise + pointwise conv (nn/SpatialSeparableConvolution.scala)."""
+
+    def __init__(self, n_input_channel: int, n_output_channel: int,
+                 depth_multiplier: int, kw: int, kh: int, sw: int = 1,
+                 sh: int = 1, pw: int = 0, ph: int = 0, has_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None, p_regularizer=None,
+                 name=None):
+        super().__init__(name=name)
+        self.n_input_channel, self.n_output_channel = n_input_channel, n_output_channel
+        self.depth_multiplier = depth_multiplier
+        self.kw, self.kh, self.sw, self.sh = kw, kh, sw, sh
+        self.pw, self.ph = pw, ph
+        self.has_bias = has_bias
+
+    def _init_params(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        cmid = self.n_input_channel * self.depth_multiplier
+        dshape = (cmid, 1, self.kh, self.kw)
+        pshape = (self.n_output_channel, cmid, 1, 1)
+        p = {"depth_weight": _conv_default_init(
+                k1, dshape, fan_in=self.kh * self.kw, fan_out=self.kh * self.kw),
+             "point_weight": _conv_default_init(
+                k2, pshape, fan_in=cmid, fan_out=self.n_output_channel)}
+        if self.has_bias:
+            p["bias"] = jnp.zeros((self.n_output_channel,))
+        return p
+
+    def _apply(self, params, state, x, training, rng):
+        pads = (_pad_pair(self.ph, self.kh, self.sh),
+                _pad_pair(self.pw, self.kw, self.sw))
+        y = lax.conv_general_dilated(
+            x, params["depth_weight"], (self.sh, self.sw),
+            _resolve_padding(pads), dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_input_channel)
+        y = lax.conv_general_dilated(
+            y, params["point_weight"], (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.has_bias:
+            y = y + params["bias"][None, :, None, None]
+        return y
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution with an explicit input→output connection table
+    (nn/SpatialConvolutionMap.scala). Implemented as a dense conv with a
+    frozen connectivity mask — on the MXU dense-with-mask beats gather loops.
+    ``conn_table`` is an (n_pairs, 2) array of 1-based (in, out) pairs.
+    """
+
+    def __init__(self, conn_table, kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, name=None):
+        super().__init__(name=name)
+        self.conn_table = np.asarray(conn_table, dtype=np.int64)
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_input_plane = int(self.conn_table[:, 0].max())
+        self.n_output_plane = int(self.conn_table[:, 1].max())
+
+    @staticmethod
+    def full(nin, nout):
+        return np.array([(i + 1, o + 1) for o in range(nout)
+                         for i in range(nin)])
+
+    @staticmethod
+    def one_to_one(nfeat):
+        return np.array([(i + 1, i + 1) for i in range(nfeat)])
+
+    @staticmethod
+    def random(nin, nout, nto):
+        rngs = np.random.RandomState(1)
+        pairs = []
+        for o in range(nout):
+            for i in rngs.choice(nin, size=min(nto, nin), replace=False):
+                pairs.append((i + 1, o + 1))
+        return np.array(pairs)
+
+    def _mask(self):
+        m = np.zeros((self.n_output_plane, self.n_input_plane), np.float32)
+        for i, o in self.conn_table:
+            m[o - 1, i - 1] = 1.0
+        return m
+
+    def _init_params(self, rng):
+        fan_in = self.kh * self.kw * \
+            max(1, len(self.conn_table) // self.n_output_plane)
+        stdv = 1.0 / np.sqrt(fan_in)
+        k1, k2 = jax.random.split(rng)
+        w = jax.random.uniform(
+            k1, (self.n_output_plane, self.n_input_plane, self.kh, self.kw),
+            minval=-stdv, maxval=stdv)
+        return {"weight": w * self._mask()[:, :, None, None],
+                "bias": jax.random.uniform(k2, (self.n_output_plane,),
+                                           minval=-stdv, maxval=stdv)}
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x, squeeze = x[None], True
+        w = params["weight"] * self._mask()[:, :, None, None]
+        pads = (_pad_pair(self.pad_h, self.kh, self.dh),
+                _pad_pair(self.pad_w, self.kw, self.dw))
+        y = lax.conv_general_dilated(
+            x, w, (self.dh, self.dw), _resolve_padding(pads),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        y = y + params["bias"][None, :, None, None]
+        return y[0] if squeeze else y
+
+
+class TemporalConvolution(Module):
+    """1-D conv over (batch, nFrames, frameSize) (nn/TemporalConvolution.scala)."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1, propagate_back=True,
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w, self.stride_w = kernel_w, stride_w
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.input_frame_size * self.kernel_w
+        stdv = 1.0 / np.sqrt(fan_in)
+        return {"weight": jax.random.uniform(
+                    k1, (self.output_frame_size, self.input_frame_size,
+                         self.kernel_w), minval=-stdv, maxval=stdv),
+                "bias": jax.random.uniform(k2, (self.output_frame_size,),
+                                           minval=-stdv, maxval=stdv)}
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 2:
+            x, squeeze = x[None], True
+        # (B, T, C) → NCW conv
+        y = lax.conv_general_dilated(
+            x.swapaxes(1, 2), params["weight"], (self.stride_w,), "VALID",
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        y = (y + params["bias"][None, :, None]).swapaxes(1, 2)
+        return y[0] if squeeze else y
+
+
+class VolumetricConvolution(Module):
+    """3-D conv over NCDHW (nn/VolumetricConvolution.scala)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int, k_t: int,
+                 k_w: int, k_h: int, d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True, w_regularizer=None, b_regularizer=None,
+                 name=None):
+        super().__init__(name=name)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t, self.d_w, self.d_h = d_t, d_w, d_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.with_bias = with_bias
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.n_input_plane * self.k_t * self.k_h * self.k_w
+        shape = (self.n_output_plane, self.n_input_plane,
+                 self.k_t, self.k_h, self.k_w)
+        w = _conv_default_init(k1, shape, fan_in=fan_in,
+                               fan_out=self.n_output_plane * self.k_t *
+                               self.k_h * self.k_w)
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = jnp.zeros((self.n_output_plane,))
+        return p
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 4:
+            x, squeeze = x[None], True
+        pads = (_pad_pair(self.pad_t, self.k_t, self.d_t),
+                _pad_pair(self.pad_h, self.k_h, self.d_h),
+                _pad_pair(self.pad_w, self.k_w, self.d_w))
+        y = lax.conv_general_dilated(
+            x, params["weight"], (self.d_t, self.d_h, self.d_w),
+            _resolve_padding(pads),
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None, None]
+        return y[0] if squeeze else y
+
+
+class VolumetricFullConvolution(Module):
+    """Transposed 3-D conv (nn/VolumetricFullConvolution.scala)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int, kt: int,
+                 kw: int, kh: int, dt: int = 1, dw: int = 1, dh: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False, name=None):
+        super().__init__(name=name)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.kt, self.kw, self.kh = kt, kw, kh
+        self.dt, self.dw, self.dh = dt, dw, dh
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.adj_t, self.adj_w, self.adj_h = adj_t, adj_w, adj_h
+        self.no_bias = no_bias
+
+    def _init_params(self, rng):
+        k1, _ = jax.random.split(rng)
+        shape = (self.n_input_plane, self.n_output_plane, self.kt, self.kh, self.kw)
+        fan_in = self.n_input_plane * self.kt * self.kh * self.kw
+        p = {"weight": _conv_default_init(k1, shape, fan_in=fan_in,
+                                          fan_out=fan_in)}
+        if not self.no_bias:
+            p["bias"] = jnp.zeros((self.n_output_plane,))
+        return p
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 4:
+            x, squeeze = x[None], True
+        w = jnp.flip(params["weight"], axis=(-1, -2, -3)).swapaxes(0, 1)
+        pt = (self.kt - 1 - self.pad_t, self.kt - 1 - self.pad_t + self.adj_t)
+        ph = (self.kh - 1 - self.pad_h, self.kh - 1 - self.pad_h + self.adj_h)
+        pw = (self.kw - 1 - self.pad_w, self.kw - 1 - self.pad_w + self.adj_w)
+        y = lax.conv_general_dilated(
+            x, w, (1, 1, 1), [pt, ph, pw],
+            lhs_dilation=(self.dt, self.dh, self.dw),
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if not self.no_bias:
+            y = y + params["bias"][None, :, None, None, None]
+        return y[0] if squeeze else y
+
+
+class LocallyConnected2D(Module):
+    """Unshared-weight 2-D conv (nn/LocallyConnected2D.scala). Implemented as
+    patch extraction + one batched einsum (a single MXU contraction) instead of
+    the reference's per-position GEMM loop."""
+
+    def __init__(self, n_input_plane: int, input_width: int, input_height: int,
+                 n_output_plane: int, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1, pad_w: int = 0,
+                 pad_h: int = 0, with_bias: bool = True, name=None):
+        super().__init__(name=name)
+        self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
+        self.input_width, self.input_height = input_width, input_height
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.with_bias = with_bias
+        self.out_h = (input_height + 2 * pad_h - kernel_h) // stride_h + 1
+        self.out_w = (input_width + 2 * pad_w - kernel_w) // stride_w + 1
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.n_input_plane * self.kernel_h * self.kernel_w
+        stdv = 1.0 / np.sqrt(fan_in)
+        w = jax.random.uniform(
+            k1, (self.out_h, self.out_w, self.n_output_plane, fan_in),
+            minval=-stdv, maxval=stdv)
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = jax.random.uniform(
+                k2, (self.n_output_plane, self.out_h, self.out_w),
+                minval=-stdv, maxval=stdv)
+        return p
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x, squeeze = x[None], True
+        patches = lax.conv_general_dilated_patches(
+            x, (self.kernel_h, self.kernel_w),
+            (self.stride_h, self.stride_w),
+            [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: (B, C*kh*kw, out_h, out_w)
+        y = jnp.einsum("bphw,hwop->bohw", patches, params["weight"])
+        if self.with_bias:
+            y = y + params["bias"][None]
+        return y[0] if squeeze else y
+
+
+class LocallyConnected1D(Module):
+    """Unshared-weight 1-D conv over (B, nFrames, frameSize)
+    (nn/LocallyConnected1D.scala)."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 propagate_back=True, name=None):
+        super().__init__(name=name)
+        self.n_input_frame = n_input_frame
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w, self.stride_w = kernel_w, stride_w
+        self.out_frames = (n_input_frame - kernel_w) // stride_w + 1
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.input_frame_size * self.kernel_w
+        stdv = 1.0 / np.sqrt(fan_in)
+        return {"weight": jax.random.uniform(
+                    k1, (self.out_frames, self.output_frame_size, fan_in),
+                    minval=-stdv, maxval=stdv),
+                "bias": jax.random.uniform(
+                    k2, (self.out_frames, self.output_frame_size),
+                    minval=-stdv, maxval=stdv)}
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 2:
+            x, squeeze = x[None], True
+        # x: (B, T, C) → patches (B, out_T, k*C)
+        idx = (np.arange(self.out_frames)[:, None] * self.stride_w +
+               np.arange(self.kernel_w)[None, :])
+        pat = x[:, idx, :]  # (B, out_T, k, C)
+        pat = pat.reshape(pat.shape[0], self.out_frames, -1)
+        y = jnp.einsum("btp,top->bto", pat, params["weight"]) + params["bias"]
+        return y[0] if squeeze else y

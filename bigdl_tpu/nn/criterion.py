@@ -1,0 +1,609 @@
+"""Loss functions (criteria).
+
+Parity: reference ``nn/*Criterion*.scala`` (one file per loss there). Targets
+use the reference's conventions: classification targets are **1-based** class
+indices; ``size_average=True`` means mean over batch. ``backward`` (gradInput)
+comes from autodiff in the base class — no hand-written gradients.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .module import Criterion
+from ..utils.table import Table
+
+
+def _reduce(x, size_average):
+    return jnp.mean(x) if size_average else jnp.sum(x)
+
+
+def _onehot(target, n, offset=1):
+    idx = target.astype(jnp.int32) - offset
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+class ClassNLLCriterion(Criterion):
+    """NLL over log-probabilities; 1-based integer targets
+    (nn/ClassNLLCriterion.scala). ``logProbAsInput=True`` default matches
+    reference. Optional per-class weights and paddingValue (ignored index)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 log_prob_as_input: bool = True, padding_value: int = -1):
+        super().__init__(size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.log_prob_as_input = log_prob_as_input
+        self.padding_value = padding_value
+
+    def _forward(self, input, target):
+        logp = input if self.log_prob_as_input else jnp.log(input + 1e-8)
+        if logp.ndim == 1:
+            logp = logp[None]
+            target = jnp.asarray(target).reshape((1,))
+        t = jnp.asarray(target).astype(jnp.int32).reshape((-1,))
+        valid = (t != self.padding_value)
+        idx = jnp.clip(t - 1, 0, logp.shape[-1] - 1)
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=-1)[:, 0]
+        w = (jnp.take(self.weights, idx) if self.weights is not None
+             else jnp.ones_like(picked))
+        w = w * valid
+        loss = -jnp.sum(w * picked)
+        if self.size_average:
+            loss = loss / jnp.maximum(jnp.sum(w), 1e-8)
+        return loss
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL (nn/CrossEntropyCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__(size_average)
+        self.nll = ClassNLLCriterion(weights, size_average)
+
+    def _forward(self, input, target):
+        return self.nll._forward(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class CategoricalCrossEntropy(Criterion):
+    """Keras-style CCE: probabilities input, one-hot target
+    (nn/CategoricalCrossEntropy.scala)."""
+
+    def _forward(self, input, target):
+        p = jnp.clip(input, 1e-8, 1.0)
+        return _reduce(-jnp.sum(target * jnp.log(p), axis=-1), True)
+
+
+class BCECriterion(Criterion):
+    """Binary cross entropy on probabilities (nn/BCECriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__(size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+
+    def _forward(self, input, target):
+        eps = 1e-12
+        p = jnp.clip(input, eps, 1 - eps)
+        l = -(target * jnp.log(p) + (1 - target) * jnp.log(1 - p))
+        if self.weights is not None:
+            l = l * self.weights
+        return _reduce(l, self.size_average)
+
+
+class MSECriterion(Criterion):
+    def _forward(self, input, target):
+        return _reduce(jnp.square(input - target), self.size_average)
+
+
+class AbsCriterion(Criterion):
+    def _forward(self, input, target):
+        return _reduce(jnp.abs(input - target), self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    def _forward(self, input, target):
+        d = jnp.abs(input - target)
+        l = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(l, self.size_average)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """nn/SmoothL1CriterionWithWeights.scala — fast-rcnn bbox loss with
+    inside/outside weights. Input Table or tensor; target Table(t, in_w, out_w)."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__(False)
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def _forward(self, input, target):
+        if isinstance(target, Table):
+            t, in_w, out_w = target[1], target[2], target[3]
+        else:
+            t, in_w, out_w = target, 1.0, 1.0
+        d = in_w * (input - t)
+        ad = jnp.abs(d)
+        l = jnp.where(ad < 1.0 / self.sigma2,
+                      0.5 * self.sigma2 * d * d, ad - 0.5 / self.sigma2)
+        l = out_w * l
+        s = jnp.sum(l)
+        return s / self.num if self.num > 0 else s
+
+
+class MarginCriterion(Criterion):
+    """Hinge / squared hinge with ±1 targets (nn/MarginCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        super().__init__(size_average)
+        self.margin, self.squared = margin, squared
+
+    def _forward(self, input, target):
+        h = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            h = h * h
+        return _reduce(h, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    """Sigmoid BCE on logits with multi-hot targets
+    (nn/MultiLabelSoftMarginCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__(size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+
+    def _forward(self, input, target):
+        l = jnp.logaddexp(0.0, -input) * target + \
+            jnp.logaddexp(0.0, input) * (1 - target)
+        if self.weights is not None:
+            l = l * self.weights
+        return _reduce(jnp.mean(l, axis=-1) if l.ndim > 1 else l,
+                       self.size_average)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multi-class hinge (nn/MultiMarginCriterion.scala); 1-based targets."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        super().__init__(size_average)
+        self.p, self.margin = p, margin
+        self.weights = None if weights is None else jnp.asarray(weights)
+
+    def _forward(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.asarray(target).astype(jnp.int32).reshape((-1,)) - 1
+        xt = jnp.take_along_axis(x, t[:, None], axis=-1)
+        h = jnp.maximum(0.0, self.margin - xt + x)
+        if self.p == 2:
+            h = h * h
+        if self.weights is not None:
+            h = h * jnp.take(self.weights, t)[:, None]
+        mask = 1.0 - jax.nn.one_hot(t, x.shape[-1])
+        per = jnp.sum(h * mask, axis=-1) / x.shape[-1]
+        return _reduce(per, self.size_average)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """nn/MultiLabelMarginCriterion.scala — multi-label hinge; target rows are
+    1-based label ids, zero-terminated."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__(size_average)
+
+    def _forward(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.asarray(target).astype(jnp.int32)
+        t = t if t.ndim == 2 else t[None]
+        n = x.shape[-1]
+        valid = (t > 0)
+        idx = jnp.clip(t - 1, 0, n - 1)
+        is_target = jnp.zeros_like(x).at[
+            jnp.arange(x.shape[0])[:, None], idx].max(
+            valid.astype(x.dtype))
+        xt = jnp.take_along_axis(x, idx, axis=-1)  # (B, L)
+        # hinge between every valid target and every non-target
+        margins = 1.0 - xt[:, :, None] + x[:, None, :]   # (B, L, N)
+        m = jnp.maximum(0.0, margins) * valid[:, :, None] * \
+            (1.0 - is_target)[:, None, :]
+        per = jnp.sum(m, axis=(1, 2)) / n
+        return _reduce(per, self.size_average)
+
+
+class SoftMarginCriterion(Criterion):
+    """log(1 + exp(-y*x)) (nn/SoftMarginCriterion.scala)."""
+
+    def _forward(self, input, target):
+        return _reduce(jnp.logaddexp(0.0, -input * target), self.size_average)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || input) with log-prob input (nn/DistKLDivCriterion.scala)."""
+
+    def _forward(self, input, target):
+        l = jnp.where(target > 0, target * (jnp.log(target + 1e-12) - input),
+                      0.0)
+        if self.size_average:
+            return jnp.sum(l) / input.shape[0] if input.ndim > 1 else jnp.sum(l)
+        return jnp.sum(l)
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    """Keras kld on probabilities (nn/KullbackLeiblerDivergenceCriterion.scala)."""
+
+    def _forward(self, input, target):
+        p = jnp.clip(target, 1e-7, 1.0)
+        q = jnp.clip(input, 1e-7, 1.0)
+        return _reduce(jnp.sum(p * jnp.log(p / q), axis=-1), True)
+
+
+class KLDCriterion(Criterion):
+    """VAE KL to N(0, I): input Table(mean, logvar) (nn/KLDCriterion.scala)."""
+
+    def _forward(self, input, target=None):
+        mean, logvar = input[1], input[2]
+        kl = 0.5 * jnp.sum(jnp.square(mean) + jnp.exp(logvar) - 1.0 - logvar,
+                           axis=-1)
+        return jnp.mean(kl) if self.size_average else jnp.sum(kl)
+
+    def backward(self, input, target=None):
+        g = jax.grad(lambda i: self._forward(i, target))(input)
+        self.grad_input = g
+        return g
+
+
+class GaussianCriterion(Criterion):
+    """-log N(target; mean, exp(logvar)) (nn/GaussianCriterion.scala).
+    Input Table(mean, logvar)."""
+
+    def _forward(self, input, target):
+        mean, logvar = input[1], input[2]
+        nll = 0.5 * (jnp.log(2 * np.pi) + logvar +
+                     jnp.square(target - mean) / jnp.exp(logvar))
+        return jnp.sum(nll)
+
+    def backward(self, input, target):
+        g = jax.grad(lambda i: self._forward(i, target))(input)
+        self.grad_input = g
+        return g
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """nn/CosineEmbeddingCriterion.scala — input Table(a,b), target ±1."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__(size_average)
+        self.margin = margin
+
+    def _forward(self, input, target):
+        a, b = input[1], input[2]
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        t = jnp.asarray(target).reshape(cos.shape)
+        l = jnp.where(t > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _reduce(l, self.size_average)
+
+    def backward(self, input, target):
+        g = jax.grad(lambda i: self._forward(i, target))(input)
+        self.grad_input = g
+        return g
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__(size_average)
+        self.margin = margin
+
+    def _forward(self, input, target):
+        l = jnp.where(target > 0, input,
+                      jnp.maximum(0.0, self.margin - input))
+        return _reduce(l, self.size_average)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """nn/L1HingeEmbeddingCriterion.scala — input Table(a,b), target ±1."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__(True)
+        self.margin = margin
+
+    def _forward(self, input, target):
+        d = jnp.sum(jnp.abs(input[1] - input[2]), axis=-1)
+        t = jnp.asarray(target).reshape(d.shape)
+        l = jnp.where(t > 0, d, jnp.maximum(0.0, self.margin - d))
+        return _reduce(l, True)
+
+    def backward(self, input, target):
+        g = jax.grad(lambda i: self._forward(i, target))(input)
+        self.grad_input = g
+        return g
+
+
+class MarginRankingCriterion(Criterion):
+    """nn/MarginRankingCriterion.scala — input Table(x1,x2), target ±1."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__(size_average)
+        self.margin = margin
+
+    def _forward(self, input, target):
+        x1, x2 = input[1], input[2]
+        t = target[1] if isinstance(target, Table) else target
+        l = jnp.maximum(0.0, -t * (x1 - x2) + self.margin)
+        return _reduce(l, self.size_average)
+
+    def backward(self, input, target):
+        g = jax.grad(lambda i: self._forward(i, target))(input)
+        self.grad_input = g
+        return g
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe SoftmaxWithLoss over NCHW (nn/SoftmaxWithCriterion.scala)."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID"):
+        super().__init__(True)
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def _forward(self, input, target):
+        # input (B, C, ...), target (B, ...) 1-based
+        logp = jax.nn.log_softmax(input, axis=1)
+        t = jnp.asarray(target).astype(jnp.int32)
+        idx = jnp.clip(t - 1, 0, input.shape[1] - 1)
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        valid = jnp.ones_like(picked) if self.ignore_label is None else \
+            (t != self.ignore_label).astype(picked.dtype)
+        loss = -jnp.sum(picked * valid)
+        if self.normalize_mode == "VALID":
+            return loss / jnp.maximum(jnp.sum(valid), 1.0)
+        if self.normalize_mode == "BATCH_SIZE":
+            return loss / input.shape[0]
+        if self.normalize_mode == "FULL":
+            return loss / picked.size
+        return loss
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at each timestep (nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, critrn: Criterion, size_average: bool = False,
+                 dimension: int = 2):
+        super().__init__(size_average)
+        self.critrn = critrn
+        self.dimension = dimension
+
+    def _forward(self, input, target):
+        d = self.dimension - 1
+        steps = input.shape[d]
+        total = 0.0
+        for i in range(steps):
+            total = total + self.critrn._forward(
+                jnp.take(input, i, axis=d), jnp.take(target, i, axis=d))
+        return total / steps if self.size_average else total
+
+
+class TimeDistributedMaskCriterion(Criterion):
+    """Masked per-timestep criterion (nn/TimeDistributedMaskCriterion.scala).
+    padding entries (target == padding_value) are excluded."""
+
+    def __init__(self, critrn: Criterion, padding_value: int = 0):
+        super().__init__(True)
+        self.critrn = critrn
+        self.padding_value = padding_value
+
+    def _forward(self, input, target):
+        # flatten time into batch; rely on inner criterion padding support
+        x = input.reshape((-1, input.shape[-1]))
+        t = target.reshape((-1,))
+        if isinstance(self.critrn, ClassNLLCriterion):
+            inner = ClassNLLCriterion(
+                self.critrn.weights, True, self.critrn.log_prob_as_input,
+                padding_value=self.padding_value)
+            return inner._forward(x, t)
+        mask = (t != self.padding_value).astype(x.dtype)
+        per = jax.vmap(self.critrn._forward)(x, t)
+        return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class ParallelCriterion(Criterion):
+    """Weighted sum of criteria over zipped Tables (nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__(True)
+        self.repeat_target = repeat_target
+        self.criterions = []
+        self.cweights = []
+
+    def add(self, criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.cweights.append(weight)
+        return self
+
+    def _forward(self, input, target):
+        total = 0.0
+        for i, (c, w) in enumerate(zip(self.criterions, self.cweights)):
+            t = target if self.repeat_target else target[i + 1]
+            total = total + w * c._forward(input[i + 1], t)
+        return total
+
+    def backward(self, input, target):
+        g = jax.grad(lambda i: self._forward(i, target))(input)
+        self.grad_input = g
+        return g
+
+
+class MultiCriterion(Criterion):
+    """Sum of criteria on the same input (nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        super().__init__(True)
+        self.criterions = []
+        self.cweights = []
+
+    def add(self, criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.cweights.append(weight)
+        return self
+
+    def _forward(self, input, target):
+        return sum(w * c._forward(input, target)
+                   for c, w in zip(self.criterions, self.cweights))
+
+
+class L1Cost(Criterion):
+    """|x| sum, target ignored (nn/L1Cost.scala)."""
+
+    def _forward(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+    def backward(self, input, target=None):
+        g = jax.grad(lambda i: self._forward(i))(input)
+        self.grad_input = g
+        return g
+
+
+class DiceCoefficientCriterion(Criterion):
+    """1 - dice overlap (nn/DiceCoefficientCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__(size_average)
+        self.epsilon = epsilon
+
+    def _forward(self, input, target):
+        x = input.reshape((input.shape[0], -1))
+        t = target.reshape((target.shape[0], -1))
+        inter = jnp.sum(x * t, axis=-1)
+        denom = jnp.sum(x, axis=-1) + jnp.sum(t, axis=-1)
+        dice = (2.0 * inter + self.epsilon) / (denom + self.epsilon)
+        return _reduce(1.0 - dice, self.size_average)
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    def _forward(self, input, target):
+        diff = jnp.abs(target - input) / jnp.clip(jnp.abs(target), 1e-7, None)
+        return jnp.mean(diff) * 100.0
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    def _forward(self, input, target):
+        a = jnp.log(jnp.clip(input, 1e-7, None) + 1.0)
+        b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
+        return jnp.mean(jnp.square(a - b))
+
+
+class PoissonCriterion(Criterion):
+    def _forward(self, input, target):
+        return jnp.mean(input - target * jnp.log(input + 1e-7))
+
+
+class CosineProximityCriterion(Criterion):
+    def _forward(self, input, target):
+        xn = input / jnp.maximum(jnp.linalg.norm(input, axis=-1,
+                                                 keepdims=True), 1e-12)
+        tn = target / jnp.maximum(jnp.linalg.norm(target, axis=-1,
+                                                  keepdims=True), 1e-12)
+        return -jnp.mean(jnp.sum(xn * tn, axis=-1))
+
+
+class DotProductCriterion(Criterion):
+    """-<x, t> (nn/DotProductCriterion.scala)."""
+
+    def _forward(self, input, target):
+        return jnp.sum(input * target)
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient criterion (nn/PGCriterion.scala): -sum(t * log p)."""
+
+    def __init__(self, size_average: bool = False):
+        super().__init__(size_average)
+
+    def _forward(self, input, target):
+        return _reduce(-target * jnp.log(input + 1e-8), self.size_average)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against simplex-embedded targets (nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes: int):
+        super().__init__(True)
+        self.n_classes = n_classes
+        self.simplex = self._build_simplex(n_classes)
+
+    @staticmethod
+    def _build_simplex(n):
+        # regular simplex construction (Gram-Schmidt based, matching torch)
+        a = np.zeros((n, n), dtype=np.float32)
+        for k in range(n - 1):
+            a[k, k] = 1.0
+        a[n - 1] = (1.0 - np.sqrt(n + 1.0)) / n
+        c = np.mean(a, axis=0)
+        a = a - c
+        a = a / np.linalg.norm(a[0])
+        return jnp.asarray(a)
+
+    def _forward(self, input, target):
+        t = jnp.asarray(target).astype(jnp.int32).reshape((-1,)) - 1
+        emb = jnp.take(self.simplex, t, axis=0)
+        return jnp.mean(jnp.square(input - emb))
+
+
+class CosineDistanceCriterion(Criterion):
+    """1 - cos(x, t) (nn/CosineDistanceCriterion.scala)."""
+
+    def _forward(self, input, target):
+        num = jnp.sum(input * target, axis=-1)
+        den = jnp.maximum(jnp.linalg.norm(input, axis=-1) *
+                          jnp.linalg.norm(target, axis=-1), 1e-12)
+        return _reduce(1.0 - num / den, self.size_average)
+
+
+class ActivityRegularization(Criterion):
+    """L1+L2 activity penalty (nn/ActivityRegularization.scala)."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        super().__init__(False)
+        self.l1, self.l2 = l1, l2
+
+    def _forward(self, input, target=None):
+        return self.l1 * jnp.sum(jnp.abs(input)) + \
+            self.l2 * jnp.sum(jnp.square(input))
+
+
+class NegativeEntropyPenalty(Criterion):
+    """beta * sum(p log p) (nn/NegativeEntropyPenalty.scala)."""
+
+    def __init__(self, beta: float = 0.01):
+        super().__init__(False)
+        self.beta = beta
+
+    def _forward(self, input, target=None):
+        return self.beta * jnp.sum(input * jnp.log(input + 1e-8))
+
+
+class TransformerCriterion(Criterion):
+    """Apply transforms to input/target before an inner criterion
+    (nn/TransformerCriterion.scala)."""
+
+    def __init__(self, criterion, input_transformer=None,
+                 target_transformer=None):
+        super().__init__(True)
+        self.criterion = criterion
+        self.input_transformer = input_transformer
+        self.target_transformer = target_transformer
+
+    def _transform(self, m, x):
+        if m is None:
+            return x
+        m.ensure_initialized()
+        return m.apply(m.params, m.state, x, training=False)[0]
+
+    def _forward(self, input, target):
+        return self.criterion._forward(
+            self._transform(self.input_transformer, input),
+            self._transform(self.target_transformer, target))

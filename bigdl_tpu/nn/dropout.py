@@ -1,0 +1,126 @@
+"""Dropout / noise layers.
+
+Parity: reference ``nn/Dropout.scala``, ``nn/GaussianDropout.scala``,
+``nn/GaussianNoise.scala``, ``nn/GaussianSampler.scala``,
+``nn/SpatialDropout1D/2D/3D.scala``. Randomness comes from the explicit PRNG
+key threaded through ``apply`` (no global mutable RNG under jit).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+
+
+def _require_rng(rng, name):
+    if rng is None:
+        raise ValueError(f"{name} needs an rng key in training mode; pass "
+                         "rng= to apply() (the stateful facade does this "
+                         "automatically)")
+    return rng
+
+
+class Dropout(Module):
+    """Inverted dropout (nn/Dropout.scala: scale at train time by 1/(1-p))."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True, name=None):
+        super().__init__(name=name)
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p):
+        self.p = p
+        return self
+
+    def _apply(self, params, state, x, training, rng):
+        if not training or self.p <= 0.0:
+            return x
+        rng = _require_rng(rng, "Dropout")
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, x.shape)
+        y = jnp.where(keep, x, 0.0)
+        return y / (1.0 - self.p) if self.scale else y
+
+
+class GaussianDropout(Module):
+    """Multiplicative N(1, p/(1-p)) noise (nn/GaussianDropout.scala)."""
+
+    def __init__(self, rate: float, name=None):
+        super().__init__(name=name)
+        self.rate = rate
+
+    def _apply(self, params, state, x, training, rng):
+        if not training:
+            return x
+        rng = _require_rng(rng, "GaussianDropout")
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        return x * (1.0 + std * jax.random.normal(rng, x.shape, x.dtype))
+
+
+class GaussianNoise(Module):
+    """Additive N(0, stddev) noise at train time (nn/GaussianNoise.scala)."""
+
+    def __init__(self, stddev: float, name=None):
+        super().__init__(name=name)
+        self.stddev = stddev
+
+    def _apply(self, params, state, x, training, rng):
+        if not training:
+            return x
+        rng = _require_rng(rng, "GaussianNoise")
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+
+
+class GaussianSampler(Module):
+    """VAE reparameterisation: sample from N(mean, exp(logvar))
+    (nn/GaussianSampler.scala). Input Table(mean, logvar)."""
+
+    def _apply(self, params, state, x, training, rng):
+        mean, logvar = x[1], x[2]
+        rng = _require_rng(rng, "GaussianSampler") if training else None
+        if rng is None:
+            return mean
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(0.5 * logvar) * eps
+
+
+class _SpatialDropout(Module):
+    """Drop whole feature maps (channels) together."""
+
+    _mask_from = None  # dims to broadcast the mask over
+
+    def __init__(self, init_p: float = 0.5, name=None):
+        super().__init__(name=name)
+        self.p = init_p
+
+    def _mask_shape(self, x):
+        raise NotImplementedError
+
+    def _apply(self, params, state, x, training, rng):
+        if not training or self.p <= 0.0:
+            return x
+        rng = _require_rng(rng, type(self).__name__)
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, self._mask_shape(x))
+        return jnp.where(keep, x, 0.0) / (1.0 - self.p)
+
+
+class SpatialDropout1D(_SpatialDropout):
+    """(B, T, C): drop channels (nn/SpatialDropout1D.scala)."""
+
+    def _mask_shape(self, x):
+        return x.shape[:-2] + (1, x.shape[-1])
+
+
+class SpatialDropout2D(_SpatialDropout):
+    """(B, C, H, W): drop channels (nn/SpatialDropout2D.scala)."""
+
+    def _mask_shape(self, x):
+        return x.shape[:-2] + (1, 1)
+
+
+class SpatialDropout3D(_SpatialDropout):
+    """(B, C, D, H, W): drop channels (nn/SpatialDropout3D.scala)."""
+
+    def _mask_shape(self, x):
+        return x.shape[:-3] + (1, 1, 1)

@@ -1,0 +1,142 @@
+"""Elementwise math / utility layers.
+
+Parity: reference ``nn/Abs.scala``, ``nn/Exp.scala``, ``nn/Power.scala``,
+``nn/AddConstant.scala``, ``nn/MulConstant.scala``, ``nn/GradientReversal.scala``,
+``nn/Identity.scala``, ``nn/Echo.scala``, ``nn/Contiguous.scala``,
+``nn/Negative.scala``, ``nn/Sqrt.scala``, ``nn/Square.scala``,
+``nn/Log.scala``, ``nn/Clock``-free.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+
+
+class _Elementwise(Module):
+    def _fn(self, x):
+        raise NotImplementedError
+
+    def _apply(self, params, state, x, training, rng):
+        return self._fn(x)
+
+
+class Identity(_Elementwise):
+    def _fn(self, x):
+        return x
+
+
+class Echo(_Elementwise):
+    """Prints shape at trace time (debug aid; parity nn/Echo.scala)."""
+
+    def _fn(self, x):
+        print(f"[Echo {self.name}] shape={getattr(x, 'shape', None)} "
+              f"dtype={getattr(x, 'dtype', None)}")
+        return x
+
+
+class Contiguous(_Elementwise):
+    """No-op on TPU: XLA arrays have no stride aliasing (parity nn/Contiguous)."""
+
+    def _fn(self, x):
+        return x
+
+
+class Abs(_Elementwise):
+    def _fn(self, x):
+        return jnp.abs(x)
+
+
+class Exp(_Elementwise):
+    def _fn(self, x):
+        return jnp.exp(x)
+
+
+class Log(_Elementwise):
+    def _fn(self, x):
+        return jnp.log(x)
+
+
+class Sqrt(_Elementwise):
+    def _fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Square(_Elementwise):
+    def _fn(self, x):
+        return jnp.square(x)
+
+
+class Negative(_Elementwise):
+    def __init__(self, inplace=False, name=None):
+        super().__init__(name=name)
+
+    def _fn(self, x):
+        return -x
+
+
+class Power(_Elementwise):
+    """(shift + scale * x) ** power  (nn/Power.scala)."""
+
+    def __init__(self, power, scale: float = 1.0, shift: float = 0.0, name=None):
+        super().__init__(name=name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _fn(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class AddConstant(_Elementwise):
+    def __init__(self, constant_scalar, ip: bool = False, name=None):
+        super().__init__(name=name)
+        self.constant_scalar = constant_scalar
+
+    def _fn(self, x):
+        return x + self.constant_scalar
+
+
+class MulConstant(_Elementwise):
+    def __init__(self, scalar, ip: bool = False, name=None):
+        super().__init__(name=name)
+        self.scalar = scalar
+
+    def _fn(self, x):
+        return x * self.scalar
+
+
+@jax.custom_vjp
+def _grad_reverse(x, lmbda):
+    return x
+
+
+def _grad_reverse_fwd(x, lmbda):
+    return x, lmbda
+
+
+def _grad_reverse_bwd(lmbda, g):
+    return (-lmbda * g, None)
+
+
+_grad_reverse.defvjp(_grad_reverse_fwd, _grad_reverse_bwd)
+
+
+class GradientReversal(Module):
+    """Identity forward, -lambda * grad backward (nn/GradientReversal.scala)."""
+
+    def __init__(self, the_lambda: float = 1.0, name=None):
+        super().__init__(name=name)
+        self.the_lambda = the_lambda
+
+    def set_lambda(self, l):
+        self.the_lambda = l
+        return self
+
+    def _apply(self, params, state, x, training, rng):
+        return _grad_reverse(x, self.the_lambda)
+
+
+class ErrorInfo:
+    """Parity placeholder for nn/ErrorInfo.scala messages."""
+    constrainEachInputAsVectorOrBatch = \
+        "Each input should be a 1D vector or a batch of them"

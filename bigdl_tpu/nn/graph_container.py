@@ -1,0 +1,97 @@
+"""DAG graph container.
+
+Parity: reference ``nn/Graph.scala`` / ``nn/StaticGraph.scala`` / ``nn/Input.scala``.
+Nodes are created by calling modules on other nodes; ``Graph(inputs, outputs)``
+freezes the DAG, topologically sorts it once at construction (host-side), and
+``apply`` evaluates it as straight-line traced code — XLA sees one fused
+program, no interpreter in the loop.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax
+
+from .module import Container, Module, Node
+from ..utils.table import Table
+
+
+def Input(name=None) -> Node:
+    """Create a graph input placeholder node (parity: nn/Input.scala)."""
+    return Node(None, [], name=name or "input")
+
+
+class Graph(Container):
+    def __init__(self, inputs: Union[Node, Sequence[Node]],
+                 outputs: Union[Node, Sequence[Node]], name=None):
+        self.input_nodes: List[Node] = ([inputs] if isinstance(inputs, Node)
+                                        else list(inputs))
+        self.output_nodes: List[Node] = ([outputs] if isinstance(outputs, Node)
+                                         else list(outputs))
+        self.topo: List[Node] = self._topo_sort()
+        modules = [n.module for n in self.topo if n.module is not None]
+        super().__init__(*modules, name=name)
+        # map node -> module index for params lookup
+        self._node_mod_idx = {}
+        mi = 0
+        for n in self.topo:
+            if n.module is not None:
+                self._node_mod_idx[id(n)] = mi
+                mi += 1
+
+    def _topo_sort(self) -> List[Node]:
+        order, seen, visiting = [], set(), set()
+
+        def visit(n: Node):
+            if id(n) in seen:
+                return
+            if id(n) in visiting:
+                raise ValueError("Graph has a cycle")
+            visiting.add(id(n))
+            for p in n.prevs:
+                visit(p)
+            visiting.discard(id(n))
+            seen.add(id(n))
+            order.append(n)
+
+        for out in self.output_nodes:
+            visit(out)
+        for inp in self.input_nodes:
+            if id(inp) not in seen:
+                raise ValueError(f"input node {inp} not connected to outputs")
+        return order
+
+    def _apply(self, params, state, x, training, rng):
+        values = {}
+        if len(self.input_nodes) == 1:
+            values[id(self.input_nodes[0])] = x
+        else:
+            items = x.to_list() if isinstance(x, Table) else list(x)
+            if len(items) != len(self.input_nodes):
+                raise ValueError(
+                    f"graph expects {len(self.input_nodes)} inputs, got {len(items)}")
+            for node, item in zip(self.input_nodes, items):
+                values[id(node)] = item
+
+        new_state = dict(state)
+        for n in self.topo:
+            if n.module is None:
+                if id(n) not in values:
+                    raise ValueError(f"unbound input node {n}")
+                continue
+            ins = [values[id(p)] for p in n.prevs]
+            arg = ins[0] if len(ins) == 1 else Table(*ins)
+            mi = self._node_mod_idx[id(n)]
+            sub_rng = None if rng is None else jax.random.fold_in(rng, mi)
+            out, new_state[str(mi)] = self.modules[mi].apply(
+                params[str(mi)], state[str(mi)], arg, training, sub_rng)
+            values[id(n)] = out
+
+        outs = [values[id(o)] for o in self.output_nodes]
+        return (outs[0] if len(outs) == 1 else Table(*outs)), new_state
+
+    def node(self, name):
+        for n in self.topo:
+            if n.name == name:
+                return n
+        return None

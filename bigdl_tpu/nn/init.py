@@ -1,0 +1,107 @@
+"""Weight initialization methods.
+
+Parity: reference ``nn/InitializationMethod.scala`` (Zeros, Ones, Const,
+RandomUniform, RandomNormal, Xavier, MsraFiller, BilinearFiller). The fan
+conventions match the reference: for a 2-D weight (out, in) fanIn is in and
+fanOut is out; for convs, fan includes the receptive-field size.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fans(shape, fan_in=None, fan_out=None):
+    if fan_in is not None and fan_out is not None:
+        return fan_in, fan_out
+    shape = tuple(shape)
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:  # (out, in) — reference Linear layout
+        return shape[1], shape[0]
+    # conv (out, in, *kernel)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class InitializationMethod:
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return jnp.zeros(shape, dtype)
+
+
+class Ones(InitializationMethod):
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return jnp.ones(shape, dtype)
+
+
+class ConstInit(InitializationMethod):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return jnp.full(shape, self.value, dtype)
+
+
+class RandomUniform(InitializationMethod):
+    def __init__(self, lower=None, upper=None):
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        if self.lower is None:
+            fi, _ = _fans(shape, fan_in, fan_out)
+            stdv = 1.0 / np.sqrt(max(fi, 1))
+            lo, hi = -stdv, stdv
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, dtype, minval=lo, maxval=hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean=0.0, stdv=1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, dtype)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform (reference InitializationMethod.scala Xavier)."""
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        fi, fo = _fans(shape, fan_in, fan_out)
+        stdv = np.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(rng, shape, dtype, minval=-stdv, maxval=stdv)
+
+
+class MsraFiller(InitializationMethod):
+    """He init (reference MsraFiller: varianceNormAverage → fanIn or mean)."""
+
+    def __init__(self, variance_norm_average: bool = True):
+        self.variance_norm_average = variance_norm_average
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        fi, fo = _fans(shape, fan_in, fan_out)
+        n = (fi + fo) / 2.0 if self.variance_norm_average else fi
+        std = np.sqrt(2.0 / max(n, 1.0))
+        return std * jax.random.normal(rng, shape, dtype)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear upsampling kernel for transposed convs (parity: BilinearFiller)."""
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        # shape (out, in, kh, kw)
+        kh, kw = shape[-2], shape[-1]
+        f = int(np.ceil(kw / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        xs = np.arange(kh * kw)
+        vals = (1 - np.abs(xs % kw / f - c)) * (1 - np.abs(xs // kw / f - c))
+        w = np.zeros(shape, dtype=np.float32)
+        w[..., :, :] = vals.reshape(kh, kw)
+        return jnp.asarray(w, dtype)

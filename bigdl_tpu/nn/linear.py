@@ -1,0 +1,304 @@
+"""Dense / affine-family layers.
+
+Parity: reference ``nn/Linear.scala``, ``nn/Bilinear.scala``, ``nn/Cosine.scala``,
+``nn/Euclidean.scala``, ``nn/Add.scala``, ``nn/Mul.scala``, ``nn/CMul.scala``,
+``nn/CAdd.scala``, ``nn/Highway.scala``, ``nn/Scale.scala``,
+``nn/SparseLinear.scala``, ``nn/LookupTable.scala``.
+
+Weight layout matches the reference Linear: ``weight`` is (out, in); the
+forward contraction ``x @ W^T + b`` lowers to a single MXU dot.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .module import Module
+from .init import RandomUniform, Zeros
+
+_default_init = RandomUniform()
+
+
+class Linear(Module):
+    """y = x W^T + b  (nn/Linear.scala:35)."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight=None, init_bias=None,
+                 init_method=None, bias_init_method=None, name=None):
+        super().__init__(name=name)
+        self.input_size, self.output_size = input_size, output_size
+        self.with_bias = with_bias
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+        self.init_weight, self.init_bias = init_weight, init_bias
+        self.init_method = init_method or _default_init
+        self.bias_init_method = bias_init_method
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        if self.init_weight is not None:
+            w = jnp.asarray(self.init_weight, jnp.float32)
+        else:
+            w = self.init_method(k1, (self.output_size, self.input_size),
+                                 fan_in=self.input_size, fan_out=self.output_size)
+        p = {"weight": w}
+        if self.with_bias:
+            if self.init_bias is not None:
+                b = jnp.asarray(self.init_bias, jnp.float32)
+            elif self.bias_init_method is not None:
+                b = self.bias_init_method(k2, (self.output_size,),
+                                          fan_in=self.input_size,
+                                          fan_out=self.output_size)
+            else:
+                b = self.init_method(k2, (self.output_size,),
+                                     fan_in=self.input_size,
+                                     fan_out=self.output_size)
+            p["bias"] = b
+        return p
+
+    def _regularizers(self):
+        r = {}
+        if self.w_regularizer is not None:
+            r["weight"] = self.w_regularizer
+        if self.b_regularizer is not None and self.with_bias:
+            r["bias"] = self.b_regularizer
+        return r
+
+    def _apply(self, params, state, x, training, rng):
+        y = x @ params["weight"].T
+        if self.with_bias:
+            y = y + params["bias"]
+        return y
+
+
+class SparseLinear(Linear):
+    """nn/SparseLinear.scala. TPU note: XLA has no efficient dynamic sparsity;
+    sparse inputs are represented densely (the MXU is fast enough that dense
+    beats gather-scatter for the reference's use cases)."""
+
+
+class Bilinear(Module):
+    """y_k = x1^T W_k x2 + b_k over a Table(x1, x2)  (nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True, w_regularizer=None, b_regularizer=None,
+                 name=None):
+        super().__init__(name=name)
+        self.input_size1, self.input_size2 = input_size1, input_size2
+        self.output_size, self.bias_res = output_size, bias_res
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        stdv = 1.0 / np.sqrt(self.input_size1)
+        p = {"weight": jax.random.uniform(
+            k1, (self.output_size, self.input_size1, self.input_size2),
+            minval=-stdv, maxval=stdv)}
+        if self.bias_res:
+            p["bias"] = jax.random.uniform(k2, (self.output_size,),
+                                           minval=-stdv, maxval=stdv)
+        return p
+
+    def _apply(self, params, state, x, training, rng):
+        x1, x2 = x[1], x[2]
+        y = jnp.einsum("bi,oij,bj->bo", x1, params["weight"], x2)
+        if self.bias_res:
+            y = y + params["bias"]
+        return y
+
+
+class Cosine(Module):
+    """Cosine similarity to each of outputSize weight rows (nn/Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, name=None):
+        super().__init__(name=name)
+        self.input_size, self.output_size = input_size, output_size
+
+    def _init_params(self, rng):
+        stdv = 1.0 / np.sqrt(self.input_size)
+        return {"weight": jax.random.uniform(
+            rng, (self.output_size, self.input_size), minval=-stdv, maxval=stdv)}
+
+    def _apply(self, params, state, x, training, rng):
+        w = params["weight"]
+        xn = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+        wn = w / (jnp.linalg.norm(w, axis=-1, keepdims=True) + 1e-12)
+        return xn @ wn.T
+
+
+class Euclidean(Module):
+    """Euclidean distance to weight columns (nn/Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, fast_backward=True,
+                 name=None):
+        super().__init__(name=name)
+        self.input_size, self.output_size = input_size, output_size
+
+    def _init_params(self, rng):
+        stdv = 1.0 / np.sqrt(self.input_size)
+        return {"weight": jax.random.uniform(
+            rng, (self.output_size, self.input_size), minval=-stdv, maxval=stdv)}
+
+    def _apply(self, params, state, x, training, rng):
+        diff = x[..., None, :] - params["weight"]
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-12)
+
+
+class Add(Module):
+    """Learnable bias vector add (nn/Add.scala)."""
+
+    def __init__(self, input_size: int, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+
+    def _init_params(self, rng):
+        stdv = 1.0 / np.sqrt(self.input_size)
+        return {"bias": jax.random.uniform(rng, (self.input_size,),
+                                           minval=-stdv, maxval=stdv)}
+
+    def _apply(self, params, state, x, training, rng):
+        return x + params["bias"]
+
+
+class Mul(Module):
+    """Single learnable scalar multiply (nn/Mul.scala)."""
+
+    def _init_params(self, rng):
+        return {"weight": jax.random.uniform(rng, (1,), minval=-1.0, maxval=1.0)}
+
+    def _apply(self, params, state, x, training, rng):
+        return x * params["weight"][0]
+
+
+class CMul(Module):
+    """Componentwise learnable multiply, broadcast by ``size`` (nn/CMul.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def _init_params(self, rng):
+        stdv = 1.0 / np.sqrt(int(np.prod(self.size)))
+        return {"weight": jax.random.uniform(rng, self.size,
+                                             minval=-stdv, maxval=stdv)}
+
+    def _apply(self, params, state, x, training, rng):
+        w = params["weight"]
+        if w.ndim < x.ndim:
+            w = w.reshape((1,) * (x.ndim - w.ndim) + w.shape)
+        return x * w
+
+
+class CAdd(Module):
+    """Componentwise learnable add, broadcast by ``size`` (nn/CAdd.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def _init_params(self, rng):
+        stdv = 1.0 / np.sqrt(int(np.prod(self.size)))
+        return {"bias": jax.random.uniform(rng, self.size,
+                                           minval=-stdv, maxval=stdv)}
+
+    def _apply(self, params, state, x, training, rng):
+        b = params["bias"]
+        if b.ndim < x.ndim:
+            b = b.reshape((1,) * (x.ndim - b.ndim) + b.shape)
+        return x + b
+
+
+class Scale(Module):
+    """CMul then CAdd (nn/Scale.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        stdv = 1.0 / np.sqrt(int(np.prod(self.size)))
+        return {"weight": jax.random.uniform(k1, self.size, minval=-stdv,
+                                             maxval=stdv),
+                "bias": jax.random.uniform(k2, self.size, minval=-stdv,
+                                           maxval=stdv)}
+
+    def _apply(self, params, state, x, training, rng):
+        w, b = params["weight"], params["bias"]
+        if w.ndim < x.ndim:
+            w = w.reshape((1,) * (x.ndim - w.ndim) + w.shape)
+            b = b.reshape((1,) * (x.ndim - b.ndim) + b.shape)
+        return x * w + b
+
+
+class Highway(Module):
+    """Highway network layer over features (nn/Highway.scala)."""
+
+    def __init__(self, size: int, with_bias: bool = True, activation="tanh",
+                 name=None):
+        super().__init__(name=name)
+        self.size, self.with_bias = size, with_bias
+        self.activation = activation
+
+    def _init_params(self, rng):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        stdv = 1.0 / np.sqrt(self.size)
+        u = lambda k, s: jax.random.uniform(k, s, minval=-stdv, maxval=stdv)
+        p = {"w_t": u(k1, (self.size, self.size)),
+             "w_h": u(k2, (self.size, self.size))}
+        if self.with_bias:
+            p["b_t"] = jnp.full((self.size,), -2.0)  # gate bias toward carry
+            p["b_h"] = u(k4, (self.size,))
+        return p
+
+    def _act(self, x):
+        if callable(self.activation):
+            return self.activation(x)
+        return {"tanh": jnp.tanh, "relu": jax.nn.relu,
+                "sigmoid": jax.nn.sigmoid, None: lambda v: v}[self.activation](x)
+
+    def _apply(self, params, state, x, training, rng):
+        t = x @ params["w_t"].T + (params.get("b_t", 0.0) if self.with_bias else 0.0)
+        t = jax.nn.sigmoid(t)
+        h = x @ params["w_h"].T + (params.get("b_h", 0.0) if self.with_bias else 0.0)
+        h = self._act(h)
+        return t * h + (1.0 - t) * x
+
+
+class LookupTable(Module):
+    """Embedding lookup (nn/LookupTable.scala). Indices are 1-based to match
+    the reference; max_norm renormalisation applied on the fly."""
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0,
+                 max_norm: float = np.inf, norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False, w_regularizer=None,
+                 mask_zero: bool = False, name=None):
+        super().__init__(name=name)
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = padding_value
+        self.max_norm, self.norm_type = max_norm, norm_type
+        self.mask_zero = mask_zero
+        self.w_regularizer = w_regularizer
+
+    def _init_params(self, rng):
+        return {"weight": jax.random.normal(rng, (self.n_index, self.n_output))}
+
+    def _regularizers(self):
+        return {"weight": self.w_regularizer} if self.w_regularizer else {}
+
+    def _apply(self, params, state, x, training, rng):
+        w = params["weight"]
+        if np.isfinite(self.max_norm):
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / (norms + 1e-12))
+        idx = x.astype(jnp.int32) - 1  # reference is 1-based
+        out = jnp.take(w, jnp.clip(idx, 0, self.n_index - 1), axis=0)
+        if self.mask_zero:
+            out = out * (x != self.padding_value).astype(out.dtype)[..., None]
+        return out
+
+
+class LookupTableSparse(LookupTable):
+    """nn/LookupTableSparse.scala — dense representation on TPU (see
+    SparseLinear note)."""

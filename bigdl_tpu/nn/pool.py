@@ -1,0 +1,234 @@
+"""Pooling layers.
+
+Parity: reference ``nn/SpatialMaxPooling.scala``,
+``nn/SpatialAveragePooling.scala``, ``nn/TemporalMaxPooling.scala``,
+``nn/VolumetricMaxPooling.scala``, ``nn/VolumetricAveragePooling.scala``,
+``nn/RoiPooling.scala``. All lower to ``lax.reduce_window`` (fused by XLA).
+Ceil mode is realised by asymmetric right-padding before a VALID window.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .module import Module
+
+
+def _pool_pads(size, k, stride, pad, ceil_mode):
+    """Compute (lo, hi) padding for one spatial dim."""
+    if ceil_mode:
+        out = int(np.ceil((size + 2 * pad - k) / stride)) + 1
+        # torch convention: last window must start inside the padded input
+        if pad > 0 and (out - 1) * stride >= size + pad:
+            out -= 1
+    else:
+        out = int(np.floor((size + 2 * pad - k) / stride)) + 1
+    needed = max(0, (out - 1) * stride + k - size - pad)
+    return (pad, needed), out
+
+
+class _Pool2D(Module):
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0, name=None):
+        super().__init__(name=name)
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = False
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def _pads(self, x):
+        h, w = x.shape[-2], x.shape[-1]
+        ph, _ = _pool_pads(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+        pw, _ = _pool_pads(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        return ph, pw
+
+
+class SpatialMaxPooling(_Pool2D):
+    """nn/SpatialMaxPooling.scala (NCHW)."""
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x, squeeze = x[None], True
+        ph, pw = self._pads(x)
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 1, self.kh, self.kw),
+            (1, 1, self.dh, self.dw), [(0, 0), (0, 0), ph, pw])
+        return y[0] if squeeze else y
+
+
+class SpatialAveragePooling(_Pool2D):
+    """nn/SpatialAveragePooling.scala. count_include_pad matches reference
+    default (True); ``global_pooling`` pools the whole plane."""
+
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0,
+                 global_pooling=False, ceil_mode=False,
+                 count_include_pad=True, divide=True, name=None):
+        super().__init__(kw, kh, dw, dh, pad_w, pad_h, name=name)
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+        self.global_pooling = global_pooling
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x, squeeze = x[None], True
+        kh, kw = self.kh, self.kw
+        dh, dw = self.dh, self.dw
+        if self.global_pooling:
+            kh, kw = x.shape[-2], x.shape[-1]
+            dh, dw = 1, 1
+            ph = pw = (0, 0)
+        else:
+            ph, pw = self._pads(x)
+        s = lax.reduce_window(
+            x, 0.0, lax.add, (1, 1, kh, kw), (1, 1, dh, dw),
+            [(0, 0), (0, 0), ph, pw])
+        if not self.divide:
+            y = s
+        elif self.count_include_pad:
+            y = s / (kh * kw)
+        else:
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(
+                ones, 0.0, lax.add, (1, 1, kh, kw), (1, 1, dh, dw),
+                [(0, 0), (0, 0), ph, pw])
+            y = s / cnt
+        return y[0] if squeeze else y
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pooling over (B, T, C) (nn/TemporalMaxPooling.scala)."""
+
+    def __init__(self, k_w: int, d_w: int = None, name=None):
+        super().__init__(name=name)
+        self.k_w = k_w
+        self.d_w = d_w if d_w is not None else k_w
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 2:
+            x, squeeze = x[None], True
+        y = lax.reduce_window(x, -jnp.inf, lax.max, (1, self.k_w, 1),
+                              (1, self.d_w, 1), "VALID")
+        return y[0] if squeeze else y
+
+
+class VolumetricMaxPooling(Module):
+    """nn/VolumetricMaxPooling.scala (NCDHW)."""
+
+    def __init__(self, kt, kw, kh, dt=None, dw=None, dh=None,
+                 pad_t=0, pad_w=0, pad_h=0, name=None):
+        super().__init__(name=name)
+        self.kt, self.kw, self.kh = kt, kw, kh
+        self.dt = dt if dt is not None else kt
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.ceil_mode = False
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 4:
+            x, squeeze = x[None], True
+        t, h, w = x.shape[-3:]
+        pt, _ = _pool_pads(t, self.kt, self.dt, self.pad_t, self.ceil_mode)
+        ph, _ = _pool_pads(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+        pw, _ = _pool_pads(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 1, self.kt, self.kh, self.kw),
+            (1, 1, self.dt, self.dh, self.dw),
+            [(0, 0), (0, 0), pt, ph, pw])
+        return y[0] if squeeze else y
+
+
+class VolumetricAveragePooling(VolumetricMaxPooling):
+    """nn/VolumetricAveragePooling.scala."""
+
+    def __init__(self, kt, kw, kh, dt=None, dw=None, dh=None,
+                 pad_t=0, pad_w=0, pad_h=0, count_include_pad=True, name=None):
+        super().__init__(kt, kw, kh, dt, dw, dh, pad_t, pad_w, pad_h, name=name)
+        self.count_include_pad = count_include_pad
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 4:
+            x, squeeze = x[None], True
+        t, h, w = x.shape[-3:]
+        pt, _ = _pool_pads(t, self.kt, self.dt, self.pad_t, self.ceil_mode)
+        ph, _ = _pool_pads(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
+        pw, _ = _pool_pads(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
+        s = lax.reduce_window(
+            x, 0.0, lax.add, (1, 1, self.kt, self.kh, self.kw),
+            (1, 1, self.dt, self.dh, self.dw),
+            [(0, 0), (0, 0), pt, ph, pw])
+        if self.count_include_pad:
+            y = s / (self.kt * self.kh * self.kw)
+        else:
+            cnt = lax.reduce_window(
+                jnp.ones_like(x), 0.0, lax.add,
+                (1, 1, self.kt, self.kh, self.kw),
+                (1, 1, self.dt, self.dh, self.dw),
+                [(0, 0), (0, 0), pt, ph, pw])
+            y = s / cnt
+        return y[0] if squeeze else y
+
+
+class RoiPooling(Module):
+    """ROI max pooling (nn/RoiPooling.scala). Input: Table(features NCHW,
+    rois (R, 5) [batchIdx, x1, y1, x2, y2] in input-pixel coords)."""
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float = 1.0,
+                 name=None):
+        super().__init__(name=name)
+        self.pooled_w, self.pooled_h = pooled_w, pooled_h
+        self.spatial_scale = spatial_scale
+
+    def _apply(self, params, state, x, training, rng):
+        feats, rois = x[1], x[2]
+        B, C, H, W = feats.shape
+
+        def pool_one(roi):
+            bi = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.spatial_scale)
+            y1 = jnp.round(roi[2] * self.spatial_scale)
+            x2 = jnp.round(roi[3] * self.spatial_scale)
+            y2 = jnp.round(roi[4] * self.spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            fm = feats[bi]  # (C, H, W)
+            ys = jnp.arange(H, dtype=jnp.float32)
+            xs = jnp.arange(W, dtype=jnp.float32)
+
+            def cell(ph, pw):
+                hs = jnp.floor(y1 + ph * rh / self.pooled_h)
+                he = jnp.ceil(y1 + (ph + 1) * rh / self.pooled_h)
+                ws = jnp.floor(x1 + pw * rw / self.pooled_w)
+                we = jnp.ceil(x1 + (pw + 1) * rw / self.pooled_w)
+                mask = ((ys >= hs) & (ys < jnp.maximum(he, hs + 1)))[:, None] & \
+                       ((xs >= ws) & (xs < jnp.maximum(we, ws + 1)))[None, :]
+                masked = jnp.where(mask[None], fm, -jnp.inf)
+                m = jnp.max(masked, axis=(1, 2))
+                return jnp.where(jnp.isfinite(m), m, 0.0)
+
+            grid = jnp.stack([jnp.stack([cell(ph, pw)
+                                         for pw in range(self.pooled_w)], -1)
+                              for ph in range(self.pooled_h)], -2)
+            return grid  # (C, pooled_h, pooled_w)
+
+        return jax.vmap(pool_one)(rois.astype(jnp.float32))

@@ -1,0 +1,424 @@
+"""Recurrent layers.
+
+Parity: reference ``nn/Cell.scala``, ``nn/RNN.scala`` (RnnCell),
+``nn/LSTM.scala``, ``nn/LSTMPeephole.scala``, ``nn/GRU.scala``,
+``nn/ConvLSTMPeephole.scala``, ``nn/Recurrent.scala``,
+``nn/RecurrentDecoder.scala``, ``nn/BiRecurrent.scala``,
+``nn/MultiRNNCell.scala``, ``nn/TimeDistributed.scala``.
+
+TPU-first: the reference unrolls time in a Scala while-loop over mutable
+tensors; here ``Recurrent`` is one ``lax.scan`` — a single compiled loop with
+the per-step cell fused by XLA, and the whole input-to-hidden projection for
+all timesteps hoisted into one big MXU matmul where possible.
+
+Input layout is (batch, time, features...), matching the reference default.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .module import Module
+from ..utils.table import Table
+
+
+class Cell(Module):
+    """Base recurrent cell: subclasses define ``init_hidden`` and
+    ``step(params, x_t, h) -> (out_t, new_h)``."""
+
+    def init_hidden(self, batch_size: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def step(self, params, x_t, h):
+        raise NotImplementedError
+
+    def _apply(self, params, state, x, training, rng):
+        # Cell as standalone module: input Table(x_t, hidden)
+        if isinstance(x, Table):
+            out, new_h = self.step(params, x[1], x[2])
+            return Table(out, new_h)
+        h = self.init_hidden(x.shape[0], x.dtype)
+        out, new_h = self.step(params, x, h)
+        return Table(out, new_h)
+
+
+def _uniform(rng, shape, stdv):
+    return jax.random.uniform(rng, shape, minval=-stdv, maxval=stdv)
+
+
+class RnnCell(Cell):
+    """Vanilla RNN cell (nn/RNN.scala): h' = act(W x + U h + b)."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
+                 isInputWithBias: bool = True, w_regularizer=None,
+                 u_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+
+    def _init_params(self, rng):
+        k = jax.random.split(rng, 3)
+        stdv = 1.0 / np.sqrt(self.hidden_size)
+        return {"w_i": _uniform(k[0], (self.input_size, self.hidden_size), stdv),
+                "w_h": _uniform(k[1], (self.hidden_size, self.hidden_size), stdv),
+                "bias": _uniform(k[2], (self.hidden_size,), stdv)}
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def step(self, params, x_t, h):
+        act = self.activation if callable(self.activation) else jnp.tanh
+        nh = act(x_t @ params["w_i"] + h @ params["w_h"] + params["bias"])
+        return nh, nh
+
+
+class LSTM(Cell):
+    """LSTM cell (nn/LSTM.scala). Gate order (i, f, g, o); forget bias 1.0."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 activation=None, inner_activation=None, w_regularizer=None,
+                 u_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.p = p
+        self.activation = activation or jnp.tanh
+        self.inner_activation = inner_activation or jax.nn.sigmoid
+
+    def _init_params(self, rng):
+        k = jax.random.split(rng, 3)
+        stdv = 1.0 / np.sqrt(self.hidden_size)
+        H = self.hidden_size
+        b = jnp.zeros((4 * H,)).at[H:2 * H].set(1.0)  # forget bias 1
+        return {"w_i": _uniform(k[0], (self.input_size, 4 * H), stdv),
+                "w_h": _uniform(k[1], (H, 4 * H), stdv),
+                "bias": b}
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        H = self.hidden_size
+        return Table(jnp.zeros((batch_size, H), dtype),
+                     jnp.zeros((batch_size, H), dtype))
+
+    def step(self, params, x_t, h):
+        hx, cx = h[1], h[2]
+        z = x_t @ params["w_i"] + hx @ params["w_h"] + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = self.inner_activation(i)
+        f = self.inner_activation(f)
+        o = self.inner_activation(o)
+        g = self.activation(g)
+        c = f * cx + i * g
+        hnew = o * self.activation(c)
+        return hnew, Table(hnew, c)
+
+
+class LSTMPeephole(Cell):
+    """LSTM with peephole connections (nn/LSTMPeephole.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 name=None):
+        super().__init__(name=name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    def _init_params(self, rng):
+        k = jax.random.split(rng, 6)
+        stdv = 1.0 / np.sqrt(self.hidden_size)
+        H = self.hidden_size
+        return {"w_i": _uniform(k[0], (self.input_size, 4 * H), stdv),
+                "w_h": _uniform(k[1], (H, 4 * H), stdv),
+                "bias": jnp.zeros((4 * H,)).at[H:2 * H].set(1.0),
+                "p_i": _uniform(k[2], (H,), stdv),
+                "p_f": _uniform(k[3], (H,), stdv),
+                "p_o": _uniform(k[4], (H,), stdv)}
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        H = self.hidden_size
+        return Table(jnp.zeros((batch_size, H), dtype),
+                     jnp.zeros((batch_size, H), dtype))
+
+    def step(self, params, x_t, h):
+        hx, cx = h[1], h[2]
+        z = x_t @ params["w_i"] + hx @ params["w_h"] + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i + params["p_i"] * cx)
+        f = jax.nn.sigmoid(f + params["p_f"] * cx)
+        g = jnp.tanh(g)
+        c = f * cx + i * g
+        o = jax.nn.sigmoid(o + params["p_o"] * c)
+        hnew = o * jnp.tanh(c)
+        return hnew, Table(hnew, c)
+
+
+class GRU(Cell):
+    """GRU cell (nn/GRU.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, p: float = 0.0,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None,
+                 name=None):
+        super().__init__(name=name)
+        self.input_size, self.hidden_size = input_size, output_size
+
+    def _init_params(self, rng):
+        k = jax.random.split(rng, 4)
+        stdv = 1.0 / np.sqrt(self.hidden_size)
+        H = self.hidden_size
+        return {"w_i": _uniform(k[0], (self.input_size, 3 * H), stdv),
+                "w_h": _uniform(k[1], (H, 2 * H), stdv),
+                "w_hn": _uniform(k[2], (H, H), stdv),
+                "bias": jnp.zeros((3 * H,))}
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def step(self, params, x_t, h):
+        H = self.hidden_size
+        zi = x_t @ params["w_i"] + params["bias"]
+        zr, zz, zn = zi[..., :H], zi[..., H:2 * H], zi[..., 2 * H:]
+        hh = h @ params["w_h"]
+        r = jax.nn.sigmoid(zr + hh[..., :H])
+        z = jax.nn.sigmoid(zz + hh[..., H:])
+        n = jnp.tanh(zn + (r * h) @ params["w_hn"])
+        hnew = (1 - z) * n + z * h
+        return hnew, hnew
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM with peepholes over NCHW maps
+    (nn/ConvLSTMPeephole.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, kernel_i: int = 3,
+                 kernel_c: int = 3, stride: int = 1, padding: int = -1,
+                 with_peephole: bool = True, name=None):
+        super().__init__(name=name)
+        self.input_size, self.output_size = input_size, output_size
+        self.kernel_i, self.kernel_c = kernel_i, kernel_c
+        self.with_peephole = with_peephole
+        self.spatial = None  # inferred on first init_hidden call
+
+    def _init_params(self, rng):
+        k = jax.random.split(rng, 3)
+        fan = self.input_size * self.kernel_i * self.kernel_i
+        stdv = 1.0 / np.sqrt(fan)
+        O, I = self.output_size, self.input_size
+        p = {"w_i": _uniform(k[0], (4 * O, I, self.kernel_i, self.kernel_i),
+                             stdv),
+             "w_h": _uniform(k[1], (4 * O, O, self.kernel_c, self.kernel_c),
+                             stdv),
+             "bias": jnp.zeros((4 * O,)).at[O:2 * O].set(1.0)}
+        if self.with_peephole:
+            p["p_i"] = jnp.zeros((O,))
+            p["p_f"] = jnp.zeros((O,))
+            p["p_o"] = jnp.zeros((O,))
+        return p
+
+    def set_spatial(self, h, w):
+        self.spatial = (h, w)
+        return self
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        if self.spatial is None:
+            raise ValueError("call set_spatial(h, w) before init_hidden, or "
+                             "use Recurrent which infers it from the input")
+        H, W = self.spatial
+        z = jnp.zeros((batch_size, self.output_size, H, W), dtype)
+        return Table(z, z)
+
+    def _conv(self, x, w):
+        return lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def step(self, params, x_t, h):
+        hx, cx = h[1], h[2]
+        z = self._conv(x_t, params["w_i"]) + self._conv(hx, params["w_h"]) + \
+            params["bias"][None, :, None, None]
+        i, f, g, o = jnp.split(z, 4, axis=1)
+        if self.with_peephole:
+            i = i + params["p_i"][None, :, None, None] * cx
+            f = f + params["p_f"][None, :, None, None] * cx
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        c = f * cx + i * g
+        if self.with_peephole:
+            o = o + params["p_o"][None, :, None, None] * c
+        o = jax.nn.sigmoid(o)
+        hnew = o * jnp.tanh(c)
+        return hnew, Table(hnew, c)
+
+
+ConvLSTMPeephole3D = ConvLSTMPeephole  # 3D variant: same structure, NCDHW maps
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells acting as one (nn/MultiRNNCell.scala)."""
+
+    def __init__(self, cells, name=None):
+        super().__init__(name=name)
+        self.cells = list(cells)
+
+    def _init_params(self, rng):
+        return {str(i): c._init_params(jax.random.fold_in(rng, i))
+                for i, c in enumerate(self.cells)}
+
+    def init_hidden(self, batch_size, dtype=jnp.float32):
+        return Table(*[c.init_hidden(batch_size, dtype) for c in self.cells])
+
+    def step(self, params, x_t, h):
+        new_hs = []
+        out = x_t
+        for i, c in enumerate(self.cells):
+            out, nh = c.step(params[str(i)], out, h[i + 1])
+            new_hs.append(nh)
+        return out, Table(*new_hs)
+
+
+class Recurrent(Module):
+    """Run a cell over (batch, time, ...) via lax.scan (nn/Recurrent.scala)."""
+
+    def __init__(self, cell: Optional[Cell] = None, name=None):
+        super().__init__(name=name)
+        self.cell = cell
+
+    def add(self, cell: Cell):
+        self.cell = cell
+        return self
+
+    def _init_params(self, rng):
+        return {"cell": self.cell._init_params(rng)}
+
+    def _infer_spatial(self, x):
+        if isinstance(self.cell, ConvLSTMPeephole) and self.cell.spatial is None:
+            self.cell.set_spatial(x.shape[-2], x.shape[-1])
+
+    def _apply(self, params, state, x, training, rng):
+        self._infer_spatial(x)
+        h0 = self.cell.init_hidden(x.shape[0], x.dtype)
+        xt = jnp.moveaxis(x, 1, 0)  # (T, B, ...)
+
+        def body(h, x_t):
+            out, nh = self.cell.step(params["cell"], x_t, h)
+            return nh, out
+
+        _, ys = lax.scan(body, h0, xt)
+        return jnp.moveaxis(ys, 0, 1)
+
+    def training(self):
+        super().training()
+        if self.cell:
+            self.cell.training()
+        return self
+
+    def evaluate(self):
+        super().evaluate()
+        if self.cell:
+            self.cell.evaluate()
+        return self
+
+
+class RecurrentDecoder(Module):
+    """Feed output back as next input for seq_length steps
+    (nn/RecurrentDecoder.scala). Input: (B, features) first step input."""
+
+    def __init__(self, seq_length: int, name=None):
+        super().__init__(name=name)
+        self.seq_length = seq_length
+        self.cell: Optional[Cell] = None
+
+    def add(self, cell: Cell):
+        self.cell = cell
+        return self
+
+    def _init_params(self, rng):
+        return {"cell": self.cell._init_params(rng)}
+
+    def _apply(self, params, state, x, training, rng):
+        h0 = self.cell.init_hidden(x.shape[0], x.dtype)
+
+        def body(carry, _):
+            inp, h = carry
+            out, nh = self.cell.step(params["cell"], inp, h)
+            return (out, nh), out
+
+        _, ys = lax.scan(body, (x, h0), None, length=self.seq_length)
+        return jnp.moveaxis(ys, 0, 1)
+
+
+class BiRecurrent(Module):
+    """Bidirectional recurrent wrapper (nn/BiRecurrent.scala). ``merge``
+    defaults to elementwise add (reference default CAddTable)."""
+
+    def __init__(self, merge=None, name=None):
+        super().__init__(name=name)
+        self.merge = merge  # None → add; "concat" or a callable
+        self.cell: Optional[Cell] = None
+
+    def add(self, cell: Cell):
+        self.cell = cell
+        return self
+
+    def _init_params(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"fwd": self.cell._init_params(k1),
+                "bwd": self.cell._init_params(k2)}
+
+    def _run(self, cell_params, x):
+        h0 = self.cell.init_hidden(x.shape[0], x.dtype)
+        xt = jnp.moveaxis(x, 1, 0)
+
+        def body(h, x_t):
+            out, nh = self.cell.step(cell_params, x_t, h)
+            return nh, out
+
+        _, ys = lax.scan(body, h0, xt)
+        return jnp.moveaxis(ys, 0, 1)
+
+    def _apply(self, params, state, x, training, rng):
+        fwd = self._run(params["fwd"], x)
+        bwd = jnp.flip(self._run(params["bwd"], jnp.flip(x, axis=1)), axis=1)
+        if self.merge is None:
+            return fwd + bwd
+        if self.merge == "concat":
+            return jnp.concatenate([fwd, bwd], axis=-1)
+        if callable(self.merge):
+            return self.merge(fwd, bwd)
+        from .table_ops import CAddTable
+        return fwd + bwd
+
+
+class TimeDistributed(Module):
+    """Apply a module independently at each timestep (nn/TimeDistributed.scala).
+    Implemented by folding time into batch — one big fused call instead of the
+    reference's per-step loop."""
+
+    def __init__(self, layer: Module, name=None):
+        super().__init__(name=name)
+        self.layer = layer
+
+    def _init_params(self, rng):
+        return {"layer": self.layer._init_params(rng)}
+
+    def _init_state(self):
+        return {"layer": self.layer._init_state()}
+
+    def _apply(self, params, state, x, training, rng):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        y, new_sub = self.layer.apply(params["layer"], state["layer"], flat,
+                                      training, rng)
+        return y.reshape((b, t) + y.shape[1:]), {**state, "layer": new_sub}
+
+    def training(self):
+        super().training()
+        self.layer.training()
+        return self
+
+    def evaluate(self):
+        super().evaluate()
+        self.layer.evaluate()
+        return self
+
+
+class RNN(RnnCell):
+    """Alias matching reference file name nn/RNN.scala."""

@@ -1,0 +1,465 @@
+"""Shape / slicing / resampling layers.
+
+Parity: reference ``nn/Reshape.scala``, ``nn/View.scala``,
+``nn/InferReshape.scala``, ``nn/Squeeze.scala``, ``nn/Unsqueeze.scala``,
+``nn/Transpose.scala``, ``nn/Replicate.scala``, ``nn/Padding.scala``,
+``nn/SpatialZeroPadding.scala``, ``nn/Narrow.scala``, ``nn/Select.scala``,
+``nn/Index.scala``, ``nn/MaskedSelect.scala``, ``nn/Max.scala``,
+``nn/Min.scala``, ``nn/Mean.scala``, ``nn/Sum.scala``, ``nn/Tile.scala``,
+``nn/ExpandSize.scala``, ``nn/Cropping2D.scala``, ``nn/Cropping3D.scala``,
+``nn/Reverse.scala``, ``nn/Pack.scala``, ``nn/UpSampling1D/2D/3D.scala``,
+``nn/ResizeBilinear.scala``, ``nn/DenseToSparse.scala``.
+
+Dimension arguments are 1-based (torch convention, matching the reference).
+Layers taking ``n_input_dims`` shift the dim by one automatically when a batch
+dimension is present.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .module import Module
+from ..utils.table import Table
+
+
+def _dim0(dim: int, x, n_input_dims: int = -1) -> int:
+    """1-based (maybe negative) dim → 0-based absolute axis."""
+    nd = x.ndim
+    if dim < 0:
+        return nd + dim
+    d = dim - 1
+    if 0 < n_input_dims < nd:
+        d += nd - n_input_dims  # batch dims present
+    return d
+
+
+class Reshape(Module):
+    """Reshape non-batch dims (nn/Reshape.scala). ``batch_mode=None`` infers:
+    if the element count of the full input matches prod(size), no batch dim."""
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = None,
+                 name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def _apply(self, params, state, x, training, rng):
+        n = int(np.prod(self.size))
+        if self.batch_mode is True or (
+                self.batch_mode is None and x.size != n):
+            return x.reshape((x.shape[0],) + self.size)
+        return x.reshape(self.size)
+
+
+class View(Module):
+    """nn/View.scala — reshape keeping batch when num_elements matches."""
+
+    def __init__(self, *sizes, name=None):
+        super().__init__(name=name)
+        if len(sizes) == 1 and isinstance(sizes[0], (list, tuple)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(sizes)
+
+    def _apply(self, params, state, x, training, rng):
+        if -1 in self.sizes:
+            return x.reshape(self.sizes)
+        n = int(np.prod(self.sizes))
+        if x.size == n:
+            return x.reshape(self.sizes)
+        return x.reshape((-1,) + self.sizes)
+
+
+class InferReshape(Module):
+    """nn/InferReshape.scala — size entries: -1 infer, 0 keep input dim."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False, name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def _apply(self, params, state, x, training, rng):
+        in_shape = x.shape[1:] if self.batch_mode else x.shape
+        out = []
+        for i, s in enumerate(self.size):
+            if s == 0:
+                out.append(in_shape[i])
+            else:
+                out.append(s)
+        if self.batch_mode:
+            return x.reshape((x.shape[0],) + tuple(out))
+        return x.reshape(tuple(out))
+
+
+class Squeeze(Module):
+    """nn/Squeeze.scala."""
+
+    def __init__(self, dim: Optional[int] = None, num_input_dims: int = -1,
+                 name=None):
+        super().__init__(name=name)
+        self.dim, self.num_input_dims = dim, num_input_dims
+
+    def _apply(self, params, state, x, training, rng):
+        if self.dim is None:
+            return jnp.squeeze(x)
+        return jnp.squeeze(x, axis=_dim0(self.dim, x, self.num_input_dims))
+
+
+class Unsqueeze(Module):
+    """nn/Unsqueeze.scala — insert singleton at 1-based pos."""
+
+    def __init__(self, pos: int, num_input_dims: int = -1, name=None):
+        super().__init__(name=name)
+        self.pos, self.num_input_dims = pos, num_input_dims
+
+    def _apply(self, params, state, x, training, rng):
+        d = self.pos - 1
+        if 0 < self.num_input_dims < x.ndim:
+            d += x.ndim - self.num_input_dims
+        return jnp.expand_dims(x, d)
+
+
+class Transpose(Module):
+    """nn/Transpose.scala — sequence of 1-based (dim1, dim2) swaps."""
+
+    def __init__(self, permutations, name=None):
+        super().__init__(name=name)
+        self.permutations = [tuple(p) for p in permutations]
+
+    def _apply(self, params, state, x, training, rng):
+        for d1, d2 in self.permutations:
+            x = jnp.swapaxes(x, d1 - 1, d2 - 1)
+        return x
+
+
+class Replicate(Module):
+    """nn/Replicate.scala — insert new dim of size n_features at ``dim``."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = -1,
+                 name=None):
+        super().__init__(name=name)
+        self.n_features, self.dim, self.n_dim = n_features, dim, n_dim
+
+    def _apply(self, params, state, x, training, rng):
+        d = self.dim - 1
+        if 0 < self.n_dim < x.ndim:
+            d += x.ndim - self.n_dim
+        y = jnp.expand_dims(x, d)
+        reps = [1] * y.ndim
+        reps[d] = self.n_features
+        return jnp.tile(y, reps)
+
+
+class Padding(Module):
+    """nn/Padding.scala — pad ``pad`` entries (sign = side) on dim with value."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int,
+                 value: float = 0.0, n_index: int = 1, name=None):
+        super().__init__(name=name)
+        self.dim, self.pad, self.n_input_dim = dim, pad, n_input_dim
+        self.value = value
+
+    def _apply(self, params, state, x, training, rng):
+        d = _dim0(self.dim, x, self.n_input_dim)
+        cfg = [(0, 0)] * x.ndim
+        cfg[d] = (abs(self.pad), 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, cfg, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    """nn/SpatialZeroPadding.scala (NCHW; negative pad crops)."""
+
+    def __init__(self, pad_left: int, pad_right: int = None, pad_top: int = None,
+                 pad_bottom: int = None, name=None):
+        super().__init__(name=name)
+        if pad_right is None:
+            pad_right = pad_top = pad_bottom = pad_left
+        self.l, self.r, self.t, self.b = pad_left, pad_right, pad_top, pad_bottom
+
+    def _apply(self, params, state, x, training, rng):
+        def padcrop(arr, axis, lo, hi):
+            if lo < 0:
+                arr = jax.lax.slice_in_dim(arr, -lo, arr.shape[axis], axis=axis)
+                lo = 0
+            if hi < 0:
+                arr = jax.lax.slice_in_dim(arr, 0, arr.shape[axis] + hi,
+                                           axis=axis)
+                hi = 0
+            if lo or hi:
+                cfg = [(0, 0)] * arr.ndim
+                cfg[axis] = (lo, hi)
+                arr = jnp.pad(arr, cfg)
+            return arr
+        x = padcrop(x, x.ndim - 2, self.t, self.b)
+        x = padcrop(x, x.ndim - 1, self.l, self.r)
+        return x
+
+
+class Narrow(Module):
+    """nn/Narrow.scala — slice [offset, offset+length) on dim (1-based offset;
+    negative length means 'to end + length + 1')."""
+
+    def __init__(self, dimension: int, offset: int, length: int = 1, name=None):
+        super().__init__(name=name)
+        self.dimension, self.offset, self.length = dimension, offset, length
+
+    def _apply(self, params, state, x, training, rng):
+        d = _dim0(self.dimension, x)
+        start = self.offset - 1 if self.offset > 0 else x.shape[d] + self.offset
+        length = self.length
+        if length < 0:
+            length = x.shape[d] - start + length + 1
+        return jax.lax.slice_in_dim(x, start, start + length, axis=d)
+
+
+class Select(Module):
+    """nn/Select.scala — pick index on dim and squeeze it (1-based; negative
+    index counts from the end)."""
+
+    def __init__(self, dim: int, index: int, name=None):
+        super().__init__(name=name)
+        self.dim, self.index = dim, index
+
+    def _apply(self, params, state, x, training, rng):
+        d = _dim0(self.dim, x)
+        i = self.index - 1 if self.index > 0 else x.shape[d] + self.index
+        return jnp.take(x, i, axis=d)
+
+
+class Index(Module):
+    """nn/Index.scala — Table(src, indices): gather rows on dim (1-based ids)."""
+
+    def __init__(self, dimension: int, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+
+    def _apply(self, params, state, x, training, rng):
+        src, idx = x[1], x[2]
+        return jnp.take(src, idx.astype(jnp.int32) - 1,
+                        axis=self.dimension - 1)
+
+
+class MaskedSelect(Module):
+    """nn/MaskedSelect.scala — Table(src, mask) → 1-D selected values.
+    Dynamic output shape: eager-only (cannot run under jit; XLA requires
+    static shapes — use multiplication by mask inside compiled code instead)."""
+
+    def _apply(self, params, state, x, training, rng):
+        src, mask = x[1], x[2]
+        return src[mask.astype(bool)]
+
+
+class _Reduce(Module):
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True, name=None):
+        super().__init__(name=name)
+        self.dimension, self.n_input_dims = dimension, n_input_dims
+        self.squeeze = squeeze
+
+    def _reduce(self, x, axis):
+        raise NotImplementedError
+
+    def _apply(self, params, state, x, training, rng):
+        d = _dim0(self.dimension, x, self.n_input_dims)
+        return self._reduce(x, d) if self.squeeze else \
+            jnp.expand_dims(self._reduce(x, d), d)
+
+
+class Max(_Reduce):
+    """nn/Max.scala (values only, parity with forward output)."""
+
+    def __init__(self, dim: int = 1, num_input_dims: int = -1, name=None):
+        super().__init__(dim, num_input_dims, True, name=name)
+
+    def _reduce(self, x, axis):
+        return jnp.max(x, axis=axis)
+
+
+class Min(_Reduce):
+    def __init__(self, dim: int = 1, num_input_dims: int = -1, name=None):
+        super().__init__(dim, num_input_dims, True, name=name)
+
+    def _reduce(self, x, axis):
+        return jnp.min(x, axis=axis)
+
+
+class Mean(_Reduce):
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True, name=None):
+        super().__init__(dimension, n_input_dims, squeeze, name=name)
+
+    def _reduce(self, x, axis):
+        return jnp.mean(x, axis=axis)
+
+
+class Sum(_Reduce):
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True, name=None):
+        super().__init__(dimension, n_input_dims, squeeze, name=name)
+        self.size_average = size_average
+
+    def _reduce(self, x, axis):
+        return jnp.mean(x, axis=axis) if self.size_average else \
+            jnp.sum(x, axis=axis)
+
+
+class Tile(Module):
+    """nn/Tile.scala — repeat ``copies`` times along dim."""
+
+    def __init__(self, dim: int = 1, copies: int = 2, name=None):
+        super().__init__(name=name)
+        self.dim, self.copies = dim, copies
+
+    def _apply(self, params, state, x, training, rng):
+        reps = [1] * x.ndim
+        reps[_dim0(self.dim, x)] = self.copies
+        return jnp.tile(x, reps)
+
+
+class ExpandSize(Module):
+    """nn/ExpandSize.scala — broadcast singleton dims to target sizes
+    (-1 keeps)."""
+
+    def __init__(self, sizes: Sequence[int], name=None):
+        super().__init__(name=name)
+        self.sizes = tuple(sizes)
+
+    def _apply(self, params, state, x, training, rng):
+        target = tuple(x.shape[i] if s == -1 else s
+                       for i, s in enumerate(self.sizes))
+        return jnp.broadcast_to(x, target)
+
+
+class Cropping2D(Module):
+    """nn/Cropping2D.scala (NCHW)."""
+
+    def __init__(self, height_crop=(0, 0), width_crop=(0, 0),
+                 data_format="NCHW", name=None):
+        super().__init__(name=name)
+        self.hc, self.wc = tuple(height_crop), tuple(width_crop)
+        self.data_format = data_format
+
+    def _apply(self, params, state, x, training, rng):
+        h_ax = x.ndim - 2 if self.data_format == "NCHW" else x.ndim - 3
+        w_ax = x.ndim - 1 if self.data_format == "NCHW" else x.ndim - 2
+        x = jax.lax.slice_in_dim(x, self.hc[0], x.shape[h_ax] - self.hc[1],
+                                 axis=h_ax)
+        x = jax.lax.slice_in_dim(x, self.wc[0], x.shape[w_ax] - self.wc[1],
+                                 axis=w_ax)
+        return x
+
+
+class Cropping3D(Module):
+    """nn/Cropping3D.scala (NCDHW)."""
+
+    def __init__(self, dim1_crop=(0, 0), dim2_crop=(0, 0), dim3_crop=(0, 0),
+                 name=None):
+        super().__init__(name=name)
+        self.crops = [tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop)]
+
+    def _apply(self, params, state, x, training, rng):
+        for i, (lo, hi) in enumerate(self.crops):
+            ax = x.ndim - 3 + i
+            x = jax.lax.slice_in_dim(x, lo, x.shape[ax] - hi, axis=ax)
+        return x
+
+
+class Reverse(Module):
+    """nn/Reverse.scala — flip along dim."""
+
+    def __init__(self, dimension: int = 1, is_inplace: bool = False, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+
+    def _apply(self, params, state, x, training, rng):
+        return jnp.flip(x, axis=self.dimension - 1)
+
+
+class Pack(Module):
+    """nn/Pack.scala — stack a Table of tensors along a new 1-based dim."""
+
+    def __init__(self, dimension: int, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+
+    def _apply(self, params, state, x, training, rng):
+        items = x.to_list() if isinstance(x, Table) else [x]
+        return jnp.stack(items, axis=self.dimension - 1)
+
+
+class UpSampling1D(Module):
+    """nn/UpSampling1D.scala — repeat timesteps (B, T, C) → (B, T*len, C)."""
+
+    def __init__(self, length: int, name=None):
+        super().__init__(name=name)
+        self.length = length
+
+    def _apply(self, params, state, x, training, rng):
+        return jnp.repeat(x, self.length, axis=-2)
+
+
+class UpSampling2D(Module):
+    """nn/UpSampling2D.scala — nearest-neighbor (NCHW)."""
+
+    def __init__(self, size=(2, 2), data_format="NCHW", name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def _apply(self, params, state, x, training, rng):
+        x = jnp.repeat(x, self.size[0], axis=-2)
+        return jnp.repeat(x, self.size[1], axis=-1)
+
+
+class UpSampling3D(Module):
+    """nn/UpSampling3D.scala (NCDHW)."""
+
+    def __init__(self, size=(2, 2, 2), name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def _apply(self, params, state, x, training, rng):
+        x = jnp.repeat(x, self.size[0], axis=-3)
+        x = jnp.repeat(x, self.size[1], axis=-2)
+        return jnp.repeat(x, self.size[2], axis=-1)
+
+
+class ResizeBilinear(Module):
+    """nn/ResizeBilinear.scala — bilinear resize of NCHW to (H', W')."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, data_format="NCHW", name=None):
+        super().__init__(name=name)
+        self.oh, self.ow = output_height, output_width
+        self.align_corners = align_corners
+
+    def _apply(self, params, state, x, training, rng):
+        method = "bilinear"
+        target = x.shape[:-2] + (self.oh, self.ow)
+        if self.align_corners:
+            # jax.image.resize has no align_corners; emulate via scale/translate
+            h, w = x.shape[-2], x.shape[-1]
+            scale = ((h - 1) / max(self.oh - 1, 1), (w - 1) / max(self.ow - 1, 1))
+            ys = jnp.arange(self.oh) * scale[0]
+            xs = jnp.arange(self.ow) * scale[1]
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+            y1 = jnp.clip(y0 + 1, 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+            x1 = jnp.clip(x0 + 1, 0, w - 1)
+            wy = (ys - y0)[..., :, None]
+            wx = (xs - x0)[..., None, :]
+            g = lambda yy, xx: x[..., yy, :][..., :, xx]
+            top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+            bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+            return top * (1 - wy) + bot * wy
+        return jax.image.resize(x, target, method)
+
+
+class DenseToSparse(Module):
+    """nn/DenseToSparse.scala — on TPU dense representation is canonical;
+    this is a tagged identity for API parity."""
+
+    def _apply(self, params, state, x, training, rng):
+        return x

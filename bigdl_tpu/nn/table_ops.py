@@ -1,0 +1,265 @@
+"""Table (multi-activity) layers.
+
+Parity: reference ``nn/CAddTable.scala`` and friends, ``nn/JoinTable.scala``,
+``nn/SplitTable.scala``, ``nn/SelectTable.scala``, ``nn/NarrowTable.scala``,
+``nn/FlattenTable.scala``, ``nn/MixtureTable.scala``, ``nn/DotProduct.scala``,
+``nn/MM.scala``, ``nn/MV.scala``, ``nn/CrossProduct.scala``,
+``nn/PairwiseDistance.scala``, ``nn/CosineDistance.scala``,
+``nn/BifurcateSplitTable.scala``, ``nn/TableOperation.scala``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .module import Module
+from .shape_ops import _dim0
+from ..utils.table import Table
+
+
+class _CwiseTable(Module):
+    def _combine(self, a, b):
+        raise NotImplementedError
+
+    def _apply(self, params, state, x, training, rng):
+        items = x.to_list() if isinstance(x, Table) else list(x)
+        out = items[0]
+        for it in items[1:]:
+            out = self._combine(out, it)
+        return out
+
+
+class CAddTable(_CwiseTable):
+    def __init__(self, inplace: bool = False, name=None):
+        super().__init__(name=name)
+
+    def _combine(self, a, b):
+        return a + b
+
+
+class CSubTable(_CwiseTable):
+    def _combine(self, a, b):
+        return a - b
+
+
+class CMulTable(_CwiseTable):
+    def _combine(self, a, b):
+        return a * b
+
+
+class CDivTable(_CwiseTable):
+    def _combine(self, a, b):
+        return a / b
+
+
+class CMaxTable(_CwiseTable):
+    def _combine(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class CMinTable(_CwiseTable):
+    def _combine(self, a, b):
+        return jnp.minimum(a, b)
+
+
+class CAveTable(Module):
+    def __init__(self, inplace: bool = False, name=None):
+        super().__init__(name=name)
+
+    def _apply(self, params, state, x, training, rng):
+        items = x.to_list() if isinstance(x, Table) else list(x)
+        return sum(items) / len(items)
+
+
+class JoinTable(Module):
+    """Concat table elements along 1-based dim (nn/JoinTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1, name=None):
+        super().__init__(name=name)
+        self.dimension, self.n_input_dims = dimension, n_input_dims
+
+    def _apply(self, params, state, x, training, rng):
+        items = x.to_list() if isinstance(x, Table) else list(x)
+        d = _dim0(self.dimension, items[0], self.n_input_dims)
+        return jnp.concatenate(items, axis=d)
+
+
+class SplitTable(Module):
+    """Split along 1-based dim into a Table (nn/SplitTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1, name=None):
+        super().__init__(name=name)
+        self.dimension, self.n_input_dims = dimension, n_input_dims
+
+    def _apply(self, params, state, x, training, rng):
+        d = _dim0(self.dimension, x, self.n_input_dims)
+        n = x.shape[d]
+        parts = [jnp.take(x, i, axis=d) for i in range(n)]
+        return Table(*parts)
+
+
+class BifurcateSplitTable(Module):
+    """Split in half along dim (nn/BifurcateSplitTable.scala)."""
+
+    def __init__(self, dimension: int, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+
+    def _apply(self, params, state, x, training, rng):
+        d = self.dimension - 1
+        half = x.shape[d] // 2
+        import jax
+        a = jax.lax.slice_in_dim(x, 0, half, axis=d)
+        b = jax.lax.slice_in_dim(x, half, x.shape[d], axis=d)
+        return Table(a, b)
+
+
+class SelectTable(Module):
+    """Pick the i-th (1-based) element (nn/SelectTable.scala)."""
+
+    def __init__(self, index: int, name=None):
+        super().__init__(name=name)
+        self.index = index
+
+    def _apply(self, params, state, x, training, rng):
+        i = self.index if self.index > 0 else len(x) + self.index + 1
+        return x[i]
+
+
+class NarrowTable(Module):
+    """Slice the table itself (nn/NarrowTable.scala)."""
+
+    def __init__(self, offset: int, length: int = 1, name=None):
+        super().__init__(name=name)
+        self.offset, self.length = offset, length
+
+    def _apply(self, params, state, x, training, rng):
+        length = self.length
+        if length < 0:
+            length = len(x) - self.offset + 2 + length
+        items = [x[self.offset + i] for i in range(length)]
+        return Table(*items)
+
+
+class FlattenTable(Module):
+    """Flatten nested Tables (nn/FlattenTable.scala)."""
+
+    def _apply(self, params, state, x, training, rng):
+        out = []
+
+        def rec(t):
+            if isinstance(t, Table):
+                for item in t:
+                    rec(item)
+            else:
+                out.append(t)
+        rec(x)
+        return Table(*out)
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts blend: Table(gate (B,K), experts Table/Tensor)
+    (nn/MixtureTable.scala)."""
+
+    def __init__(self, dim: int = None, name=None):
+        super().__init__(name=name)
+        self.dim = dim
+
+    def _apply(self, params, state, x, training, rng):
+        gate, experts = x[1], x[2]
+        if isinstance(experts, Table):
+            stacked = jnp.stack(experts.to_list(), axis=1)  # (B, K, ...)
+        else:
+            stacked = experts
+        g = gate.reshape(gate.shape + (1,) * (stacked.ndim - gate.ndim))
+        return jnp.sum(stacked * g, axis=1)
+
+
+class DotProduct(Module):
+    """Rowwise dot of Table(a, b) (nn/DotProduct.scala)."""
+
+    def _apply(self, params, state, x, training, rng):
+        a, b = x[1], x[2]
+        if a.ndim == 1:
+            return jnp.sum(a * b)[None]
+        return jnp.sum(a * b, axis=-1)
+
+
+class CrossProduct(Module):
+    """Pairwise dot between every pair of table entries (nn/CrossProduct.scala)."""
+
+    def __init__(self, num_tensor: int = 0, embedding_size: int = 0, name=None):
+        super().__init__(name=name)
+
+    def _apply(self, params, state, x, training, rng):
+        items = x.to_list()
+        outs = []
+        for i in range(len(items)):
+            for j in range(i + 1, len(items)):
+                outs.append(jnp.sum(items[i] * items[j], axis=-1, keepdims=True))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class MM(Module):
+    """Matrix-matrix product of Table(a, b) (nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False, name=None):
+        super().__init__(name=name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def _apply(self, params, state, x, training, rng):
+        a, b = x[1], x[2]
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class MV(Module):
+    """Matrix-vector product of Table(mat, vec) (nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False, name=None):
+        super().__init__(name=name)
+        self.trans = trans
+
+    def _apply(self, params, state, x, training, rng):
+        m, v = x[1], x[2]
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class PairwiseDistance(Module):
+    """Lp distance of Table(a, b) rows (nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2, name=None):
+        super().__init__(name=name)
+        self.norm = norm
+
+    def _apply(self, params, state, x, training, rng):
+        a, b = x[1], x[2]
+        d = jnp.abs(a - b)
+        return jnp.power(jnp.sum(jnp.power(d, self.norm), axis=-1),
+                         1.0 / self.norm)
+
+
+class CosineDistance(Module):
+    """Cosine similarity of Table(a, b) rows (nn/CosineDistance.scala)."""
+
+    def _apply(self, params, state, x, training, rng):
+        a, b = x[1], x[2]
+        num = jnp.sum(a * b, axis=-1)
+        den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+        return num / jnp.maximum(den, 1e-12)
+
+
+class TableOperation(Module):
+    """Apply a binary op elementwise over Table(a, b), broadcasting smaller
+    (nn/TableOperation.scala)."""
+
+    def __init__(self, operation, name=None):
+        super().__init__(name=name)
+        self.operation = operation
+
+    def _apply(self, params, state, x, training, rng):
+        return self.operation(x[1], x[2])

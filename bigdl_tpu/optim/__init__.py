@@ -1,0 +1,17 @@
+from .optim_method import (OptimMethod, SGD, Adam, ParallelAdam, Adagrad,
+                           Adadelta, Adamax, RMSprop, Ftrl, LarsSGD, LBFGS,
+                           LearningRateSchedule, Default, Poly, Step,
+                           MultiStep, EpochStep, EpochDecay, NaturalExp,
+                           Exponential, Warmup, SequentialSchedule, Regime,
+                           EpochSchedule, Plateau, EpochDecayWithWarmUp)
+from .regularizer import (Regularizer, L1Regularizer, L2Regularizer,
+                          L1L2Regularizer)
+from .trigger import (Trigger, every_epoch, several_iteration, max_epoch,
+                      max_iteration, max_score, min_loss, and_, or_)
+from .validation import (ValidationMethod, ValidationResult, AccuracyResult,
+                         LossResult, Top1Accuracy, Top5Accuracy, Loss, MAE,
+                         HitRatio, NDCG, TreeNNAccuracy)
+from .optimizer import (Optimizer, LocalOptimizer, DistriOptimizer,
+                        BaseOptimizer, Metrics)
+from .evaluator import Evaluator
+from .predictor import Predictor, PredictionService

@@ -1,0 +1,43 @@
+"""Evaluator (parity: reference ``optim/Evaluator.scala`` /
+``optim/LocalValidator.scala`` / ``optim/DistriValidator.scala``)."""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..dataset.dataset import AbstractDataSet, ShardedDataSet
+from ..utils.table import Table
+
+
+class Evaluator:
+    def __init__(self, model):
+        self.model = model
+        self._fwd = None
+
+    def _forward_fn(self):
+        if self._fwd is None:
+            model = self.model
+
+            def fwd(params, state, x):
+                out, _ = model.apply(params, state, x, training=False)
+                return out
+            self._fwd = jax.jit(fwd)
+        return self._fwd
+
+    def evaluate(self, dataset: AbstractDataSet, methods: List,
+                 batch_size: int = 32):
+        self.model.ensure_initialized()
+        fwd = self._forward_fn()
+        batched = ShardedDataSet(dataset, batch_size, drop_last=False)
+        results = [None] * len(methods)
+        for mb in batched.data(train=False):
+            x = mb.get_input()
+            x = jax.tree_util.tree_map(jnp.asarray, x) \
+                if isinstance(x, Table) else jnp.asarray(x)
+            out = fwd(self.model.params, self.model.state, x)
+            for i, m in enumerate(methods):
+                r = m(out, mb.get_target())
+                results[i] = r if results[i] is None else results[i] + r
+        return results

@@ -1,0 +1,649 @@
+"""Optimization methods + learning-rate schedules.
+
+Parity: reference ``optim/OptimMethod.scala``, ``optim/SGD.scala`` (incl. the
+full LearningRateSchedule family), ``optim/Adam.scala``,
+``optim/ParallelAdam.scala``, ``optim/Adagrad.scala``, ``optim/Adadelta.scala``,
+``optim/Adamax.scala``, ``optim/RMSprop.scala``, ``optim/Ftrl.scala``,
+``optim/LarsSGD.scala``, ``optim/LBFGS.scala`` + ``optim/LineSearch.scala``.
+
+Design: each method holds hyperparameters (python scalars, baked into the
+trace) and exposes ``init_state(params) -> pytree`` and
+``update(grads, params, state, lr) -> (new_params, new_state)`` — pure,
+jit-able, tree-mapped. Schedules run host-side each step (they are control
+logic, not compute) and feed ``lr`` in as a scalar argument, so changing lr
+never retraces the step.
+
+The reference's ParallelAdam (multi-threaded sharded update) maps to
+DistriOptimizer's ZeRO-style sharded update in
+``bigdl_tpu/parallel/allreduce.py``; the math here is identical to Adam.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_tmap = jax.tree_util.tree_map
+
+
+# ---------------------------------------------------------------------------
+# Learning-rate schedules (parity: optim/SGD.scala:200-700)
+# ---------------------------------------------------------------------------
+class LearningRateSchedule:
+    """Host-side schedule. ``update_lr(method_state) -> lr`` where
+    method_state carries 'neval' (iterations so far, 0-based), 'epoch'
+    (1-based), optionally 'score'/'loss'."""
+
+    def update_lr(self, lr, state):
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + neval * learningRateDecay) (SGD.scala:500)."""
+
+    def __init__(self):
+        self.decay = 0.0  # set by SGD from learningrate_decay
+
+    def update_lr(self, lr, state):
+        return lr / (1.0 + state["neval"] * self.decay)
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - neval/maxIteration)^power (SGD.scala:290)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def update_lr(self, lr, state):
+        if state["neval"] >= self.max_iteration:
+            return 0.0
+        return lr * (1.0 - state["neval"] / self.max_iteration) ** self.power
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(neval/stepSize)) (SGD.scala:329)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def update_lr(self, lr, state):
+        return lr * self.gamma ** (state["neval"] // self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    """(SGD.scala:360)."""
+
+    def __init__(self, step_sizes, gamma: float):
+        self.step_sizes, self.gamma = list(step_sizes), gamma
+
+    def update_lr(self, lr, state):
+        n = sum(1 for s in self.step_sizes if state["neval"] >= s)
+        return lr * self.gamma ** n
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(floor((epoch-1)/stepSize)) (SGD.scala:423)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def update_lr(self, lr, state):
+        return lr * self.gamma ** ((state["epoch"] - 1) // self.step_size)
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decayType(epoch) (SGD.scala:397)."""
+
+    def __init__(self, decay_type):
+        self.decay_type = decay_type
+
+    def update_lr(self, lr, state):
+        return lr * 0.1 ** self.decay_type(state["epoch"])
+
+
+class NaturalExp(LearningRateSchedule):
+    """lr * exp(-gamma * floor(neval/decayStep)) (SGD.scala:455)."""
+
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step, self.gamma = decay_step, gamma
+
+    def update_lr(self, lr, state):
+        return lr * math.exp(-self.gamma * (state["neval"] // self.decay_step))
+
+
+class Exponential(LearningRateSchedule):
+    """lr * decayRate^(neval/decayStep) (SGD.scala:476)."""
+
+    def __init__(self, decay_step: int, decay_rate: float,
+                 stair_case: bool = False):
+        self.decay_step, self.decay_rate = decay_step, decay_rate
+        self.stair_case = stair_case
+
+    def update_lr(self, lr, state):
+        p = state["neval"] / self.decay_step
+        if self.stair_case:
+            p = math.floor(p)
+        return lr * self.decay_rate ** p
+
+
+class Warmup(LearningRateSchedule):
+    """lr + delta * neval (SGD.scala:599; used inside SequentialSchedule)."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def update_lr(self, lr, state):
+        return lr + self.delta * state["neval"]
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for maxIteration steps (SGD.scala:623)."""
+
+    def __init__(self, iteration_per_epoch: int = 1):
+        self.iteration_per_epoch = iteration_per_epoch
+        self.schedules = []  # (schedule, max_iter)
+
+    def add(self, schedule, max_iteration: int):
+        self.schedules.append((schedule, max_iteration))
+        return self
+
+    def update_lr(self, lr, state):
+        n = state["neval"]
+        offset = 0
+        for sched, mx in self.schedules:
+            if n < offset + mx or (sched, mx) == self.schedules[-1]:
+                sub = dict(state)
+                sub["neval"] = n - offset
+                sub["epoch"] = max(1, (n - offset) // self.iteration_per_epoch + 1)
+                return sched.update_lr(lr, sub)
+            offset += mx
+        return lr
+
+
+class Regime:
+    """(SGD.scala:526)."""
+
+    def __init__(self, start_epoch: int, end_epoch: int, config: dict):
+        self.start_epoch, self.end_epoch, self.config = \
+            start_epoch, end_epoch, config
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Per-epoch-range config regimes (SGD.scala:233)."""
+
+    def __init__(self, regimes):
+        self.regimes = list(regimes)
+
+    def update_lr(self, lr, state):
+        e = state["epoch"]
+        for r in self.regimes:
+            if r.start_epoch <= e <= r.end_epoch:
+                return r.config.get("learningRate",
+                                    r.config.get("learning_rate", lr))
+        return lr
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce on plateau of a monitored metric (SGD.scala:544)."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.mode, self.epsilon, self.cooldown = mode, epsilon, cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.multiplier = 1.0
+
+    def _better(self, cur, best):
+        if self.mode == "min":
+            return cur < best - self.epsilon
+        return cur > best + self.epsilon
+
+    def update_lr(self, lr, state):
+        cur = state.get(self.monitor)
+        if cur is not None:
+            if self.best is None or self._better(cur, self.best):
+                self.best = cur
+                self.wait = 0
+            elif self.cooldown_counter > 0:
+                self.cooldown_counter -= 1
+                self.wait = 0
+            else:
+                self.wait += 1
+                if self.wait >= self.patience:
+                    self.multiplier *= self.factor
+                    self.wait = 0
+                    self.cooldown_counter = self.cooldown
+        return max(lr * self.multiplier, self.min_lr)
+
+
+class EpochDecayWithWarmUp(LearningRateSchedule):
+    """Linear warmup then epoch decay (SGD.scala:671)."""
+
+    def __init__(self, warmup_iteration: int, warmup_delta: float, decay_type):
+        self.warmup_iteration = warmup_iteration
+        self.warmup_delta = warmup_delta
+        self.decay_type = decay_type
+
+    def update_lr(self, lr, state):
+        if state["neval"] < self.warmup_iteration:
+            return lr + self.warmup_delta * state["neval"]
+        return (lr + self.warmup_delta * self.warmup_iteration) * \
+            0.1 ** self.decay_type(state["epoch"])
+
+
+# ---------------------------------------------------------------------------
+# Optim methods
+# ---------------------------------------------------------------------------
+class OptimMethod:
+    """Base (parity: optim/OptimMethod.scala)."""
+
+    def __init__(self, learningrate: float = 1e-3):
+        self.learningrate = learningrate
+        self.state = {"neval": 0, "epoch": 1}
+
+    def init_state(self, params):
+        return {}
+
+    def update(self, grads, params, opt_state, lr):
+        raise NotImplementedError
+
+    def get_learning_rate(self):
+        return self.current_lr()
+
+    def current_lr(self):
+        return self.learningrate
+
+    def clone(self):
+        import copy
+        return copy.deepcopy(self)
+
+    # reference-style optimize() on a feval closure, for LBFGS parity and
+    # unit tests of a single method on a flat vector
+    def optimize(self, feval, x):
+        if not hasattr(self, "_flat_state"):
+            self._flat_state = self.init_state(x)
+        loss, g = feval(x)
+        lr = self.current_lr()
+        new_x, self._flat_state = self.update(g, x, self._flat_state, lr)
+        self.state["neval"] += 1
+        return new_x, [loss]
+
+
+class SGD(OptimMethod):
+    """optim/SGD.scala:39 — momentum/dampening/nesterov/weightDecay +
+    schedule family."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0, weightdecay: float = 0.0,
+                 momentum: float = 0.0, dampening: Optional[float] = None,
+                 nesterov: bool = False, learningrate_schedule=None,
+                 **_ignored):
+        super().__init__(learningrate)
+        self.learningrate_decay = learningrate_decay
+        self.weightdecay = weightdecay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        if learningrate_schedule is None:
+            learningrate_schedule = Default()
+        if isinstance(learningrate_schedule, Default):
+            learningrate_schedule.decay = learningrate_decay
+        self.learningrate_schedule = learningrate_schedule
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            # match reference require: nesterov needs momentum, zero dampening
+            self.dampening = 0.0
+
+    def current_lr(self):
+        return self.learningrate_schedule.update_lr(self.learningrate,
+                                                    self.state)
+
+    def init_state(self, params):
+        if self.momentum <= 0:
+            return {}
+        return {"v": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, opt_state, lr):
+        wd, mom, damp = self.weightdecay, self.momentum, self.dampening
+        if wd > 0:
+            grads = _tmap(lambda g, w: g + wd * w, grads, params)
+        if mom > 0:
+            v = _tmap(lambda v, g: mom * v + (1 - damp) * g,
+                      opt_state["v"], grads)
+            if self.nesterov:
+                grads = _tmap(lambda g, vv: g + mom * vv, grads, v)
+            else:
+                grads = v
+            new_state = {"v": v}
+        else:
+            new_state = opt_state
+        new_params = _tmap(lambda w, g: w - lr * g, params, grads)
+        return new_params, new_state
+
+
+class Adam(OptimMethod):
+    """optim/Adam.scala."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **_ignored):
+        super().__init__(learningrate)
+        self.learningrate_decay = learningrate_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def current_lr(self):
+        return self.learningrate / (1 + self.state["neval"] *
+                                    self.learningrate_decay)
+
+    def init_state(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, params, opt_state, lr):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        t = opt_state["t"] + 1
+        m = _tmap(lambda m, g: b1 * m + (1 - b1) * g, opt_state["m"], grads)
+        v = _tmap(lambda v, g: b2 * v + (1 - b2) * g * g, opt_state["v"], grads)
+        bc1 = 1 - b1 ** t.astype(jnp.float32)
+        bc2 = 1 - b2 ** t.astype(jnp.float32)
+        new_params = _tmap(
+            lambda w, mm, vv: w - lr * (mm / bc1) /
+            (jnp.sqrt(vv / bc2) + eps), params, m, v)
+        return new_params, {"m": m, "v": v, "t": t}
+
+
+class ParallelAdam(Adam):
+    """optim/ParallelAdam.scala — identical math; the parallel (sharded)
+    update is realised by DistriOptimizer's ZeRO path."""
+
+
+class Adagrad(OptimMethod):
+    """optim/Adagrad.scala."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0, weightdecay: float = 0.0,
+                 **_ignored):
+        super().__init__(learningrate)
+        self.learningrate_decay = learningrate_decay
+        self.weightdecay = weightdecay
+
+    def current_lr(self):
+        return self.learningrate / (1 + self.state["neval"] *
+                                    self.learningrate_decay)
+
+    def init_state(self, params):
+        return {"accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, opt_state, lr):
+        if self.weightdecay > 0:
+            grads = _tmap(lambda g, w: g + self.weightdecay * w, grads, params)
+        accum = _tmap(lambda a, g: a + g * g, opt_state["accum"], grads)
+        new_params = _tmap(
+            lambda w, g, a: w - lr * g / (jnp.sqrt(a) + 1e-10),
+            params, grads, accum)
+        return new_params, {"accum": accum}
+
+
+class Adadelta(OptimMethod):
+    """optim/Adadelta.scala (decayRate rho)."""
+
+    def __init__(self, decayrate: float = 0.9, epsilon: float = 1e-10,
+                 **_ignored):
+        super().__init__(1.0)
+        self.rho, self.epsilon = decayrate, epsilon
+
+    def init_state(self, params):
+        return {"accum": _tmap(jnp.zeros_like, params),
+                "delta_accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, opt_state, lr):
+        rho, eps = self.rho, self.epsilon
+        accum = _tmap(lambda a, g: rho * a + (1 - rho) * g * g,
+                      opt_state["accum"], grads)
+        delta = _tmap(
+            lambda g, a, d: g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps),
+            grads, accum, opt_state["delta_accum"])
+        delta_accum = _tmap(lambda d, dl: rho * d + (1 - rho) * dl * dl,
+                            opt_state["delta_accum"], delta)
+        new_params = _tmap(lambda w, d: w - lr * d, params, delta)
+        return new_params, {"accum": accum, "delta_accum": delta_accum}
+
+
+class Adamax(OptimMethod):
+    """optim/Adamax.scala."""
+
+    def __init__(self, learningrate: float = 0.002, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38, **_ignored):
+        super().__init__(learningrate)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "u": _tmap(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, params, opt_state, lr):
+        b1, b2 = self.beta1, self.beta2
+        t = opt_state["t"] + 1
+        m = _tmap(lambda m, g: b1 * m + (1 - b1) * g, opt_state["m"], grads)
+        u = _tmap(lambda u, g: jnp.maximum(b2 * u, jnp.abs(g) + self.epsilon),
+                  opt_state["u"], grads)
+        bc = 1 - b1 ** t.astype(jnp.float32)
+        new_params = _tmap(lambda w, mm, uu: w - (lr / bc) * mm / uu,
+                           params, m, u)
+        return new_params, {"m": m, "u": u, "t": t}
+
+
+class RMSprop(OptimMethod):
+    """optim/RMSprop.scala."""
+
+    def __init__(self, learningrate: float = 1e-2,
+                 learningrate_decay: float = 0.0, decayrate: float = 0.99,
+                 epsilon: float = 1e-8, **_ignored):
+        super().__init__(learningrate)
+        self.learningrate_decay = learningrate_decay
+        self.decayrate, self.epsilon = decayrate, epsilon
+
+    def current_lr(self):
+        return self.learningrate / (1 + self.state["neval"] *
+                                    self.learningrate_decay)
+
+    def init_state(self, params):
+        return {"accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, opt_state, lr):
+        rho = self.decayrate
+        accum = _tmap(lambda a, g: rho * a + (1 - rho) * g * g,
+                      opt_state["accum"], grads)
+        new_params = _tmap(
+            lambda w, g, a: w - lr * g / (jnp.sqrt(a) + self.epsilon),
+            params, grads, accum)
+        return new_params, {"accum": accum}
+
+
+class Ftrl(OptimMethod):
+    """optim/Ftrl.scala — FTRL-proximal."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0,
+                 l2_shrinkage_regularization_strength: float = 0.0,
+                 **_ignored):
+        super().__init__(learningrate)
+        self.lr_power = learningrate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+        self.l2_shrinkage = l2_shrinkage_regularization_strength
+
+    def init_state(self, params):
+        return {"accum": _tmap(lambda p: jnp.full_like(p, self.init_accum),
+                               params),
+                "linear": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, opt_state, lr):
+        lp = self.lr_power
+
+        def upd(w, g, a, l):
+            g_shrunk = g + 2 * self.l2_shrinkage * w
+            new_a = a + g * g
+            sigma = (jnp.power(new_a, -lp) - jnp.power(a, -lp)) / lr
+            new_l = l + g_shrunk - sigma * w
+            quad = jnp.power(new_a, -lp) / lr + 2 * self.l2
+            l_reg = jnp.clip(new_l, -self.l1, self.l1)
+            new_w = (l_reg - new_l) / quad
+            return new_w, new_a, new_l
+
+        flat_p, tdef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_a = jax.tree_util.tree_leaves(opt_state["accum"])
+        flat_l = jax.tree_util.tree_leaves(opt_state["linear"])
+        outs = [upd(w, g, a, l) for w, g, a, l in
+                zip(flat_p, flat_g, flat_a, flat_l)]
+        new_params = jax.tree_util.tree_unflatten(tdef, [o[0] for o in outs])
+        accum = jax.tree_util.tree_unflatten(tdef, [o[1] for o in outs])
+        linear = jax.tree_util.tree_unflatten(tdef, [o[2] for o in outs])
+        return new_params, {"accum": accum, "linear": linear}
+
+
+class LarsSGD(OptimMethod):
+    """optim/LarsSGD.scala — layer-wise adaptive rate scaling. Trust ratio is
+    computed per param leaf (≈ per layer tensor, as in the reference)."""
+
+    def __init__(self, learningrate: float = 1e-2, trust: float = 1.0,
+                 momentum: float = 0.9, weightdecay: float = 0.0,
+                 learningrate_schedule=None, **_ignored):
+        super().__init__(learningrate)
+        self.trust, self.momentum, self.weightdecay = \
+            trust, momentum, weightdecay
+        self.learningrate_schedule = learningrate_schedule or Default()
+
+    def current_lr(self):
+        return self.learningrate_schedule.update_lr(self.learningrate,
+                                                    self.state)
+
+    def init_state(self, params):
+        return {"v": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, opt_state, lr):
+        def upd(w, g, v):
+            wn = jnp.linalg.norm(w.reshape(-1))
+            gn = jnp.linalg.norm(g.reshape(-1))
+            local_lr = jnp.where(
+                (wn > 0) & (gn > 0),
+                self.trust * wn / (gn + self.weightdecay * wn + 1e-9),
+                1.0)
+            vv = self.momentum * v + lr * local_lr * \
+                (g + self.weightdecay * w)
+            return w - vv, vv
+
+        flat_p, tdef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_v = jax.tree_util.tree_leaves(opt_state["v"])
+        outs = [upd(w, g, v) for w, g, v in zip(flat_p, flat_g, flat_v)]
+        new_params = jax.tree_util.tree_unflatten(tdef, [o[0] for o in outs])
+        new_v = jax.tree_util.tree_unflatten(tdef, [o[1] for o in outs])
+        return new_params, {"v": new_v}
+
+
+class LBFGS(OptimMethod):
+    """optim/LBFGS.scala — limited-memory BFGS with optional line search.
+    Host-driven (two-loop recursion over flat vectors); ``optimize(feval, x)``
+    is the entry point, matching the reference's full-batch usage."""
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tol_fun: float = 1e-5, tol_x: float = 1e-9,
+                 n_correction: int = 100, learningrate: float = 1.0,
+                 line_search: bool = False, **_ignored):
+        super().__init__(learningrate)
+        self.max_iter, self.tol_fun, self.tol_x = max_iter, tol_fun, tol_x
+        self.n_correction = n_correction
+        self.line_search = line_search
+        self.max_eval = max_eval or max_iter * 1.25
+
+    def optimize(self, feval, x):
+        from jax.flatten_util import ravel_pytree
+        x_flat, unravel = ravel_pytree(x)
+
+        def f(v):
+            loss, g = feval(unravel(v))
+            return float(loss), ravel_pytree(g)[0]
+
+        losses = []
+        s_list, y_list, rho_list = [], [], []
+        loss, g = f(x_flat)
+        losses.append(loss)
+        n_eval = 1
+        for it in range(self.max_iter):
+            if jnp.max(jnp.abs(g)) <= self.tol_fun:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y, rho in zip(reversed(s_list), reversed(y_list),
+                                 reversed(rho_list)):
+                a = rho * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            if y_list:
+                gamma = jnp.dot(s_list[-1], y_list[-1]) / \
+                    jnp.dot(y_list[-1], y_list[-1])
+                q = gamma * q
+            for (s, y, rho), a in zip(zip(s_list, y_list, rho_list),
+                                      reversed(alphas)):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            d = -q
+            # step size: line search (backtracking armijo) or fixed lr
+            t = self.learningrate if it > 0 or s_list else \
+                min(1.0, 1.0 / float(jnp.sum(jnp.abs(g)))) * self.learningrate
+            gtd = float(jnp.dot(g, d))
+            if gtd > -self.tol_x:
+                break
+            if self.line_search:
+                for _ in range(25):
+                    new_loss, _ = f(x_flat + t * d)
+                    n_eval += 1
+                    if new_loss <= loss + 1e-4 * t * gtd:
+                        break
+                    t *= 0.5
+            x_new = x_flat + t * d
+            loss_new, g_new = f(x_new)
+            n_eval += 1
+            s = x_new - x_flat
+            y = g_new - g
+            sy = float(jnp.dot(s, y))
+            if sy > 1e-10:
+                if len(s_list) >= self.n_correction:
+                    s_list.pop(0)
+                    y_list.pop(0)
+                    rho_list.pop(0)
+                s_list.append(s)
+                y_list.append(y)
+                rho_list.append(1.0 / sy)
+            if abs(loss_new - loss) < self.tol_fun:
+                x_flat, loss, g = x_new, loss_new, g_new
+                losses.append(loss)
+                break
+            x_flat, loss, g = x_new, loss_new, g_new
+            losses.append(loss)
+            if n_eval >= self.max_eval:
+                break
+        self.state["neval"] += 1
+        return unravel(x_flat), losses
+
+    def init_state(self, params):
+        return {}
+
+    def update(self, grads, params, opt_state, lr):
+        # plain gradient step when used inside a jitted loop
+        return _tmap(lambda w, g: w - lr * g, params, grads), opt_state

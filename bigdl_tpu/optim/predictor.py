@@ -1,0 +1,51 @@
+"""Predictor (parity: reference ``optim/Predictor.scala`` /
+``optim/LocalPredictor.scala`` / ``optim/PredictionService.scala``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dataset.dataset import AbstractDataSet, ShardedDataSet, DataSet
+from ..utils.table import Table
+
+
+class Predictor:
+    def __init__(self, model, batch_per_partition: int = 4):
+        self.model = model
+        self._fwd = None
+
+    def _forward_fn(self):
+        if self._fwd is None:
+            model = self.model
+
+            def fwd(params, state, x):
+                out, _ = model.apply(params, state, x, training=False)
+                return out
+            self._fwd = jax.jit(fwd)
+        return self._fwd
+
+    def _iter_outputs(self, dataset, batch_size):
+        if isinstance(dataset, np.ndarray):
+            dataset = DataSet.from_arrays(dataset)
+        self.model.ensure_initialized()
+        fwd = self._forward_fn()
+        batched = ShardedDataSet(dataset, batch_size, drop_last=False)
+        for mb in batched.data(train=False):
+            x = mb.get_input()
+            x = jax.tree_util.tree_map(jnp.asarray, x) \
+                if isinstance(x, Table) else jnp.asarray(x)
+            yield np.asarray(fwd(self.model.params, self.model.state, x))
+
+    def predict(self, dataset, batch_size: int = 32):
+        outs = list(self._iter_outputs(dataset, batch_size))
+        return np.concatenate(outs, axis=0)
+
+    def predict_class(self, dataset, batch_size: int = 32):
+        """1-based argmax class, parity with predictClass."""
+        return np.argmax(self.predict(dataset, batch_size), axis=-1) + 1
+
+
+class PredictionService(Predictor):
+    """Thread-safe serving facade (parity: optim/PredictionService.scala).
+    XLA compiled functions are thread-safe; this is a thin alias."""

@@ -1,0 +1,67 @@
+"""Regularizers (parity: reference ``optim/Regularizer.scala``).
+
+The reference adds the penalty gradient inside each layer's
+accGradParameters; here the penalty is added to the (differentiated) loss in
+the train step — same update, autodiff does the work.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def loss(self, w):
+        return 0.0
+
+
+class L1L2Regularizer(Regularizer):
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1, self.l2 = l1, l2
+
+    def loss(self, w):
+        out = 0.0
+        if self.l1:
+            out = out + self.l1 * jnp.sum(jnp.abs(w))
+        if self.l2:
+            out = out + 0.5 * self.l2 * jnp.sum(jnp.square(w))
+        return out
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1: float):
+        super().__init__(l1=l1, l2=0.0)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2: float):
+        super().__init__(l1=0.0, l2=l2)
+
+
+def regularizer_tree(module):
+    """Build a nested dict mirroring ``module``'s params containing
+    Regularizer objects (or missing keys where none)."""
+    from ..nn.module import Container
+    if isinstance(module, Container):
+        tree = {}
+        for i, child in enumerate(module.modules):
+            sub = regularizer_tree(child)
+            if sub:
+                tree[str(i)] = sub
+        return tree
+    if hasattr(module, "_regularizers"):
+        return {k: v for k, v in module._regularizers().items()
+                if v is not None}
+    return {}
+
+
+def regularization_loss(reg_tree, params):
+    """Sum penalty over params matching the regularizer tree."""
+    total = 0.0
+    for k, v in reg_tree.items():
+        if k not in params:
+            continue
+        if isinstance(v, dict):
+            total = total + regularization_loss(v, params[k])
+        else:
+            total = total + v.loss(params[k])
+    return total

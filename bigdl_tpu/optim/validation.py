@@ -1,0 +1,206 @@
+"""Validation methods and results.
+
+Parity: reference ``optim/ValidationMethod.scala`` (Top1Accuracy,
+Top5Accuracy, Loss, MAE, HitRatio, NDCG, TreeNNAccuracy) and
+``optim/EvaluateMethods.scala``. Results merge with ``+`` across batches
+(and across mesh shards in DistriValidator).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    def result(self):
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: int, count: int):
+        self.correct, self.count = int(correct), int(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct,
+                              self.count + other.count)
+
+    def __repr__(self):
+        acc, cnt = self.result()
+        return f"Accuracy(correct: {self.correct}, count: {cnt}, " \
+               f"accuracy: {acc})"
+
+    def __eq__(self, other):
+        return (self.correct, self.count) == (other.correct, other.count)
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: int):
+        self.loss, self.count = float(loss), int(count)
+
+    def result(self):
+        return (self.loss / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        return f"Loss(loss: {self.loss}, count: {self.count}, " \
+               f"average: {self.result()[0]})"
+
+
+class ContiguousResult(LossResult):
+    pass
+
+
+class ValidationMethod:
+    """Apply to (output, target) of one batch → ValidationResult."""
+
+    def __call__(self, output, target):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+def _to_class_pred(output):
+    out = np.asarray(output)
+    if out.ndim == 1:
+        return out  # already class scores? treat as binary
+    return np.argmax(out, axis=-1) + 1  # 1-based
+
+
+class Top1Accuracy(ValidationMethod):
+    """optim/ValidationMethod.scala:170."""
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1)
+        if out.ndim == 1 and t.size == 1:
+            out = out[None]
+        pred = np.argmax(out, axis=-1) + 1
+        correct = int(np.sum(pred == t.astype(np.int64)))
+        return AccuracyResult(correct, t.size)
+
+    def __repr__(self):
+        return "Top1Accuracy"
+
+
+class Top5Accuracy(ValidationMethod):
+    """optim/ValidationMethod.scala:224."""
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        if out.ndim == 1 and t.size == 1:
+            out = out[None]
+        top5 = np.argsort(-out, axis=-1)[:, :5] + 1
+        correct = int(np.sum(np.any(top5 == t[:, None], axis=-1)))
+        return AccuracyResult(correct, t.size)
+
+    def __repr__(self):
+        return "Top5Accuracy"
+
+
+class Loss(ValidationMethod):
+    """optim/ValidationMethod.scala:475 — average criterion loss."""
+
+    def __init__(self, criterion=None):
+        if criterion is None:
+            from ..nn.criterion import ClassNLLCriterion
+            criterion = ClassNLLCriterion()
+        self.criterion = criterion
+
+    def __call__(self, output, target):
+        l = float(self.criterion._forward(jnp.asarray(output),
+                                          jnp.asarray(target)))
+        n = np.asarray(output).shape[0]
+        return LossResult(l * n, n)
+
+    def __repr__(self):
+        return "Loss"
+
+
+class MAE(ValidationMethod):
+    """optim/ValidationMethod.scala:500 — mean absolute error."""
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        l = float(np.mean(np.abs(out - t)))
+        n = out.shape[0]
+        return LossResult(l * n, n)
+
+    def __repr__(self):
+        return "MAE"
+
+
+class HitRatio(ValidationMethod):
+    """optim/ValidationMethod.scala:279 — HR@k for recommendation: each row of
+    output scores 1 positive + negNum negatives; target marks the positive."""
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k, self.neg_num = k, neg_num
+
+    def __call__(self, output, target):
+        out = np.asarray(output).reshape(-1)
+        t = np.asarray(target).reshape(-1)
+        pos = out[t > 0.5]
+        hits = 0.0
+        count = 0
+        for p in np.atleast_1d(pos):
+            rank = int(np.sum(out > p)) + 1
+            hits += 1.0 if rank <= self.k else 0.0
+            count += 1
+        return AccuracyResult(int(hits), max(count, 1))
+
+    def __repr__(self):
+        return f"HitRate@{self.k}"
+
+
+class NDCG(ValidationMethod):
+    """optim/ValidationMethod.scala:346 — NDCG@k, same setup as HitRatio."""
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k, self.neg_num = k, neg_num
+
+    def __call__(self, output, target):
+        out = np.asarray(output).reshape(-1)
+        t = np.asarray(target).reshape(-1)
+        pos = out[t > 0.5]
+        total = 0.0
+        count = 0
+        for p in np.atleast_1d(pos):
+            rank = int(np.sum(out > p)) + 1
+            total += float(np.log(2) / np.log(rank + 1)) if rank <= self.k \
+                else 0.0
+            count += 1
+        r = LossResult(total, max(count, 1))
+        return r
+
+    def __repr__(self):
+        return f"NDCG@{self.k}"
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """optim/ValidationMethod.scala:118 — accuracy on the root (last)
+    prediction of a tree/sequence output."""
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        if out.ndim == 3:
+            out = out[:, 0, :]
+        t = np.asarray(target)
+        if t.ndim >= 2:
+            t = t[:, 0]
+        pred = np.argmax(out, axis=-1) + 1
+        correct = int(np.sum(pred == t.reshape(-1).astype(np.int64)))
+        return AccuracyResult(correct, t.size)
+
+    def __repr__(self):
+        return "TreeNNAccuracy"

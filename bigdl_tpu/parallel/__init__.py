@@ -1,0 +1,9 @@
+from .mesh import (make_mesh, data_parallel_mesh, get_default_mesh,
+                   set_default_mesh, axis_size)
+from .collective import (all_reduce_sum, all_reduce_mean, all_gather,
+                         reduce_scatter, ppermute_ring, all_to_all, psum,
+                         pmean)
+from .allreduce import AllReduceParameter, FP16CompressPolicy
+from .sharding import (replicated, data_sharding, shard_batch, shard_params,
+                       tp_linear_rules)
+from .ring_attention import ring_attention

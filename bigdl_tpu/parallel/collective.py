@@ -1,0 +1,49 @@
+"""Collective wrappers for use inside ``shard_map``-ped functions.
+
+Parity note: the reference implements gradient aggregation as a Spark shuffle
+to per-partition owners (``parameters/AllReduceParameter.scala:putGradients``)
+— a software parameter server. Here every collective is an XLA primitive that
+lowers to ICI hardware collectives; these wrappers only fix axis-name plumbing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis: str = "data"):
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str = "data"):
+    return lax.pmean(x, axis_name=axis)
+
+
+def all_reduce_sum(tree, axis: str = "data"):
+    return jax.tree_util.tree_map(lambda t: lax.psum(t, axis), tree)
+
+
+def all_reduce_mean(tree, axis: str = "data"):
+    return jax.tree_util.tree_map(lambda t: lax.pmean(t, axis), tree)
+
+
+def all_gather(x, axis: str = "data", tiled: bool = True):
+    return lax.all_gather(x, axis_name=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str = "data", scatter_dimension: int = 0):
+    return lax.psum_scatter(x, axis_name=axis,
+                            scatter_dimension=scatter_dimension, tiled=True)
+
+
+def ppermute_ring(x, axis: str = "data", shift: int = 1):
+    """Rotate shards around the ring (basis of ring attention)."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def all_to_all(x, axis: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis_name=axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)

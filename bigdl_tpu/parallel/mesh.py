@@ -1,0 +1,58 @@
+"""Device-mesh management.
+
+This package replaces the reference's distributed substrate (Spark executors +
+block-manager parameter service, ``parameters/AllReduceParameter.scala`` and
+``utils/Engine.scala`` cluster config) with jax.sharding over an explicit
+``Mesh``. Axes:
+
+* ``data`` — data parallelism (the reference's only mode)
+* ``model`` — tensor parallelism (new capability, rides ICI)
+* ``seq``  — sequence/context parallelism (ring attention)
+
+Multi-host: call ``jax.distributed.initialize()`` before ``make_mesh`` and the
+same code spans hosts — collectives ride ICI within a pod slice and DCN
+across, scheduled by XLA, which is the TPU-native analog of the reference's
+NCCL/MPI-free Spark shuffle aggregation.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(shape: Sequence[int], axes: Sequence[str],
+              devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axes))
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n or len(devs)
+    return make_mesh((n,), ("data",), devs)
+
+
+def set_default_mesh(mesh: Mesh):
+    global _default_mesh
+    _default_mesh = mesh
+    return mesh
+
+
+def get_default_mesh() -> Mesh:
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = data_parallel_mesh()
+    return _default_mesh
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]

@@ -1,0 +1,53 @@
+"""Sharding helpers: batch/param placement and tensor-parallel rules.
+
+The reference has no tensor parallelism (its only mode is data parallel over
+Spark partitions); TP here is a new TPU-native capability expressed entirely
+through PartitionSpecs — XLA inserts the collectives.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh, axis: str = "data"):
+    """Shard the leading (batch) dim."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Device-put a host batch with the leading dim split over ``axis``."""
+    sh = data_sharding(mesh, axis)
+
+    def put(x):
+        if x is None:
+            return None
+        return jax.device_put(np.asarray(x), sh)
+    return jax.tree_util.tree_map(put, batch)
+
+
+def shard_params(params, mesh: Mesh):
+    """Replicate params across the mesh."""
+    sh = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), params)
+
+
+def tp_linear_rules(axis: str = "model"):
+    """PartitionSpecs for a column→row parallel Linear pair (Megatron-style):
+    first Linear's (out, in) weight column-sharded, second row-sharded;
+    activations stay sharded on the hidden dim between them, one psum at the
+    end — XLA derives this from the specs."""
+    return {
+        "column": {"weight": P(axis, None), "bias": P(axis)},
+        "row": {"weight": P(None, axis), "bias": P()},
+    }
+
+
+def constraint(x, mesh: Mesh, spec: P):
+    """jax.lax.with_sharding_constraint wrapper."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -1,0 +1,3 @@
+from .table import Table, T
+from .shape import Shape, SingleShape, MultiShape
+from . import engine

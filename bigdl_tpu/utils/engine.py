@@ -1,0 +1,118 @@
+"""Runtime engine: device discovery, mesh construction, seeds.
+
+Parity: reference ``utils/Engine.scala`` — there it configures Spark executor
+cores/nodes and the MKL thread pools. On TPU the analog is device/mesh
+management: how many chips, what logical mesh axes (data/model/seq), and the
+host-side PRNG. XLA owns intra-chip parallelism, so there is no thread-pool
+knob to tune; ``Engine.init`` instead fixes the mesh every distributed
+component uses.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu")
+
+_state = {
+    "initialized": False,
+    "mesh": None,
+    "seed": None,
+    "rng_key": None,
+    "node_number": 1,
+    "core_number": 1,
+    "engine_type": "xla",
+}
+
+
+def init(node_number: int = 1,
+         core_number: Optional[int] = None,
+         mesh_shape: Optional[Sequence[int]] = None,
+         mesh_axes: Sequence[str] = ("data",),
+         seed: int = 42,
+         devices=None):
+    """Initialise the engine (parity: Engine.init, utils/Engine.scala:106).
+
+    ``mesh_shape``/``mesh_axes`` define the logical device mesh. Default is a
+    1-D ``data`` mesh over every visible device. Multi-host initialisation
+    (jax.distributed) must happen before calling this.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if core_number is None:
+        core_number = len(devices)
+    if mesh_shape is None:
+        mesh_shape = (len(devices),)
+    dev_arr = np.array(devices[: int(np.prod(mesh_shape))]).reshape(mesh_shape)
+    mesh = jax.sharding.Mesh(dev_arr, tuple(mesh_axes))
+    _state.update(initialized=True, mesh=mesh, seed=seed,
+                  rng_key=jax.random.PRNGKey(seed),
+                  node_number=node_number, core_number=core_number)
+    return mesh
+
+
+def is_initialized() -> bool:
+    return _state["initialized"]
+
+
+def get_mesh() -> jax.sharding.Mesh:
+    if _state["mesh"] is None:
+        init()
+    return _state["mesh"]
+
+
+def set_seed(seed: int):
+    _state["seed"] = seed
+    _state["rng_key"] = jax.random.PRNGKey(seed)
+
+
+def get_seed():
+    return _state["seed"]
+
+
+def next_rng_key():
+    """Split and return a fresh PRNG key from the global stream."""
+    if _state["rng_key"] is None:
+        set_seed(42 if _state["seed"] is None else _state["seed"])
+    _state["rng_key"], sub = jax.random.split(_state["rng_key"])
+    return sub
+
+
+def node_number() -> int:
+    return _state["node_number"]
+
+
+def core_number() -> int:
+    return _state["core_number"]
+
+
+def engine_type() -> str:
+    return _state["engine_type"]
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def default_dtype():
+    return np.float32
+
+
+class RandomGenerator:
+    """Parity: utils/RandomGenerator.scala — thin facade over the engine PRNG."""
+
+    @staticmethod
+    def set_seed(seed):
+        set_seed(seed)
+        np.random.seed(seed & 0x7FFFFFFF)
+
+    @staticmethod
+    def uniform(lo, hi, shape=()):
+        return jax.random.uniform(next_rng_key(), shape, minval=lo, maxval=hi)
+
+    @staticmethod
+    def normal(mean, std, shape=()):
+        return mean + std * jax.random.normal(next_rng_key(), shape)

@@ -1,0 +1,76 @@
+"""Torch-style heterogeneous activity container.
+
+Parity: reference ``utils/Table.scala`` — a 1-indexed map used wherever a module
+consumes/produces multiple activities. Here a ``Table`` is a thin list-like pytree
+node so it can flow through ``jax.jit``/``jax.vjp`` unchanged.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Table:
+    """1-indexed heterogeneous container (reference utils/Table.scala:37)."""
+
+    def __init__(self, *items):
+        if len(items) == 1 and isinstance(items[0], (list, tuple)):
+            items = tuple(items[0])
+        self._items = list(items)
+
+    # -- torch-style 1-indexed access ------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            if key < 1:
+                raise IndexError("Table is 1-indexed (torch convention)")
+            return self._items[key - 1]
+        raise TypeError(f"Table index must be int, got {type(key)}")
+
+    def __setitem__(self, key, value):
+        if key < 1:
+            raise IndexError("Table is 1-indexed")
+        while len(self._items) < key:
+            self._items.append(None)
+        self._items[key - 1] = value
+
+    def insert(self, value):
+        self._items.append(value)
+        return self
+
+    def length(self):
+        return len(self._items)
+
+    def __len__(self):
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def to_list(self):
+        return list(self._items)
+
+    def __repr__(self):
+        return "Table{" + ", ".join(repr(i) for i in self._items) + "}"
+
+    def __eq__(self, other):
+        if isinstance(other, Table):
+            return self._items == other._items
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+
+def _table_flatten(t: Table):
+    return t._items, None
+
+
+def _table_unflatten(aux, items):
+    return Table(*items)
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)
+
+
+def T(*items):
+    """Shorthand constructor, parity with reference ``T(...)``."""
+    return Table(*items)

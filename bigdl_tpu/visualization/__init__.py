@@ -1,0 +1,1 @@
+from .summary import TrainSummary, ValidationSummary, Summary

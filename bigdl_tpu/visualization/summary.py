@@ -1,0 +1,51 @@
+"""Training summaries.
+
+Parity: reference ``visualization/TrainSummary.scala`` /
+``visualization/ValidationSummary.scala`` — scalar (and histogram) logging to
+TensorBoard event files, plus in-memory readback (``read_scalar``) used by
+tests and notebooks.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Tuple
+
+from .event_writer import EventWriter
+
+
+class Summary:
+    def __init__(self, log_dir: str, app_name: str, sub_dir: str):
+        self.log_dir = os.path.join(log_dir, app_name, sub_dir)
+        self.writer = EventWriter(self.log_dir)
+        self._scalars: Dict[str, List[Tuple[int, float]]] = {}
+        self._triggers = {}
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._scalars.setdefault(tag, []).append((step, float(value)))
+        self.writer.add_scalar(tag, value, step)
+        return self
+
+    def add_histogram(self, tag: str, values, step: int):
+        self.writer.add_histogram(tag, values, step)
+        return self
+
+    def read_scalar(self, tag: str):
+        """Return [(step, value), ...] (parity: Summary.readScalar)."""
+        return list(self._scalars.get(tag, []))
+
+    def set_summary_trigger(self, name: str, trigger):
+        self._triggers[name] = trigger
+        return self
+
+    def close(self):
+        self.writer.close()
+
+
+class TrainSummary(Summary):
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "train")
+
+
+class ValidationSummary(Summary):
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "validation")

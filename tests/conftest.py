@@ -1,0 +1,29 @@
+"""Test config: force CPU backend with 8 virtual devices so distributed
+(mesh/shard_map) paths are exercised without TPU hardware.
+
+Note: the axon sitecustomize pins jax_platforms to the TPU backend at
+interpreter start, so the env var alone is not enough — we must override via
+jax.config before any backend is initialised.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        _flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests must run on CPU"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    from bigdl_tpu.utils import engine
+    engine.set_seed(42)
+    yield

@@ -1,0 +1,398 @@
+"""Per-layer forward value/shape tests + gradient checks (modeled on the
+reference's per-layer spec files in spark/dl/src/test)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import torch
+import torch.nn.functional as F
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import Table
+from utils import check_gradient, allclose
+
+
+def test_linear_matches_torch():
+    m = nn.Linear(5, 3)
+    m.ensure_initialized()
+    x = np.random.randn(4, 5).astype(np.float32)
+    out = m.forward(x)
+    w = np.asarray(m.params["weight"])
+    b = np.asarray(m.params["bias"])
+    ref = torch.nn.functional.linear(torch.tensor(x), torch.tensor(w),
+                                     torch.tensor(b)).numpy()
+    assert allclose(out, ref)
+
+
+def test_linear_gradcheck():
+    check_gradient(nn.Linear(6, 4), np.random.randn(3, 6))
+
+
+def test_spatial_convolution_matches_torch():
+    m = nn.SpatialConvolution(3, 8, 3, 3, 2, 2, 1, 1)
+    m.ensure_initialized()
+    x = np.random.randn(2, 3, 9, 9).astype(np.float32)
+    out = m.forward(x)
+    w = torch.tensor(np.asarray(m.params["weight"]))
+    b = torch.tensor(np.asarray(m.params["bias"]))
+    ref = F.conv2d(torch.tensor(x), w, b, stride=2, padding=1).numpy()
+    assert allclose(out, ref, tol=1e-4)
+    assert out.shape == ref.shape
+
+
+def test_conv_grouped():
+    m = nn.SpatialConvolution(4, 8, 3, 3, n_group=2)
+    m.ensure_initialized()
+    x = np.random.randn(2, 4, 8, 8).astype(np.float32)
+    out = m.forward(x)
+    w = torch.tensor(np.asarray(m.params["weight"]))
+    b = torch.tensor(np.asarray(m.params["bias"]))
+    ref = F.conv2d(torch.tensor(x), w, b, groups=2).numpy()
+    assert allclose(out, ref, tol=1e-4)
+
+
+def test_dilated_conv_matches_torch():
+    m = nn.SpatialDilatedConvolution(2, 4, 3, 3, dilation_w=2, dilation_h=2)
+    m.ensure_initialized()
+    x = np.random.randn(1, 2, 10, 10).astype(np.float32)
+    out = m.forward(x)
+    ref = F.conv2d(torch.tensor(x),
+                   torch.tensor(np.asarray(m.params["weight"])),
+                   torch.tensor(np.asarray(m.params["bias"])),
+                   dilation=2).numpy()
+    assert allclose(out, ref, tol=1e-4)
+
+
+def test_full_convolution_matches_torch():
+    m = nn.SpatialFullConvolution(3, 5, 3, 3, 2, 2, 1, 1, adj_w=1, adj_h=1)
+    m.ensure_initialized()
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    out = m.forward(x)
+    w = torch.tensor(np.asarray(m.params["weight"]))
+    b = torch.tensor(np.asarray(m.params["bias"]))
+    ref = F.conv_transpose2d(torch.tensor(x), w, b, stride=2, padding=1,
+                             output_padding=1).numpy()
+    assert allclose(out, ref, tol=1e-4)
+
+
+def test_volumetric_conv_matches_torch():
+    m = nn.VolumetricConvolution(2, 4, 3, 3, 3, 1, 1, 1, 1, 1, 1)
+    m.ensure_initialized()
+    x = np.random.randn(1, 2, 6, 6, 6).astype(np.float32)
+    out = m.forward(x)
+    ref = F.conv3d(torch.tensor(x),
+                   torch.tensor(np.asarray(m.params["weight"])),
+                   torch.tensor(np.asarray(m.params["bias"])),
+                   padding=1).numpy()
+    assert allclose(out, ref, tol=1e-4)
+
+
+def test_maxpool_matches_torch():
+    m = nn.SpatialMaxPooling(2, 2, 2, 2)
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    assert allclose(m.forward(x),
+                    F.max_pool2d(torch.tensor(x), 2).numpy())
+
+
+def test_maxpool_ceil():
+    m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+    x = np.random.randn(2, 3, 7, 7).astype(np.float32)
+    ref = F.max_pool2d(torch.tensor(x), 3, 2, ceil_mode=True).numpy()
+    assert allclose(m.forward(x), ref)
+
+
+def test_avgpool_matches_torch():
+    m = nn.SpatialAveragePooling(2, 2, 2, 2)
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    assert allclose(m.forward(x), F.avg_pool2d(torch.tensor(x), 2).numpy())
+
+
+def test_batchnorm_train_and_eval():
+    m = nn.SpatialBatchNormalization(4)
+    x = np.random.randn(8, 4, 5, 5).astype(np.float32) * 3 + 1
+    m.training()
+    out = m.forward(x)
+    assert abs(float(np.mean(np.asarray(out)))) < 1e-4
+    assert abs(float(np.std(np.asarray(out))) - 1.0) < 1e-2
+    # running stats moved toward batch stats
+    rm = np.asarray(m.state["running_mean"])
+    assert np.all(np.abs(rm) > 0)
+    m.evaluate()
+    out_eval = m.forward(x)
+    assert out_eval.shape == x.shape
+
+
+def test_batchnorm_matches_torch_eval():
+    m = nn.BatchNormalization(6)
+    m.ensure_initialized()
+    m.evaluate()
+    x = np.random.randn(4, 6).astype(np.float32)
+    out = m.forward(x)
+    tb = torch.nn.BatchNorm1d(6).eval()
+    with torch.no_grad():
+        tb.weight.copy_(torch.tensor(np.asarray(m.params["weight"])))
+        tb.bias.copy_(torch.tensor(np.asarray(m.params["bias"])))
+    ref = tb(torch.tensor(x)).detach().numpy()
+    assert allclose(out, ref, tol=1e-4)
+
+
+def test_layernorm_matches_torch():
+    m = nn.LayerNormalization(8)
+    m.ensure_initialized()
+    x = np.random.randn(2, 5, 8).astype(np.float32)
+    out = m.forward(x)
+    ref = F.layer_norm(torch.tensor(x), (8,),
+                       torch.tensor(np.asarray(m.params["weight"])),
+                       torch.tensor(np.asarray(m.params["bias"])),
+                       eps=1e-6).numpy()
+    assert allclose(out, ref, tol=1e-4)
+
+
+def test_lrn_matches_torch():
+    m = nn.SpatialCrossMapLRN(5, 0.0001, 0.75, 1.0)
+    x = np.abs(np.random.randn(2, 7, 4, 4)).astype(np.float32)
+    ref = torch.nn.LocalResponseNorm(5, 0.0001, 0.75, 1.0)(
+        torch.tensor(x)).numpy()
+    assert allclose(m.forward(x), ref, tol=1e-4)
+
+
+@pytest.mark.parametrize("cls,tfn", [
+    (nn.ReLU, F.relu), (nn.Tanh, torch.tanh), (nn.Sigmoid, torch.sigmoid),
+    (nn.ELU, F.elu), (nn.SoftPlus, F.softplus), (nn.SoftSign, F.softsign),
+    (nn.LogSigmoid, F.logsigmoid), (nn.ReLU6, F.relu6),
+])
+def test_activations_match_torch(cls, tfn):
+    x = np.random.randn(4, 7).astype(np.float32)
+    out = cls().forward(x)
+    ref = tfn(torch.tensor(x)).numpy()
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_hard_sigmoid_reference_formula():
+    # BigDL HardSigmoid is clip(0.2x + 0.5, 0, 1) (keras convention),
+    # NOT torch's clip(x/6 + 0.5, 0, 1).
+    x = np.random.randn(4, 7).astype(np.float32)
+    out = nn.HardSigmoid().forward(x)
+    assert allclose(out, np.clip(0.2 * x + 0.5, 0, 1))
+
+
+def test_softmax_logsoftmax():
+    x = np.random.randn(3, 5).astype(np.float32)
+    assert allclose(nn.SoftMax().forward(x),
+                    F.softmax(torch.tensor(x), dim=1).numpy())
+    assert allclose(nn.LogSoftMax().forward(x),
+                    F.log_softmax(torch.tensor(x), dim=1).numpy())
+
+
+def test_prelu_gradcheck():
+    check_gradient(nn.PReLU(3), np.random.randn(2, 3, 4, 4))
+
+
+def test_dropout_train_eval():
+    m = nn.Dropout(0.5)
+    x = np.ones((100, 100), np.float32)
+    m.training()
+    out = np.asarray(m.forward(x))
+    frac = np.mean(out == 0)
+    assert 0.3 < frac < 0.7
+    kept = out[out != 0]
+    assert np.allclose(kept, 2.0)
+    m.evaluate()
+    assert allclose(m.forward(x), x)
+
+
+def test_lookup_table():
+    m = nn.LookupTable(10, 4)
+    m.ensure_initialized()
+    ids = np.array([[1, 2, 10]], np.float32)
+    out = m.forward(ids)
+    assert out.shape == (1, 3, 4)
+    w = np.asarray(m.params["weight"])
+    assert allclose(out[0, 0], w[0])
+    assert allclose(out[0, 2], w[9])
+
+
+def test_embedding_gradcheck_like_sum():
+    m = nn.CMul([4])
+    check_gradient(m, np.random.randn(3, 4))
+
+
+def test_reshape_view_squeeze():
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    assert nn.Reshape([12]).forward(x).shape == (2, 12)
+    assert nn.View(12).forward(x).shape == (2, 12)
+    assert nn.Squeeze(2).forward(np.zeros((3, 1, 4))).shape == (3, 4)
+    assert nn.Unsqueeze(2).forward(np.zeros((3, 4))).shape == (3, 1, 4)
+    assert nn.Transpose([(1, 2)]).forward(x).shape == (3, 2, 4)
+
+
+def test_narrow_select_index():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    out = nn.Narrow(2, 2, 2).forward(x)
+    assert out.shape == (2, 2, 4)
+    assert allclose(out, x[:, 1:3])
+    out = nn.Select(1, 2).forward(x)
+    assert allclose(out, x[1])
+    out = nn.Select(1, -1).forward(x)
+    assert allclose(out, x[1])
+
+
+def test_padding_zeropad():
+    x = np.ones((2, 2), np.float32)
+    out = nn.Padding(2, 2, 2, value=7.0).forward(x)
+    assert out.shape == (2, 4)
+    assert np.all(np.asarray(out)[:, 2:] == 7.0)
+    x4 = np.ones((1, 1, 3, 3), np.float32)
+    out = nn.SpatialZeroPadding(1, 1, 1, 1).forward(x4)
+    assert out.shape == (1, 1, 5, 5)
+    out = nn.SpatialZeroPadding(-1, -1, -1, -1).forward(x4)
+    assert out.shape == (1, 1, 1, 1)
+
+
+def test_table_ops():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(3, 4).astype(np.float32)
+    t = Table(a, b)
+    assert allclose(nn.CAddTable().forward(t), a + b)
+    assert allclose(nn.CSubTable().forward(t), a - b)
+    assert allclose(nn.CMulTable().forward(t), a * b)
+    assert allclose(nn.CMaxTable().forward(t), np.maximum(a, b))
+    assert allclose(nn.JoinTable(2).forward(t), np.concatenate([a, b], 1))
+    assert allclose(nn.DotProduct().forward(t), np.sum(a * b, -1))
+    parts = nn.SplitTable(2).forward(a)
+    assert len(parts) == 4
+    assert allclose(parts[1], a[:, 0])
+    assert allclose(nn.SelectTable(2).forward(t), b)
+
+
+def test_mm_mv():
+    a = np.random.randn(2, 3, 4).astype(np.float32)
+    b = np.random.randn(2, 4, 5).astype(np.float32)
+    assert allclose(nn.MM().forward(Table(a, b)), a @ b)
+    v = np.random.randn(2, 5).astype(np.float32)
+    assert allclose(nn.MV().forward(Table(b, v)),
+                    np.einsum("bij,bj->bi", b, v))
+
+
+def test_containers():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = np.random.randn(3, 4).astype(np.float32)
+    out = seq.forward(x)
+    assert out.shape == (3, 2)
+    check_gradient(seq, x)
+
+    ct = nn.ConcatTable(nn.Linear(4, 2), nn.Identity())
+    out = ct.forward(x)
+    assert isinstance(out, Table) and len(out) == 2
+
+    cc = nn.Concat(2, nn.Linear(4, 2), nn.Linear(4, 3))
+    assert cc.forward(x).shape == (3, 5)
+
+    pt = nn.ParallelTable(nn.Linear(4, 2), nn.ReLU())
+    out = pt.forward(Table(x, x))
+    assert out[1].shape == (3, 2) and out[2].shape == (3, 4)
+
+
+def test_graph():
+    inp = nn.Input()
+    h = nn.Linear(4, 8)(inp)
+    a = nn.ReLU()(h)
+    b = nn.Tanh()(h)
+    merged = nn.CAddTable()(a, b)
+    out = nn.Linear(8, 2)(merged)
+    g = nn.Graph(inp, out)
+    x = np.random.randn(5, 4).astype(np.float32)
+    y = g.forward(x)
+    assert y.shape == (5, 2)
+    check_gradient(g, x)
+
+
+def test_bottle():
+    m = nn.Bottle(nn.Linear(4, 3))
+    x = np.random.randn(2, 5, 4).astype(np.float32)
+    assert m.forward(x).shape == (2, 5, 3)
+
+
+def test_highway_maxout():
+    x = np.random.randn(3, 6).astype(np.float32)
+    assert nn.Highway(6).forward(x).shape == (3, 6)
+    assert nn.Maxout(6, 4, 3).forward(x).shape == (3, 4)
+
+
+def test_upsampling_resize():
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    assert nn.UpSampling2D((2, 2)).forward(x).shape == (1, 2, 8, 8)
+    out = nn.ResizeBilinear(8, 8).forward(x)
+    ref = F.interpolate(torch.tensor(x), size=(8, 8), mode="bilinear",
+                        align_corners=False).numpy()
+    assert allclose(out, ref, tol=1e-4)
+
+
+def test_resize_align_corners():
+    x = np.random.randn(1, 1, 4, 4).astype(np.float32)
+    out = nn.ResizeBilinear(7, 7, align_corners=True).forward(x)
+    ref = F.interpolate(torch.tensor(x), size=(7, 7), mode="bilinear",
+                        align_corners=True).numpy()
+    assert allclose(out, ref, tol=1e-4)
+
+
+def test_normalize():
+    x = np.random.randn(4, 6).astype(np.float32)
+    out = np.asarray(nn.Normalize(2).forward(x))
+    norms = np.linalg.norm(out, axis=1)
+    assert np.allclose(norms, 1.0, atol=1e-4)
+
+
+def test_temporal_conv_matches_torch():
+    m = nn.TemporalConvolution(6, 8, 3, 1)
+    m.ensure_initialized()
+    x = np.random.randn(2, 10, 6).astype(np.float32)
+    out = m.forward(x)
+    w = np.asarray(m.params["weight"])  # (out, in, k)
+    ref = F.conv1d(torch.tensor(x).transpose(1, 2), torch.tensor(w),
+                   torch.tensor(np.asarray(m.params["bias"]))
+                   ).transpose(1, 2).numpy()
+    assert allclose(out, ref, tol=1e-4)
+
+
+def test_locally_connected_2d():
+    m = nn.LocallyConnected2D(2, 6, 6, 3, 3, 3)
+    x = np.random.randn(2, 2, 6, 6).astype(np.float32)
+    out = m.forward(x)
+    assert out.shape == (2, 3, 4, 4)
+    check_gradient(m, x, tol=5e-2)
+
+
+def test_separable_conv():
+    m = nn.SpatialSeparableConvolution(3, 6, 2, 3, 3)
+    x = np.random.randn(1, 3, 8, 8).astype(np.float32)
+    assert m.forward(x).shape == (1, 6, 6, 6)
+
+
+def test_conv_map():
+    tbl = nn.SpatialConvolutionMap.one_to_one(3)
+    m = nn.SpatialConvolutionMap(tbl, 3, 3)
+    x = np.random.randn(1, 3, 6, 6).astype(np.float32)
+    assert m.forward(x).shape == (1, 3, 4, 4)
+
+
+def test_gradient_reversal():
+    m = nn.GradientReversal(0.5)
+    x = np.random.randn(3, 4).astype(np.float32)
+    assert allclose(m.forward(x), x)
+    g = m.backward(x, np.ones((3, 4), np.float32))
+    assert allclose(g, -0.5 * np.ones((3, 4)))
+
+
+def test_srelu_forward():
+    m = nn.SReLU((4,))
+    x = np.random.randn(3, 4).astype(np.float32)
+    assert m.forward(x).shape == (3, 4)
+
+
+def test_masking():
+    m = nn.Masking(0.0)
+    x = np.array([[[1, 2], [0, 0], [3, 0]]], np.float32)
+    out = np.asarray(m.forward(x))
+    assert np.all(out[0, 1] == 0)
+    assert np.all(out[0, 0] == [1, 2])
+    assert np.all(out[0, 2] == [3, 0])
