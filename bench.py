@@ -1,0 +1,87 @@
+"""Headline benchmark: ResNet-50 ImageNet-shape sync-SGD images/sec/chip.
+
+Matches BASELINE.json: "images/sec/chip ResNet-50 sync-SGD". The fixed
+baseline constant is the reference's MKL-DNN Xeon-node throughput estimate
+(~60 img/s fp32 per node for ResNet-50 training, the deployment the reference
+README benchmarks against); ``vs_baseline`` = our images/sec/chip ÷ 60.
+
+Prints exactly ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC = 60.0  # MKL-DNN Xeon node, ResNet-50 train (SURVEY §6)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models import ResNet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.utils import engine
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    batch = 256 if on_tpu else 4
+    steps = 20 if on_tpu else 2
+    warmup = 3 if on_tpu else 1
+    # f32 params: on TPU, XLA's default matmul/conv precision already runs
+    # the MXU in bf16 multiply + f32 accumulate, so f32 storage costs only
+    # HBM bandwidth, not FLOPs.
+    dtype = jnp.float32
+
+    engine.set_seed(0)
+    model = ResNet(class_num=1000, depth=50)
+    params, mstate = model.init(jax.random.PRNGKey(0))
+    crit = CrossEntropyCriterion()
+    optim = SGD(learningrate=0.1, momentum=0.9)
+    opt_state = optim.init_state(params)
+
+    size = 224 if on_tpu else 64
+    rng = np.random.RandomState(0)
+    x_host = rng.randn(batch, 3, size, size).astype(np.float32)
+    y_host = rng.randint(1, 1001, size=(batch,)).astype(np.int32)
+    x = jnp.asarray(x_host, dtype)
+    y = jnp.asarray(y_host)
+
+    def train_step(params, opt_state, mstate, x, y, lr):
+        def loss_fn(p):
+            out, new_state = model.apply(p, mstate, x, training=True,
+                                         rng=jax.random.PRNGKey(0))
+            return crit._forward(out.astype(jnp.float32), y), new_state
+        (loss, new_mstate), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt = optim.update(grads, params, opt_state, lr)
+        return loss, new_params, new_opt, new_mstate
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    lr = jnp.float32(0.1)
+    for _ in range(warmup):
+        loss, params, opt_state, mstate = step(params, opt_state, mstate,
+                                               x, y, lr)
+    float(loss)  # full sync (block_until_ready is unreliable over the tunnel)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt_state, mstate = step(params, opt_state, mstate,
+                                               x, y, lr)
+    final_loss = float(loss)  # forces the whole chained step sequence
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+    img_per_sec = batch * steps / dt
+
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

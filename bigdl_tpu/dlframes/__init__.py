@@ -1,0 +1,1 @@
+from .dl_estimator import DLEstimator, DLModel, DLClassifier, DLClassifierModel

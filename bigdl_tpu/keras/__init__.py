@@ -1,0 +1,13 @@
+from .topology import Sequential, Model, Input, KerasLayer, KerasNode
+from .layers import (Dense, Activation, Dropout, Flatten, Reshape, Permute,
+                     RepeatVector, Convolution2D, Convolution1D, MaxPooling2D,
+                     AveragePooling2D, GlobalAveragePooling2D,
+                     GlobalMaxPooling2D, MaxPooling1D, GlobalAveragePooling1D,
+                     ZeroPadding2D, UpSampling2D, Cropping2D,
+                     BatchNormalization, Embedding, LSTM, GRU, SimpleRNN,
+                     Bidirectional, TimeDistributed, Merge, Highway,
+                     LeakyReLU, ELU, ThresholdedReLU, GaussianNoise,
+                     GaussianDropout, SpatialDropout2D, Masking)
+
+Conv2D = Convolution2D
+Conv1D = Convolution1D

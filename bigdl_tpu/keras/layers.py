@@ -1,0 +1,537 @@
+"""Keras layer set (parity: reference ``nn/keras/*.scala``; the long tail
+beyond this core set is tracked in SURVEY §2.8 for r2).
+
+Image layout: channels-first (reference default dim ordering 'th')."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import nn as N
+from .topology import KerasLayer
+
+_ACTIVATIONS = {
+    "relu": N.ReLU, "tanh": N.Tanh, "sigmoid": N.Sigmoid,
+    "softmax": N.SoftMax, "log_softmax": N.LogSoftMax, "linear": N.Identity,
+    "softplus": N.SoftPlus, "softsign": N.SoftSign,
+    "hard_sigmoid": N.HardSigmoid, "elu": N.ELU, "relu6": N.ReLU6,
+    "gelu": N.GELU,
+}
+
+
+def _activation(name):
+    if name is None or name == "linear":
+        return None
+    if callable(name):
+        return name
+    return _ACTIVATIONS[name]()
+
+
+class Dense(KerasLayer):
+    """nn/keras/Dense.scala."""
+
+    def __init__(self, output_dim: int, activation=None, with_bias=True,
+                 w_regularizer=None, b_regularizer=None, input_shape=None,
+                 input_dim=None, name=None):
+        if input_dim is not None:
+            input_shape = (input_dim,)
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.with_bias = with_bias
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+
+    def compute_output_shape(self, s):
+        return tuple(s[:-1]) + (self.output_dim,)
+
+    def build(self, s):
+        lin = N.Linear(s[-1], self.output_dim, self.with_bias,
+                       self.w_regularizer, self.b_regularizer)
+        if len(s) > 1:
+            lin = N.Bottle(lin, n_input_dim=2)
+        act = _activation(self.activation)
+        return N.Sequential(lin, act) if act else lin
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.activation = activation
+
+    def build(self, s):
+        return _activation(self.activation) or N.Identity()
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p: float, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def build(self, s):
+        return N.Dropout(self.p)
+
+
+class Flatten(KerasLayer):
+    def compute_output_shape(self, s):
+        return (int(np.prod(s)),)
+
+    def build(self, s):
+        return N.Reshape([int(np.prod(s))], batch_mode=True)
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.target_shape = tuple(target_shape)
+
+    def compute_output_shape(self, s):
+        if -1 in self.target_shape:
+            known = -int(np.prod(self.target_shape))
+            total = int(np.prod(s))
+            return tuple(total // known if d == -1 else d
+                         for d in self.target_shape)
+        return self.target_shape
+
+    def build(self, s):
+        return N.Reshape(list(self.compute_output_shape(s)), batch_mode=True)
+
+
+class Permute(KerasLayer):
+    def __init__(self, dims, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.dims = tuple(dims)  # 1-based over non-batch dims
+
+    def compute_output_shape(self, s):
+        return tuple(s[d - 1] for d in self.dims)
+
+    def build(self, s):
+        # express permutation as swaps (reference KerasLayer does the same)
+        perm = [d for d in self.dims]
+        swaps = []
+        cur = list(range(1, len(s) + 1))
+        for i, want in enumerate(perm):
+            j = cur.index(want)
+            if j != i:
+                cur[i], cur[j] = cur[j], cur[i]
+                swaps.append((i + 2, j + 2))  # +1 batch, +1 1-based
+        return N.Transpose(swaps) if swaps else N.Identity()
+
+
+class RepeatVector(KerasLayer):
+    def __init__(self, n: int, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.n = n
+
+    def compute_output_shape(self, s):
+        return (self.n,) + tuple(s)
+
+    def build(self, s):
+        return N.Replicate(self.n, dim=2)
+
+
+class Convolution2D(KerasLayer):
+    """nn/keras/Convolution2D.scala (channels-first)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, border_mode: str = "valid",
+                 subsample=(1, 1), dim_ordering="th", w_regularizer=None,
+                 b_regularizer=None, bias=True, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter, self.nb_row, self.nb_col = nb_filter, nb_row, nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = tuple(subsample)
+        self.bias = bias
+        self.w_regularizer, self.b_regularizer = w_regularizer, b_regularizer
+
+    def _pads(self):
+        if self.border_mode == "same":
+            return -1, -1
+        return 0, 0
+
+    def compute_output_shape(self, s):
+        c, h, w = s
+        pw, ph = self._pads()
+        if self.border_mode == "same":
+            oh = int(np.ceil(h / self.subsample[0]))
+            ow = int(np.ceil(w / self.subsample[1]))
+        else:
+            oh = (h - self.nb_row) // self.subsample[0] + 1
+            ow = (w - self.nb_col) // self.subsample[1] + 1
+        return (self.nb_filter, oh, ow)
+
+    def build(self, s):
+        pw, ph = self._pads()
+        conv = N.SpatialConvolution(
+            s[0], self.nb_filter, self.nb_col, self.nb_row,
+            self.subsample[1], self.subsample[0], pw, ph,
+            with_bias=self.bias, w_regularizer=self.w_regularizer,
+            b_regularizer=self.b_regularizer)
+        act = _activation(self.activation)
+        return N.Sequential(conv, act) if act else conv
+
+
+class Convolution1D(KerasLayer):
+    """nn/keras/Convolution1D.scala — input (T, C)."""
+
+    def __init__(self, nb_filter: int, filter_length: int, activation=None,
+                 border_mode="valid", subsample_length: int = 1,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.nb_filter, self.filter_length = nb_filter, filter_length
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample_length = subsample_length
+
+    def compute_output_shape(self, s):
+        t, c = s
+        if self.border_mode == "same":
+            ot = int(np.ceil(t / self.subsample_length))
+        else:
+            ot = (t - self.filter_length) // self.subsample_length + 1
+        return (ot, self.nb_filter)
+
+    def build(self, s):
+        conv = N.TemporalConvolution(s[-1], self.nb_filter,
+                                     self.filter_length,
+                                     self.subsample_length)
+        act = _activation(self.activation)
+        return N.Sequential(conv, act) if act else conv
+
+
+class _Pool2D(KerasLayer):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 dim_ordering="th", input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.pool_size = tuple(pool_size)
+        self.strides = tuple(strides) if strides else self.pool_size
+        self.border_mode = border_mode
+
+    def compute_output_shape(self, s):
+        c, h, w = s
+        if self.border_mode == "same":
+            return (c, int(np.ceil(h / self.strides[0])),
+                    int(np.ceil(w / self.strides[1])))
+        return (c, (h - self.pool_size[0]) // self.strides[0] + 1,
+                (w - self.pool_size[1]) // self.strides[1] + 1)
+
+
+class MaxPooling2D(_Pool2D):
+    def build(self, s):
+        pad = -1 if self.border_mode == "same" else 0
+        return N.SpatialMaxPooling(self.pool_size[1], self.pool_size[0],
+                                   self.strides[1], self.strides[0], pad, pad)
+
+
+class AveragePooling2D(_Pool2D):
+    def build(self, s):
+        pad = -1 if self.border_mode == "same" else 0
+        return N.SpatialAveragePooling(self.pool_size[1], self.pool_size[0],
+                                       self.strides[1], self.strides[0],
+                                       pad, pad)
+
+
+class GlobalAveragePooling2D(KerasLayer):
+    def compute_output_shape(self, s):
+        return (s[0],)
+
+    def build(self, s):
+        return N.Sequential(
+            N.SpatialAveragePooling(1, 1, global_pooling=True),
+            N.Reshape([s[0]], batch_mode=True))
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def compute_output_shape(self, s):
+        return (s[0],)
+
+    def build(self, s):
+        return N.Sequential(
+            N.SpatialMaxPooling(s[2], s[1], 1, 1),
+            N.Reshape([s[0]], batch_mode=True))
+
+
+class MaxPooling1D(KerasLayer):
+    def __init__(self, pool_length: int = 2, stride=None,
+                 border_mode="valid", input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+
+    def compute_output_shape(self, s):
+        return ((s[0] - self.pool_length) // self.stride + 1, s[1])
+
+    def build(self, s):
+        return N.TemporalMaxPooling(self.pool_length, self.stride)
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def compute_output_shape(self, s):
+        return (s[1],)
+
+    def build(self, s):
+        return N.Mean(dimension=2)
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.padding = tuple(padding)
+
+    def compute_output_shape(self, s):
+        return (s[0], s[1] + 2 * self.padding[0], s[2] + 2 * self.padding[1])
+
+    def build(self, s):
+        return N.SpatialZeroPadding(self.padding[1], self.padding[1],
+                                    self.padding[0], self.padding[0])
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size=(2, 2), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.size = tuple(size)
+
+    def compute_output_shape(self, s):
+        return (s[0], s[1] * self.size[0], s[2] * self.size[1])
+
+    def build(self, s):
+        return N.UpSampling2D(self.size)
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.cropping = cropping
+
+    def compute_output_shape(self, s):
+        (t, b), (l, r) = self.cropping
+        return (s[0], s[1] - t - b, s[2] - l - r)
+
+    def build(self, s):
+        return N.Cropping2D(self.cropping[0], self.cropping[1])
+
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon=1e-3, momentum=0.99, input_shape=None,
+                 name=None):
+        super().__init__(input_shape, name)
+        self.epsilon, self.momentum = epsilon, momentum
+
+    def build(self, s):
+        # keras momentum is running-average keep-rate; reference BN momentum
+        # is the update rate
+        if len(s) == 3:
+            return N.SpatialBatchNormalization(s[0], self.epsilon,
+                                               1.0 - self.momentum)
+        return N.BatchNormalization(s[-1], self.epsilon, 1.0 - self.momentum)
+
+
+class Embedding(KerasLayer):
+    """nn/keras/Embedding.scala — 0-based token ids in, (T, dim) out."""
+
+    def __init__(self, input_dim: int, output_dim: int, input_shape=None,
+                 input_length=None, name=None):
+        if input_length is not None:
+            input_shape = (input_length,)
+        super().__init__(input_shape, name)
+        self.input_dim, self.output_dim = input_dim, output_dim
+
+    def compute_output_shape(self, s):
+        return (s[0], self.output_dim)
+
+    def build(self, s):
+        return N.Sequential(N.AddConstant(1.0),
+                            N.LookupTable(self.input_dim, self.output_dim))
+
+
+class _KerasRecurrent(KerasLayer):
+    def __init__(self, output_dim: int, activation="tanh",
+                 return_sequences=False, go_backwards=False,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def compute_output_shape(self, s):
+        if self.return_sequences:
+            return (s[0], self.output_dim)
+        return (self.output_dim,)
+
+    def _cell(self, input_size):
+        raise NotImplementedError
+
+    def build(self, s):
+        seq = N.Sequential()
+        if self.go_backwards:
+            seq.add(N.Reverse(2))
+        seq.add(N.Recurrent(self._cell(s[-1])))
+        if not self.return_sequences:
+            seq.add(N.Select(2, -1))
+        return seq
+
+
+class LSTM(_KerasRecurrent):
+    def _cell(self, input_size):
+        return N.LSTM(input_size, self.output_dim)
+
+
+class GRU(_KerasRecurrent):
+    def _cell(self, input_size):
+        return N.GRU(input_size, self.output_dim)
+
+
+class SimpleRNN(_KerasRecurrent):
+    def _cell(self, input_size):
+        return N.RnnCell(input_size, self.output_dim)
+
+
+class Bidirectional(KerasLayer):
+    def __init__(self, layer: _KerasRecurrent, merge_mode="concat",
+                 input_shape=None, name=None):
+        super().__init__(input_shape or layer.input_shape, name)
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def compute_output_shape(self, s):
+        base = self.layer.compute_output_shape(s)
+        if self.merge_mode == "concat":
+            return base[:-1] + (base[-1] * 2,)
+        return base
+
+    def build(self, s):
+        br = N.BiRecurrent("concat" if self.merge_mode == "concat" else None)
+        br.add(self.layer._cell(s[-1]))
+        seq = N.Sequential(br)
+        if not self.layer.return_sequences:
+            seq.add(N.Select(2, -1))
+        return seq
+
+
+class TimeDistributed(KerasLayer):
+    def __init__(self, layer: KerasLayer, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.layer = layer
+
+    def compute_output_shape(self, s):
+        inner = self.layer.compute_output_shape(s[1:])
+        return (s[0],) + tuple(inner)
+
+    def build(self, s):
+        return N.TimeDistributed(self.layer._built(s[1:]))
+
+
+class Merge(KerasLayer):
+    """nn/keras/Merge.scala — merge a list of KerasNodes."""
+
+    def __init__(self, layers=None, mode="sum", concat_axis=-1,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def compute_output_shape_multi(self, shapes):
+        if self.mode == "concat":
+            ax = self.concat_axis if self.concat_axis >= 0 else \
+                len(shapes[0]) - 1
+            out = list(shapes[0])
+            out[ax] = sum(s[ax] for s in shapes)
+            return tuple(out)
+        return tuple(shapes[0])
+
+    def build(self, s):
+        if self.mode == "sum":
+            return N.CAddTable()
+        if self.mode == "mul":
+            return N.CMulTable()
+        if self.mode == "max":
+            return N.CMaxTable()
+        if self.mode == "ave":
+            return N.CAveTable()
+        if self.mode == "dot":
+            return N.DotProduct()
+        if self.mode == "concat":
+            ax = self.concat_axis
+            return N.JoinTable(ax + 1 if ax > 0 else -1)
+        raise ValueError(f"unknown merge mode {self.mode}")
+
+    def __call__(self, nodes):
+        from .topology import KerasNode
+        m = self._built(nodes[0].shape)
+        nn_node = m([n.nn_node for n in nodes])
+        shape = self.compute_output_shape_multi([n.shape for n in nodes])
+        return KerasNode(nn_node, shape)
+
+
+class Highway(KerasLayer):
+    def __init__(self, activation="tanh", input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.activation = activation
+
+    def build(self, s):
+        return N.Highway(s[-1], activation=self.activation)
+
+
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha=0.3, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def build(self, s):
+        return N.LeakyReLU(self.alpha)
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha=1.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.alpha = alpha
+
+    def build(self, s):
+        return N.ELU(self.alpha)
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta=1.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.theta = theta
+
+    def build(self, s):
+        return N.Threshold(self.theta, 0.0)
+
+
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.sigma = sigma
+
+    def build(self, s):
+        return N.GaussianNoise(self.sigma)
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def build(self, s):
+        return N.GaussianDropout(self.p)
+
+
+class SpatialDropout2D(KerasLayer):
+    def __init__(self, p=0.5, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.p = p
+
+    def build(self, s):
+        return N.SpatialDropout2D(self.p)
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value=0.0, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.mask_value = mask_value
+
+    def build(self, s):
+        return N.Masking(self.mask_value)

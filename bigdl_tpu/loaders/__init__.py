@@ -1,0 +1,2 @@
+from .caffe import load_caffe, parse_prototxt, read_caffemodel_blobs
+from .torchfile import load_torch, load_t7

@@ -1,0 +1,401 @@
+"""Caffe model loader.
+
+Parity: reference ``utils/caffe/CaffeLoader.scala`` + ``Converter.scala``
+(Module.loadCaffeModel(prototxt, caffemodel)). No protoc dependency:
+
+* prototxt: hand-written parser for the protobuf *text* format subset Caffe
+  uses (nested ``name { ... }`` blocks, ``key: value`` scalars);
+* caffemodel: minimal protobuf *wire-format* decoder extracting
+  LayerParameter name/type/blobs (field numbers from caffe.proto: NetParameter
+  ``layer = 100`` / ``layers = 2(V1)``, LayerParameter ``name=1, type=2,
+  blobs=7``; V1LayerParameter ``name=1, type=2(enum), blobs=6``; BlobProto
+  ``shape=7, data=5(packed float), num/channels/height/width=1-4``).
+
+Supported layer types cover the Inception-v1 / VGG / ResNet class of nets:
+Convolution, InnerProduct, Pooling, ReLU, LRN, Concat, Dropout, Softmax,
+BatchNorm, Scale, Eltwise, Input/Data.
+"""
+from __future__ import annotations
+
+import re
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import nn as N
+
+
+# ---------------------------------------------------------------------------
+# prototxt (text format) parser
+# ---------------------------------------------------------------------------
+_TOKEN = re.compile(r"[\w.+-]+|\"[^\"]*\"|'[^']*'|[{}:]")
+
+
+def parse_prototxt(text: str) -> Dict:
+    """Parse protobuf text format into nested dicts; repeated fields become
+    lists."""
+    toks = _TOKEN.findall(re.sub(r"#.*", "", text))
+    pos = [0]
+
+    def parse_block():
+        out: Dict = {}
+        while pos[0] < len(toks):
+            t = toks[pos[0]]
+            if t == "}":
+                pos[0] += 1
+                return out
+            key = t
+            pos[0] += 1
+            nxt = toks[pos[0]]
+            if nxt == ":":
+                pos[0] += 1
+                val = toks[pos[0]]
+                pos[0] += 1
+                if val.startswith(('"', "'")):
+                    val = val[1:-1]
+                else:
+                    try:
+                        val = int(val)
+                    except ValueError:
+                        try:
+                            val = float(val)
+                        except ValueError:
+                            if val in ("true", "false"):
+                                val = val == "true"
+            elif nxt == "{":
+                pos[0] += 1
+                val = parse_block()
+            else:
+                raise ValueError(f"unexpected token {nxt} after {key}")
+            if key in out:
+                if not isinstance(out[key], list):
+                    out[key] = [out[key]]
+                out[key].append(val)
+            else:
+                out[key] = val
+        return out
+
+    return parse_block()
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+# ---------------------------------------------------------------------------
+# caffemodel (binary wire format) decoder
+# ---------------------------------------------------------------------------
+def _read_varint(buf, i):
+    shift, val = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _iter_fields(buf):
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, i = _read_varint(buf, i)
+        elif wire == 1:
+            val = buf[i:i + 8]
+            i += 8
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            val = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _decode_blob(buf) -> np.ndarray:
+    shape = []
+    dims_legacy = {}
+    data = None
+    for field, wire, val in _iter_fields(buf):
+        if field == 7 and wire == 2:  # BlobShape
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1:
+                    if w2 == 2:  # packed
+                        j = 0
+                        while j < len(v2):
+                            d, j = _read_varint(v2, j)
+                            shape.append(d)
+                    else:
+                        shape.append(v2)
+        elif field in (1, 2, 3, 4) and wire == 0:  # num/channels/h/w
+            dims_legacy[field] = val
+        elif field == 5 and wire == 2:  # packed float data
+            data = np.frombuffer(val, dtype="<f4")
+        elif field == 5 and wire == 5:  # unpacked single float
+            data = np.concatenate([data if data is not None else
+                                   np.empty(0, np.float32),
+                                   np.frombuffer(val, dtype="<f4")])
+        elif field == 8 and wire == 2:  # double data
+            data = np.frombuffer(val, dtype="<f8").astype(np.float32)
+    if not shape and dims_legacy:
+        shape = [dims_legacy.get(k, 1) for k in (1, 2, 3, 4)]
+    if data is None:
+        data = np.empty(0, np.float32)
+    if shape and int(np.prod(shape)) == data.size:
+        data = data.reshape(shape)
+    return data
+
+
+_V1_TYPE_NAMES = {
+    4: "Convolution", 14: "InnerProduct", 17: "Pooling", 18: "ReLU",
+    15: "LRN", 3: "Concat", 6: "Dropout", 20: "Softmax", 21: "SoftmaxWithLoss",
+    5: "Data", 33: "Eltwise", 19: "Sigmoid", 23: "Tanh",
+}
+
+
+def read_caffemodel_blobs(path: str) -> Dict[str, List[np.ndarray]]:
+    """Return {layer_name: [blob arrays]} from a .caffemodel file."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    out: Dict[str, List[np.ndarray]] = {}
+    for field, wire, val in _iter_fields(buf):
+        if field == 100 and wire == 2:  # LayerParameter (V2)
+            name, blobs = "", []
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1:
+                    name = v2.decode("utf-8", "replace")
+                elif f2 == 7:
+                    blobs.append(_decode_blob(v2))
+            if blobs:
+                out[name] = blobs
+        elif field == 2 and wire == 2:  # V1LayerParameter
+            name, blobs = "", []
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1:
+                    name = v2.decode("utf-8", "replace")
+                elif f2 == 6:
+                    blobs.append(_decode_blob(v2))
+            if blobs:
+                out[name] = blobs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# layer conversion (prototxt → bigdl_tpu modules)
+# ---------------------------------------------------------------------------
+def _kernel_params(p):
+    k = p.get("kernel_size", p.get("kernel_h", 1))
+    kh = int(p.get("kernel_h", k))
+    kw = int(p.get("kernel_w", k))
+    s = p.get("stride", 1)
+    sh = int(p.get("stride_h", s))
+    sw = int(p.get("stride_w", s))
+    pad = p.get("pad", 0)
+    ph = int(p.get("pad_h", pad))
+    pw = int(p.get("pad_w", pad))
+    return kh, kw, sh, sw, ph, pw
+
+
+def _convert_layer(layer: Dict, in_channels: Optional[int]):
+    """Return (module or None, out_channels or None)."""
+    typ = layer.get("type")
+    if isinstance(typ, int):
+        typ = _V1_TYPE_NAMES.get(typ, str(typ))
+    name = layer.get("name", typ)
+    if typ in ("Data", "Input", "HDF5Data", "ImageData", "Accuracy",
+               "Silence", None):
+        return None, in_channels
+    if typ == "Convolution":
+        p = layer.get("convolution_param", {})
+        nout = int(p["num_output"])
+        kh, kw, sh, sw, ph, pw = _kernel_params(p)
+        group = int(p.get("group", 1))
+        bias = bool(p.get("bias_term", True))
+        m = N.SpatialConvolution(in_channels, nout, kw, kh, sw, sh, pw, ph,
+                                 n_group=group, with_bias=bias)
+        m.set_name(name)
+        return m, nout
+    if typ == "InnerProduct":
+        p = layer.get("inner_product_param", {})
+        nout = int(p["num_output"])
+        m = N.Sequential(N.InferReshape([-1], batch_mode=True) if False
+                         else N.Reshape([-1]),
+                         N.Linear(in_channels, nout)) if False else \
+            N.Linear(in_channels, nout)
+        m.set_name(name)
+        return m, nout
+    if typ == "Pooling":
+        p = layer.get("pooling_param", {})
+        kh, kw, sh, sw, ph, pw = _kernel_params(p)
+        global_p = bool(p.get("global_pooling", False))
+        pool = p.get("pool", "MAX")
+        if pool in ("MAX", 0):
+            m = N.SpatialMaxPooling(kw, kh, sw, sh, pw, ph).ceil()
+        else:
+            m = N.SpatialAveragePooling(kw, kh, sw, sh, pw, ph,
+                                        global_pooling=global_p,
+                                        ceil_mode=True)
+        m.set_name(name)
+        return m, in_channels
+    if typ == "ReLU":
+        return N.ReLU().set_name(name), in_channels
+    if typ == "Sigmoid":
+        return N.Sigmoid().set_name(name), in_channels
+    if typ == "TanH" or typ == "Tanh":
+        return N.Tanh().set_name(name), in_channels
+    if typ == "LRN":
+        p = layer.get("lrn_param", {})
+        m = N.SpatialCrossMapLRN(int(p.get("local_size", 5)),
+                                 float(p.get("alpha", 1.0)),
+                                 float(p.get("beta", 0.75)),
+                                 float(p.get("k", 1.0)))
+        return m.set_name(name), in_channels
+    if typ == "Concat":
+        return N.JoinTable(2).set_name(name), None  # channels summed by caller
+    if typ == "Dropout":
+        p = layer.get("dropout_param", {})
+        return N.Dropout(float(p.get("dropout_ratio", 0.5))).set_name(name), \
+            in_channels
+    if typ in ("Softmax", "SoftmaxWithLoss"):
+        return N.SoftMax().set_name(name), in_channels
+    if typ == "LogSoftmax":
+        return N.LogSoftMax().set_name(name), in_channels
+    if typ == "BatchNorm":
+        m = N.SpatialBatchNormalization(in_channels, affine=False)
+        return m.set_name(name), in_channels
+    if typ == "Scale":
+        m = N.Scale([in_channels, 1, 1])
+        return m.set_name(name), in_channels
+    if typ == "Eltwise":
+        p = layer.get("eltwise_param", {})
+        op = p.get("operation", "SUM")
+        if op in ("SUM", 1):
+            return N.CAddTable().set_name(name), in_channels
+        if op in ("PROD", 0):
+            return N.CMulTable().set_name(name), in_channels
+        return N.CMaxTable().set_name(name), in_channels
+    if typ == "Flatten":
+        return N.InferReshape([0, -1], batch_mode=False).set_name(name), \
+            in_channels
+    raise ValueError(f"unsupported caffe layer type {typ} ({name})")
+
+
+def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None,
+               input_channels: int = 3):
+    """Build a Graph from a deploy prototxt; optionally load weights.
+
+    Parity: Module.loadCaffeModel (utils/caffe/CaffeLoader.scala:430).
+    """
+    with open(prototxt_path) as f:
+        net = parse_prototxt(f.read())
+    layers = _as_list(net.get("layer")) + _as_list(net.get("layers"))
+
+    # channel tracking per top blob
+    channels: Dict[str, Optional[int]] = {}
+    inputs = _as_list(net.get("input"))
+    input_dims = _as_list(net.get("input_dim"))
+    if inputs:
+        channels[inputs[0]] = (int(input_dims[1]) if len(input_dims) >= 2
+                               else input_channels)
+    nodes: Dict[str, object] = {}
+    in_node = N.Input(name="data")
+    for iname in inputs or ["data"]:
+        nodes[iname] = in_node
+        channels.setdefault(iname, input_channels)
+
+    modules_by_name = {}
+    last_top = None
+    for layer in layers:
+        typ = layer.get("type")
+        bottoms = _as_list(layer.get("bottom"))
+        tops = _as_list(layer.get("top"))
+        if isinstance(typ, str) and typ in ("Input",):
+            for t in tops:
+                nodes[t] = in_node
+                p = layer.get("input_param", {}).get("shape", {})
+                dims = _as_list(p.get("dim")) if isinstance(p, dict) else []
+                channels[t] = int(dims[1]) if len(dims) >= 2 else \
+                    input_channels
+            continue
+        in_ch = channels.get(bottoms[0]) if bottoms else input_channels
+        if typ == "Concat" or typ == 3:
+            in_ch_total = sum(channels.get(b) or 0 for b in bottoms)
+        m, out_ch = _convert_layer(layer, in_ch)
+        if m is None:
+            for t in tops:
+                if bottoms:
+                    nodes[t] = nodes.get(bottoms[0], in_node)
+                    channels[t] = channels.get(bottoms[0], input_channels)
+                else:
+                    nodes[t] = in_node
+                    channels[t] = input_channels
+            continue
+        modules_by_name[layer.get("name", "")] = m
+        ins = [nodes[b] for b in bottoms] if bottoms else [in_node]
+        node = m(*ins) if len(ins) > 1 else m(ins[0])
+        if typ == "Concat" or typ == 3:
+            out_ch = in_ch_total
+        for t in tops:
+            nodes[t] = node
+            channels[t] = out_ch
+        last_top = tops[0] if tops else last_top
+
+    graph = N.Graph(in_node, nodes[last_top])
+    graph.ensure_initialized()
+
+    if caffemodel_path:
+        blobs = read_caffemodel_blobs(caffemodel_path)
+        _load_weights(graph, modules_by_name, blobs)
+    return graph
+
+
+def _load_weights(graph, modules_by_name, blobs):
+    import jax.numpy as jnp
+    # map module object → its index key in graph params
+    idx_of = {id(m): str(i) for i, m in enumerate(graph.modules)}
+    params = dict(graph.params)
+    state = dict(graph.state)
+    for name, bl in blobs.items():
+        m = modules_by_name.get(name)
+        if m is None or id(m) not in idx_of:
+            continue
+        key = idx_of[id(m)]
+        p = dict(params[key])
+        if isinstance(m, N.SpatialConvolution):
+            w = bl[0].reshape(np.asarray(p["weight"]).shape)
+            p["weight"] = jnp.asarray(w)
+            if len(bl) > 1 and "bias" in p:
+                p["bias"] = jnp.asarray(bl[1].reshape(-1))
+        elif isinstance(m, N.Linear):
+            p["weight"] = jnp.asarray(
+                bl[0].reshape(np.asarray(p["weight"]).shape))
+            if len(bl) > 1 and "bias" in p:
+                p["bias"] = jnp.asarray(bl[1].reshape(-1))
+        elif isinstance(m, N.SpatialBatchNormalization):
+            scale = float(bl[2].reshape(-1)[0]) if len(bl) > 2 and \
+                bl[2].size else 1.0
+            scale = 1.0 / scale if scale != 0 else 1.0
+            st = dict(state[key])
+            st["running_mean"] = jnp.asarray(bl[0].reshape(-1) * scale)
+            st["running_var"] = jnp.asarray(bl[1].reshape(-1) * scale)
+            state[key] = st
+        elif isinstance(m, N.Scale):
+            p["weight"] = jnp.asarray(
+                bl[0].reshape(np.asarray(p["weight"]).shape))
+            if len(bl) > 1:
+                p["bias"] = jnp.asarray(
+                    bl[1].reshape(np.asarray(p["bias"]).shape))
+        params[key] = p
+    graph.params = params
+    graph.state = state
+    return graph

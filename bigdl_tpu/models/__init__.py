@@ -1,2 +1,8 @@
-from . import lenet
+from . import lenet, resnet, vgg, inception, rnn, autoencoder, transformer_lm
 from .lenet import LeNet5
+from .resnet import ResNet, ResNet50, ResNetCifar, ShortcutType
+from .vgg import VggForCifar10, Vgg_16, Vgg_19
+from .inception import Inception_v1, Inception_v1_NoAuxClassifier
+from .rnn import PTBModel, SimpleRNN
+from .autoencoder import Autoencoder
+from .transformer_lm import TransformerLM

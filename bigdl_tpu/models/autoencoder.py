@@ -1,0 +1,15 @@
+"""Autoencoder (parity: reference ``models/autoencoder/Autoencoder.scala``)."""
+from __future__ import annotations
+
+from ..nn import Sequential, Linear, ReLU, Sigmoid, Reshape
+
+
+def Autoencoder(class_num: int = 32):
+    """models/autoencoder/Autoencoder.scala:27 — 784 → classNum → 784."""
+    model = Sequential()
+    model.add(Reshape([28 * 28]))
+    model.add(Linear(28 * 28, class_num))
+    model.add(ReLU(True))
+    model.add(Linear(class_num, 28 * 28))
+    model.add(Sigmoid())
+    return model

@@ -1,0 +1,97 @@
+"""Inception-v1 / GoogLeNet (parity: reference
+``models/inception/Inception_v1.scala``; v2 structure in ``Inception_v2.scala``
+is the r2 follow-up). Built on the Graph/Concat APIs exactly like the
+reference's inception() helper."""
+from __future__ import annotations
+
+from ..nn import (Sequential, SpatialConvolution, ReLU, SpatialMaxPooling,
+                  SpatialAveragePooling, SpatialCrossMapLRN, Linear, View,
+                  Dropout, LogSoftMax, Concat, SpatialBatchNormalization)
+from ..nn.init import Xavier
+
+
+def _conv(nin, nout, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
+    c = SpatialConvolution(nin, nout, kw, kh, sw, sh, pw, ph,
+                           init_method=Xavier())
+    if name:
+        c.set_name(name)
+    return c
+
+
+def inception_block(input_size, config, name_prefix=""):
+    """config: ((1x1), (3x3reduce, 3x3), (5x5reduce, 5x5), (poolproj))
+    (models/inception/Inception_v1.scala inception())."""
+    concat = Concat(2)
+    c1 = Sequential()
+    c1.add(_conv(input_size, config[0][0], 1, 1, name=name_prefix + "1x1"))
+    c1.add(ReLU(True))
+    concat.add(c1)
+    c3 = Sequential()
+    c3.add(_conv(input_size, config[1][0], 1, 1,
+                 name=name_prefix + "3x3_reduce"))
+    c3.add(ReLU(True))
+    c3.add(_conv(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1,
+                 name=name_prefix + "3x3"))
+    c3.add(ReLU(True))
+    concat.add(c3)
+    c5 = Sequential()
+    c5.add(_conv(input_size, config[2][0], 1, 1,
+                 name=name_prefix + "5x5_reduce"))
+    c5.add(ReLU(True))
+    c5.add(_conv(config[2][0], config[2][1], 5, 5, 1, 1, 2, 2,
+                 name=name_prefix + "5x5"))
+    c5.add(ReLU(True))
+    concat.add(c5)
+    pool = Sequential()
+    pool.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+    pool.add(_conv(input_size, config[3][0], 1, 1,
+                   name=name_prefix + "pool_proj"))
+    pool.add(ReLU(True))
+    concat.add(pool)
+    return concat
+
+
+def Inception_v1_NoAuxClassifier(class_num: int = 1000,
+                                 has_dropout: bool = True):
+    """models/inception/Inception_v1.scala:36 (no aux heads variant)."""
+    model = Sequential()
+    model.add(_conv(3, 64, 7, 7, 2, 2, 3, 3, "conv1/7x7_s2"))
+    model.add(ReLU(True))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(SpatialCrossMapLRN(5, 0.0001, 0.75))
+    model.add(_conv(64, 64, 1, 1, name="conv2/3x3_reduce"))
+    model.add(ReLU(True))
+    model.add(_conv(64, 192, 3, 3, 1, 1, 1, 1, "conv2/3x3"))
+    model.add(ReLU(True))
+    model.add(SpatialCrossMapLRN(5, 0.0001, 0.75))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(inception_block(192, ((64,), (96, 128), (16, 32), (32,)),
+                              "inception_3a/"))
+    model.add(inception_block(256, ((128,), (128, 192), (32, 96), (64,)),
+                              "inception_3b/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(inception_block(480, ((192,), (96, 208), (16, 48), (64,)),
+                              "inception_4a/"))
+    model.add(inception_block(512, ((160,), (112, 224), (24, 64), (64,)),
+                              "inception_4b/"))
+    model.add(inception_block(512, ((128,), (128, 256), (24, 64), (64,)),
+                              "inception_4c/"))
+    model.add(inception_block(512, ((112,), (144, 288), (32, 64), (64,)),
+                              "inception_4d/"))
+    model.add(inception_block(528, ((256,), (160, 320), (32, 128), (128,)),
+                              "inception_4e/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2).ceil())
+    model.add(inception_block(832, ((256,), (160, 320), (32, 128), (128,)),
+                              "inception_5a/"))
+    model.add(inception_block(832, ((384,), (192, 384), (48, 128), (128,)),
+                              "inception_5b/"))
+    model.add(SpatialAveragePooling(7, 7, 1, 1, global_pooling=True))
+    if has_dropout:
+        model.add(Dropout(0.4))
+    model.add(View(1024))
+    model.add(Linear(1024, class_num).set_name("loss3/classifier"))
+    model.add(LogSoftMax())
+    return model
+
+
+Inception_v1 = Inception_v1_NoAuxClassifier

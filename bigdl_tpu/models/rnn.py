@@ -1,0 +1,40 @@
+"""RNN language model (parity: reference ``models/rnn/PTBModel.scala`` +
+``models/rnn/SimpleRNN.scala``)."""
+from __future__ import annotations
+
+from ..nn import (Sequential, LookupTable, Recurrent, LSTM, GRU, RnnCell,
+                  TimeDistributed, Linear, LogSoftMax, Dropout, MultiRNNCell)
+
+
+def PTBModel(input_size: int, hidden_size: int = 256, output_size: int = None,
+             num_layers: int = 2, keep_prob: float = 1.0,
+             cell_type: str = "lstm"):
+    """models/rnn/PTBModel.scala — embed → stacked LSTM → per-step softmax.
+    Input: (B, T) 1-based token ids; output (B, T, vocab) log-probs."""
+    output_size = output_size or input_size
+    model = Sequential()
+    model.add(LookupTable(input_size, hidden_size))
+    if keep_prob < 1.0:
+        model.add(Dropout(1.0 - keep_prob))
+    cells = []
+    for i in range(num_layers):
+        if cell_type == "lstm":
+            cells.append(LSTM(hidden_size, hidden_size))
+        else:
+            cells.append(GRU(hidden_size, hidden_size))
+    model.add(Recurrent(MultiRNNCell(cells) if len(cells) > 1 else cells[0]))
+    model.add(TimeDistributed(Linear(hidden_size, output_size)))
+    model.add(LogSoftMax(axis=-1))
+    return model
+
+
+def SimpleRNN(input_size: int = 100, hidden_size: int = 40,
+              output_size: int = 10):
+    """models/rnn/SimpleRNN.scala — one tanh RNN over (B, T, inputSize)."""
+    model = Sequential()
+    model.add(Recurrent(RnnCell(input_size, hidden_size)))
+    from ..nn import Select
+    model.add(Select(2, -1))  # last timestep
+    model.add(Linear(hidden_size, output_size))
+    model.add(LogSoftMax())
+    return model

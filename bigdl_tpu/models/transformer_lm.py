@@ -1,0 +1,23 @@
+"""Transformer language model — the flagship multi-chip model.
+
+Parity: reference ``nn/Transformer.scala`` LM mode (used by the reference's
+Transformer example); extended TPU-first with flash attention and
+dp×tp×sp sharding hooks (see ``parallel/``). This is the ``__graft_entry__``
+model: the driver compile-checks its forward single-chip and its full
+sharded train step on an N-device mesh.
+"""
+from __future__ import annotations
+
+from ..nn import Transformer
+
+
+def TransformerLM(vocab_size: int = 32000, hidden_size: int = 512,
+                  num_heads: int = 8, filter_size: int = 2048,
+                  num_layers: int = 6, dropout: float = 0.0,
+                  max_len: int = 2048):
+    return Transformer(vocab_size=vocab_size, hidden_size=hidden_size,
+                       num_heads=num_heads, filter_size=filter_size,
+                       num_hidden_layers=num_layers,
+                       postprocess_dropout=dropout,
+                       attention_dropout=dropout, relu_dropout=dropout,
+                       mode="lm", max_len=max_len)

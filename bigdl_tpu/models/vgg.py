@@ -1,0 +1,77 @@
+"""VGG (parity: reference ``models/vgg/VggForCifar10.scala`` + the ImageNet
+VGG-16/19 in ``models/vgg/Vgg_16.scala`` / ``Vgg_19.scala``)."""
+from __future__ import annotations
+
+from ..nn import (Sequential, SpatialConvolution, SpatialBatchNormalization,
+                  ReLU, SpatialMaxPooling, Linear, View, Dropout,
+                  LogSoftMax, BatchNormalization)
+
+
+def _conv_bn_relu(model, nin, nout, bn=True):
+    model.add(SpatialConvolution(nin, nout, 3, 3, 1, 1, 1, 1))
+    if bn:
+        model.add(SpatialBatchNormalization(nout, 1e-3))
+    model.add(ReLU(True))
+    return nout
+
+
+def VggForCifar10(class_num: int = 10, has_dropout: bool = True):
+    """models/vgg/VggForCifar10.scala — VGG-16-style with BN for 32x32."""
+    model = Sequential()
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    nin = 3
+    for v in cfg:
+        if v == "M":
+            model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+        else:
+            if has_dropout and v != 64 and nin != 3:
+                pass
+            nin = _conv_bn_relu(model, nin, v)
+    model.add(View(512))
+    classifier = Sequential()
+    if has_dropout:
+        classifier.add(Dropout(0.5))
+    classifier.add(Linear(512, 512))
+    classifier.add(BatchNormalization(512))
+    classifier.add(ReLU(True))
+    if has_dropout:
+        classifier.add(Dropout(0.5))
+    classifier.add(Linear(512, class_num))
+    classifier.add(LogSoftMax())
+    model.add(classifier)
+    return model
+
+
+def _vgg_imagenet(cfg, class_num, has_dropout=True):
+    model = Sequential()
+    nin = 3
+    for v in cfg:
+        if v == "M":
+            model.add(SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            nin = _conv_bn_relu(model, nin, v, bn=False)
+    model.add(View(512 * 7 * 7))
+    model.add(Linear(512 * 7 * 7, 4096))
+    model.add(ReLU(True))
+    if has_dropout:
+        model.add(Dropout(0.5))
+    model.add(Linear(4096, 4096))
+    model.add(ReLU(True))
+    if has_dropout:
+        model.add(Dropout(0.5))
+    model.add(Linear(4096, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+def Vgg_16(class_num: int = 1000, has_dropout: bool = True):
+    return _vgg_imagenet([64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                          512, 512, 512, "M", 512, 512, 512, "M"],
+                         class_num, has_dropout)
+
+
+def Vgg_19(class_num: int = 1000, has_dropout: bool = True):
+    return _vgg_imagenet([64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+                          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+                         class_num, has_dropout)

@@ -49,8 +49,10 @@ class Reshape(Module):
 
     def _apply(self, params, state, x, training, rng):
         n = int(np.prod(self.size))
-        if self.batch_mode is True or (
-                self.batch_mode is None and x.size != n):
+        if self.batch_mode is False:
+            return x.reshape(self.size)
+        rest = int(np.prod(x.shape[1:])) if x.ndim > 1 else -1
+        if self.batch_mode is True or rest == n:
             return x.reshape((x.shape[0],) + self.size)
         return x.reshape(self.size)
 
@@ -68,6 +70,9 @@ class View(Module):
         if -1 in self.sizes:
             return x.reshape(self.sizes)
         n = int(np.prod(self.sizes))
+        rest = int(np.prod(x.shape[1:])) if x.ndim > 1 else -1
+        if rest == n:
+            return x.reshape((x.shape[0],) + self.sizes)
         if x.size == n:
             return x.reshape(self.sizes)
         return x.reshape((-1,) + self.sizes)

@@ -413,18 +413,14 @@ class DistriOptimizer(BaseOptimizer):
             new_mstate = _tmap(lambda t: jax.lax.pmean(t, "data"), new_mstate)
             return loss, new_flat, new_opt, new_mstate
 
-        opt_specs = _tmap(lambda _: P("data"),
-                          jax.eval_shape(
-                              lambda w: self.optim_method.init_state(
-                                  w[: flat.shard_size]),
-                              jnp.zeros((flat.padded_size,))))
+        opt_specs = arp.state_specs()
         mstate_specs = _tmap(lambda _: P(), self.model.state)
         sharded = shard_map(
             local_step, mesh=mesh,
             in_specs=(P(), opt_specs, mstate_specs, P("data"), P("data"),
                       P(), P()),
             out_specs=(P(), P(), opt_specs, mstate_specs),
-            check_rep=False)
+            check_vma=False)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
 

@@ -102,12 +102,18 @@ class AllReduceParameter:
 
         specs_in = P()
         init = shard_map(init_slice, mesh=self.mesh, in_specs=(specs_in,),
-                         out_specs=jax.tree_util.tree_map(
-                             lambda _: P(self.axis),
-                             jax.eval_shape(lambda w: self.optim.init_state(
-                                 w[: self.flat.shard_size]), flat_w)),
-                         check_rep=False)
+                         out_specs=self.state_specs(),
+                         check_vma=False)
         return flat_w, init(flat_w)
+
+    def state_specs(self):
+        """Per-leaf PartitionSpecs for the sharded optimizer state: vector
+        state sharded over the axis, scalar state (step counters) replicated."""
+        shapes = jax.eval_shape(
+            lambda w: self.optim.init_state(w[: self.flat.shard_size]),
+            jnp.zeros((self.flat.padded_size,), jnp.float32))
+        return jax.tree_util.tree_map(
+            lambda s: P(self.axis) if s.ndim >= 1 else P(), shapes)
 
     def update(self, grads_flat, params_flat, opt_state, lr):
         """Runs INSIDE shard_map over the mesh: grads_flat/params_flat are

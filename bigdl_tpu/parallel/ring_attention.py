@@ -74,4 +74,4 @@ def make_ring_attention(mesh, axis: str = "seq", causal: bool = False):
     spec = P(None, None, axis, None)
     return shard_map(partial(ring_attention, axis=axis, causal=causal),
                      mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)
+                     out_specs=spec, check_vma=False)

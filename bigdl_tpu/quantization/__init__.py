@@ -1,0 +1,2 @@
+from .quantize import (quantize, QuantizedLinear, QuantizedSpatialConvolution,
+                       quantize_weight)

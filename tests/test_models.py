@@ -1,0 +1,131 @@
+"""Model zoo forward/shape/training tests (modeled on the reference's
+models/*Spec.scala)."""
+import numpy as np
+import jax
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu import models
+from bigdl_tpu.dataset import DataSet, mnist
+from bigdl_tpu.optim import LocalOptimizer, SGD, Adam, max_iteration, \
+    Top1Accuracy
+
+
+def _count_params(model):
+    model.ensure_initialized()
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(model.params))
+
+
+def test_lenet_param_count():
+    m = models.LeNet5(10)
+    # conv1 6*1*25+6, conv2 12*6*25+12, fc1 192*100+100, fc2 100*10+10
+    assert _count_params(m) == (6 * 25 + 6) + (12 * 6 * 25 + 12) + \
+        (192 * 100 + 100) + (100 * 10 + 10)
+
+
+def test_resnet18_like_cifar_forward():
+    m = models.ResNetCifar(10, depth=20)
+    x = np.random.randn(2, 3, 32, 32).astype(np.float32)
+    out = m.forward(x)
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_forward_tiny():
+    m = models.ResNet(class_num=100, depth=50)
+    x = np.random.randn(1, 3, 64, 64).astype(np.float32)  # small spatial
+    m.evaluate()
+    out = m.forward(x)
+    assert out.shape == (1, 100)
+    # ~25.5M params for class_num=1000; with 100 classes slightly fewer
+    n = _count_params(m)
+    assert 23_000_000 < n < 26_000_000, n
+
+
+def test_resnet_param_count_matches_torch_resnet50():
+    m = models.ResNet(class_num=1000, depth=50)
+    n = _count_params(m)
+    assert n == 25_557_032, n  # torchvision resnet50 param count
+
+
+def test_vgg_cifar_forward():
+    m = models.VggForCifar10(10)
+    m.evaluate()
+    x = np.random.randn(2, 3, 32, 32).astype(np.float32)
+    assert m.forward(x).shape == (2, 10)
+
+
+def test_inception_v1_forward():
+    m = models.Inception_v1(1000)
+    m.evaluate()
+    x = np.random.randn(1, 3, 224, 224).astype(np.float32)
+    out = m.forward(x)
+    assert out.shape == (1, 1000)
+
+
+def test_ptb_model_forward():
+    m = models.PTBModel(input_size=50, hidden_size=16, output_size=50,
+                        num_layers=2)
+    ids = np.random.randint(1, 51, size=(3, 12)).astype(np.float32)
+    out = m.forward(ids)
+    assert out.shape == (3, 12, 50)
+    # log-probs normalize
+    assert np.allclose(np.exp(np.asarray(out)).sum(-1), 1.0, atol=1e-4)
+
+
+def test_simple_rnn_forward():
+    m = models.SimpleRNN(20, 8, 5)
+    x = np.random.randn(4, 7, 20).astype(np.float32)
+    assert m.forward(x).shape == (4, 5)
+
+
+def test_autoencoder_trains():
+    m = models.Autoencoder(32)
+    imgs, _ = mnist.load(n_synthetic=128)
+    x = (imgs.astype(np.float32) / 255.0)[:, None]
+    from bigdl_tpu.dataset import Sample
+    samples = [Sample(x[i], x[i].reshape(-1)) for i in range(len(x))]
+    ds = DataSet.array(samples)
+    opt = LocalOptimizer(m, ds, nn.MSECriterion(), Adam(learningrate=1e-3),
+                         max_iteration(20), batch_size=32)
+    opt.optimize()
+    losses = opt.optim_method.state["loss"]
+    assert losses < 0.25
+
+
+def test_transformer_lm_forward_and_train():
+    m = models.TransformerLM(vocab_size=60, hidden_size=32, num_heads=4,
+                             filter_size=64, num_layers=2)
+    ids = np.random.randint(1, 60, size=(2, 16))
+    out = m.forward(ids.astype(np.float32))
+    assert out.shape == (2, 16, 60)
+
+    # next-token training decreases loss
+    from bigdl_tpu.dataset import Sample
+    rng = np.random.RandomState(0)
+    seqs = rng.randint(1, 59, size=(64, 17))
+    seqs[:, 1::2] = seqs[:, 0:-1:2]  # learnable copy structure
+    samples = [Sample(seqs[i, :-1].astype(np.float32),
+                      seqs[i, 1:].astype(np.float32)) for i in range(64)]
+    ds = DataSet.array(samples)
+    crit = nn.TimeDistributedMaskCriterion(
+        nn.CrossEntropyCriterion(), padding_value=0)
+    opt = LocalOptimizer(m, ds, crit, Adam(learningrate=3e-3),
+                         max_iteration(2), batch_size=32)
+    opt.optimize()
+    first = opt.optim_method.state["loss"]
+    opt2 = LocalOptimizer(m, ds, crit, Adam(learningrate=3e-3),
+                          max_iteration(25), batch_size=32)
+    opt2.optimize()
+    assert opt2.optim_method.state["loss"] < first
+
+
+def test_transformer_translation_mode():
+    from bigdl_tpu.nn import Transformer
+    from bigdl_tpu.utils.table import Table
+    m = Transformer(vocab_size=40, hidden_size=16, num_heads=2,
+                    filter_size=32, num_hidden_layers=1, mode="translation")
+    src = np.random.randint(1, 40, size=(2, 10)).astype(np.float32)
+    tgt = np.random.randint(1, 40, size=(2, 8)).astype(np.float32)
+    out = m.forward(Table(src, tgt))
+    assert out.shape == (2, 8, 40)
