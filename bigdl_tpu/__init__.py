@@ -21,3 +21,9 @@ from . import dataset
 from . import parallel
 from . import models
 from . import visualization
+from . import transform
+from . import keras
+from . import quantization
+from . import loaders
+from . import dlframes
+from . import native

@@ -226,12 +226,13 @@ def _convert_layer(layer: Dict, in_channels: Optional[int]):
         m.set_name(name)
         return m, nout
     if typ == "InnerProduct":
+        # caffe flattens implicitly; channel tracking assumes 1x1 spatial at
+        # this point (true after global pooling, e.g. Inception/ResNet deploy
+        # nets). Full spatial-shape propagation is the r2 item (SURVEY §2.8).
         p = layer.get("inner_product_param", {})
         nout = int(p["num_output"])
-        m = N.Sequential(N.InferReshape([-1], batch_mode=True) if False
-                         else N.Reshape([-1]),
-                         N.Linear(in_channels, nout)) if False else \
-            N.Linear(in_channels, nout)
+        m = N.Sequential(N.InferReshape([0, -1], batch_mode=False),
+                         N.Linear(in_channels, nout))
         m.set_name(name)
         return m, nout
     if typ == "Pooling":
@@ -371,6 +372,20 @@ def _load_weights(graph, modules_by_name, blobs):
             continue
         key = idx_of[id(m)]
         p = dict(params[key])
+        if isinstance(m, N.Sequential):
+            # InnerProduct wrapper: flatten + Linear at index 1
+            inner = next((c for c in m.modules if isinstance(c, N.Linear)),
+                         None)
+            if inner is not None:
+                ikey = str(m.modules.index(inner))
+                sub = dict(p[ikey])
+                sub["weight"] = jnp.asarray(
+                    bl[0].reshape(np.asarray(sub["weight"]).shape))
+                if len(bl) > 1 and "bias" in sub:
+                    sub["bias"] = jnp.asarray(bl[1].reshape(-1))
+                p[ikey] = sub
+                params[key] = p
+            continue
         if isinstance(m, N.SpatialConvolution):
             w = bl[0].reshape(np.asarray(p["weight"]).shape)
             p["weight"] = jnp.asarray(w)

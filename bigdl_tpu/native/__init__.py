@@ -1,0 +1,158 @@
+"""Native (C++) data-loading runtime, ctypes-bound.
+
+Parity: the reference's native runtime split — Spark-executor threaded decode
+(utils/ThreadPool.scala + dataset image readers) around the MKL compute core.
+Here: this C++ prefetcher around the XLA compute core. Built on first use with
+g++ (cached in the package dir); everything degrades gracefully to the pure
+python pipeline when a toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libbigdl_tpu_native.so")
+_SRC = os.path.join(_HERE, "prefetcher.cpp")
+_lib = None
+_lock = threading.Lock()
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load_library():
+    """Build (if needed) and load the native library; None if unavailable."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or \
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except Exception:
+            return None
+        lib.pf_create_mnist.restype = ctypes.c_void_p
+        lib.pf_create_mnist.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                        ctypes.c_float, ctypes.c_float]
+        lib.pf_create_cifar.restype = ctypes.c_void_p
+        lib.pf_create_cifar.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+        lib.pf_create_raw.restype = ctypes.c_void_p
+        lib.pf_create_raw.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float)]
+        for name in ("pf_size", "pf_image_floats", "pf_next"):
+            getattr(lib, name).restype = ctypes.c_int
+        lib.pf_size.argtypes = [ctypes.c_void_p]
+        lib.pf_image_floats.argtypes = [ctypes.c_void_p]
+        lib.pf_start_epoch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.pf_next.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_float),
+                                ctypes.POINTER(ctypes.c_float)]
+        lib.pf_end_epoch.argtypes = [ctypes.c_void_p]
+        lib.pf_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load_library() is not None
+
+
+class NativePrefetcher:
+    """Threaded native decode+normalize pipeline producing float CHW batches.
+
+    Usable as a dataset for the optimizers: ``data(train)`` yields MiniBatch
+    with inputs shaped (B, C, H, W) and 1-based float labels.
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 mean, std, batch_size: int = 32, n_workers: int = 4,
+                 queue_capacity: int = 4, seed: int = 1):
+        """images: uint8 (N, C, H, W); labels: 1-based int."""
+        self.lib = load_library()
+        if self.lib is None:
+            raise RuntimeError("native library unavailable (no g++?)")
+        images = np.ascontiguousarray(images, np.uint8)
+        if images.ndim == 3:
+            images = images[:, None]
+        n, c, h, w = images.shape
+        labels = np.ascontiguousarray(labels, np.int64)
+        mean = np.ascontiguousarray(np.broadcast_to(
+            np.asarray(mean, np.float32), (c,)))
+        std = np.ascontiguousarray(np.broadcast_to(
+            np.asarray(std, np.float32), (c,)))
+        self.handle = self.lib.pf_create_raw(
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, c, h, w,
+            mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if not self.handle:
+            raise RuntimeError("pf_create_raw failed")
+        self.n, self.c, self.h, self.w = n, c, h, w
+        self.batch_size = batch_size
+        self.n_workers = n_workers
+        self.queue_capacity = queue_capacity
+        self._rng = np.random.RandomState(seed)
+        self._epoch_open = False
+
+    # dataset protocol ---------------------------------------------------
+    def size(self):
+        return self.n
+
+    def shuffle(self):
+        return self
+
+    def batches_per_epoch(self):
+        return self.n // self.batch_size
+
+    def data(self, train: bool = True):
+        from ..dataset.minibatch import MiniBatch
+        if self._epoch_open:
+            self.lib.pf_end_epoch(self.handle)
+        order = (self._rng.permutation(self.n) if train
+                 else np.arange(self.n)).astype(np.int32)
+        order = np.ascontiguousarray(order)
+        self.lib.pf_start_epoch(
+            self.handle, order.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            self.n, self.batch_size, self.n_workers, self.queue_capacity)
+        self._epoch_open = True
+        per = self.c * self.h * self.w
+        while True:
+            x = np.empty((self.batch_size, self.c, self.h, self.w),
+                         np.float32)
+            y = np.empty((self.batch_size,), np.float32)
+            got = self.lib.pf_next(
+                self.handle, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if got == 0:
+                self._epoch_open = False
+                return
+            yield MiniBatch(x[:got], y[:got])
+
+    def transform(self, transformer):
+        raise NotImplementedError(
+            "NativePrefetcher bakes normalization in; compose python-side "
+            "transforms before constructing it")
+
+    def __del__(self):
+        try:
+            if getattr(self, "handle", None) and self.lib:
+                self.lib.pf_destroy(self.handle)
+        except Exception:
+            pass

@@ -31,12 +31,11 @@ class Graph(Container):
         self.topo: List[Node] = self._topo_sort()
         modules = [n.module for n in self.topo if n.module is not None]
         super().__init__(*modules, name=name)
-        # map node -> module index for params lookup
-        self._node_mod_idx = {}
+        # module index stored on the node (survives deepcopy)
         mi = 0
         for n in self.topo:
             if n.module is not None:
-                self._node_mod_idx[id(n)] = mi
+                n.mod_idx = mi
                 mi += 1
 
     def _topo_sort(self) -> List[Node]:
@@ -81,7 +80,7 @@ class Graph(Container):
                 continue
             ins = [values[id(p)] for p in n.prevs]
             arg = ins[0] if len(ins) == 1 else Table(*ins)
-            mi = self._node_mod_idx[id(n)]
+            mi = n.mod_idx
             sub_rng = None if rng is None else jax.random.fold_in(rng, mi)
             out, new_state[str(mi)] = self.modules[mi].apply(
                 params[str(mi)], state[str(mi)], arg, training, sub_rng)

@@ -48,12 +48,13 @@ class Node:
     Created by calling a module on other nodes: ``y = Linear(3, 4)(x_node)``.
     """
 
-    __slots__ = ("module", "prevs", "name")
+    __slots__ = ("module", "prevs", "name", "mod_idx")
 
     def __init__(self, module, prevs, name=None):
         self.module = module
         self.prevs = list(prevs)
         self.name = name or (module.name if module is not None else "input")
+        self.mod_idx = None  # set by Graph at construction
 
     def __repr__(self):
         return f"Node({self.name})"
@@ -189,7 +190,8 @@ class Module:
         return flat_w, flat_g, unravel
 
     def get_weights(self):
-        return _to_numpy_tree(self.params) if self.params is not None else None
+        self.ensure_initialized()
+        return _to_numpy_tree(self.params)
 
     def set_weights(self, weights):
         self.ensure_initialized()

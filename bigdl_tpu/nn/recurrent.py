@@ -53,12 +53,12 @@ def _uniform(rng, shape, stdv):
 class RnnCell(Cell):
     """Vanilla RNN cell (nn/RNN.scala): h' = act(W x + U h + b)."""
 
-    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
+    def __init__(self, input_size: int, hidden_size: int, activation=None,
                  isInputWithBias: bool = True, w_regularizer=None,
                  u_regularizer=None, b_regularizer=None, name=None):
         super().__init__(name=name)
         self.input_size, self.hidden_size = input_size, hidden_size
-        self.activation = activation
+        self.activation = activation  # None → tanh (picklable default)
 
     def _init_params(self, rng):
         k = jax.random.split(rng, 3)
@@ -72,6 +72,10 @@ class RnnCell(Cell):
 
     def step(self, params, x_t, h):
         act = self.activation if callable(self.activation) else jnp.tanh
+        if isinstance(self.activation, str):
+            import jax as _jax
+            act = {"tanh": jnp.tanh, "relu": _jax.nn.relu,
+                   "sigmoid": _jax.nn.sigmoid}[self.activation]
         nh = act(x_t @ params["w_i"] + h @ params["w_h"] + params["bias"])
         return nh, nh
 
@@ -85,8 +89,8 @@ class LSTM(Cell):
         super().__init__(name=name)
         self.input_size, self.hidden_size = input_size, hidden_size
         self.p = p
-        self.activation = activation or jnp.tanh
-        self.inner_activation = inner_activation or jax.nn.sigmoid
+        self.activation = activation  # None → tanh (picklable default)
+        self.inner_activation = inner_activation  # None → sigmoid
 
     def _init_params(self, rng):
         k = jax.random.split(rng, 3)
@@ -103,15 +107,17 @@ class LSTM(Cell):
                      jnp.zeros((batch_size, H), dtype))
 
     def step(self, params, x_t, h):
+        act = self.activation or jnp.tanh
+        inner = self.inner_activation or jax.nn.sigmoid
         hx, cx = h[1], h[2]
         z = x_t @ params["w_i"] + hx @ params["w_h"] + params["bias"]
         i, f, g, o = jnp.split(z, 4, axis=-1)
-        i = self.inner_activation(i)
-        f = self.inner_activation(f)
-        o = self.inner_activation(o)
-        g = self.activation(g)
+        i = inner(i)
+        f = inner(f)
+        o = inner(o)
+        g = act(g)
         c = f * cx + i * g
-        hnew = o * self.activation(c)
+        hnew = o * act(c)
         return hnew, Table(hnew, c)
 
 

@@ -154,12 +154,16 @@ class BaseOptimizer:
             return DataSet.from_arrays(ds[0], ds[1])
         if isinstance(ds, (list,)):
             return DataSet.array(ds)
+        if hasattr(ds, "data") and hasattr(ds, "size"):
+            return ds  # batch-level dataset (e.g. native.NativePrefetcher)
         raise TypeError(f"unsupported dataset {type(ds)}")
 
     def _num_shards(self):
         return 1
 
     def _batched(self):
+        if hasattr(self.training_set, "batches_per_epoch"):
+            return self.training_set  # already yields MiniBatches
         return ShardedDataSet(self.training_set, self.batch_size,
                               num_shards=self._num_shards())
 

@@ -1,0 +1,53 @@
+"""LeNet-5 on MNIST (parity: reference models/lenet/Train.scala and
+pyspark/bigdl/models/lenet/lenet5.py).
+
+Usage: python examples/lenet_mnist.py [--data-dir DIR] [--epochs N]
+Falls back to synthetic MNIST when no data dir is given (zero-egress envs).
+"""
+import argparse
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import LeNet5
+from bigdl_tpu.dataset import DataSet, mnist
+from bigdl_tpu.optim import (Optimizer, SGD, Top1Accuracy, Top5Accuracy,
+                             Loss, max_epoch, every_epoch)
+from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--log-dir", default=None)
+    args = ap.parse_args()
+
+    train_x, train_y = mnist.load(args.data_dir, train=True,
+                                  n_synthetic=2048)
+    test_x, test_y = mnist.load(args.data_dir, train=False, n_synthetic=512)
+    train_ds = DataSet.array(mnist.to_samples(train_x, train_y, train=True))
+    test_ds = DataSet.array(mnist.to_samples(test_x, test_y, train=False))
+
+    model = LeNet5(class_num=10)
+    opt = Optimizer(model=model, training_set=train_ds,
+                    criterion=nn.ClassNLLCriterion(),
+                    optim_method=SGD(learningrate=args.lr,
+                                     learningrate_decay=0.0002),
+                    end_trigger=max_epoch(args.epochs),
+                    batch_size=args.batch_size)
+    opt.set_validation(every_epoch(), test_ds,
+                       [Top1Accuracy(), Top5Accuracy(), Loss()],
+                       args.batch_size)
+    if args.log_dir:
+        opt.set_train_summary(TrainSummary(args.log_dir, "lenet"))
+        opt.set_val_summary(ValidationSummary(args.log_dir, "lenet"))
+    trained = opt.optimize()
+
+    results = trained.evaluate_dataset(test_ds, [Top1Accuracy()],
+                                       args.batch_size)
+    print(f"final: {results[0]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""PTB LSTM language model (parity: reference models/rnn/Train.scala +
+example/languagemodel)."""
+import argparse
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import PTBModel
+from bigdl_tpu.dataset import DataSet, text
+from bigdl_tpu.optim import Optimizer, Adam, max_epoch
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=200)
+    ap.add_argument("--seq-len", type=int, default=20)
+    ap.add_argument("--hidden", type=int, default=128)
+    args = ap.parse_args()
+
+    sents = text.ptb_synthetic(n_sentences=512, vocab=args.vocab,
+                               max_len=args.seq_len)
+    d = text.Dictionary(sents)
+    pipeline = text.TextToLabeledSentence(d) | \
+        text.LabeledSentenceToSample(fixed_length=args.seq_len)
+    samples = list(pipeline(sents))
+    ds = DataSet.array(samples)
+
+    model = PTBModel(input_size=d.vocab_size() + 1, hidden_size=args.hidden,
+                     output_size=d.vocab_size() + 1, num_layers=2)
+    crit = nn.TimeDistributedMaskCriterion(nn.ClassNLLCriterion(),
+                                           padding_value=0)
+    opt = Optimizer(model=model, training_set=ds, criterion=crit,
+                    optim_method=Adam(learningrate=2e-3),
+                    end_trigger=max_epoch(args.epochs), batch_size=32)
+    opt.optimize()
+    ppl = float(np.exp(min(opt.optim_method.state["loss"], 20.0)))
+    print(f"final train loss {opt.optim_method.state['loss']:.3f} "
+          f"(ppl ~{ppl:.1f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""ResNet-20 on CIFAR-10 (parity: reference models/resnet/TrainCIFAR10.scala).
+
+Demonstrates the reference's recipe: momentum SGD + weight decay + the
+epoch-decay schedule, with the vision augmentation pipeline.
+"""
+import argparse
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import ResNetCifar
+from bigdl_tpu.dataset import DataSet, Sample, cifar
+from bigdl_tpu.optim import (Optimizer, SGD, EpochStep, Top1Accuracy,
+                             max_epoch, every_epoch)
+from bigdl_tpu.transform import vision
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--depth", type=int, default=20)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=128)
+    args = ap.parse_args()
+
+    imgs, labels = cifar.load(args.data_dir, train=True, n_synthetic=1024)
+    # augmentation: pad-crop + flip on HWC, then normalize + CHW
+    pipeline = vision.RandomCrop(32, 32) | vision.RandomFlip(0.5) | \
+        vision.ChannelNormalize(*cifar.TRAIN_MEAN, *cifar.TRAIN_STD) | \
+        vision.MatToTensor()
+    hwc = [np.pad(i.transpose(1, 2, 0).astype(np.float32),
+                  ((4, 4), (4, 4), (0, 0))) for i in imgs]
+    feats = list(pipeline(hwc))
+    samples = [Sample(feats[i], np.int64(labels[i]))
+               for i in range(len(labels))]
+    train_ds = DataSet.array(samples)
+
+    model = ResNetCifar(10, depth=args.depth)
+    opt = Optimizer(model=model, training_set=train_ds,
+                    criterion=nn.CrossEntropyCriterion(),
+                    optim_method=SGD(learningrate=0.1, momentum=0.9,
+                                     weightdecay=1e-4, nesterov=True,
+                                     learningrate_schedule=EpochStep(80, 0.1)),
+                    end_trigger=max_epoch(args.epochs),
+                    batch_size=args.batch_size)
+    opt.set_validation(every_epoch(), train_ds, [Top1Accuracy()],
+                       args.batch_size)
+    opt.optimize()
+    print("metrics:", opt.metrics.summary())
+
+
+if __name__ == "__main__":
+    main()

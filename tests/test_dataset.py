@@ -1,0 +1,136 @@
+"""Dataset / transformer / vision / text pipeline tests (modeled on the
+reference's dataset + transform specs)."""
+import numpy as np
+
+from bigdl_tpu.dataset import (DataSet, Sample, MiniBatch, PaddingParam,
+                               SampleToMiniBatch, mnist, cifar, text)
+from bigdl_tpu.transform import vision
+from bigdl_tpu.utils.table import Table
+
+
+def test_sample_to_minibatch():
+    samples = [Sample(np.ones((3, 4)) * i, np.int64(i)) for i in range(10)]
+    batches = list(SampleToMiniBatch(4)(samples))
+    assert len(batches) == 3
+    assert batches[0].size() == 4
+    assert batches[2].size() == 2
+    assert batches[0].get_input().shape == (4, 3, 4)
+    assert batches[0].get_target().shape == (4,)
+    sliced = batches[0].slice(2, 2)
+    assert sliced.size() == 2
+    assert np.allclose(sliced.get_input()[0], 1.0)
+
+
+def test_minibatch_padding():
+    samples = [Sample(np.ones((t,)) * t, np.int64(t)) for t in (3, 5, 2)]
+    pad = PaddingParam(padding_value=-1.0)
+    mb = MiniBatch.from_samples(samples, feature_padding=pad)
+    assert mb.get_input().shape == (3, 5)
+    assert mb.get_input()[0, 3] == -1.0
+    pad_fixed = PaddingParam(padding_value=0.0, fixed_length=8)
+    mb = MiniBatch.from_samples(samples, feature_padding=pad_fixed)
+    assert mb.get_input().shape == (3, 8)
+
+
+def test_dataset_shuffle_iterate():
+    ds = DataSet.array(list(range(100)))
+    a = list(ds.data(train=True))
+    b = list(ds.data(train=True))
+    assert sorted(a) == list(range(100))
+    assert a != b  # shuffled differently
+
+
+def test_multi_feature_samples():
+    samples = [Sample([np.ones(3), np.zeros(2)], np.int64(1))
+               for _ in range(4)]
+    mb = MiniBatch.from_samples(samples)
+    assert isinstance(mb.get_input(), Table)
+    assert mb.get_input()[1].shape == (4, 3)
+    assert mb.get_input()[2].shape == (4, 2)
+
+
+def test_mnist_cifar_loaders():
+    imgs, labels = mnist.load(n_synthetic=64)
+    assert imgs.shape == (64, 28, 28) and imgs.dtype == np.uint8
+    assert labels.min() >= 1 and labels.max() <= 10
+    x = mnist.normalize(imgs)
+    assert abs(float(x.mean())) < 1.5
+
+    ci, cl = cifar.load(n_synthetic=32)
+    assert ci.shape == (32, 3, 32, 32)
+    s = cifar.to_samples(ci, cl)
+    assert s[0].feature().shape == (3, 32, 32)
+
+
+def test_cifar_binary_roundtrip(tmp_path):
+    imgs, labels = cifar.synthetic(16)
+    rec = np.concatenate([labels[:, None].astype(np.uint8),
+                          imgs.reshape(16, -1)], axis=1)
+    path = tmp_path / "data_batch_1.bin"
+    rec.tofile(str(path))
+    i2, l2 = cifar.load(str(tmp_path), train=True)
+    assert np.array_equal(i2, imgs)
+    assert np.array_equal(l2, labels + 1)
+
+
+def test_text_pipeline():
+    corpus = ["the cat sat on the mat. the dog ran.",
+              "a cat and a dog."]
+    sents = list(text.SentenceSplitter()(corpus))
+    assert len(sents) == 3
+    toks = list(text.SentenceTokenizer()(sents))
+    assert toks[0][0] == "the"
+    d = text.Dictionary(toks)
+    assert d.get_index("the") > 0
+    assert d.get_index("zebra") == 0  # unk
+    labeled = list(text.TextToLabeledSentence(d)(toks))
+    assert len(labeled[0].data) == len(labeled[0].label)
+    samples = list(text.LabeledSentenceToSample(fixed_length=8)(labeled))
+    assert samples[0].feature().shape == (8,)
+
+
+def test_vision_transforms():
+    img = np.random.rand(20, 24, 3).astype(np.float32) * 255
+    out = vision.Resize(10, 12).transform_image(img, np.random.RandomState(0))
+    assert out.shape == (10, 12, 3)
+    out = vision.CenterCrop(8, 6).transform_image(img,
+                                                  np.random.RandomState(0))
+    assert out.shape == (6, 8, 3)
+    out = vision.RandomCrop(8, 6).transform_image(img,
+                                                  np.random.RandomState(0))
+    assert out.shape == (6, 8, 3)
+    out = vision.HFlip().transform_image(img, np.random.RandomState(0))
+    assert np.allclose(out[:, ::-1], img)
+    out = vision.ChannelNormalize(10, 20, 30, 2, 2, 2).transform_image(
+        img, np.random.RandomState(0))
+    assert np.allclose(out, (img - [10, 20, 30]) / 2.0, atol=1e-5)
+    out = vision.MatToTensor().transform_image(img, np.random.RandomState(0))
+    assert out.shape == (3, 20, 24)
+    out = vision.RandomResizedCrop(16).transform_image(
+        img, np.random.RandomState(0))
+    assert out.shape == (16, 16, 3)
+    out = vision.Lighting().transform_image(img / 255.0,
+                                            np.random.RandomState(0))
+    assert out.shape == img.shape
+    out = vision.ColorJitter().transform_image(img, np.random.RandomState(0))
+    assert out.shape == img.shape
+    out = vision.Expand(max_expand_ratio=2.0).transform_image(
+        img, np.random.RandomState(1))
+    assert out.shape[0] >= 20 and out.shape[1] >= 24
+
+
+def test_vision_pipeline_compose():
+    imgs = [np.random.rand(28, 28, 3).astype(np.float32) * 255
+            for _ in range(4)]
+    pipeline = vision.Resize(16, 16) | vision.RandomFlip(0.5) | \
+        vision.ChannelNormalize(127, 127, 127, 50, 50, 50) | \
+        vision.MatToTensor()
+    out = list(pipeline(imgs))
+    assert len(out) == 4
+    assert out[0].shape == (3, 16, 16)
+
+
+def test_ptb_synthetic_markov():
+    sents = text.ptb_synthetic(n_sentences=10, vocab=50)
+    assert len(sents) == 10
+    assert all(t.startswith("w") for t in sents[0])

@@ -1,0 +1,103 @@
+"""Keras API tests (modeled on reference nn/keras specs +
+pyspark/test keras tests)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import keras as K
+from bigdl_tpu.dataset import mnist
+
+
+def test_sequential_shape_inference():
+    model = K.Sequential()
+    model.add(K.Convolution2D(8, 3, 3, activation="relu",
+                              input_shape=(1, 28, 28)))
+    model.add(K.MaxPooling2D((2, 2)))
+    model.add(K.Flatten())
+    model.add(K.Dense(32, activation="relu"))
+    model.add(K.Dense(10, activation="softmax"))
+    assert model.output_shape == (10,)
+    assert model.shapes[0] == (8, 26, 26)
+    assert model.shapes[1] == (8, 13, 13)
+    assert model.shapes[2] == (8 * 13 * 13,)
+    x = np.random.randn(4, 1, 28, 28).astype(np.float32)
+    out = model._module().evaluate().forward(x)
+    assert out.shape == (4, 10)
+    assert np.allclose(np.asarray(out).sum(-1), 1.0, atol=1e-4)
+
+
+def test_sequential_fit_mnist():
+    imgs, labels = mnist.load(n_synthetic=256)
+    x = mnist.normalize(imgs)[:, None]
+    y = labels - 1  # keras 0-based labels
+    model = K.Sequential()
+    model.add(K.Flatten(input_shape=(1, 28, 28)))
+    model.add(K.Dense(64, activation="relu"))
+    model.add(K.Dense(10))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=32, nb_epoch=3)
+    acc = model.evaluate(x, y)[0]
+    assert acc > 0.8, acc
+    pred = model.predict_classes(x[:16])
+    assert pred.shape == (16,)
+    assert pred.max() <= 9
+
+
+def test_functional_model_with_merge():
+    inp = K.Input(shape=(16,))
+    a = K.Dense(8, activation="relu")(inp)
+    b = K.Dense(8, activation="tanh")(inp)
+    merged = K.Merge(mode="concat")([a, b])
+    out = K.Dense(2)(merged)
+    model = K.Model(inp, out)
+    assert out.shape == (2,)
+    assert merged.shape == (16,)
+    x = np.random.randn(5, 16).astype(np.float32)
+    y = model._module().forward(x)
+    assert y.shape == (5, 2)
+
+
+def test_lstm_layers():
+    model = K.Sequential()
+    model.add(K.Embedding(100, 16, input_length=12))
+    model.add(K.LSTM(24, return_sequences=True))
+    model.add(K.LSTM(8))
+    model.add(K.Dense(2, activation="softmax"))
+    assert model.output_shape == (2,)
+    ids = np.random.randint(0, 100, size=(3, 12)).astype(np.float32)
+    out = model._module().evaluate().forward(ids)
+    assert out.shape == (3, 2)
+
+
+def test_bidirectional():
+    model = K.Sequential()
+    model.add(K.Bidirectional(K.GRU(6, return_sequences=True),
+                              merge_mode="concat", input_shape=(10, 4)))
+    assert model.output_shape == (10, 12)
+    x = np.random.randn(2, 10, 4).astype(np.float32)
+    assert model._module().forward(x).shape == (2, 10, 12)
+
+
+def test_misc_layers_shapes():
+    m = K.Sequential()
+    m.add(K.Reshape((4, 16), input_shape=(64,)))
+    m.add(K.Permute((2, 1)))
+    assert m.output_shape == (16, 4)
+    m.add(K.Flatten())
+    m.add(K.RepeatVector(3))
+    assert m.output_shape == (3, 64)
+    x = np.random.randn(2, 64).astype(np.float32)
+    assert m._module().forward(x).shape == (2, 3, 64)
+
+
+def test_batchnorm_timedistributed():
+    m = K.Sequential()
+    m.add(K.TimeDistributed(K.Dense(7), input_shape=(5, 3)))
+    assert m.output_shape == (5, 7)
+    x = np.random.randn(2, 5, 3).astype(np.float32)
+    assert m._module().forward(x).shape == (2, 5, 7)
+
+    m2 = K.Sequential()
+    m2.add(K.BatchNormalization(input_shape=(4, 8, 8)))
+    x = np.random.randn(2, 4, 8, 8).astype(np.float32)
+    assert m2._module().forward(x).shape == (2, 4, 8, 8)

@@ -1,0 +1,212 @@
+"""Optim method / schedule / trigger / checkpoint tests (modeled on the
+reference's optim/*Spec.scala)."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn, optim
+from bigdl_tpu.optim import (SGD, Adam, Adagrad, Adadelta, Adamax, RMSprop,
+                             Ftrl, LarsSGD, LBFGS, Trigger, max_iteration,
+                             max_epoch, every_epoch, several_iteration,
+                             min_loss, and_, or_)
+from bigdl_tpu.optim.optim_method import (Poly, Step, MultiStep, EpochStep,
+                                          Exponential, NaturalExp, Warmup,
+                                          SequentialSchedule, Plateau,
+                                          Default)
+from bigdl_tpu.dataset import DataSet, mnist
+from bigdl_tpu.models import LeNet5
+
+
+def _quadratic():
+    """min 0.5*||x - t||^2, t = [1, -2, 3]."""
+    t = jnp.asarray([1.0, -2.0, 3.0])
+
+    def feval(x):
+        return 0.5 * jnp.sum((x["x"] - t) ** 2), {"x": x["x"] - t}
+    return feval, {"x": jnp.zeros(3)}, t
+
+
+@pytest.mark.parametrize("method,iters,tol", [
+    (SGD(learningrate=0.5), 50, 1e-2),
+    (SGD(learningrate=0.2, momentum=0.9, nesterov=True), 80, 1e-2),
+    (Adam(learningrate=0.3), 200, 1e-2),
+    (Adagrad(learningrate=1.0), 300, 5e-2),
+    (Adadelta(decayrate=0.9, epsilon=1e-2), 500, 5e-2),
+    (Adamax(learningrate=0.5), 200, 5e-2),
+    (RMSprop(learningrate=0.3), 200, 5e-2),
+    (Ftrl(learningrate=1.0), 300, 5e-2),
+    (LarsSGD(learningrate=0.1, trust=0.5), 400, 2.0),
+])
+def test_method_converges_quadratic(method, iters, tol):
+    feval, x, t = _quadratic()
+    state = method.init_state(x)
+    for i in range(iters):
+        loss, g = feval(x)
+        x, state = method.update(g, x, state, method.current_lr())
+        method.state["neval"] += 1
+    assert float(jnp.max(jnp.abs(x["x"] - t))) < tol, \
+        (type(method).__name__, x["x"])
+
+
+def test_lbfgs_rosenbrock():
+    def feval(x):
+        a, b = x[0], x[1]
+        loss = (1 - a) ** 2 + 100 * (b - a * a) ** 2
+        g = jnp.asarray([-2 * (1 - a) - 400 * a * (b - a * a),
+                         200 * (b - a * a)])
+        return loss, g
+    lbfgs = LBFGS(max_iter=100, line_search=True)
+    x, losses = lbfgs.optimize(feval, jnp.zeros(2))
+    assert losses[-1] < 1e-4, losses[-1]
+    assert np.allclose(np.asarray(x), [1.0, 1.0], atol=1e-2)
+
+
+def test_schedules():
+    st = {"neval": 0, "epoch": 1}
+    assert Default().update_lr(0.1, st) == 0.1
+    d = Default()
+    d.decay = 0.1
+    st["neval"] = 10
+    assert abs(d.update_lr(0.1, st) - 0.1 / 2.0) < 1e-9
+
+    assert abs(Poly(0.5, 100).update_lr(1.0, {"neval": 75, "epoch": 1}) -
+               0.5) < 1e-9
+    assert Poly(0.5, 100).update_lr(1.0, {"neval": 100, "epoch": 1}) == 0.0
+    assert abs(Step(10, 0.5).update_lr(1.0, {"neval": 25, "epoch": 1}) -
+               0.25) < 1e-9
+    assert abs(MultiStep([10, 20], 0.1).update_lr(
+        1.0, {"neval": 15, "epoch": 1}) - 0.1) < 1e-9
+    assert abs(EpochStep(2, 0.5).update_lr(1.0, {"neval": 0, "epoch": 5}) -
+               0.25) < 1e-9
+    assert abs(Exponential(10, 0.5, stair_case=True).update_lr(
+        1.0, {"neval": 25, "epoch": 1}) - 0.25) < 1e-9
+    assert abs(NaturalExp(1, 0.1).update_lr(
+        1.0, {"neval": 2, "epoch": 1}) - np.exp(-0.2)) < 1e-6
+    assert abs(Warmup(0.01).update_lr(0.1, {"neval": 5, "epoch": 1}) -
+               0.15) < 1e-9
+
+    seq = SequentialSchedule(10).add(Warmup(0.01), 5).add(Default(), 100)
+    assert abs(seq.update_lr(0.1, {"neval": 3, "epoch": 1}) - 0.13) < 1e-9
+    assert abs(seq.update_lr(0.1, {"neval": 7, "epoch": 1}) - 0.1) < 1e-9
+
+
+def test_plateau():
+    p = Plateau(monitor="score", factor=0.5, patience=2, mode="max")
+    lr = 1.0
+    s = {"neval": 0, "epoch": 1, "score": 0.5}
+    assert p.update_lr(lr, s) == 1.0
+    for _ in range(3):  # no improvement for patience+1 steps
+        out = p.update_lr(lr, {"neval": 0, "epoch": 1, "score": 0.4})
+    assert out == 0.5
+
+
+def test_triggers():
+    assert max_iteration(10)({"neval": 10, "epoch": 1})
+    assert not max_iteration(10)({"neval": 9, "epoch": 1})
+    assert max_epoch(2)({"neval": 0, "epoch": 3})
+    assert several_iteration(5)({"neval": 5, "epoch": 1})
+    assert not several_iteration(5)({"neval": 6, "epoch": 1})
+    assert min_loss(0.1)({"neval": 0, "epoch": 1, "loss": 0.05})
+    t = and_(max_iteration(5), min_loss(1.0))
+    assert t({"neval": 5, "epoch": 1, "loss": 0.5})
+    assert not t({"neval": 4, "epoch": 1, "loss": 0.5})
+    e = every_epoch()
+    assert not e({"neval": 3, "epoch": 1, "epoch_finished": False})
+    assert e({"neval": 3, "epoch": 1, "epoch_finished": True})
+    assert not e({"neval": 4, "epoch": 1, "epoch_finished": True})  # same ep
+
+
+def test_gradient_clipping():
+    from bigdl_tpu.optim.optimizer import _clip_grads
+    g = {"a": jnp.asarray([3.0, -4.0])}
+    out = _clip_grads(g, clip_const=(-1.0, 1.0))
+    assert np.allclose(np.asarray(out["a"]), [1.0, -1.0])
+    out = _clip_grads(g, clip_norm=1.0)  # norm 5 → scale by 1/5
+    assert np.allclose(np.asarray(out["a"]), [0.6, -0.8])
+
+
+def test_checkpoint_resume(tmp_path):
+    from bigdl_tpu.optim import LocalOptimizer
+    imgs, labels = mnist.load(n_synthetic=128)
+    ds = DataSet.array(mnist.to_samples(imgs, labels))
+    model = LeNet5(10)
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                         SGD(learningrate=0.05), max_iteration(4),
+                         batch_size=32)
+    opt.set_checkpoint(several_iteration(2), str(tmp_path))
+    opt.optimize()
+    ckpt = os.path.join(str(tmp_path), "checkpoint.bigdl")
+    assert os.path.exists(ckpt)
+
+    model2 = LeNet5(10)
+    opt2 = LocalOptimizer(model2, ds, nn.ClassNLLCriterion(),
+                          SGD(learningrate=0.05), max_iteration(8),
+                          batch_size=32)
+    opt2.load_checkpoint(ckpt)
+    assert opt2.optim_method.state["neval"] == 4
+    opt2.optimize()
+    assert opt2.optim_method.state["neval"] == 8
+
+
+def test_train_summary(tmp_path):
+    from bigdl_tpu.optim import LocalOptimizer
+    from bigdl_tpu.visualization import TrainSummary
+    imgs, labels = mnist.load(n_synthetic=64)
+    ds = DataSet.array(mnist.to_samples(imgs, labels))
+    summ = TrainSummary(str(tmp_path), "test_app")
+    opt = LocalOptimizer(LeNet5(10), ds, nn.ClassNLLCriterion(),
+                         SGD(learningrate=0.01), max_iteration(3),
+                         batch_size=32)
+    opt.set_train_summary(summ)
+    opt.optimize()
+    scalars = summ.read_scalar("Loss")
+    assert len(scalars) == 3
+    assert scalars[0][0] == 1
+    # event file exists and is non-trivial
+    assert os.path.getsize(summ.writer.path) > 50
+
+
+def test_nan_policy():
+    from bigdl_tpu.optim import LocalOptimizer
+    from bigdl_tpu.dataset import Sample
+    x = np.random.randn(64, 4).astype(np.float32)
+    samples = [Sample(x[i], x[i, :1]) for i in range(64)]
+    opt = LocalOptimizer(nn.Linear(4, 1), DataSet.array(samples),
+                         nn.MSECriterion(), SGD(learningrate=1e20),
+                         max_iteration(5), batch_size=32)
+    with pytest.raises(FloatingPointError):
+        opt.optimize()
+
+
+def test_regularizer_applied():
+    from bigdl_tpu.optim import L2Regularizer, LocalOptimizer
+    x = np.random.randn(64, 4).astype(np.float32)
+    y = np.random.randn(64, 1).astype(np.float32)
+    from bigdl_tpu.dataset import Sample
+    samples = [Sample(x[i], y[i]) for i in range(64)]
+    m_reg = nn.Linear(4, 1, w_regularizer=L2Regularizer(10.0))
+    opt = LocalOptimizer(m_reg, DataSet.array(samples), nn.MSECriterion(),
+                         SGD(learningrate=0.1), max_iteration(50), 32)
+    opt.optimize()
+    w_reg = np.linalg.norm(np.asarray(m_reg.params["weight"]))
+
+    m_plain = nn.Linear(4, 1)
+    opt = LocalOptimizer(m_plain, DataSet.array(samples), nn.MSECriterion(),
+                         SGD(learningrate=0.1), max_iteration(50), 32)
+    opt.optimize()
+    w_plain = np.linalg.norm(np.asarray(m_plain.params["weight"]))
+    assert w_reg < w_plain  # regularized weights shrink
+
+
+def test_validation_during_training():
+    from bigdl_tpu.optim import LocalOptimizer, Top1Accuracy
+    imgs, labels = mnist.load(n_synthetic=128)
+    ds = DataSet.array(mnist.to_samples(imgs, labels))
+    opt = LocalOptimizer(LeNet5(10), ds, nn.ClassNLLCriterion(),
+                         SGD(learningrate=0.05), max_iteration(6), 64)
+    opt.set_validation(several_iteration(3), ds, [Top1Accuracy()], 64)
+    opt.optimize()
+    assert "score" in opt.optim_method.state

@@ -1,0 +1,65 @@
+"""Int8 quantization tests (modeled on reference
+nn/quantized specs + quantization accuracy checks)."""
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import LeNet5
+from bigdl_tpu.quantization import quantize, quantize_weight
+from bigdl_tpu.dataset import DataSet, mnist
+from bigdl_tpu.optim import LocalOptimizer, SGD, max_iteration, Top1Accuracy
+
+
+def test_quantize_weight_roundtrip():
+    w = np.random.randn(8, 16).astype(np.float32)
+    q, s = quantize_weight(w, axis=0)
+    deq = np.asarray(q, np.float32) * np.asarray(s)
+    assert np.abs(deq - w).max() < np.abs(w).max() / 100
+
+
+def test_quantized_linear_close_to_float():
+    m = nn.Linear(32, 16)
+    m.ensure_initialized()
+    x = np.random.randn(8, 32).astype(np.float32)
+    ref = np.asarray(m.forward(x))
+    qm = quantize(m)
+    out = np.asarray(qm.forward(x))
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantized_conv_close_to_float():
+    m = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+    m.ensure_initialized()
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    ref = np.asarray(m.forward(x))
+    qm = quantize(m)
+    out = np.asarray(qm.forward(x))
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantized_lenet_accuracy():
+    """Parity with the reference's int8 claim: accuracy drop ≤ 1%."""
+    imgs, labels = mnist.load(n_synthetic=256)
+    ds = DataSet.array(mnist.to_samples(imgs, labels))
+    model = LeNet5(10)
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                         SGD(learningrate=0.05), max_iteration(30), 64)
+    opt.optimize()
+    acc_f = model.evaluate_dataset(ds, [Top1Accuracy()], 64)[0].result()[0]
+    qmodel = quantize(model)
+    acc_q = qmodel.evaluate_dataset(ds, [Top1Accuracy()], 64)[0].result()[0]
+    assert acc_f - acc_q <= 0.01 + 1e-9, (acc_f, acc_q)
+
+
+def test_quantized_graph_model():
+    inp = nn.Input()
+    h = nn.SpatialConvolution(1, 4, 3, 3)(inp)
+    r = nn.ReLU()(h)
+    g = nn.Graph(inp, r)
+    g.ensure_initialized()
+    x = np.random.randn(1, 1, 6, 6).astype(np.float32)
+    ref = np.asarray(g.forward(x))
+    qg = quantize(g)
+    out = np.asarray(qg.forward(x))
+    assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.05
