@@ -55,9 +55,10 @@ class BatchNormalization(Module):
         ax = tuple(i for i in range(x.ndim) if i != self._channel_axis)
         bshape = [1] * x.ndim
         bshape[self._channel_axis] = self.n_output
+        xf = x.astype(jnp.float32)  # stats always in f32 (bf16-safe)
         if training:
-            mean = jnp.mean(x, axis=ax)
-            var = jnp.var(x, axis=ax)
+            mean = jnp.mean(xf, axis=ax)
+            var = jnp.var(xf, axis=ax)
             n = x.size // self.n_output
             unbiased = var * n / max(n - 1, 1)
             new_state = {
@@ -74,7 +75,8 @@ class BatchNormalization(Module):
         if self.affine:
             y = y * params["weight"].reshape(bshape) + \
                 params["bias"].reshape(bshape)
-        return y, new_state
+        # keep activation dtype (bf16 flows through; stats stay f32)
+        return y.astype(x.dtype), new_state
 
 
 class SpatialBatchNormalization(BatchNormalization):

@@ -29,6 +29,12 @@ import numpy as np
 _tmap = jax.tree_util.tree_map
 
 
+def _keep_dtype(new_params, params):
+    """Updates must not promote param dtype (bf16 params stay bf16 even
+    with an f32 lr scalar — promotion would retrace every conv)."""
+    return _tmap(lambda n, o: n.astype(o.dtype), new_params, params)
+
+
 # ---------------------------------------------------------------------------
 # Learning-rate schedules (parity: optim/SGD.scala:200-700)
 # ---------------------------------------------------------------------------
@@ -324,7 +330,7 @@ class SGD(OptimMethod):
         else:
             new_state = opt_state
         new_params = _tmap(lambda w, g: w - lr * g, params, grads)
-        return new_params, new_state
+        return _keep_dtype(new_params, params), new_state
 
 
 class Adam(OptimMethod):
@@ -356,7 +362,7 @@ class Adam(OptimMethod):
         new_params = _tmap(
             lambda w, mm, vv: w - lr * (mm / bc1) /
             (jnp.sqrt(vv / bc2) + eps), params, m, v)
-        return new_params, {"m": m, "v": v, "t": t}
+        return _keep_dtype(new_params, params), {"m": m, "v": v, "t": t}
 
 
 class ParallelAdam(Adam):
@@ -388,7 +394,7 @@ class Adagrad(OptimMethod):
         new_params = _tmap(
             lambda w, g, a: w - lr * g / (jnp.sqrt(a) + 1e-10),
             params, grads, accum)
-        return new_params, {"accum": accum}
+        return _keep_dtype(new_params, params), {"accum": accum}
 
 
 class Adadelta(OptimMethod):
@@ -413,7 +419,7 @@ class Adadelta(OptimMethod):
         delta_accum = _tmap(lambda d, dl: rho * d + (1 - rho) * dl * dl,
                             opt_state["delta_accum"], delta)
         new_params = _tmap(lambda w, d: w - lr * d, params, delta)
-        return new_params, {"accum": accum, "delta_accum": delta_accum}
+        return _keep_dtype(new_params, params), {"accum": accum, "delta_accum": delta_accum}
 
 
 class Adamax(OptimMethod):
@@ -438,7 +444,7 @@ class Adamax(OptimMethod):
         bc = 1 - b1 ** t.astype(jnp.float32)
         new_params = _tmap(lambda w, mm, uu: w - (lr / bc) * mm / uu,
                            params, m, u)
-        return new_params, {"m": m, "u": u, "t": t}
+        return _keep_dtype(new_params, params), {"m": m, "u": u, "t": t}
 
 
 class RMSprop(OptimMethod):
@@ -465,7 +471,7 @@ class RMSprop(OptimMethod):
         new_params = _tmap(
             lambda w, g, a: w - lr * g / (jnp.sqrt(a) + self.epsilon),
             params, grads, accum)
-        return new_params, {"accum": accum}
+        return _keep_dtype(new_params, params), {"accum": accum}
 
 
 class Ftrl(OptimMethod):
@@ -512,7 +518,7 @@ class Ftrl(OptimMethod):
         new_params = jax.tree_util.tree_unflatten(tdef, [o[0] for o in outs])
         accum = jax.tree_util.tree_unflatten(tdef, [o[1] for o in outs])
         linear = jax.tree_util.tree_unflatten(tdef, [o[2] for o in outs])
-        return new_params, {"accum": accum, "linear": linear}
+        return _keep_dtype(new_params, params), {"accum": accum, "linear": linear}
 
 
 class LarsSGD(OptimMethod):
@@ -552,7 +558,7 @@ class LarsSGD(OptimMethod):
         outs = [upd(w, g, v) for w, g, v in zip(flat_p, flat_g, flat_v)]
         new_params = jax.tree_util.tree_unflatten(tdef, [o[0] for o in outs])
         new_v = jax.tree_util.tree_unflatten(tdef, [o[1] for o in outs])
-        return new_params, {"v": new_v}
+        return _keep_dtype(new_params, params), {"v": new_v}
 
 
 class LBFGS(OptimMethod):
@@ -646,4 +652,5 @@ class LBFGS(OptimMethod):
 
     def update(self, grads, params, opt_state, lr):
         # plain gradient step when used inside a jitted loop
-        return _tmap(lambda w, g: w - lr * g, params, grads), opt_state
+        return _keep_dtype(_tmap(lambda w, g: w - lr * g, params, grads),
+                           params), opt_state

@@ -1,3 +1,7 @@
 from .table import Table, T
 from .shape import Shape, SingleShape, MultiShape
 from . import engine
+from .directed_graph import DirectedGraph, Node as GraphNode, Edge
+from .misc import (File, ThreadPool, crc32, string_hash,
+                   redirect_spark_info_logs, profile_trace,
+                   device_memory_stats)

@@ -1,0 +1,104 @@
+"""Directed graph utility.
+
+Parity: reference ``utils/DirectedGraph.scala`` + ``utils/Node.scala`` /
+``Edge`` — generic DAG with topological sort, BFS, DFS, reverse. Used by the
+serialization/IR tooling (the nn Graph container keeps its own lean node
+type for trace-time speed).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, List, Optional
+
+
+class Edge:
+    def __init__(self, from_index: Optional[int] = None):
+        self.from_index = from_index
+
+    def __repr__(self):
+        return f"Edge({self.from_index})"
+
+
+class Node:
+    def __init__(self, element: Any):
+        self.element = element
+        self.nexts: List[tuple] = []  # (node, edge)
+        self.prevs: List[tuple] = []
+
+    def add(self, node: "Node", edge: Optional[Edge] = None):
+        e = edge or Edge()
+        self.nexts.append((node, e))
+        node.prevs.append((self, e))
+        return node
+
+    def delete(self, node: "Node"):
+        self.nexts = [(n, e) for n, e in self.nexts if n is not node]
+        node.prevs = [(n, e) for n, e in node.prevs if n is not self]
+        return self
+
+    def remove_prev_edges(self):
+        for p, e in list(self.prevs):
+            p.nexts = [(n, ee) for n, ee in p.nexts if n is not self]
+        self.prevs = []
+        return self
+
+    def __repr__(self):
+        return f"Node({self.element})"
+
+
+class DirectedGraph:
+    def __init__(self, source: Node, reverse: bool = False):
+        self.source = source
+        self.reverse = reverse
+
+    def _neighbors(self, node: Node):
+        pairs = node.prevs if self.reverse else node.nexts
+        return [n for n, _ in pairs]
+
+    def bfs(self):
+        seen = {id(self.source)}
+        q = deque([self.source])
+        while q:
+            n = q.popleft()
+            yield n
+            for nb in self._neighbors(n):
+                if id(nb) not in seen:
+                    seen.add(id(nb))
+                    q.append(nb)
+
+    def dfs(self):
+        seen = set()
+        stack = [self.source]
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            yield n
+            for nb in reversed(self._neighbors(n)):
+                if id(nb) not in seen:
+                    stack.append(nb)
+
+    def topology_sort(self) -> List[Node]:
+        order, temp, perm = [], set(), set()
+
+        def visit(n):
+            if id(n) in perm:
+                return
+            if id(n) in temp:
+                raise ValueError("graph contains a cycle")
+            temp.add(id(n))
+            for nb in self._neighbors(n):
+                visit(nb)
+            temp.discard(id(n))
+            perm.add(id(n))
+            order.append(n)
+
+        visit(self.source)
+        return list(reversed(order))
+
+    def size(self):
+        return sum(1 for _ in self.bfs())
+
+    def edges(self):
+        return sum(len(self._neighbors(n)) for n in self.bfs())

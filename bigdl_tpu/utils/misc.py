@@ -1,0 +1,109 @@
+"""Runtime utilities.
+
+Parity: reference ``utils/LoggerFilter.scala`` (log redirection/quieting),
+``utils/File.scala`` (save/load), ``utils/Crc32.scala`` + ``HashFunc``,
+``utils/ThreadPool.scala`` (host-side executor — device parallelism belongs
+to XLA), and profiling hooks (reference ``optim/Metrics`` + jax.profiler).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import contextlib
+import hashlib
+import logging
+import os
+import pickle
+import zlib
+
+
+# ---------------------------------------------------------------------------
+# LoggerFilter (utils/LoggerFilter.scala)
+# ---------------------------------------------------------------------------
+def redirect_spark_info_logs(log_file: str = "bigdl.log",
+                             quiet_loggers=("jax", "absl")):
+    """Quiet noisy third-party loggers to a file, keep bigdl_tpu on console
+    (parity: LoggerFilter.redirectSparkInfoLogs)."""
+    handler = logging.FileHandler(log_file)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(name)s %(levelname)s %(message)s"))
+    for name in quiet_loggers:
+        lg = logging.getLogger(name)
+        lg.handlers = [handler]
+        lg.propagate = False
+    logging.getLogger("bigdl_tpu").setLevel(logging.INFO)
+
+
+# ---------------------------------------------------------------------------
+# File (utils/File.scala)
+# ---------------------------------------------------------------------------
+class File:
+    @staticmethod
+    def save(obj, path: str, overwrite: bool = True):
+        if not overwrite and os.path.exists(path):
+            raise IOError(f"{path} exists; overwrite=False")
+        with open(path, "wb") as f:
+            pickle.dump(obj, f)
+
+    @staticmethod
+    def load(path: str):
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Crc32 / HashFunc (utils/Crc32.scala, utils/HashFunc.scala)
+# ---------------------------------------------------------------------------
+def crc32(data: bytes, seed: int = 0) -> int:
+    return zlib.crc32(data, seed) & 0xFFFFFFFF
+
+
+def string_hash(s: str) -> int:
+    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
+
+
+# ---------------------------------------------------------------------------
+# ThreadPool (utils/ThreadPool.scala) — host-side only
+# ---------------------------------------------------------------------------
+class ThreadPool:
+    """Host-side executor for IO/augmentation. The reference used this to
+    parallelise layer compute across Xeon cores; on TPU that role belongs to
+    XLA, so this only serves the input pipeline."""
+
+    def __init__(self, pool_size: int):
+        self.pool_size = pool_size
+        self._ex = concurrent.futures.ThreadPoolExecutor(pool_size)
+
+    def invoke(self, fns):
+        return [self._ex.submit(fn) for fn in fns]
+
+    def invoke_and_wait(self, fns):
+        return [f.result() for f in self.invoke(fns)]
+
+    def shutdown(self):
+        self._ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Profiling (jax.profiler integration + device memory stats)
+# ---------------------------------------------------------------------------
+@contextlib.contextmanager
+def profile_trace(logdir: str):
+    """Capture an XLA profile viewable in TensorBoard/perfetto."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def device_memory_stats():
+    """Per-device memory stats (HBM usage) where the backend reports them."""
+    import jax
+    out = {}
+    for d in jax.devices():
+        try:
+            out[str(d)] = d.memory_stats()
+        except Exception:
+            out[str(d)] = None
+    return out

@@ -1,0 +1,53 @@
+"""dlframes pipeline-stage tests (modeled on reference DLEstimatorSpec /
+DLClassifierSpec)."""
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dlframes import DLClassifier, DLEstimator
+
+
+def _toy_classification(n=200, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32) + 1  # classes 1/2
+    return x, y
+
+
+def test_dlclassifier_fit_transform():
+    x, y = _toy_classification()
+    model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    est = DLClassifier(model, nn.ClassNLLCriterion(), [4])
+    est.set_batch_size(32).set_max_epoch(15).set_learning_rate(1e-2)
+    df = {"features": x, "label": y}
+    fitted = est.fit(df)
+    out = fitted.transform({"features": x})
+    pred = out["prediction"]
+    acc = float(np.mean(pred == y))
+    assert acc > 0.85, acc
+
+
+def test_dlestimator_regression():
+    rng = np.random.RandomState(1)
+    x = rng.randn(200, 3).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5], np.float32))[:, None]
+    est = DLEstimator(nn.Linear(3, 1), nn.MSECriterion(), [3], [1])
+    est.set_max_epoch(30).set_learning_rate(5e-2)
+    model = est.fit({"features": x, "label": y})
+    out = model.transform({"features": x})
+    mse = float(np.mean((out["prediction"] - y) ** 2))
+    assert mse < 0.1, mse
+
+
+def test_dlframes_with_pandas():
+    pd = __import__("pandas")
+    x, y = _toy_classification(100)
+    df = pd.DataFrame({"features": list(x), "label": y})
+    est = DLClassifier(
+        nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2),
+                      nn.LogSoftMax()),
+        nn.ClassNLLCriterion(), [4]).set_max_epoch(10)
+    fitted = est.fit(df)
+    out = fitted.transform(df)
+    assert "prediction" in out.columns
+    assert len(out) == 100

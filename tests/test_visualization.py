@@ -1,0 +1,59 @@
+"""TensorBoard event-writer tests: wire format integrity (TFRecord framing,
+masked crc32c) without a TF dependency."""
+import struct
+
+import numpy as np
+
+from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+from bigdl_tpu.visualization.event_writer import (crc32c, _masked_crc,
+                                                  EventWriter)
+
+
+def test_crc32c_known_values():
+    # RFC 3720 test vectors
+    assert crc32c(b"") == 0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(bytes(32)) == 0x8A9136AA
+
+
+def _read_records(path):
+    records = []
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                break
+            (length,) = struct.unpack("<Q", hdr)
+            (len_crc,) = struct.unpack("<I", f.read(4))
+            assert len_crc == _masked_crc(hdr)
+            data = f.read(length)
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            assert data_crc == _masked_crc(data)
+            records.append(data)
+    return records
+
+
+def test_event_file_structure(tmp_path):
+    w = EventWriter(str(tmp_path))
+    w.add_scalar("loss", 1.5, 1)
+    w.add_scalar("loss", 1.2, 2)
+    w.add_histogram("weights", np.random.randn(100), 1)
+    w.close()
+    records = _read_records(w.path)
+    assert len(records) == 4  # file version + 3 events
+    assert b"brain.Event:2" in records[0]
+    assert b"loss" in records[1]
+    assert b"weights" in records[3]
+
+
+def test_summary_read_scalar(tmp_path):
+    s = TrainSummary(str(tmp_path), "app")
+    s.add_scalar("Loss", 2.0, 1).add_scalar("Loss", 1.0, 2)
+    assert s.read_scalar("Loss") == [(1, 2.0), (2, 1.0)]
+    assert s.read_scalar("Missing") == []
+    v = ValidationSummary(str(tmp_path), "app")
+    v.add_scalar("Top1Accuracy", 0.9, 10)
+    assert v.read_scalar("Top1Accuracy") == [(10, 0.9)]
+    import os
+    assert os.path.isdir(os.path.join(str(tmp_path), "app", "train"))
+    assert os.path.isdir(os.path.join(str(tmp_path), "app", "validation"))
