@@ -77,6 +77,7 @@ class ImageNetDataSet:
             self.paths = None
             self.synthetic_imgs, self.labels = synthetic(n_synthetic,
                                                          crop_size)
+            self.classes = sorted({int(l) for l in self.labels})
         if train:
             self.pipeline = (vision.RandomResizedCrop(crop_size) |
                              vision.RandomFlip(0.5) |

@@ -52,6 +52,10 @@ from .recurrent import (Cell, RnnCell, RNN, LSTM, LSTMPeephole, GRU,
                         ConvLSTMPeephole, ConvLSTMPeephole3D, MultiRNNCell,
                         Recurrent, RecurrentDecoder, BiRecurrent,
                         TimeDistributed)
+from .detection import (Anchor, Nms, PriorBox, Proposal, DetectionOutputSSD,
+                        DetectionOutputFrcnn, RoiAlign, bbox_transform_inv,
+                        bbox_iou_matrix, bbox_areas, clip_boxes, decode_boxes,
+                        nms_mask, generate_basic_anchors, bbox_vote)
 from .attention import (Attention, FeedForwardNetwork, Transformer,
                         TransformerBlock, dot_product_attention,
                         flash_attention, position_encoding, causal_mask,

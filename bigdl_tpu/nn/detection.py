@@ -1,0 +1,730 @@
+"""Object-detection heads: anchors, NMS, prior boxes, proposals, SSD/F-RCNN
+post-processing, RoiAlign.
+
+Parity: reference ``nn/Anchor.scala``, ``nn/Nms.scala``, ``nn/PriorBox.scala``,
+``nn/Proposal.scala``, ``nn/DetectionOutputSSD.scala``,
+``nn/DetectionOutputFrcnn.scala`` and
+``transform/vision/image/util/BboxUtil.scala``.
+
+TPU-first design (NOT a translation):
+
+The reference implements NMS and box decoding as sequential in-place loops over
+``Array[Float]`` storage. Here all box math (area, IoU, transform-inv, decode,
+clip) is vectorised ``jnp`` working on ``(N, 4)`` arrays, and greedy NMS is a
+*masked fixed-shape* kernel — an O(N^2) IoU matrix plus a ``lax.fori_loop``
+that computes a boolean keep-mask — so the whole thing stays inside ``jit``
+with static shapes (the TPU-friendly formulation; the variable-length index
+list of the reference is recovered on the host only at the very end).
+The DetectionOutput* modules are inference-time post-processors that produce
+variable-length detections, matching the reference's packed
+``(batch, 1 + maxDet * 6)`` output layout.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .module import Module
+
+
+# ----------------------------------------------------------------------------
+# Vectorised box utilities (BboxUtil.scala parity)
+# ----------------------------------------------------------------------------
+
+def bbox_areas(boxes, normalized: bool = False):
+    """Areas of ``(N, 4)`` [x1, y1, x2, y2] boxes.
+
+    ``normalized=False`` uses the pixel convention ``(x2 - x1 + 1)`` of
+    ``Nms.scala getAreas``; ``normalized=True`` the [0, 1] convention.
+    """
+    off = 0.0 if normalized else 1.0
+    return (boxes[:, 2] - boxes[:, 0] + off) * (boxes[:, 3] - boxes[:, 1] + off)
+
+
+def bbox_iou_matrix(boxes_a, boxes_b, normalized: bool = False):
+    """Pairwise IoU of two ``(N, 4)`` / ``(M, 4)`` box sets → ``(N, M)``."""
+    off = 0.0 if normalized else 1.0
+    ax1, ay1, ax2, ay2 = [boxes_a[:, i][:, None] for i in range(4)]
+    bx1, by1, bx2, by2 = [boxes_b[:, i][None, :] for i in range(4)]
+    iw = jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1) + off
+    ih = jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1) + off
+    inter = jnp.maximum(iw, 0.0) * jnp.maximum(ih, 0.0)
+    area_a = bbox_areas(boxes_a, normalized)[:, None]
+    area_b = bbox_areas(boxes_b, normalized)[None, :]
+    return inter / (area_a + area_b - inter)
+
+
+def bbox_transform_inv(boxes, deltas):
+    """Apply (dx, dy, dw, dh) regression deltas to boxes.
+
+    Parity: ``BboxUtil.bboxTransformInv`` — widths use the ``+1`` pixel
+    convention, centres are ``x1 + width/2``. ``boxes`` is ``(N, 4)``;
+    ``deltas`` is ``(N, 4 * A)`` (A sets of deltas per box). Returns the same
+    shape as ``deltas``.
+    """
+    boxes = jnp.asarray(boxes, jnp.float32)
+    deltas = jnp.asarray(deltas, jnp.float32)
+    repeat = deltas.shape[1] // 4
+    d = deltas.reshape(deltas.shape[0], repeat, 4)
+    x1, y1 = boxes[:, 0:1], boxes[:, 1:2]
+    w = boxes[:, 2:3] - x1 + 1.0
+    h = boxes[:, 3:4] - y1 + 1.0
+    ctr_x = d[:, :, 0] * w + x1 + w / 2.0
+    ctr_y = d[:, :, 1] * h + y1 + h / 2.0
+    half_w = jnp.exp(d[:, :, 2]) * w / 2.0
+    half_h = jnp.exp(d[:, :, 3]) * h / 2.0
+    out = jnp.stack([ctr_x - half_w, ctr_y - half_h,
+                     ctr_x + half_w, ctr_y + half_h], axis=-1)
+    return out.reshape(deltas.shape)
+
+
+def clip_boxes(boxes, height, width, min_h: float = 0.0, min_w: float = 0.0,
+               scores=None):
+    """Clip ``(N, 4*A)`` boxes to ``[0, width-1] x [0, height-1]``.
+
+    Parity: ``BboxUtil.clipBoxes`` — if ``scores`` is given, boxes whose
+    clipped width/height fall below ``min_w``/``min_h`` get score 0; returns
+    ``(clipped, scores, kept_count)``; otherwise just the clipped boxes.
+    """
+    boxes = jnp.asarray(boxes, jnp.float32)
+    a = boxes.reshape(boxes.shape[0], -1, 4)
+    x = jnp.clip(a[..., 0::2], 0.0, width - 1.0)
+    y = jnp.clip(a[..., 1::2], 0.0, height - 1.0)
+    clipped = jnp.stack([x[..., 0], y[..., 0], x[..., 1], y[..., 1]], axis=-1)
+    flat = clipped.reshape(boxes.shape)
+    if scores is None:
+        return flat
+    w = clipped[..., 2] - clipped[..., 0] + 1.0
+    h = clipped[..., 3] - clipped[..., 1] + 1.0
+    ok = (w >= min_w) & (h >= min_h)
+    ok = ok.reshape(scores.shape)
+    new_scores = jnp.where(ok, scores, 0.0)
+    return flat, new_scores, jnp.sum(ok.astype(jnp.int32))
+
+
+def decode_boxes(prior_boxes, prior_variances, deltas,
+                 variance_encoded_in_target: bool = False,
+                 clip: bool = False):
+    """SSD box decoding (``BboxUtil.decodeBoxes``). All args ``(N, 4)``.
+
+    Prior widths use the normalised (no ``+1``) convention.
+    """
+    p = jnp.asarray(prior_boxes, jnp.float32)
+    v = jnp.asarray(prior_variances, jnp.float32)
+    d = jnp.asarray(deltas, jnp.float32)
+    pw = p[:, 2] - p[:, 0]
+    ph = p[:, 3] - p[:, 1]
+    pcx = (p[:, 0] + p[:, 2]) / 2.0
+    pcy = (p[:, 1] + p[:, 3]) / 2.0
+    if variance_encoded_in_target:
+        cx = d[:, 0] * pw + pcx
+        cy = d[:, 1] * ph + pcy
+        w = jnp.exp(d[:, 2]) * pw
+        h = jnp.exp(d[:, 3]) * ph
+    else:
+        cx = v[:, 0] * d[:, 0] * pw + pcx
+        cy = v[:, 1] * d[:, 1] * ph + pcy
+        w = jnp.exp(v[:, 2] * d[:, 2]) * pw
+        h = jnp.exp(v[:, 3] * d[:, 3]) * ph
+    out = jnp.stack([cx - w / 2.0, cy - h / 2.0,
+                     cx + w / 2.0, cy + h / 2.0], axis=1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def scale_bboxes(boxes, height, width):
+    """Scale box coords by (width, height, width, height) — BboxUtil.scaleBBox."""
+    s = jnp.asarray([width, height, width, height], jnp.float32)
+    return jnp.asarray(boxes, jnp.float32) * s[None, :]
+
+
+# ----------------------------------------------------------------------------
+# Anchors (Anchor.scala parity)
+# ----------------------------------------------------------------------------
+
+def generate_basic_anchors(ratios: Sequence[float], scales: Sequence[float],
+                           base_size: float = 16.0) -> np.ndarray:
+    """Enumerate ratio x scale anchors around a (0, 0, base-1, base-1) window.
+
+    Parity: ``Anchor.generateBasicAnchors`` — ratio widths are *rounded* to
+    the nearest integer before centring, matching the reference (and the
+    original py-faster-rcnn). Returns ``(len(ratios) * len(scales), 4)``.
+    """
+    base = np.array([0.0, 0.0, base_size - 1.0, base_size - 1.0], np.float32)
+
+    def info(a):
+        w = a[2] - a[0] + 1
+        h = a[3] - a[1] + 1
+        return w, h, a[0] + 0.5 * (w - 1), a[1] + 0.5 * (h - 1)
+
+    def mk(ws, hs, xc, yc):
+        ws, hs = np.asarray(ws, np.float32), np.asarray(hs, np.float32)
+        return np.stack([xc - (ws / 2 - 0.5), yc - (hs / 2 - 0.5),
+                         xc + (ws / 2 - 0.5), yc + (hs / 2 - 0.5)], axis=1)
+
+    w, h, xc, yc = info(base)
+    area = w * h
+    ws = np.array([round(math.sqrt(area / r)) for r in ratios], np.float32)
+    hs = np.array([round(wi * r) for wi, r in zip(ws, ratios)], np.float32)
+    ratio_anchors = mk(ws, hs, xc, yc)
+    out = []
+    for i in range(ratio_anchors.shape[0]):
+        w, h, xc, yc = info(ratio_anchors[i])
+        sw = np.array([s * w for s in scales], np.float32)
+        sh = np.array([s * h for s in scales], np.float32)
+        out.append(mk(sw, sh, xc, yc))
+    return np.concatenate(out, axis=0)
+
+
+class Anchor:
+    """Regular grid of multi-scale multi-aspect anchors (``nn/Anchor.scala``)."""
+
+    def __init__(self, ratios: Sequence[float], scales: Sequence[float]):
+        self.ratios = list(ratios)
+        self.scales = list(scales)
+        self.basic_anchors = generate_basic_anchors(ratios, scales)
+        self.anchor_num = len(ratios) * len(scales)
+
+    def generate_anchors(self, width: int, height: int,
+                         feat_stride: float = 16.0) -> np.ndarray:
+        """All anchors over a ``height x width`` feature map, ordered
+        (y, x, anchor) slowest→fastest like the reference. ``(H*W*A, 4)``."""
+        sx = np.arange(width, dtype=np.float32) * feat_stride
+        sy = np.arange(height, dtype=np.float32) * feat_stride
+        # shift layout: for each y, for each x, each basic anchor
+        shifts = np.stack(
+            [np.tile(sx, height),
+             np.repeat(sy, width),
+             np.tile(sx, height),
+             np.repeat(sy, width)], axis=1)  # (H*W, 4)
+        all_a = (self.basic_anchors[None, :, :] + shifts[:, None, :])
+        return all_a.reshape(-1, 4).astype(np.float32)
+
+
+# ----------------------------------------------------------------------------
+# NMS — masked greedy kernel (Nms.scala parity, jit-friendly formulation)
+# ----------------------------------------------------------------------------
+
+def nms_mask(boxes, scores, iou_thresh: float, score_thresh: float = 0.0,
+             topk: int = -1, eta: float = 1.0, normalized: bool = False,
+             sorted_input: bool = False, valid=None):
+    """Greedy NMS as a fixed-shape masked kernel.
+
+    Returns ``(order, keep)`` where ``order`` is the score-descending
+    candidate index list (length ``min(topk, N)`` if ``topk > 0``, else
+    ``N``) and ``keep[i]`` says whether ``boxes[order[i]]`` survives.
+    Everything is static-shape, so this whole function jits onto TPU; the
+    caller converts to a variable-length index list on the host if needed.
+
+    ``valid`` is an optional boolean mask of live entries — padding and
+    data-dependent pre-filters (e.g. per-class score cuts) are expressed
+    through it so the compiled kernel is reused across inputs instead of
+    retracing on every new candidate count.
+
+    When ``topk > 0`` the candidate set is truncated *before* the O(M^2)
+    IoU matrix is built, so the pairwise work is ``min(topk, N)^2``, not
+    ``N^2`` (parity with ``Nms.nmsFast`` which only examines the top-k).
+
+    Semantics follow ``Nms.nms`` (``eta==1, score_thresh==0``) and
+    ``Nms.nmsFast`` (adaptive ``eta``, score threshold, topk).
+    """
+    boxes = jnp.asarray(boxes, jnp.float32)
+    scores = jnp.asarray(scores, jnp.float32)
+    n = scores.shape[0]
+    if n == 0:
+        return jnp.zeros((0,), jnp.int32), jnp.zeros((0,), bool)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+    if score_thresh > 0:
+        v = v & (scores >= score_thresh)
+    if sorted_input:
+        order = jnp.arange(n, dtype=jnp.int32)
+    else:
+        # invalid entries sort to the back so topk truncation keeps the
+        # top-k *valid* candidates
+        masked = jnp.where(v, scores, -jnp.inf)
+        order = jnp.argsort(-masked, stable=True).astype(jnp.int32)
+    if topk and 0 < topk < n:
+        order = order[:topk]
+    m = order.shape[0]
+    bs = boxes[order]
+    vs = v[order]
+    iou = bbox_iou_matrix(bs, bs, normalized=normalized)
+    idx = jnp.arange(m)
+
+    def body(i, carry):
+        keep, thresh = carry
+        suppressed = jnp.any(keep & (iou[i] > thresh) & (idx < i))
+        ki = vs[i] & ~suppressed
+        keep = keep.at[i].set(ki)
+        if eta < 1.0:
+            thresh = jnp.where(ki & (thresh > 0.5), thresh * eta, thresh)
+        return keep, thresh
+
+    keep, _ = lax.fori_loop(
+        0, m, body, (jnp.zeros((m,), bool), jnp.float32(iou_thresh)))
+    return order, keep
+
+
+_nms_mask_jit = jax.jit(nms_mask, static_argnames=(
+    "iou_thresh", "score_thresh", "topk", "eta", "normalized", "sorted_input"))
+
+
+def _bucket_pad(boxes, scores, min_cap: int = 16):
+    """Pad (boxes, scores) up to a power-of-two length so the jitted NMS
+    kernel compiles once per size bucket instead of once per input length."""
+    n = scores.shape[0]
+    cap = max(min_cap, 1 << (n - 1).bit_length())
+    if cap == n:
+        return boxes, scores, np.ones((n,), bool)
+    pad = cap - n
+    b = np.concatenate([boxes, np.zeros((pad, 4), np.float32)])
+    s = np.concatenate([scores, np.full((pad,), -np.inf, np.float32)])
+    valid = np.arange(cap) < n
+    return b, s, valid
+
+
+class Nms:
+    """Host-facing NMS with the reference's index-list API (``nn/Nms.scala``).
+
+    ``nms``/``nms_fast`` return a 0-based numpy index array into the input
+    (the reference returns a count plus 1-based indices in a caller buffer).
+    Inputs are padded to power-of-two buckets before hitting the jitted
+    kernel, bounding XLA recompiles to O(log N) distinct shapes.
+    """
+
+    def nms(self, scores, boxes, thresh: float, sorted_input: bool = False
+            ) -> np.ndarray:
+        scores = np.asarray(scores, np.float32)
+        boxes = np.asarray(boxes, np.float32)
+        if scores.size == 0:
+            return np.zeros((0,), np.int64)
+        b, s, valid = _bucket_pad(boxes, scores)
+        order, keep = _nms_mask_jit(
+            b, s, iou_thresh=float(thresh), sorted_input=sorted_input,
+            valid=valid)
+        order, keep = np.asarray(order), np.asarray(keep)
+        return order[keep]
+
+    def nms_fast(self, scores, boxes, nms_thresh: float, score_thresh: float,
+                 topk: int = -1, eta: float = 1.0, normalized: bool = True
+                 ) -> np.ndarray:
+        scores = np.asarray(scores, np.float32)
+        boxes = np.asarray(boxes, np.float32)
+        if scores.size == 0:
+            return np.zeros((0,), np.int64)
+        b, s, valid = _bucket_pad(boxes, scores)
+        order, keep = _nms_mask_jit(
+            b, s, iou_thresh=float(nms_thresh),
+            score_thresh=float(score_thresh), topk=int(topk),
+            eta=float(eta), normalized=normalized, valid=valid)
+        order, keep = np.asarray(order), np.asarray(keep)
+        return order[keep]
+
+
+# ----------------------------------------------------------------------------
+# PriorBox (PriorBox.scala parity)
+# ----------------------------------------------------------------------------
+
+class PriorBox(Module):
+    """Generate SSD prior boxes across a feature map (``nn/PriorBox.scala``).
+
+    Output ``(1, 2, layerH * layerW * numPriors * 4)``: channel 0 the prior
+    coordinates, channel 1 the variances.
+    """
+
+    def __init__(self, min_sizes: Sequence[float],
+                 max_sizes: Optional[Sequence[float]] = None,
+                 aspect_ratios: Optional[Sequence[float]] = None,
+                 is_flip: bool = True, is_clip: bool = False,
+                 variances: Optional[Sequence[float]] = None,
+                 offset: float = 0.5, img_h: int = 0, img_w: int = 0,
+                 img_size: int = 0, step_h: float = 0.0, step_w: float = 0.0,
+                 step: float = 0.0, name=None):
+        super().__init__(name=name)
+        assert min_sizes, "must provide min_sizes"
+        self.min_sizes = list(min_sizes)
+        self.max_sizes = list(max_sizes) if max_sizes else []
+        ars = [1.0]
+        for ar in (aspect_ratios or []):
+            if not any(abs(ar - a) < 1e-6 for a in ars):
+                ars.append(float(ar))
+                if is_flip:
+                    ars.append(1.0 / ar)
+        self.aspect_ratios = ars
+        self.num_priors = len(ars) * len(self.min_sizes) + len(self.max_sizes)
+        if self.max_sizes:
+            assert len(self.max_sizes) == len(self.min_sizes)
+        self.is_clip = is_clip
+        self.variances = list(variances) if variances is not None else [0.1]
+        if len(self.variances) > 1:
+            assert len(self.variances) == 4, "must provide exactly 4 variances"
+        self.offset = offset
+        self.img_h = img_h or img_size
+        self.img_w = img_w or img_size
+        self.step_h = step_h or step
+        self.step_w = step_w or step
+        self._cache = {}  # (layer_h, layer_w) -> device prior tensor
+
+    def _priors_for(self, layer_h: int, layer_w: int) -> np.ndarray:
+        img_w, img_h = float(self.img_w), float(self.img_h)
+        step_w = self.step_w or img_w / layer_w
+        step_h = self.step_h or img_h / layer_h
+        # per-cell template: (num_priors, 4) half-sizes in pixel units,
+        # ordered min, [sqrt(min*max)], ratios != 1 — per min_size
+        halves = []
+        for s, mn in enumerate(self.min_sizes):
+            m = float(int(mn))
+            halves.append((m / 2.0, m / 2.0))
+            if self.max_sizes:
+                hw = math.sqrt(int(mn) * int(self.max_sizes[s])) / 2.0
+                halves.append((hw, hw))
+            for ar in self.aspect_ratios:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                v = math.sqrt(ar)
+                halves.append((m * v / 2.0, m / v / 2.0))
+        halves = np.asarray(halves, np.float32)  # (P, 2) [half_w, half_h]
+        cx = (np.arange(layer_w, dtype=np.float32) + self.offset) * step_w
+        cy = (np.arange(layer_h, dtype=np.float32) + self.offset) * step_h
+        cx = np.tile(cx, layer_h)
+        cy = np.repeat(cy, layer_w)  # (H*W,) row-major cells
+        centers = np.stack([cx, cy], axis=1)  # (H*W, 2)
+        c = centers[:, None, :]          # (H*W, 1, 2)
+        hwh = halves[None, :, :]         # (1, P, 2)
+        boxes = np.concatenate([c - hwh, c + hwh], axis=2)  # (H*W, P, 4)
+        boxes /= np.array([img_w, img_h, img_w, img_h], np.float32)
+        flat = boxes.reshape(-1)
+        if self.is_clip:
+            flat = np.clip(flat, 0.0, 1.0)
+        if len(self.variances) == 1:
+            var = np.full_like(flat, self.variances[0])
+        else:
+            var = np.tile(np.asarray(self.variances, np.float32),
+                          flat.shape[0] // 4)
+        return np.stack([flat, var], axis=0)[None]  # (1, 2, dim)
+
+    def _apply(self, params, state, x, training, rng):
+        feature = x[1] if not hasattr(x, "shape") else x
+        assert self.img_w > 0 and self.img_h > 0, "img_w and img_h must be > 0"
+        layer_h, layer_w = int(feature.shape[2]), int(feature.shape[3])
+        # priors depend only on the feature-map size — cache per size like
+        # the reference's early-out (PriorBox.scala:135)
+        key = (layer_h, layer_w)
+        if key not in self._cache:
+            self._cache[key] = jnp.asarray(self._priors_for(layer_h, layer_w))
+        return self._cache[key]
+
+
+# ----------------------------------------------------------------------------
+# Proposal (Proposal.scala parity)
+# ----------------------------------------------------------------------------
+
+class Proposal(Module):
+    """RPN proposal layer (``nn/Proposal.scala``).
+
+    Input table: (cls scores ``(1, 2A, H, W)``, bbox deltas ``(1, 4A, H, W)``,
+    im_info ``(1, 4)`` [height, width, scale_h, scale_w]). Output
+    ``(numKeep, 5)`` rows ``[0, x1, y1, x2, y2]``.
+    """
+
+    MIN_SIZE = 16.0
+
+    def __init__(self, pre_nms_topn: int, post_nms_topn: int,
+                 ratios: Sequence[float], scales: Sequence[float],
+                 rpn_pre_nms_topn_train: int = 12000,
+                 rpn_post_nms_topn_train: int = 2000, name=None):
+        super().__init__(name=name)
+        self.pre_nms_topn = pre_nms_topn
+        self.post_nms_topn = post_nms_topn
+        self.rpn_pre_nms_topn_train = rpn_pre_nms_topn_train
+        self.rpn_post_nms_topn_train = rpn_post_nms_topn_train
+        self.anchor = Anchor(ratios, scales)
+
+    def _apply(self, params, state, x, training, rng):
+        cls_score, bbox_pred, im_info = x[1], x[2], x[3]
+        assert cls_score.shape[0] == 1 and im_info.shape[0] == 1, \
+            "only single batch supported (reference Proposal.scala:82)"
+        a_num = self.anchor.anchor_num
+        h, w = int(cls_score.shape[2]), int(cls_score.shape[3])
+        # (1, 4A, H, W) -> (H*W*A, 4) ordered (h, w, a)
+        deltas = jnp.transpose(
+            jnp.asarray(bbox_pred).reshape(a_num, 4, h, w), (2, 3, 0, 1)
+        ).reshape(-1, 4)
+        # foreground scores: second half of the 2A channel dim
+        scores = jnp.transpose(
+            jnp.asarray(cls_score)[0, a_num:], (1, 2, 0)).reshape(-1)
+        anchors = jnp.asarray(
+            self.anchor.generate_anchors(w, h))
+        proposals = bbox_transform_inv(anchors, deltas)
+        info = np.asarray(im_info)[0]
+        min_h = self.MIN_SIZE * info[2]
+        min_w = self.MIN_SIZE * info[3]
+        proposals, scores, _ = clip_boxes(
+            proposals, float(info[0]), float(info[1]), float(min_h),
+            float(min_w), scores)
+        pre_n = self.rpn_pre_nms_topn_train if training else self.pre_nms_topn
+        post_n = (self.rpn_post_nms_topn_train if training
+                  else self.post_nms_topn)
+        # fixed-shape NMS call: the min-size filter (score zeroed) enters as
+        # the validity mask and pre_nms_topn as the static topk, so one
+        # compiled kernel serves every image of this feature-map size
+        order, keep_mask = _nms_mask_jit(
+            proposals, scores, iou_thresh=0.7, topk=int(pre_n),
+            valid=scores > 0)
+        keep = np.asarray(order)[np.asarray(keep_mask)]
+        if post_n > 0:
+            keep = keep[:post_n]
+        kept = np.asarray(proposals)[keep]
+        out = np.concatenate(
+            [np.zeros((kept.shape[0], 1), np.float32), kept], axis=1)
+        return jnp.asarray(out)
+
+
+# ----------------------------------------------------------------------------
+# DetectionOutputSSD (DetectionOutputSSD.scala parity)
+# ----------------------------------------------------------------------------
+
+def _softmax_np(x, axis=-1):
+    m = np.max(x, axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / np.sum(e, axis=axis, keepdims=True)
+
+
+class DetectionOutputSSD(Module):
+    """SSD post-processing (``nn/DetectionOutputSSD.scala``).
+
+    Input table: (loc ``(B, nPriors*4)``, conf ``(B, nPriors*nClasses)``,
+    prior ``(1, 2, nPriors*4)``). Output ``(B, 1 + maxDet*6)``; per image the
+    first element is the detection count, then rows
+    ``[label, score, x1, y1, x2, y2]``. Training mode passes input through.
+    """
+
+    def __init__(self, n_classes: int = 21, share_location: bool = True,
+                 bg_label: int = 0, nms_thresh: float = 0.45,
+                 nms_topk: int = 400, keep_topk: int = 200,
+                 conf_thresh: float = 0.01,
+                 variance_encoded_in_target: bool = False,
+                 conf_post_process: bool = True, name=None):
+        super().__init__(name=name)
+        assert share_location, "share_location=False not supported"
+        self.n_classes = n_classes
+        self.bg_label = bg_label
+        self.nms_thresh = nms_thresh
+        self.nms_topk = nms_topk
+        self.keep_topk = keep_topk
+        self.conf_thresh = conf_thresh
+        self.variance_encoded_in_target = variance_encoded_in_target
+        self.conf_post_process = conf_post_process
+        self._nms = Nms()
+
+    def _apply(self, params, state, x, training, rng):
+        if training:
+            return x
+        loc = np.asarray(x[1], np.float32)
+        conf = np.asarray(x[2], np.float32)
+        prior = np.asarray(x[3], np.float32)
+        batch = loc.shape[0]
+        n_priors = prior.shape[2] // 4
+        prior_boxes = prior[0, 0].reshape(n_priors, 4)
+        prior_vars = prior[0, 1].reshape(n_priors, 4)
+        conf = conf.reshape(batch, n_priors, self.n_classes)
+        if self.conf_post_process:
+            conf = _softmax_np(conf, axis=-1)
+        loc = loc.reshape(batch, n_priors, 4)
+
+        results = []  # per image: list of (label, score, box) arrays
+        max_det = 0
+        for i in range(batch):
+            decoded = np.asarray(decode_boxes(
+                prior_boxes, prior_vars, loc[i],
+                self.variance_encoded_in_target))
+            dets = []
+            for c in range(self.n_classes):
+                if c == self.bg_label:
+                    continue
+                keep = self._nms.nms_fast(
+                    conf[i, :, c], decoded, self.nms_thresh, self.conf_thresh,
+                    topk=self.nms_topk, normalized=True)
+                for idx in keep:
+                    dets.append((c, conf[i, idx, c], decoded[idx]))
+            if self.keep_topk > -1 and len(dets) > self.keep_topk:
+                dets.sort(key=lambda d: -d[1])
+                dets = dets[:self.keep_topk]
+                dets.sort(key=lambda d: d[0])  # regroup by class like ref
+            results.append(dets)
+            max_det = max(max_det, len(dets))
+
+        out = np.zeros((batch, 1 + max_det * 6), np.float32)
+        for i, dets in enumerate(results):
+            out[i, 0] = len(dets)
+            off = 1
+            for (c, s, box) in dets:
+                out[i, off:off + 6] = [c, s, box[0], box[1], box[2], box[3]]
+                off += 6
+        return jnp.asarray(out)
+
+
+# ----------------------------------------------------------------------------
+# DetectionOutputFrcnn (DetectionOutputFrcnn.scala parity)
+# ----------------------------------------------------------------------------
+
+def bbox_vote(scores_nms, bbox_nms, scores_all, bbox_all):
+    """Weighted box voting (``BboxUtil.bboxVote``): each kept box becomes the
+    score-weighted average of all candidate boxes overlapping it by IoU>=0.5."""
+    scores_nms = np.asarray(scores_nms, np.float32)
+    bbox_nms = np.asarray(bbox_nms, np.float32).copy()
+    scores_all = np.asarray(scores_all, np.float32)
+    bbox_all = np.asarray(bbox_all, np.float32)
+    iou = np.asarray(bbox_iou_matrix(jnp.asarray(bbox_nms),
+                                     jnp.asarray(bbox_all)))
+    for i in range(bbox_nms.shape[0]):
+        m = iou[i] >= 0.5
+        wsum = scores_all[m].sum()
+        if wsum > 0:
+            bbox_nms[i] = (scores_all[m, None] * bbox_all[m]).sum(0) / wsum
+    return scores_nms, bbox_nms
+
+
+class DetectionOutputFrcnn(Module):
+    """Faster-RCNN post-processing (``nn/DetectionOutputFrcnn.scala``).
+
+    Input table: (im_info ``(1, 4)``, rois ``(N, 5)``, box deltas
+    ``(N, 4*nClasses)``, scores ``(N, nClasses)``). Output
+    ``(1, 1 + maxDet*6)`` rows ``[label, score, x1, y1, x2, y2]``.
+    """
+
+    def __init__(self, nms_thresh: float = 0.3, n_classes: int = 21,
+                 bbox_vote: bool = False, max_per_image: int = 100,
+                 thresh: float = 0.05, name=None):
+        super().__init__(name=name)
+        self.nms_thresh = nms_thresh
+        self.n_classes = n_classes
+        self.use_bbox_vote = bbox_vote
+        self.max_per_image = max_per_image
+        self.thresh = thresh
+
+    def _apply(self, params, state, x, training, rng):
+        if training:
+            return x
+        im_info = np.asarray(x[1], np.float32)
+        rois = np.asarray(x[2], np.float32)
+        box_deltas = np.asarray(x[3], np.float32)
+        scores = np.asarray(x[4], np.float32)
+        # unscale rois back to raw image space
+        boxes = np.asarray(scale_bboxes(
+            rois[:, 1:5], 1.0 / im_info[0, 2], 1.0 / im_info[0, 3]))
+        pred = np.asarray(bbox_transform_inv(boxes, box_deltas))
+        pred = np.asarray(clip_boxes(
+            pred, im_info[0, 0] / im_info[0, 2], im_info[0, 1] / im_info[0, 3]))
+        pred = pred.reshape(pred.shape[0], self.n_classes, 4)
+
+        per_class = {}  # label -> (scores, boxes)
+        for c in range(1, self.n_classes):
+            # score cut enters as the validity mask so the jitted kernel
+            # keeps a single static shape (n_rois) across classes/images
+            cls_valid = scores[:, c] > self.thresh
+            if not cls_valid.any():
+                continue
+            order, keep_mask = _nms_mask_jit(
+                pred[:, c], scores[:, c], iou_thresh=float(self.nms_thresh),
+                valid=cls_valid)
+            keep = np.asarray(order)[np.asarray(keep_mask)]
+            s, b = scores[keep, c], pred[keep, c]
+            if self.use_bbox_vote:
+                s, b = bbox_vote(s, b, scores[cls_valid, c],
+                                 pred[cls_valid, c])
+            per_class[c] = (s, b)
+
+        if self.max_per_image > 0:
+            all_scores = np.concatenate(
+                [s for s, _ in per_class.values()]) if per_class else np.empty(0)
+            if all_scores.size > self.max_per_image:
+                thresh = np.sort(all_scores)[-self.max_per_image]
+                per_class = {
+                    c: (s[s >= thresh], b[s >= thresh])
+                    for c, (s, b) in per_class.items()}
+
+        n_det = sum(s.shape[0] for s, _ in per_class.values())
+        out = np.zeros((1, 1 + n_det * 6), np.float32)
+        out[0, 0] = n_det
+        off = 1
+        for c in sorted(per_class):
+            s, b = per_class[c]
+            for j in range(s.shape[0]):
+                out[0, off:off + 6] = [c, s[j], b[j, 0], b[j, 1], b[j, 2],
+                                       b[j, 3]]
+                off += 6
+        return jnp.asarray(out)
+
+
+# ----------------------------------------------------------------------------
+# RoiAlign — TPU-friendly bilinear ROI pooling (Mask-RCNN style; the
+# reference family's successor to nn/RoiPooling.scala's max pooling)
+# ----------------------------------------------------------------------------
+
+class RoiAlign(Module):
+    """Bilinear ROI align. Input: Table(features NCHW, rois (R, 5)
+    [batchIdx, x1, y1, x2, y2]). Fully jittable (static shapes, gather +
+    vmap) — unlike quantised RoiPooling there is no data-dependent rounding,
+    which keeps XLA happy and gradients exact.
+    """
+
+    def __init__(self, pooled_w: int, pooled_h: int,
+                 spatial_scale: float = 1.0, sampling_ratio: int = 2,
+                 mode: str = "avg", name=None):
+        super().__init__(name=name)
+        self.pooled_w, self.pooled_h = pooled_w, pooled_h
+        self.spatial_scale = spatial_scale
+        self.sampling_ratio = max(1, sampling_ratio)
+        assert mode in ("avg", "max")
+        self.mode = mode
+
+    def _apply(self, params, state, x, training, rng):
+        feats, rois = x[1], x[2]
+        B, C, H, W = feats.shape
+        sr = self.sampling_ratio
+
+        def bilinear(fm, ys, xs):
+            y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+            y1 = jnp.clip(y0 + 1, 0, H - 1)
+            x1 = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(ys, 0, H - 1) - y0
+            wx = jnp.clip(xs, 0, W - 1) - x0
+            y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+            x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+            v00 = fm[:, y0i, :][:, :, x0i]
+            v01 = fm[:, y0i, :][:, :, x1i]
+            v10 = fm[:, y1i, :][:, :, x0i]
+            v11 = fm[:, y1i, :][:, :, x1i]
+            wy = wy[None, :, None]
+            wx = wx[None, None, :]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                    v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        def pool_one(roi):
+            bi = roi[0].astype(jnp.int32)
+            x1 = roi[1] * self.spatial_scale
+            y1 = roi[2] * self.spatial_scale
+            x2 = roi[3] * self.spatial_scale
+            y2 = roi[4] * self.spatial_scale
+            rw = jnp.maximum(x2 - x1, 1.0)
+            rh = jnp.maximum(y2 - y1, 1.0)
+            bin_w = rw / self.pooled_w
+            bin_h = rh / self.pooled_h
+            # sample grid: pooled*sr points per dim, centred in sub-bins
+            gy = (y1 + (jnp.arange(self.pooled_h * sr) + 0.5) * bin_h / sr)
+            gx = (x1 + (jnp.arange(self.pooled_w * sr) + 0.5) * bin_w / sr)
+            vals = bilinear(feats[bi], gy, gx)  # (C, ph*sr, pw*sr)
+            v = vals.reshape(C, self.pooled_h, sr, self.pooled_w, sr)
+            if self.mode == "avg":
+                return v.mean(axis=(2, 4))
+            return v.max(axis=(2, 4))
+
+        return jax.vmap(pool_one)(jnp.asarray(rois, jnp.float32))

@@ -80,21 +80,27 @@ class DirectedGraph:
                     stack.append(nb)
 
     def topology_sort(self) -> List[Node]:
+        # iterative post-order DFS: no recursion limit on deep chains
         order, temp, perm = [], set(), set()
-
-        def visit(n):
+        stack = [(self.source, False)]
+        while stack:
+            n, children_done = stack.pop()
             if id(n) in perm:
-                return
+                continue
+            if children_done:
+                temp.discard(id(n))
+                perm.add(id(n))
+                order.append(n)
+                continue
             if id(n) in temp:
                 raise ValueError("graph contains a cycle")
             temp.add(id(n))
-            for nb in self._neighbors(n):
-                visit(nb)
-            temp.discard(id(n))
-            perm.add(id(n))
-            order.append(n)
-
-        visit(self.source)
+            stack.append((n, True))
+            for nb in reversed(self._neighbors(n)):
+                if id(nb) in temp and id(nb) not in perm:
+                    raise ValueError("graph contains a cycle")
+                if id(nb) not in perm:
+                    stack.append((nb, False))
         return list(reversed(order))
 
     def size(self):

@@ -30,6 +30,9 @@ def redirect_spark_info_logs(log_file: str = "bigdl.log",
         lg = logging.getLogger(name)
         lg.handlers = [handler]
         lg.propagate = False
+        # capture INFO into the file (otherwise the logger inherits the
+        # root's WARNING level and INFO records are dropped, not redirected)
+        lg.setLevel(logging.INFO)
     logging.getLogger("bigdl_tpu").setLevel(logging.INFO)
 
 

@@ -1,0 +1,285 @@
+"""Detection heads: anchors, NMS, PriorBox, Proposal, DetectionOutput*, RoiAlign.
+
+Oracles are independent numpy re-implementations of the reference semantics
+(nn/Nms.scala, nn/Anchor.scala, BboxUtil.scala), so the jax kernels are
+checked against straight-line scalar code, not against themselves.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import Table
+
+
+# ---------------------------------------------------------------- oracles --
+
+def np_iou(a, b, normalized=False):
+    off = 0.0 if normalized else 1.0
+    iw = min(a[2], b[2]) - max(a[0], b[0]) + off
+    ih = min(a[3], b[3]) - max(a[1], b[1]) + off
+    if iw <= 0 or ih <= 0:
+        return 0.0
+    inter = iw * ih
+    area_a = (a[2] - a[0] + off) * (a[3] - a[1] + off)
+    area_b = (b[2] - b[0] + off) * (b[3] - b[1] + off)
+    return inter / (area_a + area_b - inter)
+
+
+def np_greedy_nms(scores, boxes, thresh, normalized=False):
+    order = np.argsort(-scores, kind="stable")
+    keep, suppressed = [], np.zeros(len(scores), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if not suppressed[j] and j != i and \
+                    np_iou(boxes[i], boxes[j], normalized) > thresh:
+                suppressed[j] = True
+    return np.array(keep, np.int64)
+
+
+def random_boxes(n, seed, size=100.0):
+    rng = np.random.RandomState(seed)
+    x1 = rng.uniform(0, size, n)
+    y1 = rng.uniform(0, size, n)
+    w = rng.uniform(5, 40, n)
+    h = rng.uniform(5, 40, n)
+    boxes = np.stack([x1, y1, x1 + w, y1 + h], 1).astype(np.float32)
+    scores = rng.uniform(0.01, 1.0, n).astype(np.float32)
+    return boxes, scores
+
+
+# ------------------------------------------------------------------- tests --
+
+def test_nms_matches_numpy_oracle():
+    for seed in range(5):
+        boxes, scores = random_boxes(40, seed)
+        got = nn.Nms().nms(scores, boxes, 0.5)
+        want = np_greedy_nms(scores, boxes, 0.5)
+        assert np.array_equal(np.sort(got), np.sort(want))
+
+
+def test_nms_fast_score_thresh_and_topk():
+    boxes, scores = random_boxes(50, 7)
+    got = nn.Nms().nms_fast(scores, boxes, 0.5, score_thresh=0.4, topk=10,
+                            normalized=True)
+    # every kept score passes the threshold
+    assert np.all(scores[got] >= 0.4)
+    # keeping among the top-10 candidates only
+    top10 = set(np.argsort(-scores, kind="stable")[:10])
+    assert set(got.tolist()) <= top10
+    # oracle on the surviving candidate set
+    cand = sorted(top10, key=lambda i: -scores[i])
+    keep, supp = [], set()
+    for i in cand:
+        if i in supp or scores[i] < 0.4:
+            continue
+        keep.append(i)
+        for j in cand:
+            if j not in supp and j != i and \
+                    np_iou(boxes[i], boxes[j], True) > 0.5:
+                supp.add(j)
+    assert sorted(got.tolist()) == sorted(keep)
+
+
+def test_nms_mask_is_jittable():
+    boxes, scores = random_boxes(16, 3)
+    f = jax.jit(lambda b, s: nn.nms_mask(b, s, iou_thresh=0.5))
+    order, keep = f(boxes, scores)
+    got = np.asarray(order)[np.asarray(keep)]
+    want = np_greedy_nms(scores, boxes, 0.5)
+    assert np.array_equal(np.sort(got), np.sort(want))
+
+
+def test_basic_anchors_faster_rcnn_values():
+    # canonical py-faster-rcnn anchors for ratios 0.5,1,2 scales 8,16,32
+    a = nn.generate_basic_anchors([0.5, 1.0, 2.0], [8.0, 16.0, 32.0])
+    want = np.array([
+        [-84., -40., 99., 55.],
+        [-176., -88., 191., 103.],
+        [-360., -184., 375., 199.],
+        [-56., -56., 71., 71.],
+        [-120., -120., 135., 135.],
+        [-248., -248., 263., 263.],
+        [-36., -80., 51., 95.],
+        [-80., -168., 95., 183.],
+        [-168., -344., 183., 359.]], np.float32)
+    assert np.allclose(a, want)
+
+
+def test_anchor_grid_shift_order():
+    anc = nn.Anchor([1.0], [1.0])
+    all_a = anc.generate_anchors(width=3, height=2, feat_stride=16.0)
+    assert all_a.shape == (6, 4)
+    base = all_a[0]
+    # x varies fastest
+    assert np.allclose(all_a[1], base + [16, 0, 16, 0])
+    assert np.allclose(all_a[3], base + [0, 16, 0, 16])
+
+
+def test_bbox_transform_inv_and_clip():
+    boxes = np.array([[0., 0., 9., 19.]], np.float32)  # w=10 h=20
+    deltas = np.array([[0.1, -0.2, np.log(2.0), 0.0]], np.float32)
+    out = np.asarray(nn.bbox_transform_inv(boxes, deltas))
+    cx, cy = 0 + 10 / 2 + 0.1 * 10, 0 + 20 / 2 - 0.2 * 20
+    assert np.allclose(out[0], [cx - 10, cy - 10, cx + 10, cy + 10], atol=1e-5)
+    clipped = np.asarray(nn.clip_boxes(out, 15.0, 12.0))
+    assert clipped[0, 0] >= 0 and clipped[0, 2] <= 11 and clipped[0, 3] <= 14
+
+
+def test_decode_boxes_variance():
+    priors = np.array([[0.1, 0.1, 0.3, 0.3]], np.float32)
+    var = np.array([[0.1, 0.1, 0.2, 0.2]], np.float32)
+    deltas = np.array([[1.0, 0.5, 0.0, 0.0]], np.float32)
+    out = np.asarray(nn.decode_boxes(priors, var, deltas))
+    pw = ph = 0.2
+    cx = 0.2 + 0.1 * 1.0 * pw
+    cy = 0.2 + 0.1 * 0.5 * ph
+    assert np.allclose(out[0], [cx - pw / 2, cy - ph / 2,
+                                cx + pw / 2, cy + ph / 2], atol=1e-6)
+
+
+def test_priorbox_shape_and_values():
+    pb = nn.PriorBox([30.0], max_sizes=[60.0], aspect_ratios=[2.0],
+                     is_flip=True, is_clip=False,
+                     variances=[0.1, 0.1, 0.2, 0.2], img_h=300, img_w=300)
+    feat = jnp.zeros((1, 3, 2, 2))
+    out = np.asarray(pb.forward(feat))
+    # priors per cell: 1 (min) + 1 (max) + 2 (ar 2, 1/2) = 4
+    assert out.shape == (1, 2, 2 * 2 * 4 * 4)
+    boxes = out[0, 0].reshape(-1, 4)
+    # first cell centre = (0.5*150, 0.5*150) = (75, 75); first prior min_size 30
+    assert np.allclose(boxes[0] * 300.0, [60., 60., 90., 90.], atol=1e-4)
+    # second prior: sqrt(30*60)
+    s = np.sqrt(30.0 * 60.0) / 2
+    assert np.allclose(boxes[1] * 300.0, [75 - s, 75 - s, 75 + s, 75 + s],
+                       atol=1e-4)
+    var = out[0, 1].reshape(-1, 4)
+    assert np.allclose(var[5], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_proposal_outputs_valid_rois():
+    rng = np.random.RandomState(0)
+    A, H, W = 3, 4, 5
+    # fg/bg scores are softmax outputs in the reference → positive
+    scores = rng.rand(1, 2 * A, H, W).astype(np.float32)
+    deltas = (rng.randn(1, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[64.0, 80.0, 1.0, 1.0]], np.float32)
+    prop = nn.Proposal(pre_nms_topn=50, post_nms_topn=10,
+                       ratios=[0.5, 1.0, 2.0], scales=[1.0])
+    prop.evaluate()
+    out = np.asarray(prop.forward(Table(jnp.asarray(scores),
+                                        jnp.asarray(deltas),
+                                        jnp.asarray(im_info))))
+    assert out.ndim == 2 and out.shape[1] == 5 and out.shape[0] <= 10
+    assert np.all(out[:, 0] == 0)
+    assert np.all(out[:, 1] >= 0) and np.all(out[:, 3] <= 79)
+    assert np.all(out[:, 2] >= 0) and np.all(out[:, 4] <= 63)
+    # proposals wide/tall enough survive the min-size filter
+    assert np.all(out[:, 3] - out[:, 1] + 1 >= 16)
+    assert np.all(out[:, 4] - out[:, 2] + 1 >= 16)
+
+
+def test_detection_output_ssd():
+    n_priors, n_classes = 8, 3
+    rng = np.random.RandomState(1)
+    priors = np.zeros((1, 2, n_priors * 4), np.float32)
+    grid = np.linspace(0.05, 0.7, n_priors, dtype=np.float32)
+    pb = np.stack([grid, grid, grid + 0.2, grid + 0.2], 1)
+    priors[0, 0] = pb.reshape(-1)
+    priors[0, 1] = np.tile([0.1, 0.1, 0.2, 0.2], n_priors)
+    loc = np.zeros((2, n_priors * 4), np.float32)  # deltas 0 → boxes = priors
+    conf = rng.randn(2, n_priors * n_classes).astype(np.float32)
+    det = nn.DetectionOutputSSD(n_classes=n_classes, nms_thresh=0.45,
+                                conf_thresh=0.01, keep_topk=5)
+    det.evaluate()
+    out = np.asarray(det.forward(Table(jnp.asarray(loc), jnp.asarray(conf),
+                                       jnp.asarray(priors))))
+    assert out.shape[0] == 2
+    for i in range(2):
+        num = int(out[i, 0])
+        assert 0 <= num <= 5
+        dets = out[i, 1:1 + num * 6].reshape(num, 6)
+        assert np.all(dets[:, 0] >= 1)  # no background label
+        assert np.all((dets[:, 1] > 0) & (dets[:, 1] <= 1))
+        # boxes are decoded priors
+        for d in dets:
+            assert np.any(np.all(np.isclose(pb, d[2:6], atol=1e-5), axis=1))
+
+
+def test_detection_output_ssd_training_passthrough():
+    det = nn.DetectionOutputSSD(n_classes=3)
+    det.training()
+    t = Table(jnp.zeros((1, 4)), jnp.zeros((1, 3)), jnp.zeros((1, 2, 4)))
+    assert det.forward(t) is t
+
+
+def test_detection_output_frcnn():
+    n, n_classes = 6, 3
+    rng = np.random.RandomState(2)
+    rois = np.concatenate([np.zeros((n, 1), np.float32),
+                           random_boxes(n, 3, 50.0)[0]], axis=1)
+    deltas = (rng.randn(n, 4 * n_classes) * 0.05).astype(np.float32)
+    scores = np.abs(rng.rand(n, n_classes)).astype(np.float32)
+    scores /= scores.sum(1, keepdims=True)
+    im_info = np.array([[100.0, 100.0, 1.0, 1.0]], np.float32)
+    det = nn.DetectionOutputFrcnn(n_classes=n_classes, thresh=0.05)
+    det.evaluate()
+    out = np.asarray(det.forward(Table(
+        jnp.asarray(im_info), jnp.asarray(rois), jnp.asarray(deltas),
+        jnp.asarray(scores))))
+    num = int(out[0, 0])
+    assert out.shape == (1, 1 + num * 6)
+    dets = out[0, 1:].reshape(num, 6)
+    assert np.all(dets[:, 0] >= 1)
+    assert np.all(dets[:, 1] > 0.05)
+
+
+def test_bbox_vote_weighted_average():
+    nms_boxes = np.array([[0., 0., 10., 10.]], np.float32)
+    all_boxes = np.array([[0., 0., 10., 10.], [1., 1., 11., 11.],
+                          [50., 50., 60., 60.]], np.float32)
+    all_scores = np.array([0.8, 0.4, 0.9], np.float32)
+    s, b = nn.bbox_vote(np.array([0.8], np.float32), nms_boxes,
+                        all_scores, all_boxes)
+    want = (0.8 * all_boxes[0] + 0.4 * all_boxes[1]) / 1.2
+    assert np.allclose(b[0], want, atol=1e-5)
+
+
+def test_roi_align_constant_map():
+    # constant feature map → every pooled value equals that constant
+    feats = jnp.full((1, 2, 8, 8), 3.5)
+    rois = jnp.asarray([[0, 1.0, 1.0, 6.0, 6.0]], jnp.float32)
+    ra = nn.RoiAlign(pooled_w=3, pooled_h=3, spatial_scale=1.0)
+    out = np.asarray(ra.forward(Table(feats, rois)))
+    assert out.shape == (1, 2, 3, 3)
+    assert np.allclose(out, 3.5, atol=1e-6)
+
+
+def test_roi_align_linear_gradient_map():
+    # f(y, x) = x → pooled values should increase along x, constant along y
+    H = W = 16
+    fm = jnp.broadcast_to(jnp.arange(W, dtype=jnp.float32)[None, :], (H, W))
+    feats = fm[None, None]
+    rois = jnp.asarray([[0, 2.0, 2.0, 13.0, 13.0]], jnp.float32)
+    ra = nn.RoiAlign(pooled_w=4, pooled_h=4, sampling_ratio=2)
+    out = np.asarray(ra.forward(Table(feats, rois)))[0, 0]
+    assert np.all(np.diff(out, axis=1) > 0)
+    assert np.allclose(out[0], out[-1], atol=1e-5)
+
+
+def test_roi_align_jit_and_grad():
+    feats = jnp.asarray(np.random.RandomState(0).rand(1, 1, 8, 8),
+                        jnp.float32)
+    rois = jnp.asarray([[0, 1.0, 1.0, 6.0, 6.0]], jnp.float32)
+    ra = nn.RoiAlign(pooled_w=2, pooled_h=2)
+
+    def loss(f):
+        out, _ = ra.apply({}, {}, Table(f, rois))
+        return jnp.sum(out ** 2)
+
+    g = jax.jit(jax.grad(loss))(feats)
+    assert g.shape == feats.shape and np.isfinite(np.asarray(g)).all()
