@@ -2,7 +2,8 @@ from . import lenet, resnet, vgg, inception, rnn, autoencoder, transformer_lm
 from .lenet import LeNet5
 from .resnet import ResNet, ResNet50, ResNetCifar, ShortcutType
 from .vgg import VggForCifar10, Vgg_16, Vgg_19
-from .inception import Inception_v1, Inception_v1_NoAuxClassifier
+from .inception import (Inception_v1, Inception_v1_NoAuxClassifier,
+                        Inception_v2, Inception_v2_NoAuxClassifier)
 from .rnn import PTBModel, SimpleRNN
 from .autoencoder import Autoencoder
 from .transformer_lm import TransformerLM

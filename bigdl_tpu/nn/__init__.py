@@ -52,6 +52,7 @@ from .recurrent import (Cell, RnnCell, RNN, LSTM, LSTMPeephole, GRU,
                         ConvLSTMPeephole, ConvLSTMPeephole3D, MultiRNNCell,
                         Recurrent, RecurrentDecoder, BiRecurrent,
                         TimeDistributed)
+from .tree_lstm import TreeLSTM, BinaryTreeLSTM, tensor_tree
 from .detection import (Anchor, Nms, PriorBox, Proposal, DetectionOutputSSD,
                         DetectionOutputFrcnn, RoiAlign, bbox_transform_inv,
                         bbox_iou_matrix, bbox_areas, clip_boxes, decode_boxes,

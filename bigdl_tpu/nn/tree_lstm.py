@@ -1,0 +1,166 @@
+"""TreeLSTM / BinaryTreeLSTM (constituency Tree-LSTM).
+
+Parity: reference ``nn/TreeLSTM.scala`` + ``nn/BinaryTreeLSTM.scala``
+(Tai et al. 2015). The reference walks each tree with host-side recursion
+(``recursiveForward``, BinaryTreeLSTM.scala:218-265), cloning leaf/composer
+cells per node and sharing parameters. That shape is untraceable on TPU, so
+this implementation is *level-synchronous*: every scan step applies the (one)
+composer to **all** nodes at once, gathering child (c, h) from state buffers,
+and commits updates only for nodes whose two children are already done. After
+``depth(tree)`` steps every node has its state; the step count is a static
+``max_depth`` (default: node count, the safe worst case) so the whole forward
+is one ``lax.scan`` the compiler can unroll onto the MXU, and ``backward``
+falls out of ``jax.vjp`` like every other module.
+
+Tree encoding is the reference's ``TensorTree`` (BinaryTreeLSTM.scala:513):
+``trees`` is (batch, nNodes, 3); columns 0,1 = left/right child node index
+(1-based; 0 = none), column 2 = leaf's word index (1-based) for leaves or -1
+for the root; padding rows have -1 in column 0.
+
+Input: table ``(inputs, trees)`` with ``inputs`` (batch, nWords, inputSize).
+Output: (batch, nNodes, hiddenSize) — each node's hidden state (zeros at
+padding rows), exactly the reference's ``updateOutput`` layout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+from .init import RandomUniform
+
+_default_init = RandomUniform()
+
+
+class TreeLSTM(Module):
+    """Abstract base (parity: nn/TreeLSTM.scala:25)."""
+
+    def __init__(self, input_size: int, hidden_size: int = 150, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+
+class BinaryTreeLSTM(TreeLSTM):
+    """Binary constituency Tree-LSTM (nn/BinaryTreeLSTM.scala:40).
+
+    Leaf cell (createLeafModuleWithGraph, :63):
+      ``c = W_c x``; ``h = sigmoid(W_o x) * tanh(c)`` (or ``tanh(c)`` when
+      ``gate_output=False``).
+    Composer (createComposerWithGraph, :82): gates i, lf, rf, update (and o)
+    each ``sigmoid/tanh(W_l lh + W_r rh)``; here the five gates are one fused
+    (hidden → 5*hidden) pair of matmuls — mathematically identical to the
+    reference's per-gate Linears, but a single MXU contraction.
+
+    ``max_depth`` bounds the level-synchronous sweep; ``None`` uses the node
+    count (safe for any tree). Balanced trees only need ~log2(nNodes).
+    """
+
+    def __init__(self, input_size: int, hidden_size: int = 150,
+                 gate_output: bool = True, with_graph: bool = True,
+                 max_depth: int | None = None, name=None):
+        super().__init__(input_size, hidden_size, name=name)
+        self.gate_output = gate_output
+        self.with_graph = with_graph  # kept for API parity; same math either way
+        self.max_depth = max_depth
+
+    def _init_params(self, rng):
+        h, d = self.hidden_size, self.input_size
+        n_gate = 5 if self.gate_output else 4
+        ks = jax.random.split(rng, 6)
+        p = {
+            "leaf_wc": _default_init(ks[0], (h, d), fan_in=d, fan_out=h),
+            "leaf_bc": jnp.zeros((h,), jnp.float32),
+            "comp_wl": _default_init(ks[1], (n_gate * h, h), fan_in=h,
+                                     fan_out=n_gate * h),
+            "comp_wr": _default_init(ks[2], (n_gate * h, h), fan_in=h,
+                                     fan_out=n_gate * h),
+            "comp_b": jnp.zeros((n_gate * h,), jnp.float32),
+        }
+        if self.gate_output:
+            p["leaf_wo"] = _default_init(ks[3], (h, d), fan_in=d, fan_out=h)
+            p["leaf_bo"] = jnp.zeros((h,), jnp.float32)
+        return p
+
+    def _leaf(self, params, x):
+        # x: (..., input_size) → (c, h) each (..., hidden_size)
+        c = x @ params["leaf_wc"].T + params["leaf_bc"]
+        if self.gate_output:
+            o = jax.nn.sigmoid(x @ params["leaf_wo"].T + params["leaf_bo"])
+            hh = o * jnp.tanh(c)
+        else:
+            hh = jnp.tanh(c)
+        return c, hh
+
+    def _compose(self, params, lc, lh, rc, rh):
+        # all (..., hidden) → (c, h)
+        H = self.hidden_size
+        g = lh @ params["comp_wl"].T + rh @ params["comp_wr"].T + params["comp_b"]
+        i = jax.nn.sigmoid(g[..., :H])
+        lf = jax.nn.sigmoid(g[..., H:2 * H])
+        rf = jax.nn.sigmoid(g[..., 2 * H:3 * H])
+        u = jnp.tanh(g[..., 3 * H:4 * H])
+        c = i * u + lf * lc + rf * rc
+        if self.gate_output:
+            o = jax.nn.sigmoid(g[..., 4 * H:5 * H])
+            hh = o * jnp.tanh(c)
+        else:
+            hh = jnp.tanh(c)
+        return c, hh
+
+    def _apply(self, params, state, x, training, rng):
+        inputs, trees = (x[0], x[1]) if isinstance(x, (tuple, list)) \
+            else (x[1], x[2])  # Table is 1-indexed
+        inputs = jnp.asarray(inputs)
+        trees = jnp.asarray(trees)
+        squeeze = inputs.ndim == 2
+        if squeeze:  # single sample
+            inputs, trees = inputs[None], trees[None]
+        n_nodes = trees.shape[1]
+        depth = self.max_depth or n_nodes
+
+        def one_tree(words, tree):
+            left = tree[:, 0].astype(jnp.int32)    # 1-based, 0/-1 = none/pad
+            right = tree[:, 1].astype(jnp.int32)
+            leaf_idx = tree[:, 2].astype(jnp.int32)
+            is_pad = left < 0
+            is_leaf = (left == 0) & ~is_pad
+            has_child = left > 0
+
+            # leaves: gather word vectors (leaf_idx is 1-based into words)
+            wv = jnp.take(words, jnp.clip(leaf_idx - 1, 0, words.shape[0] - 1),
+                          axis=0)
+            lc0, lh0 = self._leaf(params, wv)
+            m = is_leaf[:, None]
+            c0 = jnp.where(m, lc0, 0.0)
+            h0 = jnp.where(m, lh0, 0.0)
+            done0 = is_leaf
+
+            li = jnp.clip(left - 1, 0, n_nodes - 1)
+            ri = jnp.clip(right - 1, 0, n_nodes - 1)
+
+            def step(carry, _):
+                c, h, done = carry
+                cc, hh = self._compose(params, c[li], h[li], c[ri], h[ri])
+                ready = has_child & done[li] & done[ri] & ~done
+                rm = ready[:, None]
+                return (jnp.where(rm, cc, c), jnp.where(rm, hh, h),
+                        done | ready), None
+
+            (c, h, _), _ = jax.lax.scan(step, (c0, h0, done0), None,
+                                        length=depth)
+            return h
+
+        out = jax.vmap(one_tree)(inputs, trees)
+        return out[0] if squeeze else out
+
+
+def tensor_tree(n_nodes: int):
+    """Host-side helper mirroring ``TensorTree`` construction
+    (BinaryTreeLSTM.scala:513): returns an (n_nodes, 3) numpy array
+    initialised to padding; use ``add_child``/``mark_as_leaf``/``mark_as_root``
+    semantics by writing columns directly."""
+    import numpy as np
+    t = np.zeros((n_nodes, 3), np.float32)
+    t[:, 0] = -1.0
+    return t

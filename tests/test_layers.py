@@ -396,3 +396,64 @@ def test_masking():
     assert np.all(out[0, 1] == 0)
     assert np.all(out[0, 0] == [1, 2])
     assert np.all(out[0, 2] == [3, 0])
+
+
+def test_binary_tree_lstm():
+    """Level-synchronous sweep must equal explicit recursion
+    (reference BinaryTreeLSTM recursiveForward)."""
+    import jax.numpy as jnp
+    np.random.seed(7)
+    # 2-sample batch; sample 0: root(1)=[2,3], leaves 2,3; node 4,5 padding
+    # sample 1: root(1)=[4,5], node4=[2,3] internal, leaves 2,3,5
+    trees = np.zeros((2, 5, 3), np.float32)
+    trees[:, :, 0] = -1
+    trees[0, 0] = [2, 3, -1]
+    trees[0, 1] = [0, 0, 1]
+    trees[0, 2] = [0, 0, 2]
+    trees[1, 0] = [4, 5, -1]
+    trees[1, 3] = [2, 3, 0]
+    trees[1, 1] = [0, 0, 1]
+    trees[1, 2] = [0, 0, 3]
+    trees[1, 4] = [0, 0, 2]
+    words = np.random.randn(2, 3, 4).astype(np.float32)
+    m = nn.BinaryTreeLSTM(4, 6)
+    out = np.asarray(m.forward((words, trees)))
+    assert out.shape == (2, 5, 6)
+    p = m.params
+
+    def leaf(w):
+        return m._leaf(p, jnp.asarray(w))
+
+    # sample 0
+    c2, h2 = leaf(words[0, 0])
+    c3, h3 = leaf(words[0, 1])
+    _, h1 = m._compose(p, c2, h2, c3, h3)
+    assert allclose(out[0, 0], h1, tol=1e-5)
+    assert allclose(out[0, 1], h2, tol=1e-5)
+    assert np.all(out[0, 3] == 0) and np.all(out[0, 4] == 0)
+    # sample 1 (two levels deep)
+    c2, h2 = leaf(words[1, 0])
+    c3, h3 = leaf(words[1, 2])
+    c5, h5 = leaf(words[1, 1])
+    c4, h4 = m._compose(p, c2, h2, c3, h3)
+    _, h1 = m._compose(p, c4, h4, c5, h5)
+    assert allclose(out[1, 0], h1, tol=1e-5)
+    assert allclose(out[1, 3], h4, tol=1e-5)
+    # backward produces grads for inputs
+    g = m.backward((words, trees), np.ones_like(out))
+    assert np.asarray(g[0]).shape == words.shape
+    assert np.isfinite(np.asarray(g[0])).all()
+    # no-gate-output variant
+    m2 = nn.BinaryTreeLSTM(4, 6, gate_output=False)
+    assert m2.forward((words, trees)).shape == (2, 5, 6)
+
+
+def test_inception_v2_shapes():
+    from bigdl_tpu.models import Inception_v2_NoAuxClassifier, Inception_v2
+    x = np.random.randn(1, 3, 224, 224).astype(np.float32)
+    m = Inception_v2_NoAuxClassifier(class_num=7)
+    m.evaluate()
+    assert m.forward(x).shape == (1, 7)
+    m2 = Inception_v2(class_num=7)
+    m2.evaluate()
+    assert m2.forward(x).shape == (1, 21)
