@@ -6,3 +6,5 @@ from .dataset import DataSet, LocalDataSet, ShardedDataSet
 from . import mnist
 from . import cifar
 from . import text
+from . import movielens
+from . import news20

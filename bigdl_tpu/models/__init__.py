@@ -1,4 +1,5 @@
-from . import lenet, resnet, vgg, inception, rnn, autoencoder, transformer_lm
+from . import (lenet, resnet, vgg, inception, rnn, autoencoder,
+               transformer_lm, recommender, textclassifier)
 from .lenet import LeNet5
 from .resnet import ResNet, ResNet50, ResNetCifar, ShortcutType
 from .vgg import VggForCifar10, Vgg_16, Vgg_19
@@ -7,3 +8,5 @@ from .inception import (Inception_v1, Inception_v1_NoAuxClassifier,
 from .rnn import PTBModel, SimpleRNN
 from .autoencoder import Autoencoder
 from .transformer_lm import TransformerLM
+from .recommender import NeuralCF, WideAndDeep
+from .textclassifier import TextClassifier

@@ -1,0 +1,69 @@
+"""TextClassifier (20-Newsgroups CNN).
+
+Parity: reference ``example/utils/TextClassifier.scala:171`` (buildModel) and
+``pyspark/bigdl/models/textclassifier/textclassifier.py`` (build_model, which
+also offers lstm/gru variants). Input is (N, seq_len, embedding_dim) GloVe
+sequences; output log-probabilities over ``class_num`` classes.
+"""
+from __future__ import annotations
+
+from ..nn import (Sequential, TemporalConvolution, ReLU, TemporalMaxPooling,
+                  Squeeze, Linear, Dropout, LogSoftMax, Recurrent, LSTM, GRU,
+                  Select)
+
+
+def TextClassifier(class_num: int, embedding_dim: int = 50,
+                   sequence_length: int = 500, encoder: str = "cnn",
+                   encoder_output_dim: int = 256):
+    """encoder: 'cnn' (TemporalConvolution, the Scala buildModel), or
+    'lstm'/'gru' (the pyspark variants)."""
+    model = Sequential()
+    if encoder == "cnn":
+        model.add(TemporalConvolution(embedding_dim, encoder_output_dim, 5))
+        model.add(ReLU())
+        model.add(TemporalMaxPooling(sequence_length - 5 + 1))
+        model.add(Squeeze(2))
+        hidden = encoder_output_dim
+    elif encoder in ("lstm", "gru"):
+        cell = LSTM(embedding_dim, encoder_output_dim) if encoder == "lstm" \
+            else GRU(embedding_dim, encoder_output_dim)
+        model.add(Recurrent().add(cell))
+        model.add(Select(2, -1))  # last time step
+        hidden = encoder_output_dim
+    else:
+        raise ValueError(f"unsupported encoder {encoder}")
+    model.add(Linear(hidden, 128))
+    model.add(Dropout(0.2))
+    model.add(ReLU())
+    model.add(Linear(128, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+def tokenize_to_glove_sequences(texts, w2v=None, sequence_length=500,
+                                embedding_dim=50, max_words=5000):
+    """Host-side featurisation mirroring the reference pipeline
+    (TextClassifier.scala getData: tokenize → top-N vocab → word2vec →
+    shape (seq_len, dim)). Returns (features (N, L, D) float32,
+    labels (N,) int64 1-based)."""
+    import numpy as np
+    import re
+    from collections import Counter
+    from ..dataset.news20 import get_glove_w2v
+
+    tokenized = [(re.findall(r"[a-z0-9]+", t.lower()), y) for t, y in texts]
+    freq = Counter(w for toks, _ in tokenized for w in toks)
+    vocab = set(w for w, _ in freq.most_common(max_words))
+    if w2v is None:
+        w2v = get_glove_w2v(None, dim=embedding_dim, vocab=vocab)
+    zeros = np.zeros((embedding_dim,), np.float32)
+    feats = np.zeros((len(tokenized), sequence_length, embedding_dim),
+                     np.float32)
+    labels = np.zeros((len(tokenized),), np.int64)
+    for n, (toks, y) in enumerate(tokenized):
+        vecs = [w2v.get(wd, zeros) for wd in toks[:sequence_length]
+                if wd in vocab]
+        if vecs:
+            feats[n, :len(vecs)] = np.stack(vecs)
+        labels[n] = y
+    return feats, labels

@@ -1,0 +1,61 @@
+"""TextClassifier on 20-Newsgroups (parity: reference
+example/textclassification/TextClassifier.scala and
+pyspark/bigdl/models/textclassifier/textclassifier.py).
+
+Usage: python examples/textclassifier_news20.py [--data-dir DIR]
+       [--encoder cnn|lstm|gru]
+Falls back to a synthetic topic corpus when no data dir is given.
+"""
+import argparse
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, Sample, news20
+from bigdl_tpu.models import TextClassifier
+from bigdl_tpu.models.textclassifier import tokenize_to_glove_sequences
+from bigdl_tpu.optim import (LocalOptimizer, Adam, Trigger, Top1Accuracy,
+                             every_epoch)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--encoder", default="cnn",
+                    choices=["cnn", "lstm", "gru"])
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--embedding-dim", type=int, default=50)
+    args = ap.parse_args()
+
+    texts = news20.get_news20(args.data_dir, n_per_class=30)
+    feats, labels = tokenize_to_glove_sequences(
+        texts, sequence_length=args.seq_len,
+        embedding_dim=args.embedding_dim)
+    rng = np.random.RandomState(0)
+    idx = rng.permutation(len(labels))
+    split = int(0.8 * len(idx))
+    tr, va = idx[:split], idx[split:]
+
+    model = TextClassifier(news20.CLASS_NUM,
+                           embedding_dim=args.embedding_dim,
+                           sequence_length=args.seq_len,
+                           encoder=args.encoder)
+    train = [Sample(feats[i], labels[i]) for i in tr]
+    val = [Sample(feats[i], labels[i]) for i in va]
+    opt = LocalOptimizer(model, DataSet.array(train), nn.ClassNLLCriterion(),
+                         Adam(learningrate=0.01),
+                         Trigger.max_epoch(args.epochs),
+                         batch_size=args.batch_size)
+    opt.set_validation(every_epoch(), DataSet.array(val),
+                       [Top1Accuracy()], batch_size=args.batch_size)
+    opt.optimize()
+
+    model.evaluate()
+    pred = np.asarray(model.forward(feats[va])).argmax(1) + 1
+    print(f"val accuracy = {(pred == labels[va]).mean():.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Recommender (NCF / Wide&Deep) + movielens + TextClassifier/news20 tests
+(parity: reference HitRatio/NDCG consumers and TextClassifier example)."""
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import DataSet, Sample, movielens, news20
+from bigdl_tpu.models import NeuralCF, WideAndDeep, TextClassifier
+from bigdl_tpu.optim import LocalOptimizer, Adam, Trigger
+from bigdl_tpu.optim.validation import HitRatio, NDCG
+
+
+def test_movielens_synthetic():
+    data = movielens.read_data_sets(None, n_synthetic=2000)
+    assert data.shape[1] == 4
+    assert data[:, 0].min() >= 1 and data[:, 2].max() <= 5
+    pairs = movielens.get_id_pairs(None, n_synthetic=500)
+    assert pairs.shape[1] == 2
+    tr, y, ev_u, ev_items = movielens.train_test_split_leave_one_out(
+        data, n_negatives=2, n_eval_negatives=5)
+    assert tr.shape[0] == y.shape[0]
+    assert ev_items.shape[1] == 6
+    assert set(np.unique(y)) <= {0, 1}
+
+
+def _train_rec(model, data, iters=60):
+    tr, y, ev_u, ev_items = movielens.train_test_split_leave_one_out(
+        data, n_negatives=2, n_eval_negatives=9)
+    samples = [Sample(tr[i].astype(np.float32), y[i].astype(np.float32))
+               for i in range(len(y))]
+    crit = nn.BCECriterion()
+    out0 = model.forward(tr.astype(np.float32))
+    l0 = float(crit.forward(out0, y.astype(np.float32)))
+    opt = LocalOptimizer(model, DataSet.array(samples), crit,
+                         Adam(learningrate=0.02),
+                         Trigger.max_iteration(iters), batch_size=256)
+    opt.optimize()
+    l1 = float(crit.forward(model.forward(tr.astype(np.float32)),
+                            y.astype(np.float32)))
+    assert l1 < l0, (l0, l1)
+    # HitRatio/NDCG over per-user candidate lists (positive first)
+    hr, ndcg = HitRatio(k=5, neg_num=9), NDCG(k=5, neg_num=9)
+    hr_res, ndcg_res = None, None
+    for u, items in zip(ev_u, ev_items):
+        pairs = np.stack([np.full(len(items), u), items], 1).astype(np.float32)
+        scores = np.asarray(model.forward(pairs))
+        target = np.zeros(len(items), np.float32)
+        target[0] = 1
+        r1, r2 = hr(scores, target), ndcg(scores, target)
+        hr_res = r1 if hr_res is None else hr_res + r1
+        ndcg_res = r2 if ndcg_res is None else ndcg_res + r2
+    # random ranking gives HR@5 of 10-choose... 5/10=0.5; trained should beat it
+    assert hr_res.result()[0] > 0.5, hr_res.result()
+    assert ndcg_res.result()[0] > 0.2
+
+
+def test_neural_cf_trains_and_ranks():
+    data = movielens.synthetic(n_users=40, n_items=30, n_ratings=1200, seed=3)
+    model = NeuralCF(user_count=41, item_count=31, mf_dim=8, mlp_dim=8,
+                     hidden_layers=(16, 8))
+    _train_rec(model, data)
+
+
+def test_wide_and_deep_trains():
+    data = movielens.synthetic(n_users=40, n_items=30, n_ratings=1200, seed=4)
+    model = WideAndDeep(user_count=41, item_count=31, embed_dim=8,
+                        hidden_layers=(16, 8))
+    _train_rec(model, data)
+
+
+def test_news20_synthetic_and_textclassifier():
+    texts = news20.get_news20(None, n_per_class=6)
+    assert len(texts) == 6 * news20.CLASS_NUM
+    from bigdl_tpu.models.textclassifier import tokenize_to_glove_sequences
+    feats, labels = tokenize_to_glove_sequences(
+        texts, sequence_length=32, embedding_dim=16)
+    assert feats.shape == (len(texts), 32, 16)
+    model = TextClassifier(news20.CLASS_NUM, embedding_dim=16,
+                           sequence_length=32)
+    crit = nn.ClassNLLCriterion()
+    out = model.forward(feats[:8])
+    assert out.shape == (8, news20.CLASS_NUM)
+    samples = [Sample(feats[i], labels[i]) for i in range(len(labels))]
+    l0 = float(crit.forward(model.forward(feats), labels))
+    opt = LocalOptimizer(model, DataSet.array(samples), crit,
+                         Adam(learningrate=0.02),
+                         Trigger.max_epoch(12), batch_size=32)
+    opt.optimize()
+    model.evaluate()
+    l1 = float(crit.forward(model.forward(feats), labels))
+    assert l1 < l0, (l0, l1)
+    pred = np.asarray(model.forward(feats)).argmax(1) + 1
+    acc = (pred == labels).mean()
+    assert acc > 0.3, acc  # 20-class random = 0.05
+
+
+def test_textclassifier_rnn_variants():
+    m = TextClassifier(5, embedding_dim=8, sequence_length=12, encoder="lstm",
+                       encoder_output_dim=16)
+    x = np.random.randn(3, 12, 8).astype(np.float32)
+    m.evaluate()
+    assert m.forward(x).shape == (3, 5)
+    m2 = TextClassifier(5, embedding_dim=8, sequence_length=12, encoder="gru",
+                        encoder_output_dim=16)
+    m2.evaluate()
+    assert m2.forward(x).shape == (3, 5)
