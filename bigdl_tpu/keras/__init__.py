@@ -7,7 +7,17 @@ from .layers import (Dense, Activation, Dropout, Flatten, Reshape, Permute,
                      BatchNormalization, Embedding, LSTM, GRU, SimpleRNN,
                      Bidirectional, TimeDistributed, Merge, Highway,
                      LeakyReLU, ELU, ThresholdedReLU, GaussianNoise,
-                     GaussianDropout, SpatialDropout2D, Masking)
+                     GaussianDropout, SpatialDropout2D, Masking,
+                     SoftMax, AtrousConvolution1D, AtrousConvolution2D,
+                     SeparableConvolution2D, Deconvolution2D, Convolution3D,
+                     LocallyConnected1D, LocallyConnected2D,
+                     Cropping1D, Cropping3D, ZeroPadding1D, ZeroPadding3D,
+                     UpSampling1D, UpSampling3D, AveragePooling1D,
+                     MaxPooling3D, AveragePooling3D, GlobalMaxPooling1D,
+                     GlobalMaxPooling3D, GlobalAveragePooling3D,
+                     ConvLSTM2D, MaxoutDense, PReLU, SReLU,
+                     SpatialDropout1D, SpatialDropout3D)
 
 Conv2D = Convolution2D
 Conv1D = Convolution1D
+Conv3D = Convolution3D

@@ -17,7 +17,12 @@ from .module import Module
 
 
 def _pool_pads(size, k, stride, pad, ceil_mode):
-    """Compute (lo, hi) padding for one spatial dim."""
+    """Compute (lo, hi) padding for one spatial dim. ``pad == -1`` means SAME
+    (keras border_mode='same'; same convention as conv.py)."""
+    if pad == -1:
+        out = int(np.ceil(size / stride))
+        total = max(0, (out - 1) * stride + k - size)
+        return (total // 2, total - total // 2), out
     if ceil_mode:
         out = int(np.ceil((size + 2 * pad - k) / stride)) + 1
         # torch convention: last window must start inside the padded input

@@ -101,3 +101,135 @@ def test_batchnorm_timedistributed():
     m2.add(K.BatchNormalization(input_shape=(4, 8, 8)))
     x = np.random.randn(2, 4, 8, 8).astype(np.float32)
     assert m2._module().forward(x).shape == (2, 4, 8, 8)
+
+
+# ---- long-tail keras layer set: shape inference == actual forward shape ----
+
+_LONGTAIL = [
+    (lambda: K.SoftMax(input_shape=(6,)), (6,)),
+    (lambda: K.AtrousConvolution2D(4, 3, 3, atrous_rate=(2, 2),
+                                   input_shape=(2, 12, 12)), (2, 12, 12)),
+    (lambda: K.AtrousConvolution1D(4, 3, atrous_rate=2,
+                                   input_shape=(10, 5)), (10, 5)),
+    (lambda: K.SeparableConvolution2D(6, 3, 3, border_mode="same",
+                                      depth_multiplier=2,
+                                      input_shape=(2, 8, 8)), (2, 8, 8)),
+    (lambda: K.Deconvolution2D(3, 3, 3, subsample=(2, 2),
+                               input_shape=(2, 5, 5)), (2, 5, 5)),
+    (lambda: K.Convolution3D(4, 2, 3, 3, input_shape=(2, 5, 8, 8)),
+     (2, 5, 8, 8)),
+    (lambda: K.LocallyConnected1D(4, 3, input_shape=(9, 5)), (9, 5)),
+    (lambda: K.LocallyConnected2D(4, 3, 3, input_shape=(2, 7, 7)),
+     (2, 7, 7)),
+    (lambda: K.Cropping1D((1, 2), input_shape=(8, 3)), (8, 3)),
+    (lambda: K.Cropping3D(((1, 1), (0, 1), (1, 0)),
+                          input_shape=(2, 5, 6, 6)), (2, 5, 6, 6)),
+    (lambda: K.ZeroPadding1D(2, input_shape=(5, 3)), (5, 3)),
+    (lambda: K.ZeroPadding3D((1, 2, 1), input_shape=(2, 3, 4, 4)),
+     (2, 3, 4, 4)),
+    (lambda: K.UpSampling1D(3, input_shape=(4, 2)), (4, 2)),
+    (lambda: K.UpSampling3D((2, 2, 2), input_shape=(2, 3, 4, 4)),
+     (2, 3, 4, 4)),
+    (lambda: K.AveragePooling1D(2, input_shape=(8, 3)), (8, 3)),
+    (lambda: K.AveragePooling1D(3, 2, border_mode="same",
+                                input_shape=(9, 3)), (9, 3)),
+    (lambda: K.MaxPooling3D((2, 2, 2), input_shape=(2, 4, 6, 6)),
+     (2, 4, 6, 6)),
+    (lambda: K.AveragePooling3D((2, 2, 2), input_shape=(2, 4, 6, 6)),
+     (2, 4, 6, 6)),
+    (lambda: K.GlobalMaxPooling1D(input_shape=(7, 4)), (7, 4)),
+    (lambda: K.GlobalMaxPooling3D(input_shape=(3, 4, 5, 5)), (3, 4, 5, 5)),
+    (lambda: K.GlobalAveragePooling3D(input_shape=(3, 4, 5, 5)),
+     (3, 4, 5, 5)),
+    (lambda: K.ConvLSTM2D(4, 3, return_sequences=True,
+                          input_shape=(3, 2, 6, 6)), (3, 2, 6, 6)),
+    (lambda: K.ConvLSTM2D(4, 3, input_shape=(3, 2, 6, 6)), (3, 2, 6, 6)),
+    (lambda: K.MaxoutDense(6, 3, input_shape=(5,)), (5,)),
+    (lambda: K.PReLU(input_shape=(4, 5)), (4, 5)),
+    (lambda: K.SReLU(input_shape=(4, 5)), (4, 5)),
+    (lambda: K.SpatialDropout1D(0.3, input_shape=(6, 4)), (6, 4)),
+    (lambda: K.SpatialDropout3D(0.3, input_shape=(2, 3, 4, 4)),
+     (2, 3, 4, 4)),
+    (lambda: K.MaxPooling2D((3, 3), strides=(2, 2), border_mode="same",
+                            input_shape=(2, 9, 9)), (2, 9, 9)),
+    (lambda: K.AveragePooling2D((2, 2), border_mode="same",
+                                input_shape=(2, 7, 7)), (2, 7, 7)),
+]
+
+
+@pytest.mark.parametrize("make,in_shape", _LONGTAIL,
+                         ids=lambda v: getattr(v, "__name__", str(v)))
+def test_longtail_layer_shape(make, in_shape):
+    layer = make()
+    model = K.Sequential().add(layer)
+    x = np.random.randn(2, *in_shape).astype(np.float32)
+    out = model._module().evaluate().forward(x)
+    assert tuple(out.shape) == (2,) + tuple(model.output_shape), \
+        f"{type(layer).__name__}: inferred {model.output_shape}, " \
+        f"got {out.shape[1:]}"
+
+
+def test_longtail_softmax_values():
+    model = K.Sequential().add(K.SoftMax(input_shape=(7,)))
+    x = np.random.randn(3, 7).astype(np.float32)
+    out = np.asarray(model._module().evaluate().forward(x))
+    assert np.allclose(out.sum(-1), 1.0, atol=1e-5)
+
+
+def test_longtail_cropping_values():
+    model = K.Sequential().add(K.Cropping1D((1, 2), input_shape=(8, 3)))
+    x = np.random.randn(2, 8, 3).astype(np.float32)
+    out = np.asarray(model._module().evaluate().forward(x))
+    assert np.allclose(out, x[:, 1:6])
+
+
+def test_longtail_zeropadding_values():
+    model = K.Sequential().add(K.ZeroPadding1D((1, 2), input_shape=(4, 3)))
+    x = np.random.randn(2, 4, 3).astype(np.float32)
+    out = np.asarray(model._module().evaluate().forward(x))
+    assert out.shape == (2, 7, 3)
+    assert np.allclose(out[:, 1:5], x)
+    assert np.allclose(out[:, 0], 0) and np.allclose(out[:, 5:], 0)
+
+
+def test_longtail_dense_grad_flows():
+    # a deconv stack still trains end-to-end
+    model = K.Sequential()
+    model.add(K.Deconvolution2D(2, 3, 3, activation="relu",
+                                input_shape=(1, 4, 4)))
+    model.add(K.Flatten())
+    model.add(K.Dense(3))
+    x = np.random.randn(8, 1, 4, 4).astype(np.float32)
+    y = np.random.randint(0, 3, 8)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    model.fit(x, y, batch_size=4, nb_epoch=1)
+
+
+def test_longtail_avgpool1d_same_values():
+    # keras 'same' average pooling excludes padding from the denominator
+    model = K.Sequential().add(
+        K.AveragePooling1D(3, 2, border_mode="same", input_shape=(5, 1)))
+    x = np.arange(5, dtype=np.float32).reshape(1, 5, 1)
+    out = np.asarray(model._module().evaluate().forward(x)).ravel()
+    assert np.allclose(out, [0.5, 2.0, 3.5]), out
+
+
+def test_longtail_locallyconnected2d_same_shape():
+    model = K.Sequential().add(
+        K.LocallyConnected2D(4, 4, 4, border_mode="same",
+                             input_shape=(2, 7, 7)))
+    x = np.random.randn(2, 2, 7, 7).astype(np.float32)
+    out = model._module().evaluate().forward(x)
+    assert tuple(out.shape) == (2,) + tuple(model.output_shape) == \
+        (2, 4, 7, 7)
+
+
+def test_longtail_unsupported_modes_raise():
+    with pytest.raises(ValueError):
+        K.AtrousConvolution2D(4, 3, 3, border_mode="same")
+    with pytest.raises(ValueError):
+        K.Deconvolution2D(4, 3, 3, border_mode="same")
+    with pytest.raises(ValueError):
+        K.ConvLSTM2D(4, 3, activation="relu")
+    with pytest.raises(ValueError):
+        K.ConvLSTM2D(4, 3, border_mode="valid")
