@@ -8,3 +8,5 @@ from . import cifar
 from . import text
 from . import movielens
 from . import news20
+from . import segmentation
+from .segmentation import RLEMasks, PolyMasks

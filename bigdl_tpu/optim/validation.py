@@ -198,7 +198,10 @@ class TreeNNAccuracy(ValidationMethod):
         t = np.asarray(target)
         if t.ndim >= 2:
             t = t[:, 0]
-        pred = np.argmax(out, axis=-1) + 1
+        if out.shape[-1] == 1:  # binary head: threshold at 0.5 (reference)
+            pred = (out[..., 0] >= 0.5).astype(np.int64)
+        else:
+            pred = np.argmax(out, axis=-1) + 1
         correct = int(np.sum(pred == t.reshape(-1).astype(np.int64)))
         return AccuracyResult(correct, t.size)
 

@@ -1,2 +1,5 @@
 from .quantize import (quantize, QuantizedLinear, QuantizedSpatialConvolution,
                        quantize_weight)
+from .calibration import (calibrate, fold_batchnorm, quantizable_paths,
+                          Observer, MinMaxObserver, MovingAverageObserver,
+                          PercentileObserver)

@@ -1,0 +1,187 @@
+"""Static int8 calibration (SURVEY §2.9 r2 item).
+
+Parity target: the reference's DL-Boost int8 flow (``nn/quantized/``) carries
+per-layer activation thresholds baked at quantize time; here a calibration
+pass runs representative batches through the *float* model with observers
+attached to every quantizable layer, records activation ranges, and
+``quantize(model, calibration=...)`` then uses the static scales instead of
+the dynamic per-batch max — removing the runtime max-reduce and making the
+quantized graph fully static for XLA.
+
+Observers:
+- ``MinMaxObserver`` — running max of |x| (the reference's default).
+- ``MovingAverageObserver`` — EMA of per-batch max |x| (robust to one-off
+  spikes; torch.quantization-style).
+- ``PercentileObserver`` — max of a per-batch percentile of |x| (clips
+  outliers; mkldnn-calibration-style).
+
+Conv+BN fusion: ``fold_batchnorm`` folds inference-mode BatchNormalization
+into a preceding Linear/SpatialConvolution inside Sequential containers (the
+analog of the reference's fusion table for quantization-friendly graphs).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.module import Module, Container
+from ..nn.linear import Linear
+from ..nn.conv import SpatialConvolution
+from ..nn.norm import BatchNormalization
+from ..nn.containers import Sequential
+
+
+class Observer:
+    """Tracks the absolute activation range of one layer's input."""
+
+    def update(self, x) -> None:
+        raise NotImplementedError
+
+    @property
+    def absmax(self) -> float:
+        raise NotImplementedError
+
+    @property
+    def scale(self) -> float:
+        return max(float(self.absmax), 1e-8) / 127.0
+
+
+class MinMaxObserver(Observer):
+    def __init__(self):
+        self._max = 0.0
+
+    def update(self, x):
+        self._max = max(self._max, float(jnp.max(jnp.abs(x))))
+
+    @property
+    def absmax(self):
+        return self._max
+
+
+class MovingAverageObserver(Observer):
+    def __init__(self, momentum: float = 0.9):
+        self.momentum = momentum
+        self._avg = None
+
+    def update(self, x):
+        batch_max = float(jnp.max(jnp.abs(x)))
+        self._avg = batch_max if self._avg is None else \
+            self.momentum * self._avg + (1 - self.momentum) * batch_max
+
+    @property
+    def absmax(self):
+        return self._avg or 0.0
+
+
+class PercentileObserver(Observer):
+    def __init__(self, percentile: float = 99.99):
+        self.percentile = percentile
+        self._max = 0.0
+
+    def update(self, x):
+        p = float(np.percentile(np.abs(np.asarray(x)), self.percentile))
+        self._max = max(self._max, p)
+
+    @property
+    def absmax(self):
+        return self._max
+
+
+def _walk(module: Module, path: str = ""):
+    """Yield (path, module) with the same keying used by
+    quantize._quantize_rec (shared child_path) so scales line up."""
+    from .quantize import child_path
+    yield path, module
+    if isinstance(module, Container):
+        for i, child in enumerate(module.modules):
+            yield from _walk(child, child_path(path, i))
+
+
+def quantizable_paths(model: Module) -> List[Tuple[str, Module]]:
+    """Layers quantize() will convert — same isinstance tests as
+    quantize._quantize_rec (covers SpatialShare/DilatedConvolution too)."""
+    from .quantize import QuantizedLinear
+    return [(p, m) for p, m in _walk(model)
+            if (isinstance(m, Linear) and not isinstance(m, QuantizedLinear))
+            or isinstance(m, SpatialConvolution)]
+
+
+def calibrate(model: Module, batches: Iterable,
+              observer_factory: Callable[[], Observer] = MinMaxObserver,
+              ) -> Dict[str, float]:
+    """Run ``batches`` through the float model in eval mode, observing the
+    input range of every quantizable layer. → {layer_path: activation_scale}.
+    """
+    model.ensure_initialized()
+    was_training = model.train_mode
+    model.evaluate()
+    observers: Dict[str, Observer] = {}
+    hooked: List[Module] = []
+    try:
+        for path, mod in quantizable_paths(model):
+            obs = observers[path] = observer_factory()
+
+            def wrapped(params, state, x, training, rng,
+                        _orig=mod._apply, _obs=obs):
+                _obs.update(x)
+                return _orig(params, state, x, training, rng)
+
+            mod._apply = wrapped  # instance attr shadows the class method
+            hooked.append(mod)
+        for x in batches:
+            model.forward(x)
+    finally:
+        for mod in hooked:
+            # the same instance may sit at several paths (shared layers)
+            mod.__dict__.pop("_apply", None)
+        if was_training:
+            model.training()
+    return {p: o.scale for p, o in observers.items()}
+
+
+def fold_batchnorm(model: Module) -> Module:
+    """Fold eval-mode BN into the preceding Linear/SpatialConvolution inside
+    Sequential containers (in place). The folded BN becomes an Identity-like
+    no-op by zeroing its normalization: we instead drop it from the chain."""
+    from ..nn.elementwise import Identity
+
+    def fold_pair(layer: Module, bn: BatchNormalization,
+                  lp, bp, bn_state) -> None:
+        gamma = np.asarray(bp.get("weight", np.ones(bn.n_output)))
+        beta = np.asarray(bp.get("bias", np.zeros(bn.n_output)))
+        mean = np.asarray(bn_state["running_mean"])
+        var = np.asarray(bn_state["running_var"])
+        factor = gamma / np.sqrt(var + bn.eps)
+        w = np.asarray(lp["weight"])
+        shape = (-1,) + (1,) * (w.ndim - 1)
+        lp["weight"] = jnp.asarray(w * factor.reshape(shape))
+        bias = np.asarray(lp["bias"]) if "bias" in lp else np.zeros_like(mean)
+        lp["bias"] = jnp.asarray((bias - mean) * factor + beta)
+
+    def rec(module: Module, params, state):
+        if isinstance(module, Sequential):
+            mods = module.modules
+            for i in range(len(mods) - 1):
+                layer, bn = mods[i], mods[i + 1]
+                if isinstance(layer, (Linear, SpatialConvolution)) and \
+                        isinstance(bn, BatchNormalization) and bn.affine:
+                    if "bias" not in params[str(i)]:
+                        params[str(i)]["bias"] = jnp.zeros(
+                            np.asarray(params[str(i)]["weight"]).shape[0])
+                        layer.with_bias = True
+                    fold_pair(layer, bn, params[str(i)], params[str(i + 1)],
+                              state[str(i + 1)])
+                    mods[i + 1] = Identity()
+                    params[str(i + 1)] = {}
+                    state[str(i + 1)] = {}
+        if isinstance(module, Container):
+            for i, child in enumerate(module.modules):
+                rec(child, params[str(i)], state.get(str(i), {}))
+
+    model.ensure_initialized()
+    rec(model, model.params, model.state)
+    model.grad_params = jax.tree_util.tree_map(jnp.zeros_like, model.params)
+    return model

@@ -42,8 +42,16 @@ def quantize_weight(w, axis=0):
 def _dynamic_quantize(x):
     absmax = jnp.max(jnp.abs(x))
     scale = jnp.maximum(absmax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
-    return q, scale
+    return _static_quantize(x, scale), scale
+
+
+def _static_quantize(x, scale):
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+
+
+def child_path(path: str, i: int) -> str:
+    """Layer-path keying shared by quantize and calibration ('0/2/...')."""
+    return f"{path}/{i}" if path else str(i)
 
 
 class QuantizedLinear(Module):
@@ -56,22 +64,29 @@ class QuantizedLinear(Module):
         self._src_params = None  # float params captured at quantize() time
 
     @staticmethod
-    def from_float(layer: Linear, params):
+    def from_float(layer: Linear, params, act_scale=None):
         q = QuantizedLinear(layer.input_size, layer.output_size,
                             layer.with_bias, name=layer.name + "_int8")
         q._src_params = params
+        q._act_scale = act_scale
         return q
 
     def _init_params(self, rng):
         w = self._src_params["weight"]
         qw, scale = quantize_weight(w, axis=0)
         p = {"qweight": qw, "scale": scale.reshape(-1)}
+        if getattr(self, "_act_scale", None) is not None:
+            p["act_scale"] = jnp.float32(self._act_scale)
         if self.with_bias:
             p["bias"] = jnp.asarray(self._src_params["bias"])
         return p
 
     def _apply(self, params, state, x, training, rng):
-        xq, xs = _dynamic_quantize(x)
+        if "act_scale" in params:  # static calibrated scale — no max-reduce
+            xs = params["act_scale"]
+            xq = _static_quantize(x, xs)
+        else:
+            xq, xs = _dynamic_quantize(x)
         acc = lax.dot_general(xq, params["qweight"],
                               (((x.ndim - 1,), (1,)), ((), ())),
                               preferred_element_type=jnp.int32)
@@ -90,15 +105,18 @@ class QuantizedSpatialConvolution(Module):
         self._src_params = None
 
     @staticmethod
-    def from_float(conv: SpatialConvolution, params):
+    def from_float(conv: SpatialConvolution, params, act_scale=None):
         q = QuantizedSpatialConvolution(conv)
         q._src_params = params
+        q._act_scale = act_scale
         return q
 
     def _init_params(self, rng):
         w = self._src_params["weight"]  # (out, in/g, kh, kw)
         qw, scale = quantize_weight(w, axis=0)
         p = {"qweight": qw, "scale": scale.reshape(-1)}
+        if getattr(self, "_act_scale", None) is not None:
+            p["act_scale"] = jnp.float32(self._act_scale)
         if self.cfg.with_bias:
             p["bias"] = jnp.asarray(self._src_params["bias"])
         return p
@@ -109,7 +127,11 @@ class QuantizedSpatialConvolution(Module):
         squeeze = False
         if x.ndim == 3:
             x, squeeze = x[None], True
-        xq, xs = _dynamic_quantize(x)
+        if "act_scale" in params:  # static calibrated scale — no max-reduce
+            xs = params["act_scale"]
+            xq = _static_quantize(x, xs)
+        else:
+            xq, xs = _dynamic_quantize(x)
         pads = (_pad_pair(c.pad_h, c.kernel_h, c.stride_h),
                 _pad_pair(c.pad_w, c.kernel_w, c.stride_w))
         acc = lax.conv_general_dilated(
@@ -126,19 +148,26 @@ class QuantizedSpatialConvolution(Module):
         return y[0] if squeeze else y
 
 
-def _quantize_rec(module: Module, params):
-    """Return (new_module, new_params) with eligible layers replaced."""
+def _quantize_rec(module: Module, params, calibration, path="", used=None):
+    """Return (new_module, new_params) with eligible layers replaced.
+    ``calibration`` maps layer paths (child_path keying, shared with
+    calibration.quantizable_paths) to static activation scales; None →
+    dynamic quantization. ``used`` collects matched calibration keys."""
+    act = (calibration or {}).get(path)
+    if act is not None and used is not None:
+        used.add(path)
     if isinstance(module, Linear) and not isinstance(module, QuantizedLinear):
-        q = QuantizedLinear.from_float(module, params)
+        q = QuantizedLinear.from_float(module, params, act)
         return q, q._init_params(None)
     if isinstance(module, SpatialConvolution):
-        q = QuantizedSpatialConvolution.from_float(module, params)
+        q = QuantizedSpatialConvolution.from_float(module, params, act)
         return q, q._init_params(None)
     if isinstance(module, Container):
         new_params = dict(params)
         replacements = {}
         for i, child in enumerate(module.modules):
-            nm, np_ = _quantize_rec(child, params[str(i)])
+            nm, np_ = _quantize_rec(child, params[str(i)], calibration,
+                                    child_path(path, i), used)
             if nm is not child:
                 replacements[i] = nm
                 new_params[str(i)] = np_
@@ -153,12 +182,21 @@ def _quantize_rec(module: Module, params):
     return module, params
 
 
-def quantize(model: Module) -> Module:
+def quantize(model: Module, calibration=None) -> Module:
     """Module.quantize() parity: returns an int8-inference copy of the model
-    (weights quantized per-channel; activations quantized dynamically)."""
+    (weights quantized per-channel; activations quantized dynamically, or
+    statically when a ``calibration`` dict from
+    ``quantization.calibrate(model, batches)`` is given)."""
     model.ensure_initialized()
     m = copy.deepcopy(model)
-    new_m, new_params = _quantize_rec(m, m.params)
+    used = set()
+    new_m, new_params = _quantize_rec(m, m.params, calibration, used=used)
+    if calibration and set(calibration) - used:
+        import logging
+        logging.getLogger(__name__).warning(
+            "calibration keys not matched to any quantizable layer "
+            "(falling back to dynamic quantization elsewhere): %s",
+            sorted(set(calibration) - used))
     new_m.params = new_params
     new_m.grad_params = jax.tree_util.tree_map(jnp.zeros_like, new_params)
     new_m.evaluate()

@@ -134,3 +134,98 @@ def test_ptb_synthetic_markov():
     sents = text.ptb_synthetic(n_sentences=10, vocab=50)
     assert len(sents) == 10
     assert all(t.startswith("w") for t in sents[0])
+
+
+# ---- COCO segmentation (poly/RLE) ------------------------------------------
+
+class TestSegmentation:
+    def test_rle_roundtrip(self):
+        from bigdl_tpu.dataset import segmentation as S
+        rng = np.random.RandomState(3)
+        for _ in range(5):
+            mask = (rng.rand(13, 17) > 0.6).astype(np.uint8)
+            counts = S.rle_encode(mask)
+            assert sum(counts) == mask.size
+            back = S.rle_decode(counts, 13, 17)
+            assert np.array_equal(back, mask)
+
+    def test_rle_counts_convention(self):
+        from bigdl_tpu.dataset import segmentation as S
+        # 2x3 mask, column-major: col0=[1,0], col1=[0,0], col2=[1,1]
+        mask = np.array([[1, 0, 1], [0, 0, 1]], np.uint8)
+        assert S.rle_encode(mask) == [0, 1, 3, 2]
+
+    def test_rle_string_roundtrip(self):
+        from bigdl_tpu.dataset import segmentation as S
+        rng = np.random.RandomState(7)
+        for _ in range(10):
+            mask = (rng.rand(20, 20) > 0.5).astype(np.uint8)
+            counts = S.rle_encode(mask)
+            s = S.rle_to_string(counts)
+            assert s.isascii()
+            assert S.rle_from_string(s) == counts
+
+    def test_rle_string_known_value(self):
+        from bigdl_tpu.dataset import segmentation as S
+        # delta coding: [6, 1, 40, 4, 5] encodes like pycocotools
+        counts = [6, 1, 40, 4, 5]
+        assert S.rle_from_string(S.rle_to_string(counts)) == counts
+
+    def test_area_bbox(self):
+        from bigdl_tpu.dataset import segmentation as S
+        mask = np.zeros((10, 12), np.uint8)
+        mask[2:5, 3:8] = 1  # y 2..4, x 3..7
+        counts = S.rle_encode(mask)
+        assert S.rle_area(counts) == 15
+        assert np.array_equal(S.rle_to_bbox(counts, 10, 12), [3, 2, 5, 3])
+
+    def test_merge_iou(self):
+        from bigdl_tpu.dataset import segmentation as S
+        a = np.zeros((8, 8), np.uint8); a[:4] = 1
+        b = np.zeros((8, 8), np.uint8); b[2:6] = 1
+        ca, cb = S.rle_encode(a), S.rle_encode(b)
+        union = S.rle_decode(S.rle_merge([ca, cb], 8, 8), 8, 8)
+        inter = S.rle_decode(S.rle_merge([ca, cb], 8, 8, intersect=True), 8, 8)
+        assert union.sum() == 6 * 8 and inter.sum() == 2 * 8
+        assert abs(S.rle_iou(ca, cb, 8, 8) - (16 / 48)) < 1e-9
+
+    def test_polygon_rasterize_square(self):
+        from bigdl_tpu.dataset import segmentation as S
+        # axis-aligned square covering pixel centers x,y in [2,6)
+        ring = [2, 2, 6, 2, 6, 6, 2, 6]
+        mask = S.rasterize_polygon(np.array(ring, float), 9, 9)
+        expect = np.zeros((9, 9), np.uint8)
+        expect[2:6, 2:6] = 1
+        assert np.array_equal(mask, expect)
+
+    def test_polygon_triangle_area(self):
+        from bigdl_tpu.dataset import segmentation as S
+        ring = [0, 0, 20, 0, 0, 20]  # right triangle, area 200
+        mask = S.rasterize_polygon(np.array(ring, float), 24, 24)
+        assert abs(int(mask.sum()) - 200) <= 12  # boundary rounding
+
+    def test_poly_masks_api(self):
+        from bigdl_tpu.dataset import PolyMasks, RLEMasks
+        pm = PolyMasks([[[1, 1, 5, 1, 5, 5, 1, 5]],
+                        [[0, 0, 3, 0, 3, 3], [4, 4, 7, 4, 7, 7]]], 8, 8)
+        assert len(pm) == 2
+        rle = pm.to_rle()
+        assert isinstance(rle, RLEMasks) and len(rle) == 2
+        dense = pm.decode()
+        assert dense.shape == (2, 8, 8)
+        assert dense[0].sum() == 16  # 4x4 interior
+        strs = rle.to_strings()
+        back = RLEMasks.from_strings(strs, 8, 8)
+        assert np.array_equal(back.decode(), dense)
+        assert np.array_equal(back.area(), rle.area())
+
+    def test_rle_masks_empty_and_full(self):
+        from bigdl_tpu.dataset import segmentation as S
+        zero = np.zeros((5, 5), np.uint8)
+        full = np.ones((5, 5), np.uint8)
+        assert S.rle_encode(zero) == [25]
+        assert S.rle_encode(full) == [0, 25]
+        assert np.array_equal(S.rle_to_bbox(S.rle_encode(zero), 5, 5),
+                              np.zeros(4))
+        assert np.array_equal(S.rle_to_bbox(S.rle_encode(full), 5, 5),
+                              [0, 0, 5, 5])

@@ -210,3 +210,20 @@ def test_validation_during_training():
     opt.set_validation(several_iteration(3), ds, [Top1Accuracy()], 64)
     opt.optimize()
     assert "score" in opt.optim_method.state
+
+
+def test_treenn_accuracy():
+    from bigdl_tpu.optim import TreeNNAccuracy
+    m = TreeNNAccuracy()
+    # (B, nodes, classes): root = node 0
+    out = np.zeros((4, 3, 5), np.float32)
+    out[0, 0, 2] = 1; out[1, 0, 1] = 1; out[2, 0, 4] = 1; out[3, 0, 0] = 1
+    target = np.zeros((4, 3), np.float32)
+    target[:, 0] = [3, 2, 1, 1]  # 1-based; three of four correct
+    acc, n = m(out, target).result()
+    assert n == 4 and abs(acc - 0.75) < 1e-9
+    # binary head thresholds at 0.5
+    outb = np.array([[[0.9]], [[0.2]]], np.float32)
+    tb = np.array([[1], [0]], np.float32)
+    accb, nb = m(outb, tb).result()
+    assert nb == 2 and accb == 1.0

@@ -63,3 +63,108 @@ def test_quantized_graph_model():
     qg = quantize(g)
     out = np.asarray(qg.forward(x))
     assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.05
+
+
+# ---- calibration (static int8) ---------------------------------------------
+
+def _small_convnet():
+    from bigdl_tpu import nn
+    return nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3), nn.ReLU(),
+        nn.Reshape([4 * 6 * 6], batch_mode=True),
+        nn.Linear(4 * 6 * 6, 10))
+
+
+def test_observers():
+    from bigdl_tpu.quantization import (MinMaxObserver, MovingAverageObserver,
+                                        PercentileObserver)
+    batches = [np.full((4,), v, np.float32) for v in (1.0, 3.0, 2.0)]
+    mm = MinMaxObserver()
+    for b in batches:
+        mm.update(b)
+    assert abs(mm.absmax - 3.0) < 1e-6
+    ma = MovingAverageObserver(momentum=0.5)
+    for b in batches:
+        ma.update(b)
+    # 1 -> .5*1+.5*3=2 -> .5*2+.5*2=2
+    assert abs(ma.absmax - 2.0) < 1e-6
+    pc = PercentileObserver(percentile=50)
+    x = np.ones(100, np.float32); x[0] = 1000.0  # outlier clipped
+    pc.update(x)
+    assert pc.absmax < 10
+
+
+def test_calibrate_records_per_layer_scales():
+    from bigdl_tpu.quantization import calibrate, quantizable_paths
+    model = _small_convnet()
+    batches = [np.random.randn(2, 1, 8, 8).astype(np.float32)
+               for _ in range(3)]
+    scales = calibrate(model, batches)
+    paths = [p for p, _ in quantizable_paths(model)]
+    assert set(scales) == set(paths) and len(paths) == 2
+    assert all(s > 0 for s in scales.values())
+    # hooks removed: forward still works and _apply restored to class impl
+    for _, m in quantizable_paths(model):
+        assert "_apply" not in m.__dict__
+
+
+def test_calibrated_quantize_close_to_float():
+    from bigdl_tpu.quantization import calibrate, quantize
+    model = _small_convnet().evaluate()
+    batches = [np.random.randn(4, 1, 8, 8).astype(np.float32)
+               for _ in range(4)]
+    scales = calibrate(model, batches)
+    qmodel = quantize(model, calibration=scales)
+    x = batches[0]
+    ref = np.asarray(model.forward(x))
+    out = np.asarray(qmodel.forward(x))
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert err < 0.1, err
+    # static scale is baked into params (no dynamic max at inference)
+    import jax
+    flat = jax.tree_util.tree_leaves_with_path(qmodel.params)
+    assert any("act_scale" in "/".join(str(k) for k in path)
+               for path, _ in flat)
+
+
+def test_fold_batchnorm_matches_unfused():
+    from bigdl_tpu import nn
+    from bigdl_tpu.quantization import fold_batchnorm
+    model = nn.Sequential(
+        nn.SpatialConvolution(2, 4, 3, 3),
+        nn.SpatialBatchNormalization(4),
+        nn.ReLU())
+    # give BN non-trivial running stats by training a few batches
+    model.training()
+    for _ in range(3):
+        model.forward(np.random.randn(4, 2, 8, 8).astype(np.float32))
+    model.evaluate()
+    x = np.random.randn(2, 2, 8, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    folded = fold_batchnorm(model)
+    out = np.asarray(folded.forward(x))
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+    # BN slot is now an identity
+    assert type(folded.modules[1]).__name__ == "Identity"
+
+
+def test_fold_then_calibrated_quantize():
+    from bigdl_tpu import nn
+    from bigdl_tpu.quantization import calibrate, fold_batchnorm, quantize
+    model = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3),
+        nn.SpatialBatchNormalization(4),
+        nn.ReLU(),
+        nn.Reshape([4 * 6 * 6], batch_mode=True),
+        nn.Linear(4 * 6 * 6, 5))
+    model.training()
+    for _ in range(3):
+        model.forward(np.random.randn(4, 1, 8, 8).astype(np.float32))
+    model.evaluate()
+    x = np.random.randn(4, 1, 8, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    fold_batchnorm(model)
+    q = quantize(model, calibration=calibrate(model, [x]))
+    out = np.asarray(q.forward(x))
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert err < 0.15, err
