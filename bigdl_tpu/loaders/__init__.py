@@ -1,2 +1,3 @@
 from .caffe import load_caffe, parse_prototxt, read_caffemodel_blobs
 from .torchfile import load_torch, load_t7
+from .tensorflow import load_tf_graph, load_tf, parse_graphdef

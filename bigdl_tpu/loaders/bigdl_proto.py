@@ -1,0 +1,516 @@
+"""bigdl.proto-compatible module serializer (SURVEY §2.8 r2 item).
+
+Parity: reference ``utils/serializer`` (``ModuleSerializer`` /
+``ModuleLoader.loadFromFile`` / ``module.saveModule``), whose on-disk form is
+a raw ``BigDLModule`` protobuf (``spark/dl/src/main/resources/serialization/
+bigdl.proto``) written via ``File.saveBytes`` — no extra framing. This module
+reads and writes that wire format directly (loaders/wire.py primitives, no
+protoc), so checkpoints cross-load between the reference and ``bigdl_tpu``
+for the common layer set.
+
+Field numbers (bigdl.proto):
+- BigDLModule: name=1, subModules=2, weight=3, bias=4, preModules=5,
+  nextModules=6, moduleType=7, attr=8(map), version=9, train=10,
+  namePostfix=11, id=12, inputShape=13, outputShape=14, hasParameters=15,
+  parameters=16.
+- BigDLTensor: datatype=1, size=2, stride=3, offset=4, dimension=5,
+  nElements=6, isScalar=7, storage=8, id=9, tensorType=10.
+- TensorStorage: datatype=1, float_data=2, double_data=3, bool_data=4,
+  string_data=5, int_data=6, long_data=7, bytes_data=8, id=9.
+- AttrValue: dataType=1, subType=2, oneof value: int32=3, int64=4, float=5,
+  double=6, string=7, bool=8, regularizer=9, tensor=10, varFormat=11,
+  initMethod=12, module=13, nameAttrList=14, array=15, dataFormat=16,
+  custom=17, shape=18.
+
+Storage sharing matches the reference: the first occurrence of a storage id
+carries the data; later references carry only the id.
+
+Supported module set (both directions): Sequential, Linear,
+SpatialConvolution, SpatialMaxPooling, SpatialAveragePooling, ReLU, Tanh,
+Sigmoid, SoftMax, LogSoftMax, Dropout, BatchNormalization,
+SpatialBatchNormalization, Reshape, View, Identity, CAddTable, JoinTable.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import nn as N
+from .wire import (field_bytes, field_string, field_varint, field_double,
+                   field_float, field_packed_float, iter_fields, read_varint,
+                   to_signed, unpack_packed)
+
+_SCALA_NN = "com.intel.analytics.bigdl.nn."
+
+# AttrValue DataType enum values (bigdl.proto)
+_DT_INT32, _DT_INT64, _DT_FLOAT, _DT_DOUBLE = 0, 1, 2, 3
+_DT_STRING, _DT_BOOL = 4, 5
+_DT_REGULARIZER, _DT_TENSOR, _DT_MODULE = 9, 10, 13
+_DT_ARRAY = 15
+
+# BigDLTensor/TensorStorage datatype: FLOAT=2 (same enum)
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+
+class _Ids:
+    def __init__(self):
+        self.next = 1
+
+    def take(self):
+        v = self.next
+        self.next += 1
+        return v
+
+
+def _enc_storage(data: np.ndarray, sid: int) -> bytes:
+    out = field_varint(1, _DT_FLOAT)
+    out += field_bytes(2, struct.pack(f"<{data.size}f",
+                                      *np.asarray(data, np.float32).ravel()))
+    out += field_varint(9, sid)
+    return out
+
+
+def _enc_tensor(arr: np.ndarray, ids: _Ids) -> bytes:
+    arr = np.asarray(arr, np.float32)
+    sizes = list(arr.shape)
+    strides = [int(np.prod(sizes[i + 1:])) for i in range(len(sizes))]
+    out = field_varint(1, _DT_FLOAT)
+    for s in sizes:
+        out += field_varint(2, s)
+    for s in strides:
+        out += field_varint(3, s)
+    out += field_varint(4, 1)            # torch-style 1-based storage offset
+    out += field_varint(5, len(sizes))
+    out += field_varint(6, arr.size)
+    out += field_bytes(8, _enc_storage(arr, ids.take()))
+    out += field_varint(9, ids.take())
+    return out
+
+
+def _attr(dt: int, body: bytes = b"") -> bytes:
+    return field_varint(1, dt) + body
+
+
+def _attr_i32(v: int) -> bytes:
+    return _attr(_DT_INT32, field_varint(3, int(v)))  # write_varint handles <0
+
+
+def _attr_double(v: float) -> bytes:
+    return _attr(_DT_DOUBLE, field_double(6, float(v)))
+
+
+def _attr_bool(v: bool) -> bytes:
+    return _attr(_DT_BOOL, field_varint(8, 1 if v else 0))
+
+
+def _attr_null_reg() -> bytes:
+    return _attr(_DT_REGULARIZER)
+
+
+def _attr_null_tensor() -> bytes:
+    return _attr(_DT_TENSOR)
+
+
+def _attr_tensor(arr: np.ndarray, ids: "_Ids") -> bytes:
+    return _attr(_DT_TENSOR, field_bytes(10, _enc_tensor(arr, ids)))
+
+
+def _attr_i32_array(vals) -> bytes:
+    from .wire import field_packed_varint
+    body = field_varint(1, len(vals)) + field_varint(2, _DT_INT32)
+    body += field_packed_varint(3, [int(v) for v in vals])  # packed i32
+    return _attr(_DT_ARRAY, field_bytes(15, body))
+
+
+def _map_entry(key: str, attr_bytes: bytes) -> bytes:
+    return field_bytes(8, field_string(1, key) + field_bytes(2, attr_bytes))
+
+
+def _module_attrs(m: N.Module, state, ids: "_Ids") -> Dict[str, bytes]:
+    """Constructor-parameter attrs, names matching the Scala ctor params so
+    the reference's reflection-based deserializer can rebuild the layer."""
+    t = type(m).__name__
+    if t == "Linear":
+        return {"inputSize": _attr_i32(m.input_size),
+                "outputSize": _attr_i32(m.output_size),
+                "withBias": _attr_bool(m.with_bias),
+                "wRegularizer": _attr_null_reg(),
+                "bRegularizer": _attr_null_reg(),
+                "initWeight": _attr_null_tensor(),
+                "initBias": _attr_null_tensor(),
+                "initGradWeight": _attr_null_tensor(),
+                "initGradBias": _attr_null_tensor()}
+    if t in ("SpatialConvolution", "SpatialShareConvolution"):
+        return {"nInputPlane": _attr_i32(m.n_input_plane),
+                "nOutputPlane": _attr_i32(m.n_output_plane),
+                "kernelW": _attr_i32(m.kernel_w),
+                "kernelH": _attr_i32(m.kernel_h),
+                "strideW": _attr_i32(m.stride_w),
+                "strideH": _attr_i32(m.stride_h),
+                "padW": _attr_i32(m.pad_w), "padH": _attr_i32(m.pad_h),
+                "nGroup": _attr_i32(m.n_group),
+                "propagateBack": _attr_bool(True),
+                "wRegularizer": _attr_null_reg(),
+                "bRegularizer": _attr_null_reg(),
+                "initWeight": _attr_null_tensor(),
+                "initBias": _attr_null_tensor(),
+                "initGradWeight": _attr_null_tensor(),
+                "initGradBias": _attr_null_tensor(),
+                "withBias": _attr_bool(m.with_bias)}
+    if t in ("SpatialMaxPooling",):
+        return {"kW": _attr_i32(m.kw), "kH": _attr_i32(m.kh),
+                "dW": _attr_i32(m.dw), "dH": _attr_i32(m.dh),
+                "padW": _attr_i32(m.pad_w), "padH": _attr_i32(m.pad_h)}
+    if t in ("SpatialAveragePooling",):
+        return {"kW": _attr_i32(m.kw), "kH": _attr_i32(m.kh),
+                "dW": _attr_i32(m.dw), "dH": _attr_i32(m.dh),
+                "padW": _attr_i32(m.pad_w), "padH": _attr_i32(m.pad_h),
+                "globalPooling": _attr_bool(m.global_pooling),
+                "ceilMode": _attr_bool(m.ceil_mode),
+                "countIncludePad": _attr_bool(m.count_include_pad),
+                "divide": _attr_bool(m.divide)}
+    if t == "Dropout":
+        return {"initP": _attr_double(m.p),
+                "inplace": _attr_bool(False), "scale": _attr_bool(True)}
+    if t in ("BatchNormalization", "SpatialBatchNormalization"):
+        # the reference's BN doSerializeModule stores running stats (and the
+        # per-batch save buffers) as tensor attrs (BatchNormalization.scala:419)
+        mean = np.asarray(state.get("running_mean", np.zeros(m.n_output)))
+        var = np.asarray(state.get("running_var", np.ones(m.n_output)))
+        return {"nOutput": _attr_i32(m.n_output),
+                "eps": _attr_double(m.eps),
+                "momentum": _attr_double(m.momentum),
+                "affine": _attr_bool(m.affine),
+                "initWeight": _attr_null_tensor(),
+                "initBias": _attr_null_tensor(),
+                "initGradWeight": _attr_null_tensor(),
+                "initGradBias": _attr_null_tensor(),
+                "runningMean": _attr_tensor(mean, ids),
+                "runningVar": _attr_tensor(var, ids),
+                "saveMean": _attr_tensor(np.zeros_like(mean), ids),
+                "saveStd": _attr_tensor(np.ones_like(var), ids)}
+    if t == "Reshape":
+        a = {"size": _attr_i32_array(list(m.size))}
+        if m.batch_mode is not None:
+            a["batchMode"] = _attr_bool(m.batch_mode)
+        return a
+    if t == "View":
+        return {"sizes": _attr_i32_array(list(m.sizes))}
+    if t == "JoinTable":
+        return {"dimension": _attr_i32(m.dimension),
+                "nInputDims": _attr_i32(m.n_input_dims)}
+    return {}
+
+
+def _collect_parameters(m: N.Module, params) -> List[np.ndarray]:
+    """Trainable tensors in the reference's (weight, bias) order, with the
+    conv weight expanded to the reference's 5-D grouped layout."""
+    t = type(m).__name__
+    out = []
+    if t in ("SpatialConvolution", "SpatialShareConvolution"):
+        w = np.asarray(params["weight"])
+        g = m.n_group
+        out.append(w.reshape(g, w.shape[0] // g, *w.shape[1:]))
+        if m.with_bias:
+            out.append(np.asarray(params["bias"]))
+        return out
+    for key in ("weight", "bias"):
+        if isinstance(params, dict) and key in params:
+            out.append(np.asarray(params[key]))
+    return out
+
+
+_SAVE_TYPES = ("Sequential", "Linear", "SpatialConvolution",
+               "SpatialShareConvolution", "SpatialMaxPooling",
+               "SpatialAveragePooling", "ReLU", "Tanh", "Sigmoid", "SoftMax",
+               "LogSoftMax", "Dropout", "BatchNormalization",
+               "SpatialBatchNormalization", "Reshape", "View", "Identity",
+               "CAddTable", "JoinTable")
+
+
+def _enc_module(m: N.Module, params, state, ids: _Ids) -> bytes:
+    t = type(m).__name__
+    if t not in _SAVE_TYPES:
+        raise NotImplementedError(
+            f"bigdl.proto serialization of {t} not supported "
+            f"(supported: {', '.join(_SAVE_TYPES)})")
+    out = field_string(1, m.name)
+    if isinstance(m, N.Sequential):
+        for i, child in enumerate(m.modules):
+            out += field_bytes(2, _enc_module(child, params[str(i)],
+                                              state.get(str(i), {}), ids))
+    out += field_string(7, _SCALA_NN + t)
+    for key, ab in _module_attrs(m, state, ids).items():
+        out += _map_entry(key, ab)
+    out += field_string(9, "0.4.0")
+    out += field_varint(10, 1 if m.train_mode else 0)
+    out += field_varint(12, ids.take())
+    tensors = [] if isinstance(m, N.Sequential) else \
+        _collect_parameters(m, params)
+    if tensors:
+        out += field_varint(15, 1)  # hasParameters
+        for tns in tensors:
+            out += field_bytes(16, _enc_tensor(tns, ids))
+    return out
+
+
+def save_bigdl(model: N.Module, path: str) -> None:
+    """module.saveModule(path) parity — writes a reference-loadable
+    BigDLModule protobuf."""
+    model.ensure_initialized()
+    with open(path, "wb") as f:
+        f.write(_enc_module(model, model.params, model.state or {}, _Ids()))
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+
+def _dec_storage(buf: bytes, storages: Dict[int, np.ndarray]):
+    sid, data = -1, None
+    for f, w, v in iter_fields(buf):
+        if f == 9 and w == 0:
+            sid = to_signed(v, 32)
+        elif f == 2:
+            data = np.array(unpack_packed(v, "float"), np.float32) \
+                if w == 2 else np.array([struct.unpack("<f", v)[0]],
+                                        np.float32)
+        elif f == 3:
+            data = np.array(unpack_packed(v, "double"), np.float32) \
+                if w == 2 else np.array([struct.unpack("<d", v)[0]],
+                                        np.float32)
+        elif f == 6:
+            vals = unpack_packed(v, "varint") if w == 2 else [v]
+            data = np.array([to_signed(x, 32) for x in vals], np.float32)
+    if data is not None and sid != -1:
+        storages[sid] = data
+    return sid, data
+
+
+def _dec_tensor(buf: bytes, storages: Dict[int, np.ndarray]) -> np.ndarray:
+    sizes, strides, offset, data, sid = [], [], 1, None, -1
+    for f, w, v in iter_fields(buf):
+        if f == 2:
+            sizes += [to_signed(x, 32) for x in unpack_packed(v, "varint")] \
+                if w == 2 else [to_signed(v, 32)]
+        elif f == 3:
+            strides += [to_signed(x, 32) for x in unpack_packed(v, "varint")]\
+                if w == 2 else [to_signed(v, 32)]
+        elif f == 4 and w == 0:
+            offset = to_signed(v, 32)
+        elif f == 8 and w == 2:
+            sid, data = _dec_storage(v, storages)
+    if data is None and sid in storages:
+        data = storages[sid]
+    if data is None:
+        return np.zeros(sizes, np.float32)
+    n = int(np.prod(sizes)) if sizes else data.size
+    flat = data[offset - 1: offset - 1 + n]
+    return flat.reshape(sizes) if sizes else flat
+
+
+def _dec_attr(buf: bytes, storages):
+    dt, val = _DT_INT32, None
+    for f, w, v in iter_fields(buf):
+        if f == 1 and w == 0:
+            dt = v
+        elif f == 3:
+            val = to_signed(v)  # negative int32 is wire-encoded as 64-bit
+        elif f == 4:
+            val = to_signed(v)
+        elif f == 5 and w == 5:
+            val = struct.unpack("<f", v)[0]
+        elif f == 6 and w == 1:
+            val = struct.unpack("<d", v)[0]
+        elif f == 7 and w == 2:
+            val = v.decode("utf-8")
+        elif f == 8 and w == 0:
+            val = bool(v)
+        elif f == 10 and w == 2:
+            val = _dec_tensor(v, storages)
+        elif f == 15 and w == 2:  # ArrayValue
+            arr = {"i32": [], "flt": [], "dbl": []}
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 3:
+                    arr["i32"] += [to_signed(x) for x in
+                                   unpack_packed(v2, "varint")] \
+                        if w2 == 2 else [to_signed(v2)]
+                elif f2 == 5:
+                    arr["flt"] += unpack_packed(v2, "float") if w2 == 2 \
+                        else [struct.unpack("<f", v2)[0]]
+                elif f2 == 6:
+                    arr["dbl"] += unpack_packed(v2, "double") if w2 == 2 \
+                        else [struct.unpack("<d", v2)[0]]
+            val = arr["i32"] or arr["flt"] or arr["dbl"]
+    return val
+
+
+def decode_bigdl_module(buf: bytes, storages=None) -> Dict:
+    """BigDLModule bytes → nested dict."""
+    storages = {} if storages is None else storages
+    mod = {"name": "", "moduleType": "", "subModules": [], "attr": {},
+           "parameters": [], "weight": None, "bias": None, "train": False}
+    for f, w, v in iter_fields(buf):
+        if f == 1 and w == 2:
+            mod["name"] = v.decode("utf-8")
+        elif f == 2 and w == 2:
+            mod["subModules"].append(decode_bigdl_module(v, storages))
+        elif f == 3 and w == 2:
+            mod["weight"] = _dec_tensor(v, storages)
+        elif f == 4 and w == 2:
+            mod["bias"] = _dec_tensor(v, storages)
+        elif f == 7 and w == 2:
+            mod["moduleType"] = v.decode("utf-8")
+        elif f == 8 and w == 2:
+            key, ab = None, None
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1:
+                    key = v2.decode("utf-8")
+                elif f2 == 2:
+                    ab = v2
+            if key is not None:
+                mod["attr"][key] = _dec_attr(ab or b"", storages)
+        elif f == 10 and w == 0:
+            mod["train"] = bool(v)
+        elif f == 16 and w == 2:
+            mod["parameters"].append(_dec_tensor(v, storages))
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# module reconstruction
+# ---------------------------------------------------------------------------
+
+
+def _build_module(mod: Dict) -> N.Module:
+    t = mod["moduleType"].rsplit(".", 1)[-1]
+    a = mod["attr"]
+    if t == "Sequential":
+        seq = N.Sequential()
+        for sub in mod["subModules"]:
+            seq.add(_build_module(sub))
+        m = seq
+    elif t == "Linear":
+        m = N.Linear(int(a["inputSize"]), int(a["outputSize"]),
+                     bool(a.get("withBias", True)))
+    elif t in ("SpatialConvolution", "SpatialShareConvolution"):
+        m = N.SpatialConvolution(
+            int(a["nInputPlane"]), int(a["nOutputPlane"]),
+            int(a["kernelW"]), int(a["kernelH"]),
+            int(a.get("strideW", 1)), int(a.get("strideH", 1)),
+            int(a.get("padW", 0)), int(a.get("padH", 0)),
+            n_group=int(a.get("nGroup", 1)),
+            with_bias=bool(a.get("withBias", True)))
+    elif t == "SpatialMaxPooling":
+        m = N.SpatialMaxPooling(int(a["kW"]), int(a["kH"]),
+                                int(a.get("dW") or a["kW"]),
+                                int(a.get("dH") or a["kH"]),
+                                int(a.get("padW", 0)), int(a.get("padH", 0)))
+    elif t == "SpatialAveragePooling":
+        m = N.SpatialAveragePooling(
+            int(a["kW"]), int(a["kH"]),
+            int(a.get("dW") or a["kW"]), int(a.get("dH") or a["kH"]),
+            int(a.get("padW", 0)), int(a.get("padH", 0)),
+            global_pooling=bool(a.get("globalPooling", False)),
+            ceil_mode=bool(a.get("ceilMode", False)),
+            count_include_pad=bool(a.get("countIncludePad", True)),
+            divide=bool(a.get("divide", True)))
+    elif t == "ReLU":
+        m = N.ReLU()
+    elif t == "Tanh":
+        m = N.Tanh()
+    elif t == "Sigmoid":
+        m = N.Sigmoid()
+    elif t == "SoftMax":
+        m = N.SoftMax()
+    elif t == "LogSoftMax":
+        m = N.LogSoftMax()
+    elif t == "Dropout":
+        m = N.Dropout(float(a.get("initP", 0.5)))
+    elif t == "BatchNormalization":
+        m = N.BatchNormalization(int(a["nOutput"]),
+                                 float(a.get("eps", 1e-5)),
+                                 float(a.get("momentum", 0.1)),
+                                 bool(a.get("affine", True)))
+    elif t == "SpatialBatchNormalization":
+        m = N.SpatialBatchNormalization(int(a["nOutput"]),
+                                        float(a.get("eps", 1e-5)),
+                                        float(a.get("momentum", 0.1)),
+                                        bool(a.get("affine", True)))
+    elif t in ("Reshape", "View"):
+        size = [int(x) for x in a.get("size", a.get("sizes", []))]
+        m = N.Reshape(size, batch_mode=a.get("batchMode"))
+    elif t == "Identity":
+        m = N.Identity()
+    elif t == "CAddTable":
+        m = N.CAddTable()
+    elif t == "JoinTable":
+        m = N.JoinTable(int(a.get("dimension", 1)),
+                        int(a.get("nInputDims", -1)))
+    else:
+        raise NotImplementedError(
+            f"bigdl.proto load of moduleType {mod['moduleType']} "
+            "not supported")
+    if mod["name"]:
+        m.set_name(mod["name"])
+    return m
+
+
+def _load_params(m: N.Module, mod: Dict, params, state) -> None:
+    import jax.numpy as jnp
+    if isinstance(m, N.Sequential):
+        for i, sub in enumerate(mod["subModules"]):
+            _load_params(m.modules[i], sub, params[str(i)],
+                         state.get(str(i), {}))
+        return
+    if isinstance(m, N.BatchNormalization):
+        a = mod["attr"]
+        if isinstance(a.get("runningMean"), np.ndarray) and \
+                a["runningMean"].size:
+            state["running_mean"] = jnp.asarray(a["runningMean"].reshape(-1))
+        if isinstance(a.get("runningVar"), np.ndarray) and \
+                a["runningVar"].size:
+            state["running_var"] = jnp.asarray(a["runningVar"].reshape(-1))
+    tensors = mod["parameters"]
+    if not tensors and mod["weight"] is not None:
+        tensors = [mod["weight"]] + \
+            ([mod["bias"]] if mod["bias"] is not None else [])
+    if not tensors:
+        return
+    if isinstance(m, N.SpatialConvolution):
+        w = tensors[0]
+        params["weight"] = jnp.asarray(
+            w.reshape(np.asarray(params["weight"]).shape))
+        if m.with_bias and len(tensors) > 1:
+            params["bias"] = jnp.asarray(tensors[1].reshape(-1))
+        return
+    keys = [k for k in ("weight", "bias") if k in params]
+    for k, tns in zip(keys, tensors):
+        params[k] = jnp.asarray(
+            tns.reshape(np.asarray(params[k]).shape))
+
+
+def load_bigdl(path_or_bytes) -> N.Module:
+    """ModuleLoader.loadFromFile parity — builds a bigdl_tpu module from a
+    reference-format BigDLModule protobuf."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    mod = decode_bigdl_module(data)
+    m = _build_module(mod)
+    m.ensure_initialized()
+    _load_params(m, mod, m.params, m.state or {})
+    if mod["train"]:
+        m.training()
+    else:
+        m.evaluate()
+    return m

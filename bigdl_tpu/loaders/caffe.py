@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import nn as N
+from .wire import read_varint as _read_varint, iter_fields as _iter_fields
 
 
 # ---------------------------------------------------------------------------
@@ -86,40 +87,8 @@ def _as_list(v):
 
 
 # ---------------------------------------------------------------------------
-# caffemodel (binary wire format) decoder
+# caffemodel (binary wire format) decoder — primitives in loaders/wire.py
 # ---------------------------------------------------------------------------
-def _read_varint(buf, i):
-    shift, val = 0, 0
-    while True:
-        b = buf[i]
-        i += 1
-        val |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return val, i
-        shift += 7
-
-
-def _iter_fields(buf):
-    i = 0
-    n = len(buf)
-    while i < n:
-        key, i = _read_varint(buf, i)
-        field, wire = key >> 3, key & 7
-        if wire == 0:
-            val, i = _read_varint(buf, i)
-        elif wire == 1:
-            val = buf[i:i + 8]
-            i += 8
-        elif wire == 2:
-            ln, i = _read_varint(buf, i)
-            val = buf[i:i + ln]
-            i += ln
-        elif wire == 5:
-            val = buf[i:i + 4]
-            i += 4
-        else:
-            raise ValueError(f"unsupported wire type {wire}")
-        yield field, wire, val
 
 
 def _decode_blob(buf) -> np.ndarray:

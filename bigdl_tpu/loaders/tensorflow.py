@@ -1,0 +1,429 @@
+"""TensorFlow GraphDef loader (SURVEY §2.8 r2 item).
+
+Parity: reference ``utils/tf/TensorflowLoader.scala`` + ``nn/tf`` op layers
+(Module.loadTF(graphFile, inputs, outputs)). No TensorFlow dependency: the
+GraphDef/NodeDef/AttrValue/TensorProto messages are decoded at the protobuf
+wire level (loaders/wire.py); field numbers from tensorflow's graph.proto,
+node_def.proto, attr_value.proto, tensor.proto.
+
+Supported op set covers the frozen-inference-graph class of nets (the
+reference's loader has the same scope): Placeholder, Const, Identity, Conv2D,
+DepthwiseConv2dNative, MatMul, BiasAdd, Add/AddV2/Sub/Mul, Relu/Relu6/Tanh/
+Sigmoid/Softmax, MaxPool/AvgPool, FusedBatchNorm(V2/V3), Reshape, Squeeze,
+Pad, ConcatV2/Concat, Mean (spatial → global average pool).
+
+Layout: TF frozen graphs are NHWC; the built bigdl_tpu Graph is NCHW-native
+(TPU-friendly). Weights are transposed at load time (HWIO→OIHW, and MatMul
+kernels are permuted so NCHW-flattened inputs line up); the returned model
+takes NCHW input.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import nn as N
+from .wire import iter_fields, read_varint, to_signed, unpack_packed
+
+# tensorflow DataType enum (types.proto)
+_DT_NUMPY = {
+    1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
+    6: np.int8, 9: np.int64, 10: np.bool_, 14: np.uint16, 19: np.float16,
+}
+
+
+# ---------------------------------------------------------------------------
+# GraphDef wire decoding
+# ---------------------------------------------------------------------------
+
+
+def _decode_shape(buf: bytes) -> List[int]:
+    dims = []
+    for f, w, v in iter_fields(buf):
+        if f == 2 and w == 2:  # Dim
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1:
+                    dims.append(to_signed(v2))
+    return dims
+
+
+def _decode_tensor(buf: bytes) -> np.ndarray:
+    dtype, shape, content = 1, [], None
+    float_vals, int_vals, double_vals = [], [], []
+    for f, w, v in iter_fields(buf):
+        if f == 1 and w == 0:
+            dtype = v
+        elif f == 2 and w == 2:
+            shape = _decode_shape(v)
+        elif f == 4 and w == 2:
+            content = v
+        elif f == 5:  # float_val (tensor.proto)
+            float_vals += unpack_packed(v, "float") if w == 2 else \
+                [struct.unpack("<f", v)[0]]
+        elif f == 6:  # double_val
+            double_vals += unpack_packed(v, "double") if w == 2 else \
+                [struct.unpack("<d", v)[0]]
+        elif f in (7, 10):  # int_val / int64_val
+            int_vals += [to_signed(x) for x in unpack_packed(v, "varint")] \
+                if w == 2 else [to_signed(v)]
+    np_dtype = _DT_NUMPY.get(dtype, np.float32)
+    if content is not None:
+        arr = np.frombuffer(content, dtype=np_dtype)
+    elif float_vals:
+        arr = np.array(float_vals, np.float32)
+    elif double_vals:
+        arr = np.array(double_vals, np.float64)
+    elif int_vals:
+        arr = np.array(int_vals, np_dtype)
+    else:
+        arr = np.zeros(0, np_dtype)
+    n = int(np.prod(shape)) if shape else arr.size
+    if arr.size == 1 and n > 1:  # splat scalar fill
+        arr = np.full(n, arr[0])
+    return arr.reshape(shape) if shape else arr
+
+
+def _decode_attr(buf: bytes):
+    """AttrValue → python value."""
+    for f, w, v in iter_fields(buf):
+        if f == 2:   # s
+            return v.decode("utf-8", "replace") if isinstance(v, bytes) else v
+        if f == 3:   # i
+            return to_signed(v)
+        if f == 4:   # f
+            return struct.unpack("<f", v)[0]
+        if f == 5:   # b
+            return bool(v)
+        if f == 6:   # type
+            return int(v)
+        if f == 7:   # shape
+            return _decode_shape(v)
+        if f == 8:   # tensor
+            return _decode_tensor(v)
+        if f == 1:   # list
+            out = {"s": [], "i": [], "f": [], "b": []}
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 2:
+                    out["s"].append(v2.decode("utf-8", "replace"))
+                elif f2 == 3:
+                    out["i"] += [to_signed(x) for x in
+                                 unpack_packed(v2, "varint")] \
+                        if w2 == 2 else [to_signed(v2)]
+                elif f2 == 4:
+                    out["f"] += unpack_packed(v2, "float") if w2 == 2 else \
+                        [struct.unpack("<f", v2)[0]]
+                elif f2 == 5:
+                    out["b"] += [bool(x) for x in unpack_packed(v2, "varint")]\
+                        if w2 == 2 else [bool(v2)]
+            if out["i"]:
+                return out["i"]
+            if out["f"]:
+                return out["f"]
+            if out["s"]:
+                return out["s"]
+            return out["b"]
+    return None
+
+
+def _decode_node(buf: bytes) -> Dict:
+    node = {"name": "", "op": "", "inputs": [], "attrs": {}}
+    for f, w, v in iter_fields(buf):
+        if f == 1:
+            node["name"] = v.decode("utf-8")
+        elif f == 2:
+            node["op"] = v.decode("utf-8")
+        elif f == 3:
+            node["inputs"].append(v.decode("utf-8"))
+        elif f == 5 and w == 2:  # map<string, AttrValue> entry
+            key, val = None, None
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1:
+                    key = v2.decode("utf-8")
+                elif f2 == 2:
+                    val = _decode_attr(v2)
+            if key is not None:
+                node["attrs"][key] = val
+    return node
+
+
+def parse_graphdef(data: bytes) -> List[Dict]:
+    """GraphDef bytes → list of node dicts {name, op, inputs, attrs}."""
+    return [_decode_node(v) for f, w, v in iter_fields(data)
+            if f == 1 and w == 2]
+
+
+# ---------------------------------------------------------------------------
+# conversion to a bigdl_tpu Graph (NCHW)
+# ---------------------------------------------------------------------------
+
+
+class _TFReshape(N.Module):
+    """TF Reshape with NHWC semantics on NCHW activations: transpose 4D
+    input back to NHWC, reshape to the (NHWC-order) target, then return
+    4D results to NCHW. Keeps element order — and downstream MatMul weights
+    trained on NHWC flatten order — aligned with the frozen graph."""
+
+    def __init__(self, target, name=None):
+        super().__init__(name=name)
+        self.target = [int(t) for t in target]
+
+    def _apply(self, params, state, x, training, rng):
+        if x.ndim == 4:
+            x = x.transpose(0, 2, 3, 1)
+        y = x.reshape(self.target)
+        if y.ndim == 4:
+            y = y.transpose(0, 3, 1, 2)
+        return y
+
+
+class _TFPad(N.Module):
+    """tensorflow Pad with constant paddings (already permuted to NCHW)."""
+
+    def __init__(self, paddings, name=None):
+        super().__init__(name=name)
+        self.paddings = [tuple(int(x) for x in p) for p in paddings]
+
+    def _apply(self, params, state, x, training, rng):
+        import jax.numpy as jnp
+        pads = self.paddings
+        if len(pads) == x.ndim - 1:  # stored without batch dim
+            pads = [(0, 0)] + pads
+        return jnp.pad(x, pads)
+
+
+def _base_name(inp: str) -> str:
+    """Strip the :output-index suffix and ^control prefix of a TF input."""
+    inp = inp.lstrip("^")
+    return inp.split(":")[0]
+
+
+def _strides_hw(attrs) -> Tuple[int, int]:
+    s = attrs.get("strides", [1, 1, 1, 1])
+    if attrs.get("data_format", "NHWC") == "NCHW":
+        return int(s[2]), int(s[3])
+    return int(s[1]), int(s[2])
+
+
+def _pad_code(attrs) -> int:
+    return -1 if attrs.get("padding", "VALID") == "SAME" else 0
+
+
+def load_tf_graph(path_or_bytes, inputs: Optional[List[str]] = None,
+                  outputs: Optional[List[str]] = None) -> N.Module:
+    """Module.loadTF parity: build an NCHW bigdl_tpu Graph from a frozen
+    GraphDef. ``inputs``/``outputs`` default to the Placeholder nodes and the
+    terminal node."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    nodes = parse_graphdef(data)
+    by_name = {n["name"]: n for n in nodes}
+    consts: Dict[str, np.ndarray] = {
+        n["name"]: n["attrs"].get("value") for n in nodes
+        if n["op"] == "Const"}
+
+    if inputs is None:
+        inputs = [n["name"] for n in nodes if n["op"] == "Placeholder"]
+    if outputs is None:
+        consumed = {_base_name(i) for n in nodes for i in n["inputs"]}
+        outputs = [n["name"] for n in nodes
+                   if n["op"] != "Const" and n["name"] not in consumed]
+    if not inputs:
+        raise ValueError("no Placeholder inputs found; pass inputs=[...]")
+
+    graph_nodes: Dict[str, object] = {}
+    input_nodes = []
+    for name in inputs:
+        gn = N.Input(name=name)
+        graph_nodes[name] = gn
+        input_nodes.append(gn)
+
+    def data_inputs(node):
+        """Non-const, non-control producer names."""
+        return [_base_name(i) for i in node["inputs"]
+                if not i.startswith("^") and _base_name(i) not in consts]
+
+    def const_inputs(node):
+        return [consts[_base_name(i)] for i in node["inputs"]
+                if _base_name(i) in consts]
+
+    def build(name: str):
+        if name in graph_nodes:
+            return graph_nodes[name]
+        node = by_name[name]
+        op, attrs = node["op"], node["attrs"]
+        srcs = [build(i) for i in data_inputs(node)]
+        cns = const_inputs(node)
+        m = _convert_op(node, op, attrs, cns, by_name, consts)
+        gn = m(srcs[0] if len(srcs) == 1 else srcs)
+        graph_nodes[name] = gn
+        return gn
+
+    out_nodes = [build(o) for o in outputs]
+    g = N.Graph(input_nodes, out_nodes)
+    # Graph init re-draws child params; overwrite with the weights each
+    # converter loaded onto its module (same pattern as the caffe loader).
+    g.ensure_initialized()
+    import jax
+    import jax.numpy as jnp
+    params, state = dict(g.params), dict(g.state)
+    for i, m in enumerate(g.modules):
+        if m.params:
+            params[str(i)] = jax.tree_util.tree_map(jnp.asarray, m.params)
+        if m.state:
+            state[str(i)] = jax.tree_util.tree_map(jnp.asarray, m.state)
+    g.params, g.state = params, state
+    g.grad_params = jax.tree_util.tree_map(jnp.zeros_like, params)
+    return g
+
+
+def _convert_op(node, op, attrs, cns, by_name, consts) -> N.Module:
+    name = node["name"]
+    if op in ("Identity", "StopGradient", "CheckNumerics", "PreventGradient"):
+        return N.Identity(name=name)
+    if op == "Conv2D":
+        w = cns[0]  # HWIO
+        kh, kw, cin, cout = w.shape
+        sh, sw = _strides_hw(attrs)
+        m = N.SpatialConvolution(cin, cout, kw, kh, sw, sh,
+                                 _pad_code(attrs), _pad_code(attrs),
+                                 with_bias=False, name=name)
+        m.ensure_initialized()
+        m.params["weight"] = np.transpose(w, (3, 2, 0, 1)).astype(np.float32)
+        return m
+    if op == "DepthwiseConv2dNative":
+        w = cns[0]  # (kh, kw, cin, channel_multiplier)
+        kh, kw, cin, mult = w.shape
+        sh, sw = _strides_hw(attrs)
+        m = N.SpatialConvolution(cin, cin * mult, kw, kh, sw, sh,
+                                 _pad_code(attrs), _pad_code(attrs),
+                                 n_group=cin, with_bias=False, name=name)
+        m.ensure_initialized()
+        # (kh,kw,cin,mult) → OIHW with O=cin*mult grouped by input channel
+        ww = np.transpose(w, (2, 3, 0, 1)).reshape(cin * mult, 1, kh, kw)
+        m.params["weight"] = ww.astype(np.float32)
+        return m
+    if op == "MatMul":
+        w = cns[0]
+        if attrs.get("transpose_b"):
+            w = w.T
+        cin, cout = w.shape
+        m = N.Linear(cin, cout, with_bias=False, name=name)
+        m.ensure_initialized()
+        m.params["weight"] = w.T.astype(np.float32)  # ours is (out, in)
+        return m
+    if op == "BiasAdd":
+        b = cns[0]
+        if _is_2d_activation(node, by_name, consts):  # after MatMul: (B, C)
+            m = N.CAdd([b.size], name=name)
+            m.ensure_initialized()
+            m.params["bias"] = b.astype(np.float32)
+        else:  # conv activations are NCHW here: bias broadcasts over (C,1,1)
+            m = N.CAdd([b.size, 1, 1], name=name)
+            m.ensure_initialized()
+            m.params["bias"] = b.reshape(-1, 1, 1).astype(np.float32)
+        return m
+    if op in ("Add", "AddV2", "Sub", "Mul") and cns:
+        c = cns[0].astype(np.float32)
+        if c.size == 1:
+            v = float(c.reshape(()))
+            if op == "Mul":
+                return N.MulConstant(v, name=name)
+            return N.AddConstant(-v if op == "Sub" else v, name=name)
+        shp = list(c.reshape(-1, 1, 1).shape) if c.ndim == 1 else list(c.shape)
+        m = (N.CMul if op == "Mul" else N.CAdd)(shp, name=name)
+        m.ensure_initialized()
+        key = "weight" if op == "Mul" else "bias"
+        m.params[key] = (c.reshape(shp) if op != "Sub" else
+                         -c.reshape(shp))
+        return m
+    if op in ("Add", "AddV2"):
+        return N.CAddTable(name=name)
+    if op == "Sub":
+        return N.CSubTable(name=name)
+    if op == "Mul":
+        return N.CMulTable(name=name)
+    if op == "Relu":
+        return N.ReLU(name=name)
+    if op == "Relu6":
+        return N.ReLU6(name=name)
+    if op == "Tanh":
+        return N.Tanh(name=name)
+    if op == "Sigmoid":
+        return N.Sigmoid(name=name)
+    if op == "Softmax":
+        return N.SoftMax(name=name)
+    if op in ("MaxPool", "AvgPool"):
+        k = attrs.get("ksize", [1, 2, 2, 1])
+        if attrs.get("data_format", "NHWC") == "NCHW":
+            kh, kw = int(k[2]), int(k[3])
+        else:
+            kh, kw = int(k[1]), int(k[2])
+        sh, sw = _strides_hw(attrs)
+        pad = _pad_code(attrs)
+        if op == "MaxPool":
+            return N.SpatialMaxPooling(kw, kh, sw, sh, pad, pad, name=name)
+        return N.SpatialAveragePooling(kw, kh, sw, sh, pad, pad,
+                                       count_include_pad=False, name=name)
+    if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+        gamma, beta, mean, var = cns[:4]
+        eps = attrs.get("epsilon", 1e-3)
+        m = N.SpatialBatchNormalization(gamma.size, eps=float(eps), name=name)
+        m.ensure_initialized()
+        m.params["weight"] = gamma.astype(np.float32)
+        m.params["bias"] = beta.astype(np.float32)
+        if mean.size:  # frozen inference graph carries moving stats
+            m.state["running_mean"] = mean.astype(np.float32)
+            m.state["running_var"] = var.astype(np.float32)
+        return m
+    if op == "Reshape":
+        target = [int(x) for x in cns[0].reshape(-1)] if cns else [-1]
+        return _TFReshape(target, name=name)
+    if op == "Squeeze":
+        dims = attrs.get("squeeze_dims", attrs.get("axis"))
+        if dims:
+            d = sorted(int(x) for x in (dims if isinstance(dims, list)
+                                        else [dims]))
+            # NHWC spatial squeeze [1,2] → NCHW [2,3]
+            if d == [1, 2]:
+                return N.Sequential(N.Squeeze(4), N.Squeeze(3), name=name)
+        return N.Squeeze(name=name)
+    if op == "Pad":
+        pads = cns[0].reshape(-1, 2)
+        if len(pads) == 4:  # NHWC → NCHW
+            pads = pads[[0, 3, 1, 2]]
+        return _TFPad(pads, name=name)
+    if op in ("ConcatV2", "Concat"):
+        axis = int(cns[-1].reshape(())) if cns else -1
+        # NHWC channel concat (axis 3 or -1) → NCHW dim 2 (1-based)
+        dim = 2 if axis in (3, -1) else axis + 1
+        return N.JoinTable(dim, name=name)
+    if op == "Mean":
+        axes = sorted(int(x) for x in cns[0].reshape(-1)) if cns else []
+        if axes == [1, 2]:  # NHWC spatial mean → global average pool
+            keep = attrs.get("keep_dims", attrs.get("keepdims", False))
+            m = N.SpatialAveragePooling(1, 1, global_pooling=True, name=name)
+            if keep:
+                return m
+            return N.Sequential(m, N.Squeeze(4), N.Squeeze(3), name=name)
+        raise NotImplementedError(f"Mean over axes {axes}")
+    raise NotImplementedError(f"TF op '{op}' (node {name}) not supported; "
+                              "supported set in loaders/tensorflow.py")
+
+
+def _is_2d_activation(node, by_name, consts) -> bool:
+    """Heuristic: BiasAdd after MatMul acts on (B, C)."""
+    for i in node["inputs"]:
+        b = _base_name(i)
+        if b in by_name and b not in consts:
+            return by_name[b]["op"] in ("MatMul", "Identity") and \
+                (by_name[b]["op"] != "Identity" or
+                 _is_2d_activation(by_name[b], by_name, consts))
+    return False
+
+
+load_tf = load_tf_graph

@@ -256,3 +256,279 @@ def test_t7_raw_objects(tmp_path):
     assert obj["s"] == "hello"
     assert obj["flag"] is True
     assert np.allclose(obj["x"], arr)
+
+
+# ---- TensorFlow GraphDef loader --------------------------------------------
+
+def _tf_attr(key, val_bytes):
+    from bigdl_tpu.loaders import wire as W
+    return W.field_bytes(5, W.field_string(1, key) + W.field_bytes(2, val_bytes))
+
+
+def _tf_tensor(arr):
+    from bigdl_tpu.loaders import wire as W
+    arr = np.asarray(arr)
+    shape = b"".join(W.field_bytes(2, W.field_varint(1, d)) for d in arr.shape)
+    dt = 3 if arr.dtype.kind == "i" else 1
+    body = W.field_varint(1, dt) + W.field_bytes(2, shape)
+    if dt == 3:
+        body += W.field_bytes(4, arr.astype("<i4").tobytes())
+    else:
+        body += W.field_bytes(4, arr.astype("<f4").tobytes())
+    return W.field_bytes(8, body)
+
+
+def _tf_node(name, op, inputs=(), **attrs):
+    from bigdl_tpu.loaders import wire as W
+    b = W.field_string(1, name) + W.field_string(2, op)
+    for i in inputs:
+        b += W.field_string(3, i)
+    for k, vb in attrs.items():
+        b += _tf_attr(k, vb)
+    return W.field_bytes(1, b)
+
+
+def _attr_s(s):
+    from bigdl_tpu.loaders import wire as W
+    return W.field_bytes(2, s.encode())
+
+
+def _attr_list_i(vals):
+    from bigdl_tpu.loaders import wire as W
+    return W.field_bytes(1, W.field_packed_varint(3, vals))
+
+
+def _attr_f(v):
+    from bigdl_tpu.loaders import wire as W
+    return W.field_float(4, v)
+
+
+def test_tf_graphdef_parse_and_forward():
+    from bigdl_tpu.loaders import load_tf_graph, parse_graphdef
+    rng = np.random.RandomState(0)
+    w = rng.randn(3, 3, 2, 4).astype(np.float32) * 0.3   # HWIO
+    b = rng.randn(4).astype(np.float32) * 0.1
+    wfc = rng.randn(4, 5).astype(np.float32) * 0.3       # (in, out)
+    bfc = rng.randn(5).astype(np.float32) * 0.1
+
+    gd = b"".join([
+        _tf_node("x", "Placeholder"),
+        _tf_node("w", "Const", value=_tf_tensor(w)),
+        _tf_node("conv", "Conv2D", ["x", "w"],
+                 strides=_attr_list_i([1, 1, 1, 1]), padding=_attr_s("SAME")),
+        _tf_node("b", "Const", value=_tf_tensor(b)),
+        _tf_node("bias", "BiasAdd", ["conv", "b"]),
+        _tf_node("relu", "Relu", ["bias"]),
+        _tf_node("pool", "MaxPool", ["relu"],
+                 ksize=_attr_list_i([1, 2, 2, 1]),
+                 strides=_attr_list_i([1, 2, 2, 1]),
+                 padding=_attr_s("VALID")),
+        _tf_node("axes", "Const", value=_tf_tensor(np.array([1, 2], np.int32))),
+        _tf_node("gap", "Mean", ["pool", "axes"]),
+        _tf_node("wfc", "Const", value=_tf_tensor(wfc)),
+        _tf_node("fc", "MatMul", ["gap", "wfc"]),
+        _tf_node("bfc", "Const", value=_tf_tensor(bfc)),
+        _tf_node("logits", "BiasAdd", ["fc", "bfc"]),
+        _tf_node("prob", "Softmax", ["logits"]),
+    ])
+
+    nodes = parse_graphdef(gd)
+    assert [n["op"] for n in nodes][:2] == ["Placeholder", "Const"]
+    model = load_tf_graph(gd)
+    model.evaluate()
+
+    x = rng.randn(2, 2, 8, 8).astype(np.float32)  # NCHW
+    out = np.asarray(model.forward(x))
+    assert out.shape == (2, 5)
+    assert np.allclose(out.sum(-1), 1.0, atol=1e-5)
+
+    # reference computation with torch (TF semantics: SAME pad 3x3/s1 == pad 1)
+    import torch
+    import torch.nn.functional as F
+    tx = torch.from_numpy(x)
+    tw = torch.from_numpy(np.transpose(w, (3, 2, 0, 1)).copy())
+    y = F.conv2d(tx, tw, torch.from_numpy(b), padding=1).relu()
+    y = F.max_pool2d(y, 2)
+    y = y.mean((2, 3))
+    y = y @ torch.from_numpy(wfc) + torch.from_numpy(bfc)
+    y = torch.softmax(y, -1).numpy()
+    assert np.allclose(out, y, atol=1e-4), np.abs(out - y).max()
+
+
+def test_tf_flatten_matmul_order():
+    # NHWC flatten order must be preserved for MatMul weights
+    from bigdl_tpu.loaders import load_tf_graph
+    rng = np.random.RandomState(1)
+    wfc = rng.randn(2 * 2 * 3, 4).astype(np.float32)
+    gd = b"".join([
+        _tf_node("x", "Placeholder"),
+        _tf_node("shape", "Const",
+                 value=_tf_tensor(np.array([-1, 12], np.int32))),
+        _tf_node("flat", "Reshape", ["x", "shape"]),
+        _tf_node("wfc", "Const", value=_tf_tensor(wfc)),
+        _tf_node("fc", "MatMul", ["flat", "wfc"]),
+    ])
+    model = load_tf_graph(gd).evaluate()
+    x = rng.randn(2, 3, 2, 2).astype(np.float32)  # NCHW, C=3, H=W=2
+    out = np.asarray(model.forward(x))
+    x_nhwc = np.transpose(x, (0, 2, 3, 1)).reshape(2, -1)
+    assert np.allclose(out, x_nhwc @ wfc, atol=1e-5)
+
+
+def test_tf_unsupported_op_raises():
+    from bigdl_tpu.loaders import load_tf_graph
+    gd = b"".join([
+        _tf_node("x", "Placeholder"),
+        _tf_node("y", "Erf", ["x"]),
+    ])
+    with pytest.raises(NotImplementedError):
+        load_tf_graph(gd)
+
+
+# ---- bigdl.proto-compatible serializer -------------------------------------
+
+def test_bigdl_proto_roundtrip_sequential():
+    from bigdl_tpu.loaders.bigdl_proto import save_bigdl, load_bigdl
+    import tempfile, os
+    model = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(4),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([4 * 4 * 4], batch_mode=True),
+        nn.Linear(4 * 4 * 4, 10),
+        nn.LogSoftMax()).evaluate()
+    x = np.random.randn(2, 1, 8, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.bigdl")
+        save_bigdl(model, path)
+        loaded = load_bigdl(path)
+    out = np.asarray(loaded.forward(x))
+    assert out.shape == ref.shape
+    assert np.allclose(out, ref, atol=1e-5), np.abs(out - ref).max()
+
+
+def test_bigdl_proto_moduletype_names():
+    from bigdl_tpu.loaders.bigdl_proto import (save_bigdl,
+                                               decode_bigdl_module)
+    import tempfile, os
+    model = nn.Sequential(nn.Linear(3, 2)).evaluate()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.bigdl")
+        save_bigdl(model, path)
+        mod = decode_bigdl_module(open(path, "rb").read())
+    assert mod["moduleType"] == "com.intel.analytics.bigdl.nn.Sequential"
+    sub = mod["subModules"][0]
+    assert sub["moduleType"] == "com.intel.analytics.bigdl.nn.Linear"
+    assert int(sub["attr"]["inputSize"]) == 3
+    assert int(sub["attr"]["outputSize"]) == 2
+    assert len(sub["parameters"]) == 2  # weight + bias
+    assert sub["parameters"][0].shape == (2, 3)
+
+
+def test_bigdl_proto_grouped_conv_layout():
+    from bigdl_tpu.loaders.bigdl_proto import (save_bigdl,
+                                               decode_bigdl_module,
+                                               load_bigdl)
+    import tempfile, os
+    model = nn.Sequential(
+        nn.SpatialConvolution(4, 6, 3, 3, n_group=2)).evaluate()
+    x = np.random.randn(2, 4, 7, 7).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "g.bigdl")
+        save_bigdl(model, path)
+        mod = decode_bigdl_module(open(path, "rb").read())
+        # reference layout: (nGroup, out/g, in/g, kh, kw)
+        assert mod["subModules"][0]["parameters"][0].shape == (2, 3, 2, 3, 3)
+        out = np.asarray(load_bigdl(path).forward(x))
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_bigdl_proto_legacy_weight_bias_fields():
+    # legacy (pre-hasParameters) checkpoints store weight=3 / bias=4
+    from bigdl_tpu.loaders.bigdl_proto import (load_bigdl, _enc_tensor,
+                                               _attr_i32, _attr_bool,
+                                               _attr_null_reg,
+                                               _attr_null_tensor,
+                                               _map_entry, _Ids)
+    from bigdl_tpu.loaders import wire as W
+    w = np.random.randn(2, 3).astype(np.float32)
+    b = np.random.randn(2).astype(np.float32)
+    ids = _Ids()
+    body = W.field_string(1, "fc")
+    body += W.field_bytes(3, _enc_tensor(w, ids))
+    body += W.field_bytes(4, _enc_tensor(b, ids))
+    body += W.field_string(7, "com.intel.analytics.bigdl.nn.Linear")
+    for k, v in [("inputSize", _attr_i32(3)), ("outputSize", _attr_i32(2)),
+                 ("withBias", _attr_bool(True))]:
+        body += _map_entry(k, v)
+    m = load_bigdl(body)
+    x = np.random.randn(4, 3).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert np.allclose(out, x @ w.T + b, atol=1e-5)
+
+
+def test_bigdl_proto_bn_running_stats_roundtrip():
+    from bigdl_tpu.loaders.bigdl_proto import save_bigdl, load_bigdl
+    import tempfile, os
+    model = nn.Sequential(nn.SpatialConvolution(1, 3, 3, 3),
+                          nn.SpatialBatchNormalization(3), nn.ReLU())
+    model.training()
+    for _ in range(3):
+        model.forward(np.random.randn(4, 1, 6, 6).astype(np.float32))
+    model.evaluate()
+    x = np.random.randn(2, 1, 6, 6).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bn.bigdl")
+        save_bigdl(model, path)
+        out = np.asarray(load_bigdl(path).forward(x))
+    assert np.allclose(out, ref, atol=1e-5), np.abs(out - ref).max()
+
+
+def test_bigdl_proto_negative_int_attr():
+    from bigdl_tpu.loaders.bigdl_proto import (save_bigdl, load_bigdl,
+                                               decode_bigdl_module)
+    import tempfile, os
+    model = nn.Sequential(nn.Reshape([-1], batch_mode=True)).evaluate()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "r.bigdl")
+        save_bigdl(model, path)
+        mod = decode_bigdl_module(open(path, "rb").read())
+        assert list(mod["subModules"][0]["attr"]["size"]) == [-1]
+        m = load_bigdl(path)
+    out = m.forward(np.random.randn(2, 3, 4).astype(np.float32))
+    assert out.shape == (2, 12)
+
+
+def test_tf_const_float_and_int_val_fields():
+    from bigdl_tpu.loaders import wire as W
+    from bigdl_tpu.loaders.tensorflow import _decode_tensor
+    # float_val (field 5) scalar splat
+    shape = W.field_bytes(2, W.field_varint(1, 3))
+    t = W.field_varint(1, 1) + W.field_bytes(2, shape) + W.field_float(5, 2.5)
+    arr = _decode_tensor(t)
+    assert arr.shape == (3,) and np.allclose(arr, 2.5)
+    # int_val (field 7)
+    t = W.field_varint(1, 3) + W.field_bytes(2, shape) + \
+        W.field_packed_varint(7, [1, 2, 3])
+    arr = _decode_tensor(t)
+    assert np.array_equal(arr, [1, 2, 3])
+
+
+def test_tf_rank_changing_reshape_order():
+    # [B,H,W,C] -> [-1, H*W, C] must preserve TF (NHWC) element order
+    from bigdl_tpu.loaders import load_tf_graph
+    gd = b"".join([
+        _tf_node("x", "Placeholder"),
+        _tf_node("shape", "Const",
+                 value=_tf_tensor(np.array([-1, 4, 3], np.int32))),
+        _tf_node("r", "Reshape", ["x", "shape"]),
+    ])
+    m = load_tf_graph(gd).evaluate()
+    x = np.random.randn(2, 3, 2, 2).astype(np.float32)  # NCHW C=3 H=W=2
+    out = np.asarray(m.forward(x))
+    expect = np.transpose(x, (0, 2, 3, 1)).reshape(2, 4, 3)
+    assert np.allclose(out, expect)
