@@ -16,7 +16,7 @@ IMAGENET_MEAN = (0.485 * 255, 0.456 * 255, 0.406 * 255)
 IMAGENET_STD = (0.229 * 255, 0.224 * 255, 0.225 * 255)
 
 
-def _decoder():
+def _python_decoder():
     try:
         from PIL import Image  # noqa
 
@@ -34,6 +34,27 @@ def _decoder():
         return dec
     except ImportError:
         return None
+
+
+def _decoder():
+    fallback = _python_decoder()  # PIL or torchvision, for non-JPEG files
+    try:  # native C++ libjpeg path first (threaded-pipeline-friendly)
+        from .. import native
+        if native.jpeg_available():
+            def dec(path):
+                try:
+                    img = native.decode_jpeg(path)
+                except ValueError:  # stray PNG/BMP etc.
+                    if fallback is None:
+                        raise
+                    return fallback(path)
+                if img.shape[-1] == 1:
+                    img = np.repeat(img, 3, axis=-1)
+                return img
+            return dec
+    except Exception:
+        pass
+    return fallback
 
 
 def scan_folder(folder: str) -> Tuple[List[str], List[int], List[str]]:

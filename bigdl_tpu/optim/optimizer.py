@@ -98,7 +98,8 @@ class BaseOptimizer:
         self.val_summary = None
         self.clip_const = None
         self.clip_norm = None
-        self.nan_policy = "error"  # or "skip"
+        self.nan_policy = "error"  # or "skip" / "resume"
+        self.max_nan_retries = 10  # consecutive non-finite steps before abort
         self.metrics = Metrics()
         self._step_fn = None
 
@@ -142,9 +143,20 @@ class BaseOptimizer:
         return self
 
     def set_nan_policy(self, policy: str):
-        assert policy in ("error", "skip")
+        """'error' raises, 'skip' drops the step, 'resume' rolls back to the
+        latest checkpoint (requires set_checkpoint) — the step-level analog of
+        Spark's failed-task retry (SURVEY §5 failure detection)."""
+        assert policy in ("error", "skip", "resume")
         self.nan_policy = policy
         return self
+
+    def _latest_checkpoint(self):
+        if not self.checkpoint_path or not os.path.isdir(self.checkpoint_path):
+            return None
+        snaps = [os.path.join(self.checkpoint_path, f)
+                 for f in os.listdir(self.checkpoint_path)
+                 if f.startswith("checkpoint") and f.endswith(".bigdl")]
+        return max(snaps, key=os.path.getmtime) if snaps else None
 
     # -- internals -------------------------------------------------------
     def _as_dataset(self, ds):
@@ -186,7 +198,14 @@ class BaseOptimizer:
                 loss_fn, has_aux=True)(params, mstate, x, y, rng)
             grads = _clip_grads(grads, clip_const, clip_norm)
             new_params, new_opt = optim.update(grads, params, opt_state, lr)
-            return loss, new_params, new_opt, new_mstate
+            # NaN/Inf guard inside the compiled step (buffers are donated, so
+            # the host can't roll back): a non-finite loss keeps the previous
+            # params/opt-state and only the loss reports the failure.
+            ok = jnp.isfinite(loss)
+            pick = lambda new, old: _tmap(
+                lambda a, b: jnp.where(ok, a, b), new, old)
+            return (loss, pick(new_params, params), pick(new_opt, opt_state),
+                    pick(new_mstate, mstate))
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -201,7 +220,7 @@ class BaseOptimizer:
             f"_e{state['epoch']}_i{state['neval']}"
         path = os.path.join(self.checkpoint_path, f"checkpoint{tag}.bigdl")
         payload = {
-            "params": _tmap(np.asarray, params),
+            "params": _tmap(np.asarray, self._params_for_checkpoint(params)),
             "opt_state": _tmap(np.asarray, opt_state),
             "model_state": _tmap(np.asarray, mstate),
             "optim_host_state": dict(self.optim_method.state),
@@ -258,6 +277,7 @@ class BaseOptimizer:
         state = optim.state  # {'neval', 'epoch', ...}
         batched = self._batched()
         done = False
+        nan_streak = 0
         while not done:
             batched.shuffle()
             epoch_start = time.time()
@@ -273,11 +293,37 @@ class BaseOptimizer:
                 loss_val = float(loss)
                 t2 = time.time()
                 if not np.isfinite(loss_val):
+                    nan_streak += 1
                     if self.nan_policy == "error":
                         raise FloatingPointError(
                             f"non-finite loss {loss_val} at iteration "
                             f"{state['neval']} — enable "
                             f"set_nan_policy('skip') to drop such steps")
+                    if nan_streak > self.max_nan_retries:
+                        raise FloatingPointError(
+                            f"{nan_streak} consecutive non-finite steps "
+                            f"(nan_policy='{self.nan_policy}') — data or "
+                            "hyperparameters are unrecoverably bad")
+                    if self.nan_policy == "resume":
+                        snap = self._latest_checkpoint()
+                        if snap is None:
+                            raise FloatingPointError(
+                                "non-finite loss with nan_policy='resume' "
+                                "but no checkpoint saved yet — call "
+                                "set_checkpoint(...) first")
+                        with open(snap, "rb") as f:
+                            payload = pickle.load(f)
+                        self.optim_method.state.update(
+                            payload["optim_host_state"])
+                        params, opt_state, mstate =                             self._restore_step_state(payload)
+                        self.metrics.add("nan_resumes", 1.0)
+                        continue
+                    # 'skip': the in-step guard already kept the previous
+                    # params; count the iteration so end triggers advance
+                    self.metrics.add("nan_skips", 1.0)
+                    state["neval"] += 1
+                    continue
+                nan_streak = 0
                 state["neval"] += 1
                 state["loss"] = loss_val
                 state["epoch_finished"] = False
@@ -333,6 +379,17 @@ class BaseOptimizer:
     def _collect(self, params, mstate, opt_state=None):
         return params, mstate
 
+    def _params_for_checkpoint(self, params):
+        return params
+
+    def _restore_step_state(self, payload):
+        """Rebuild in-step (params, opt_state, mstate) from a checkpoint
+        payload WITHOUT recreating sharding machinery (the compiled step fn
+        closes over it)."""
+        return self._prepare(_tmap(jnp.asarray, payload["params"]),
+                             _tmap(jnp.asarray, payload["opt_state"]),
+                             _tmap(jnp.asarray, payload["model_state"]))
+
 
 class LocalOptimizer(BaseOptimizer):
     """Single-device training (parity: optim/LocalOptimizer.scala — there,
@@ -386,6 +443,30 @@ class DistriOptimizer(BaseOptimizer):
             return self._flat.unflatten(jax.device_get(params)), mstate
         return params, mstate
 
+    def _params_for_checkpoint(self, params):
+        if self.parameter_mode == "zero1":
+            return self._flat.unflatten(jax.device_get(params))
+        return params
+
+    def _restore_step_state(self, payload):
+        from ..parallel.sharding import shard_params
+        params = _tmap(jnp.asarray, payload["params"])
+        opt_state = _tmap(jnp.asarray, payload["opt_state"])
+        mstate = shard_params(_tmap(jnp.asarray, payload["model_state"]),
+                              self.mesh)
+        if self.parameter_mode == "zero1" and self._arp is not None:
+            # reuse the existing FlatParameter/AllReduceParameter — the
+            # compiled step closes over them; only re-place the data
+            flat_w = jax.device_put(self._flat.flatten(params),
+                                    NamedSharding(self.mesh, P()))
+            opt_specs = self._arp.state_specs()
+            opt_state = jax.tree_util.tree_map(
+                lambda a, sp: jax.device_put(
+                    a, NamedSharding(self.mesh, sp)), opt_state, opt_specs)
+            return flat_w, opt_state, mstate
+        return (shard_params(params, self.mesh),
+                shard_params(opt_state, self.mesh), mstate)
+
     def _build_step(self):
         if self.parameter_mode != "zero1":
             return super()._build_step()
@@ -415,7 +496,13 @@ class DistriOptimizer(BaseOptimizer):
             new_flat, new_opt = arp.update(gflat, flat_w, opt_slice, lr)
             loss = jax.lax.pmean(loss, "data")
             new_mstate = _tmap(lambda t: jax.lax.pmean(t, "data"), new_mstate)
-            return loss, new_flat, new_opt, new_mstate
+            # same in-step NaN guard as the local path (post-pmean, so every
+            # shard takes the same branch — no divergence across the mesh)
+            ok = jnp.isfinite(loss)
+            pick = lambda new, old: _tmap(
+                lambda a, b: jnp.where(ok, a, b), new, old)
+            return (loss, pick(new_flat, flat_w), pick(new_opt, opt_slice),
+                    pick(new_mstate, mstate))
 
         opt_specs = arp.state_specs()
         mstate_specs = _tmap(lambda _: P(), self.model.state)

@@ -7,3 +7,5 @@ from .allreduce import AllReduceParameter, FP16CompressPolicy
 from .sharding import (replicated, data_sharding, shard_batch, shard_params,
                        tp_linear_rules)
 from .ring_attention import ring_attention
+from .failure import (probe_mesh, MeshProbeResult, Heartbeat,
+                      StragglerMonitor)

@@ -1,0 +1,168 @@
+"""Failure detection and straggler metrics (SURVEY §2.5 / §5).
+
+The reference inherits failure handling from Spark: a lost executor's tasks
+are re-run, and per-task timing feeds Spark's straggler (speculation)
+machinery. A TPU SPMD program has no per-task retry — failure handling moves
+to three layers, implemented here and in the optimizers:
+
+1. **Step-level**: the train step guards against NaN/Inf inside the compiled
+   function (non-finite loss ⇒ parameters keep their previous value), and the
+   optimizer's ``nan_policy`` ('error' | 'skip' | 'resume') decides whether to
+   raise, drop the step, or roll back to the latest checkpoint
+   (optim/optimizer.py).
+2. **Mesh-level**: ``probe_mesh`` runs a tiny collective with a timeout — a
+   hung or lost chip surfaces as a probe failure instead of an indefinite
+   stall inside a training collective.
+3. **Host-level**: ``Heartbeat`` exchanges per-process counters over the
+   jax.distributed channel (gated to multi-process runs); ``StragglerMonitor``
+   aggregates per-host step times and flags hosts slower than
+   ``threshold × median`` — the metric Spark speculation keys on.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MeshProbeResult:
+    def __init__(self, ok: bool, n_devices: int, latency_s: float,
+                 error: Optional[str] = None):
+        self.ok, self.n_devices = ok, n_devices
+        self.latency_s, self.error = latency_s, error
+
+    def __repr__(self):
+        return (f"MeshProbeResult(ok={self.ok}, n={self.n_devices}, "
+                f"latency={self.latency_s:.4f}s, error={self.error})")
+
+
+def probe_mesh(mesh, timeout_s: float = 30.0) -> MeshProbeResult:
+    """Run a psum of ones over every mesh axis with a timeout. A dead or hung
+    device makes the collective never complete — the timeout converts that
+    into a detectable failure instead of a stall."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+    n = int(np.prod([mesh.shape[a] for a in axes]))
+
+    def ones_sum():
+        def f(x):
+            s = x
+            for a in axes:
+                s = jax.lax.psum(s, a)
+            return s
+        probe = shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
+                          check_vma=False)
+        return int(jax.jit(probe)(jnp.ones(())))
+
+    result: Dict = {}
+
+    def run():
+        try:
+            t0 = time.time()
+            val = ones_sum()
+            result["latency"] = time.time() - t0
+            result["val"] = val
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            result["error"] = f"{type(e).__name__}: {e}"
+
+    th = threading.Thread(target=run, daemon=True)
+    t0 = time.time()
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        return MeshProbeResult(False, n, time.time() - t0,
+                               f"collective did not complete in {timeout_s}s")
+    if "error" in result:
+        return MeshProbeResult(False, n, time.time() - t0, result["error"])
+    ok = result["val"] == n
+    return MeshProbeResult(ok, n, result["latency"],
+                           None if ok else
+                           f"psum returned {result['val']}, expected {n}")
+
+
+class Heartbeat:
+    """Multi-host liveness: each process contributes an incrementing counter
+    via an all-gather across processes; a host whose counter stops advancing
+    for ``stale_after`` beats is reported dead. Single-process runs are a
+    no-op (always healthy)."""
+
+    def __init__(self, stale_after: int = 3):
+        self.stale_after = stale_after
+        self.beat_no = 0
+        self.last_seen: Dict[int, int] = {}
+        self.counters: Dict[int, int] = {}
+
+    @property
+    def n_processes(self) -> int:
+        return jax.process_count()
+
+    def _gather(self, value: int) -> List[int]:
+        if self.n_processes == 1:
+            return [value]
+        from jax.experimental import multihost_utils
+        out = multihost_utils.process_allgather(
+            np.array(value, np.int64))
+        return [int(v) for v in np.asarray(out).reshape(-1)]
+
+    def beat(self) -> List[int]:
+        """Advance the local counter, exchange, and return stale host ids."""
+        self.beat_no += 1
+        counters = self._gather(self.beat_no)
+        stale = []
+        for pid, c in enumerate(counters):
+            if c > self.counters.get(pid, -1):
+                self.counters[pid] = c
+                self.last_seen[pid] = self.beat_no
+            elif self.beat_no - self.last_seen.get(pid, 0) >= \
+                    self.stale_after:
+                stale.append(pid)
+        return stale
+
+
+class StragglerMonitor:
+    """Per-host step-time collection + straggler flagging (the metric Spark's
+    speculation uses, over the jax.distributed channel instead of the Spark
+    driver)."""
+
+    def __init__(self, threshold: float = 1.5, window: int = 50):
+        self.threshold = threshold
+        self.window = window
+        self.times: List[float] = []
+
+    def record(self, step_time_s: float) -> None:
+        self.times.append(float(step_time_s))
+        if len(self.times) > self.window:
+            self.times.pop(0)
+
+    def _local_mean(self) -> float:
+        return float(np.mean(self.times)) if self.times else 0.0
+
+    def _gather_means(self) -> np.ndarray:
+        local = self._local_mean()
+        if jax.process_count() == 1:
+            return np.array([local])
+        from jax.experimental import multihost_utils
+        out = multihost_utils.process_allgather(
+            np.array(local, np.float64))
+        return np.asarray(out).reshape(-1)
+
+    @staticmethod
+    def analyze(per_host_means: np.ndarray, threshold: float = 1.5) -> Dict:
+        means = np.asarray(per_host_means, np.float64)
+        med = float(np.median(means)) if means.size else 0.0
+        stragglers = [int(i) for i, m in enumerate(means)
+                      if med > 0 and m > threshold * med]
+        return {"per_host_mean_s": [float(m) for m in means],
+                "median_s": med,
+                "max_s": float(means.max()) if means.size else 0.0,
+                "imbalance": float(means.max() / med) if med > 0 else 1.0,
+                "stragglers": stragglers}
+
+    def report(self) -> Dict:
+        return self.analyze(self._gather_means(), self.threshold)

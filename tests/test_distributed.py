@@ -145,3 +145,108 @@ def test_tp_sharding_linear():
     w2s = jax.device_put(w2, NamedSharding(mesh, P(None, "model")))
     got = jax.jit(f)(xs, w1s, w2s)
     assert np.allclose(np.asarray(got), np.asarray(expect), atol=1e-4)
+
+
+# ---- failure detection & straggler metrics ---------------------------------
+
+def test_probe_mesh_healthy():
+    from bigdl_tpu.parallel import probe_mesh
+    from bigdl_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh((8,), ("data",))
+    r = probe_mesh(mesh, timeout_s=120.0)
+    assert r.ok, r
+    assert r.n_devices == 8
+
+
+def test_probe_mesh_2d():
+    from bigdl_tpu.parallel import probe_mesh
+    from bigdl_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh((4, 2), ("data", "model"))
+    r = probe_mesh(mesh, timeout_s=120.0)
+    assert r.ok and r.n_devices == 8
+
+
+def test_heartbeat_single_process():
+    from bigdl_tpu.parallel import Heartbeat
+    hb = Heartbeat(stale_after=2)
+    for _ in range(4):
+        assert hb.beat() == []
+
+
+def test_straggler_monitor_analysis():
+    from bigdl_tpu.parallel import StragglerMonitor
+    rep = StragglerMonitor.analyze(np.array([0.10, 0.11, 0.09, 0.35]),
+                                   threshold=1.5)
+    assert rep["stragglers"] == [3]
+    assert rep["imbalance"] > 3.0
+    m = StragglerMonitor()
+    for t in (0.1, 0.12, 0.11):
+        m.record(t)
+    rep = m.report()
+    assert rep["stragglers"] == []
+    assert abs(rep["median_s"] - rep["per_host_mean_s"][0]) < 1e-9
+
+
+def test_nan_guard_keeps_params():
+    # a poisoned batch must not corrupt parameters ('skip' policy)
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD, max_iteration
+    from bigdl_tpu.dataset import DataSet, Sample
+    model = nn.Sequential(nn.Linear(4, 2))
+    xs = np.random.randn(8, 4).astype(np.float32)
+    xs[4] = np.nan  # poisoned sample
+    samples = [Sample(xs[i], np.float32(i % 2 + 1)) for i in range(8)]
+    opt = LocalOptimizer(model, DataSet.array(samples),
+                         nn.CrossEntropyCriterion(), SGD(learningrate=0.1),
+                         max_iteration(4), batch_size=2)
+    opt.set_nan_policy("skip")
+    opt.optimize()
+    w = np.asarray(model.params["0"]["weight"])
+    assert np.isfinite(w).all()
+    assert opt.metrics.values.get("nan_skips")
+
+
+def test_nan_resume_policy(tmp_path):
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import (LocalOptimizer, SGD, max_iteration,
+                                 several_iteration)
+    from bigdl_tpu.dataset import DataSet, Sample
+    model = nn.Sequential(nn.Linear(4, 2))
+    xs = np.random.randn(12, 4).astype(np.float32)
+    xs[9] = np.inf
+    samples = [Sample(xs[i], np.float32(i % 2 + 1)) for i in range(12)]
+    opt = LocalOptimizer(model, DataSet.array(samples),
+                         nn.CrossEntropyCriterion(), SGD(learningrate=0.1),
+                         max_iteration(6), batch_size=2)
+    opt.set_checkpoint(several_iteration(1), str(tmp_path))
+    opt.set_nan_policy("resume")
+    opt.optimize()
+    assert np.isfinite(np.asarray(model.params["0"]["weight"])).all()
+    assert opt.metrics.values.get("nan_resumes")
+
+
+def test_zero1_nan_resume_and_checkpoint_layout(tmp_path):
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import (DistriOptimizer, SGD, max_iteration,
+                                 several_iteration)
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.parallel.mesh import make_mesh
+    import pickle, os
+    mesh = make_mesh((8,), ("data",))
+    xs = np.random.randn(32, 6).astype(np.float32)
+    xs[17] = np.nan
+    samples = [Sample(xs[i], np.float32(i % 3 + 1)) for i in range(32)]
+    model = nn.Sequential(nn.Linear(6, 3))
+    opt = DistriOptimizer(model, DataSet.array(samples),
+                          nn.CrossEntropyCriterion(), SGD(learningrate=0.1),
+                          max_iteration(4), batch_size=8, mesh=mesh,
+                          parameter_mode="zero1")
+    opt.set_checkpoint(several_iteration(1), str(tmp_path))
+    opt.set_nan_policy("resume")
+    opt.optimize()
+    w = np.asarray(model.params["0"]["weight"])
+    assert w.shape == (3, 6) and np.isfinite(w).all()
+    # checkpoint stores the UNFLATTENED tree (cross-mode resumable)
+    snap = [f for f in os.listdir(tmp_path) if f.endswith(".bigdl")][0]
+    payload = pickle.load(open(os.path.join(tmp_path, snap), "rb"))
+    assert payload["params"]["0"]["weight"].shape == (3, 6)
