@@ -21,13 +21,14 @@ from ..nn import (Sequential, SpatialConvolution, SpatialBatchNormalization,
 from ..nn.init import MsraFiller, Zeros, Ones
 
 
-def _conv(nin, nout, k, stride=1, pad=0):
+def _conv(nin, nout, k, stride=1, pad=0, fmt="NCHW"):
     return SpatialConvolution(nin, nout, k, k, stride, stride, pad, pad,
-                              with_bias=False, init_method=MsraFiller(False))
+                              with_bias=False, init_method=MsraFiller(False),
+                              format=fmt)
 
 
-def _bn(n, zero_gamma=False):
-    bn = SpatialBatchNormalization(n)
+def _bn(n, zero_gamma=False, fmt="NCHW"):
+    bn = SpatialBatchNormalization(n, data_format=fmt)
     if zero_gamma:
         bn.init_weight = jnp.zeros((n,))
     return bn
@@ -39,9 +40,10 @@ class ShortcutType:
     C = "C"  # always projection
 
 
-def _shortcut(nin, nout, stride, shortcut_type=ShortcutType.B):
+def _shortcut(nin, nout, stride, shortcut_type=ShortcutType.B, fmt="NCHW"):
     if nin != nout or stride != 1:
         if shortcut_type == ShortcutType.A:
+            assert fmt == "NCHW", "shortcut A (CIFAR) is NCHW-only"
             # avg-pool + channel zero-pad, expressed as conv-free ops is
             # awkward; the reference uses it only for CIFAR. Use a strided
             # 1x1 pool + pad via conv-free path:
@@ -49,30 +51,33 @@ def _shortcut(nin, nout, stride, shortcut_type=ShortcutType.B):
             return Sequential(
                 _AP(1, 1, stride, stride),
                 Padding(2, nout - nin, 4))
-        s = Sequential(_conv(nin, nout, 1, stride), _bn(nout))
+        s = Sequential(_conv(nin, nout, 1, stride, fmt=fmt),
+                       _bn(nout, fmt=fmt))
         return s
     return Identity()
 
 
 def basic_block(nin, nout, stride=1, shortcut_type=ShortcutType.B,
-                zero_init_residual=False):
+                zero_init_residual=False, fmt="NCHW"):
     main = Sequential(
-        _conv(nin, nout, 3, stride, 1), _bn(nout), ReLU(),
-        _conv(nout, nout, 3, 1, 1), _bn(nout, zero_init_residual))
+        _conv(nin, nout, 3, stride, 1, fmt), _bn(nout, fmt=fmt), ReLU(),
+        _conv(nout, nout, 3, 1, 1, fmt), _bn(nout, zero_init_residual, fmt))
     return Sequential(
-        ConcatTable(main, _shortcut(nin, nout, stride, shortcut_type)),
+        ConcatTable(main, _shortcut(nin, nout, stride, shortcut_type, fmt)),
         CAddTable(), ReLU())
 
 
 def bottleneck(nin, nmid, stride=1, expansion=4,
-               shortcut_type=ShortcutType.B, zero_init_residual=False):
+               shortcut_type=ShortcutType.B, zero_init_residual=False,
+               fmt="NCHW"):
     nout = nmid * expansion
     main = Sequential(
-        _conv(nin, nmid, 1), _bn(nmid), ReLU(),
-        _conv(nmid, nmid, 3, stride, 1), _bn(nmid), ReLU(),  # v1.5 stride
-        _conv(nmid, nout, 1), _bn(nout, zero_init_residual))
+        _conv(nin, nmid, 1, fmt=fmt), _bn(nmid, fmt=fmt), ReLU(),
+        _conv(nmid, nmid, 3, stride, 1, fmt), _bn(nmid, fmt=fmt),
+        ReLU(),  # v1.5 stride placement
+        _conv(nmid, nout, 1, fmt=fmt), _bn(nout, zero_init_residual, fmt))
     return Sequential(
-        ConcatTable(main, _shortcut(nin, nout, stride, shortcut_type)),
+        ConcatTable(main, _shortcut(nin, nout, stride, shortcut_type, fmt)),
         CAddTable(), ReLU())
 
 
@@ -81,26 +86,31 @@ _IMAGENET_CFG = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
 
 def ResNet(class_num: int = 1000, depth: int = 50,
            shortcut_type: str = ShortcutType.B, data_set: str = "ImageNet",
-           zero_init_residual: bool = True, with_log_softmax: bool = False):
+           zero_init_residual: bool = True, with_log_softmax: bool = False,
+           format: str = "NCHW"):
     """Factory with the reference's signature
-    (models/resnet/ResNet.scala apply(classNum, opt))."""
+    (models/resnet/ResNet.scala apply(classNum, opt)). ``format='NHWC'``
+    builds the channels-last variant (identical params; activations NHWC —
+    the layout XLA:TPU tiles convs fastest in; see bench.py)."""
     if data_set.lower() == "cifar10":
         return ResNetCifar(class_num, depth, shortcut_type)
+    fmt = format
     blocks = _IMAGENET_CFG[depth]
     model = Sequential()
-    model.add(_conv(3, 64, 7, 2, 3))
-    model.add(_bn(64))
+    model.add(_conv(3, 64, 7, 2, 3, fmt))
+    model.add(_bn(64, fmt=fmt))
     model.add(ReLU())
-    model.add(SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+    model.add(SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=fmt))
     nin = 64
     for stage, n_blocks in enumerate(blocks):
         nmid = 64 * (2 ** stage)
         for b in range(n_blocks):
             stride = 2 if (stage > 0 and b == 0) else 1
             model.add(bottleneck(nin, nmid, stride, 4, shortcut_type,
-                                 zero_init_residual))
+                                 zero_init_residual, fmt))
             nin = nmid * 4
-    model.add(SpatialAveragePooling(7, 7, 1, 1, global_pooling=True))
+    model.add(SpatialAveragePooling(7, 7, 1, 1, global_pooling=True,
+                                    format=fmt))
     model.add(View(nin))
     model.add(Linear(nin, class_num))
     if with_log_softmax:

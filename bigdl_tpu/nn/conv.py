@@ -43,7 +43,10 @@ def _resolve_padding(pads):
 
 
 class SpatialConvolution(Module):
-    """2-D convolution over NCHW (nn/SpatialConvolution.scala:48)."""
+    """2-D convolution (nn/SpatialConvolution.scala:48). ``format`` follows
+    the reference's DataFormat param (SpatialConvolution.scala:72): NCHW to
+    match the reference default, NHWC for the TPU-preferred channels-last
+    layout (weights stay OIHW either way — only activations change)."""
 
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  kernel_w: int, kernel_h: int, stride_w: int = 1,
@@ -52,8 +55,11 @@ class SpatialConvolution(Module):
                  w_regularizer=None, b_regularizer=None,
                  init_weight=None, init_bias=None, with_bias: bool = True,
                  init_method=None, bias_init_method=None,
-                 dilation_w: int = 1, dilation_h: int = 1, name=None):
+                 dilation_w: int = 1, dilation_h: int = 1,
+                 format: str = "NCHW", name=None):
         super().__init__(name=name)
+        assert format in ("NCHW", "NHWC"), format
+        self.format = format
         self.n_input_plane, self.n_output_plane = n_input_plane, n_output_plane
         self.kernel_w, self.kernel_h = kernel_w, kernel_h
         self.stride_w, self.stride_h = stride_w, stride_h
@@ -97,20 +103,28 @@ class SpatialConvolution(Module):
     def _conv(self, x, w):
         pads = (_pad_pair(self.pad_h, self.kernel_h, self.stride_h),
                 _pad_pair(self.pad_w, self.kernel_w, self.stride_w))
+        fmt = self.format
+        if fmt == "NHWC":
+            # kernels stored OIHW (reference layout); feed them HWIO — the
+            # transpose folds into XLA layout assignment and avoids the
+            # pathological NHWC+OIHW compile path on TPU
+            w = jnp.transpose(w, (2, 3, 1, 0))
         return lax.conv_general_dilated(
             x, w, window_strides=(self.stride_h, self.stride_w),
             padding=_resolve_padding(pads),
             rhs_dilation=(self.dilation_h, self.dilation_w),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=(fmt, "HWIO" if fmt == "NHWC" else "OIHW", fmt),
             feature_group_count=self.n_group)
 
     def _apply(self, params, state, x, training, rng):
         squeeze = False
-        if x.ndim == 3:  # unbatched, reference accepts CHW
+        if x.ndim == 3:  # unbatched, reference accepts CHW (or HWC in NHWC)
             x, squeeze = x[None], True
         y = self._conv(x, params["weight"])
         if self.with_bias:
-            y = y + params["bias"][None, :, None, None]
+            bias = params["bias"]
+            y = y + (bias[None, None, None, :] if self.format == "NHWC"
+                     else bias[None, :, None, None])
         return y[0] if squeeze else y
 
 

@@ -52,9 +52,10 @@ class BatchNormalization(Module):
                 "running_var": jnp.ones((self.n_output,))}
 
     def _apply(self, params, state, x, training, rng):
-        ax = tuple(i for i in range(x.ndim) if i != self._channel_axis)
+        ch = self._channel_axis % x.ndim  # -1 (NHWC) → last axis
+        ax = tuple(i for i in range(x.ndim) if i != ch)
         bshape = [1] * x.ndim
-        bshape[self._channel_axis] = self.n_output
+        bshape[ch] = self.n_output
         xf = x.astype(jnp.float32)  # stats always in f32 (bf16-safe)
         if training:
             mean = jnp.mean(xf, axis=ax)
@@ -80,7 +81,17 @@ class BatchNormalization(Module):
 
 
 class SpatialBatchNormalization(BatchNormalization):
-    """BN over NCHW, per-channel (nn/SpatialBatchNormalization.scala)."""
+    """Per-channel BN over NCHW or NHWC (nn/SpatialBatchNormalization.scala;
+    ``data_format`` mirrors the reference's DataFormat param)."""
+
+    def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
+                 init_weight=None, init_bias=None, data_format="NCHW",
+                 name=None):
+        super().__init__(n_output, eps, momentum, affine, init_weight,
+                         init_bias, name=name)
+        assert data_format in ("NCHW", "NHWC"), data_format
+        if data_format == "NHWC":
+            self._channel_axis = -1
 
 
 class VolumetricBatchNormalization(BatchNormalization):

@@ -35,8 +35,11 @@ def _pool_pads(size, k, stride, pad, ceil_mode):
 
 
 class _Pool2D(Module):
-    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0, name=None):
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0,
+                 format="NCHW", name=None):
         super().__init__(name=name)
+        assert format in ("NCHW", "NHWC"), format
+        self.format = format
         self.kw, self.kh = kw, kh
         self.dw = dw if dw is not None else kw
         self.dh = dh if dh is not None else kh
@@ -51,11 +54,23 @@ class _Pool2D(Module):
         self.ceil_mode = False
         return self
 
+    def _hw(self, x):
+        return (x.shape[1], x.shape[2]) if self.format == "NHWC" else \
+            (x.shape[-2], x.shape[-1])
+
     def _pads(self, x):
-        h, w = x.shape[-2], x.shape[-1]
+        h, w = self._hw(x)
         ph, _ = _pool_pads(h, self.kh, self.dh, self.pad_h, self.ceil_mode)
         pw, _ = _pool_pads(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
         return ph, pw
+
+    def _window(self, kh, kw, dh, dw, ph, pw):
+        """(dims, strides, pads) laid out for this format."""
+        if self.format == "NHWC":
+            return ((1, kh, kw, 1), (1, dh, dw, 1),
+                    [(0, 0), ph, pw, (0, 0)])
+        return ((1, 1, kh, kw), (1, 1, dh, dw),
+                [(0, 0), (0, 0), ph, pw])
 
 
 class SpatialMaxPooling(_Pool2D):
@@ -66,9 +81,9 @@ class SpatialMaxPooling(_Pool2D):
         if x.ndim == 3:
             x, squeeze = x[None], True
         ph, pw = self._pads(x)
-        y = lax.reduce_window(
-            x, -jnp.inf, lax.max, (1, 1, self.kh, self.kw),
-            (1, 1, self.dh, self.dw), [(0, 0), (0, 0), ph, pw])
+        dims, strides, pads = self._window(self.kh, self.kw, self.dh,
+                                           self.dw, ph, pw)
+        y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
         return y[0] if squeeze else y
 
 
@@ -78,8 +93,10 @@ class SpatialAveragePooling(_Pool2D):
 
     def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0,
                  global_pooling=False, ceil_mode=False,
-                 count_include_pad=True, divide=True, name=None):
-        super().__init__(kw, kh, dw, dh, pad_w, pad_h, name=name)
+                 count_include_pad=True, divide=True, format="NCHW",
+                 name=None):
+        super().__init__(kw, kh, dw, dh, pad_w, pad_h, format=format,
+                         name=name)
         self.ceil_mode = ceil_mode
         self.count_include_pad = count_include_pad
         self.divide = divide
@@ -92,23 +109,20 @@ class SpatialAveragePooling(_Pool2D):
         kh, kw = self.kh, self.kw
         dh, dw = self.dh, self.dw
         if self.global_pooling:
-            kh, kw = x.shape[-2], x.shape[-1]
+            kh, kw = self._hw(x)
             dh, dw = 1, 1
             ph = pw = (0, 0)
         else:
             ph, pw = self._pads(x)
-        s = lax.reduce_window(
-            x, 0.0, lax.add, (1, 1, kh, kw), (1, 1, dh, dw),
-            [(0, 0), (0, 0), ph, pw])
+        dims, strides, pads = self._window(kh, kw, dh, dw, ph, pw)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
         if not self.divide:
             y = s
         elif self.count_include_pad:
             y = s / (kh * kw)
         else:
             ones = jnp.ones_like(x)
-            cnt = lax.reduce_window(
-                ones, 0.0, lax.add, (1, 1, kh, kw), (1, 1, dh, dw),
-                [(0, 0), (0, 0), ph, pw])
+            cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
             y = s / cnt
         return y[0] if squeeze else y
 
