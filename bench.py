@@ -3,56 +3,115 @@
 Matches BASELINE.json: "images/sec/chip ResNet-50 sync-SGD". The fixed
 baseline constant is the reference's MKL-DNN Xeon-node throughput estimate
 (~60 img/s fp32 per node for ResNet-50 training, the deployment the reference
-README benchmarks against); ``vs_baseline`` = our images/sec/chip ÷ 60.
+README benchmarks against); ``vs_baseline`` = our images/sec/chip / 60.
 
-Prints exactly ONE JSON line.
+Robustness (round-2 redesign): the TPU backend init over the axon tunnel can
+either raise UNAVAILABLE *or hang indefinitely*, and a hung process can hold
+the chip claim. The parent process therefore never imports jax; it spawns the
+actual benchmark in a child subprocess with a hard timeout, retries once, and
+finally falls back to a CPU child (axon registration stripped from the env) so
+that ONE JSON line is always printed. The JSON carries a ``backend`` field so
+a CPU fallback number is never mistaken for a TPU number.
+
+Secondary configs (BASELINE.json): ``python bench.py --all`` additionally
+benchmarks LeNet-5/MNIST, VGG-16/CIFAR-10, LSTM/PTB and int8 Inception-v1 —
+one JSON line each, after the headline line.
+
+TPU-first choices in the benchmark itself: NHWC activations (TPU-native conv
+layout), bf16 compute with f32 master params (MXU-friendly; SGD update in
+f32), input bound on device, donated buffers. MFU is computed from XLA's own
+compiled cost analysis when available (falling back to the analytic
+2*4.09 GMAC * 3 per image) against the chip's bf16 peak.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 BASELINE_IMG_PER_SEC = 60.0  # MKL-DNN Xeon node, ResNet-50 train (SURVEY §6)
 
+# bf16 peak TFLOP/s per chip by device_kind substring (public specs).
+_PEAK_TFLOPS = [
+    ("v6", 918.0), ("v5p", 459.0), ("v5", 197.0), ("v4", 275.0),
+    ("v3", 123.0), ("v2", 46.0),
+]
 
-def main():
+
+def _peak_flops(device_kind: str) -> float:
+    dk = device_kind.lower()
+    for sub, tf in _PEAK_TFLOPS:
+        if sub in dk:
+            return tf * 1e12
+    return 197.0e12  # assume v5e (the BASELINE target platform)
+
+
+# --------------------------------------------------------------------------
+# child: the actual benchmark (runs under a subprocess timeout)
+# --------------------------------------------------------------------------
+
+def _init_backend_with_retry():
+    """Backend init can raise UNAVAILABLE transiently; retry in-process.
+
+    A *hang* is handled one level up by the parent's subprocess timeout.
+    """
+    import jax
+    last = None
+    for attempt in range(3):
+        try:
+            return jax.default_backend()
+        except RuntimeError as e:  # UNAVAILABLE / plugin init failure
+            last = e
+            try:
+                import jax.extend.backend as _jb
+                _jb.clear_backends()
+            except Exception:
+                pass
+            time.sleep(5 * (attempt + 1))
+    raise last
+
+
+def bench_resnet50():
     import jax
     import jax.numpy as jnp
+    import numpy as np
     from bigdl_tpu.models import ResNet
     from bigdl_tpu.nn import CrossEntropyCriterion
     from bigdl_tpu.optim import SGD
     from bigdl_tpu.utils import engine
 
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
+    backend = _init_backend_with_retry()
+    # the axon PJRT plugin registers the real chip under platform name
+    # "axon", not "tpu" — treat both as TPU-class
+    on_tpu = backend in ("tpu", "axon")
     batch = 256 if on_tpu else 4
     steps = 20 if on_tpu else 2
     warmup = 3 if on_tpu else 1
-    # f32 params: on TPU, XLA's default matmul/conv precision already runs
-    # the MXU in bf16 multiply + f32 accumulate, so f32 storage costs only
-    # HBM bandwidth, not FLOPs.
-    dtype = jnp.float32
+    size = 224 if on_tpu else 64
 
     engine.set_seed(0)
-    model = ResNet(class_num=1000, depth=50)
+    # NHWC: TPU-native conv layout (channels-last); f32 master params,
+    # bf16 compute inside the step (MXU path), f32 SGD update.
+    model = ResNet(class_num=1000, depth=50, format="NHWC")
     params, mstate = model.init(jax.random.PRNGKey(0))
     crit = CrossEntropyCriterion()
     optim = SGD(learningrate=0.1, momentum=0.9)
     opt_state = optim.init_state(params)
 
-    size = 224 if on_tpu else 64
     rng = np.random.RandomState(0)
-    x_host = rng.randn(batch, 3, size, size).astype(np.float32)
+    x_host = rng.randn(batch, size, size, 3).astype(np.float32)
     y_host = rng.randint(1, 1001, size=(batch,)).astype(np.int32)
-    x = jnp.asarray(x_host, dtype)
+    x = jnp.asarray(x_host, jnp.bfloat16)
     y = jnp.asarray(y_host)
 
     def train_step(params, opt_state, mstate, x, y, lr):
         def loss_fn(p):
-            out, new_state = model.apply(p, mstate, x, training=True,
+            p16 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if a.dtype == jnp.float32 else a, p)
+            out, new_state = model.apply(p16, mstate, x, training=True,
                                          rng=jax.random.PRNGKey(0))
             return crit._forward(out.astype(jnp.float32), y), new_state
         (loss, new_mstate), grads = jax.value_and_grad(
@@ -60,8 +119,24 @@ def main():
         new_params, new_opt = optim.update(grads, params, opt_state, lr)
         return loss, new_params, new_opt, new_mstate
 
-    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
     lr = jnp.float32(0.1)
+    # AOT-compile once and reuse the executable for the timed loop (a plain
+    # jit call after .lower().compile() would trace+compile a second time).
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2)) \
+              .lower(params, opt_state, mstate, x, y, lr).compile()
+
+    flops_per_step = None
+    try:
+        ca = step.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops_per_step = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    if not flops_per_step:
+        # analytic fallback: 4.09 GMAC fwd/image * 2 flops/MAC * 3 (train)
+        flops_per_step = 2 * 4.089e9 * 3 * batch * (size / 224.0) ** 2
+
     for _ in range(warmup):
         loss, params, opt_state, mstate = step(params, opt_state, mstate,
                                                x, y, lr)
@@ -74,13 +149,101 @@ def main():
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss)
     img_per_sec = batch * steps / dt
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    mfu = flops_per_step * steps / dt / peak
 
-    print(json.dumps({
+    return {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
-    }))
+        "mfu": round(mfu, 4),
+        "backend": backend,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def child_main(which: str):
+    if which == "headline":
+        results = [bench_resnet50()]
+    elif which == "secondary":
+        from bench_extra import bench_secondary
+        results = bench_secondary()
+    else:
+        raise SystemExit(f"unknown child config {which!r}")
+    for r in results:
+        print(json.dumps(r), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent: orchestration (never imports jax)
+# --------------------------------------------------------------------------
+
+def _json_lines(out: str):
+    found = []
+    for line in out.strip().splitlines():
+        try:
+            d = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(d, dict) and "metric" in d:
+            found.append(d)
+    return found
+
+
+def _cpu_env():
+    env = os.environ.copy()
+    # Strip axon registration so sitecustomize cannot hang at interpreter
+    # start, and force the CPU platform.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run_child(which: str, env, timeout: float):
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", which],
+            env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    lines = _json_lines(proc.stdout)
+    if proc.returncode == 0 and lines:
+        return lines, None
+    tail = (proc.stderr or "")[-2000:]
+    return None, f"rc={proc.returncode}: {tail}"
+
+
+def _orchestrate(which: str):
+    """Run a child config: TPU with timeout, retry, then CPU fallback."""
+    attempts = [
+        (os.environ.copy(), 800.0, "tpu attempt 1"),
+        (os.environ.copy(), 420.0, "tpu attempt 2"),
+        (_cpu_env(), 420.0, "cpu fallback"),
+    ]
+    errors = []
+    for env, tmo, label in attempts:
+        lines, err = _run_child(which, env, tmo)
+        if lines:
+            return lines
+        errors.append(f"{label}: {err}")
+        time.sleep(10)
+    # Even the CPU fallback failed: emit a line anyway so the driver
+    # records *something* parseable rather than rc!=0.
+    return [{"metric": "bench_failed", "value": 0, "unit": "error",
+             "vs_baseline": 0, "error": "; ".join(errors)[-500:]}]
+
+
+def main():
+    if "--child" in sys.argv:
+        child_main(sys.argv[sys.argv.index("--child") + 1])
+        return
+    for line in _orchestrate("headline"):
+        print(json.dumps(line), flush=True)
+    if "--all" in sys.argv:
+        for line in _orchestrate("secondary"):
+            print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

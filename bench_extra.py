@@ -1,0 +1,181 @@
+"""Secondary BASELINE.json benchmark configs (run via ``python bench.py --all``).
+
+Covers the four non-headline configs from BASELINE.json:
+  * LeNet-5 / MNIST train (images/sec)
+  * VGG-16 / CIFAR-10 train (images/sec)
+  * LSTM language model / PTB-shape train (tokens/sec)
+  * Inception-v1 int8 inference (images/sec, exercises the quantization path
+    end-to-end: float model -> quantize() -> int8 forward)
+
+Baseline constants are the reference's MKL/MKL-DNN Xeon-node estimates from
+SURVEY §6 (the reference publishes no exact per-config numbers; these are
+order-of-magnitude anchors recorded here as fixed constants so vs_baseline is
+stable across rounds).
+
+Runs inside a bench.py child process — backend init/retry and the CPU
+fallback are handled by the bench.py orchestrator.
+"""
+from __future__ import annotations
+
+import time
+
+# Xeon-node estimates (fixed anchors, see module docstring)
+_BASE = {
+    "lenet_mnist": 2000.0,       # images/sec train
+    "vgg16_cifar10": 40.0,       # images/sec train
+    "lstm_ptb": 8000.0,          # tokens/sec train
+    "inception_v1_int8": 200.0,  # images/sec int8 inference
+}
+
+
+def _sized(on_tpu, tpu, cpu):
+    return tpu if on_tpu else cpu
+
+
+def _train_bench(model, crit, x, y, optim, steps, warmup):
+    """Functional jitted train loop over (params, opt_state, mstate)."""
+    import jax
+    import jax.numpy as jnp
+
+    params, mstate = model.init(jax.random.PRNGKey(0))
+    opt_state = optim.init_state(params)
+
+    def train_step(params, opt_state, mstate, x, y, lr):
+        def loss_fn(p):
+            out, new_state = model.apply(p, mstate, x, training=True,
+                                         rng=jax.random.PRNGKey(0))
+            return crit._forward(out, y), new_state
+        (loss, new_mstate), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt = optim.update(grads, params, opt_state, lr)
+        return loss, new_params, new_opt, new_mstate
+
+    step = jax.jit(train_step)
+    lr = jnp.float32(0.01)
+    carry = [params, opt_state, mstate]
+    for _ in range(warmup):
+        loss, *carry = step(*carry, x, y, lr)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, *carry = step(*carry, x, y, lr)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    assert final == final, "NaN loss in bench"
+    return dt
+
+
+def bench_lenet(on_tpu):
+    import numpy as np
+    import jax.numpy as jnp
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import SGD
+
+    batch = _sized(on_tpu, 1024, 32)
+    steps, warmup = _sized(on_tpu, 30, 2), _sized(on_tpu, 5, 1)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, 28, 28).astype(np.float32))
+    y = jnp.asarray(rng.randint(1, 11, size=(batch,)).astype(np.int32))
+    dt = _train_bench(LeNet5(10), ClassNLLCriterion(), x, y,
+                      SGD(learningrate=0.01), steps, warmup)
+    v = batch * steps / dt
+    return {"metric": "lenet_mnist_train_images_per_sec", "value": round(v, 1),
+            "unit": "images/sec", "vs_baseline": round(v / _BASE["lenet_mnist"], 3)}
+
+
+def bench_vgg(on_tpu):
+    import numpy as np
+    import jax.numpy as jnp
+    from bigdl_tpu.models import VggForCifar10
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim import SGD
+
+    batch = _sized(on_tpu, 256, 4)
+    steps, warmup = _sized(on_tpu, 15, 2), _sized(on_tpu, 3, 1)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, 3, 32, 32).astype(np.float32))
+    y = jnp.asarray(rng.randint(1, 11, size=(batch,)).astype(np.int32))
+    dt = _train_bench(VggForCifar10(10), ClassNLLCriterion(), x, y,
+                      SGD(learningrate=0.01), steps, warmup)
+    v = batch * steps / dt
+    return {"metric": "vgg16_cifar10_train_images_per_sec", "value": round(v, 1),
+            "unit": "images/sec", "vs_baseline": round(v / _BASE["vgg16_cifar10"], 3)}
+
+
+def bench_lstm_ptb(on_tpu):
+    import numpy as np
+    import jax.numpy as jnp
+    from bigdl_tpu.models import PTBModel
+    from bigdl_tpu.nn import ClassNLLCriterion, TimeDistributedCriterion
+    from bigdl_tpu.optim import SGD
+
+    vocab, seqlen = 10000, _sized(on_tpu, 35, 12)
+    batch = _sized(on_tpu, 64, 4)
+    steps, warmup = _sized(on_tpu, 15, 2), _sized(on_tpu, 3, 1)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(1, vocab + 1,
+                                size=(batch, seqlen)).astype(np.float32))
+    y = jnp.asarray(rng.randint(1, vocab + 1,
+                                size=(batch, seqlen)).astype(np.float32))
+    # PTBModel already ends in LogSoftMax → NLL criterion (not CE, which
+    # would apply log_softmax twice)
+    model = PTBModel(vocab, hidden_size=_sized(on_tpu, 650, 64), num_layers=2)
+    crit = TimeDistributedCriterion(ClassNLLCriterion())
+    dt = _train_bench(model, crit, x, y, SGD(learningrate=0.01), steps, warmup)
+    v = batch * seqlen * steps / dt
+    return {"metric": "lstm_ptb_train_tokens_per_sec", "value": round(v, 1),
+            "unit": "tokens/sec", "vs_baseline": round(v / _BASE["lstm_ptb"], 3)}
+
+
+def bench_inception_int8(on_tpu):
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models import Inception_v1_NoAuxClassifier
+    from bigdl_tpu.quantization import quantize
+
+    batch = _sized(on_tpu, 128, 2)
+    size = _sized(on_tpu, 224, 64)
+    steps, warmup = _sized(on_tpu, 20, 2), _sized(on_tpu, 3, 1)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, 3, size, size).astype(np.float32))
+
+    model = Inception_v1_NoAuxClassifier(1000)
+    model.ensure_initialized()
+    qmodel = quantize(model)
+    params, mstate = qmodel.params, qmodel.state
+
+    def fwd(params, x):
+        out, _ = qmodel.apply(params, mstate, x, training=False)
+        return out
+
+    step = jax.jit(fwd)
+    for _ in range(warmup):
+        out = step(params, x)
+    np.asarray(out[0, 0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step(params, x)
+    np.asarray(out[0, 0])
+    dt = time.perf_counter() - t0
+    v = batch * steps / dt
+    return {"metric": "inception_v1_int8_infer_images_per_sec",
+            "value": round(v, 1), "unit": "images/sec",
+            "vs_baseline": round(v / _BASE["inception_v1_int8"], 3)}
+
+
+def bench_secondary():
+    from bench import _init_backend_with_retry
+    backend = _init_backend_with_retry()
+    on_tpu = backend in ("tpu", "axon")
+    results = []
+    for fn in (bench_lenet, bench_vgg, bench_lstm_ptb, bench_inception_int8):
+        try:
+            r = fn(on_tpu)
+        except Exception as e:  # one broken config must not hide the rest
+            r = {"metric": f"{fn.__name__}_failed", "value": 0,
+                 "unit": "error", "vs_baseline": 0, "error": str(e)[-300:]}
+        r["backend"] = backend
+        results.append(r)
+    return results

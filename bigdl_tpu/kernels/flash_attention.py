@@ -1,0 +1,337 @@
+"""Fused flash attention as a hand-written Pallas TPU kernel.
+
+Replaces the reference's O(T^2)-memory attention (the reference materialises
+the full score matrix — ``nn/Attention.scala`` builds it with two MM layers)
+with the online-softmax tiling of FlashAttention: Q/K/V stream through VMEM
+in (block x d) tiles, scores never leave VMEM, and the output is rescaled
+incrementally — O(T) HBM traffic per head.
+
+Forward and backward are both Pallas kernels wired through ``jax.custom_vjp``
+(flash-attention-2 split: the backward recomputes probabilities per tile from
+the saved logsumexp; one kernel accumulates dK/dV over query tiles, one
+accumulates dQ over key tiles).
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+  * the streaming axis is the innermost grid dimension, so the VMEM scratch
+    accumulators persist across its sequential iterations;
+  * all matmuls request ``preferred_element_type=float32`` (MXU accumulates
+    f32 even for bf16 inputs);
+  * sequence lengths are padded to the block size; real lengths are baked in
+    statically and masked with ``broadcasted_iota`` (no dynamic shapes);
+  * ``interpret=True`` runs the identical kernel on CPU for the test suite.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _pick_block(t: int, target: int) -> int:
+    """Block size: multiple of 128, capped at the (padded) sequence length."""
+    t_pad = (t + 127) // 128 * 128
+    return min(target, t_pad)
+
+
+def _pad_t(x, t_pad):
+    t = x.shape[2]
+    if t == t_pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+
+
+def _mm(a, b, ta=False, tb=False):
+    """f32-accumulating matmul on the MXU; optionally transpose operands."""
+    ca = 0 if ta else 1
+    cb = 1 if tb else 0
+    out = jax.lax.dot_general(a, b, (((ca,), (cb,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, block_q, block_k, causal, kv_len, nk):
+    i = pl.program_id(2)   # query-block index
+    j = pl.program_id(3)   # key-block index (sequential, innermost)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_off = i * block_q
+    k_off = j * block_k
+    # key blocks strictly above the causal diagonal contribute nothing
+    needed = (k_off <= q_off + block_q - 1) if causal else (j >= 0)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = _mm(q, k, tb=True) * scale             # (bq, bk)
+
+        col = k_off + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = q_off + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                      # (bq, 1)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                     # (bq, bk)
+        l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + _mm(p, v_ref[0, 0].astype(jnp.float32))
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)       # fully-masked rows → 0
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    bq = _pick_block(t_q, block_q)
+    bk = _pick_block(t_kv, block_k)
+    tq_pad = (t_q + bq - 1) // bq * bq
+    tkv_pad = (t_kv + bk - 1) // bk * bk
+    qp, kp, vp = _pad_t(q, tq_pad), _pad_t(k, tkv_pad), _pad_t(v, tkv_pad)
+    nq, nk = tq_pad // bq, tkv_pad // bk
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=bq, block_k=bk, causal=causal,
+        kv_len=t_kv, nk=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, bq, 128),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq_pad, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :, :t_q], lse[:, :, :t_q, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc,
+                   *, scale, block_q, block_k, causal, kv_len, nq):
+    j = pl.program_id(2)   # key-block (parallel)
+    i = pl.program_id(3)   # query-block (sequential, innermost)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_off = i * block_q
+    k_off = j * block_k
+    needed = (k_off <= q_off + block_q - 1) if causal else (i >= 0)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]                 # (bq, 1)
+        delta = delta_ref[0, 0][:, :1]             # (bq, 1)
+
+        s = _mm(q, k, tb=True) * scale             # (bq, bk)
+        col = k_off + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = q_off + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk)
+
+        dv_acc[:] += _mm(p, do, ta=True)            # (bk, d)
+        dp = _mm(do, v, tb=True)                    # (bq, bk)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += _mm(ds, q, ta=True)            # (bk, d)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  dq_ref, dq_acc,
+                  *, scale, block_q, block_k, causal, kv_len, nk):
+    i = pl.program_id(2)   # query-block (parallel)
+    j = pl.program_id(3)   # key-block (sequential, innermost)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_off = i * block_q
+    k_off = j * block_k
+    needed = (k_off <= q_off + block_q - 1) if causal else (j >= 0)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+
+        s = _mm(q, k, tb=True) * scale
+        col = k_off + jax.lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = q_off + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = _mm(do, v, tb=True)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += _mm(ds, k)                     # (bq, d)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[2]
+    bq = _pick_block(t_q, block_q)
+    bk = _pick_block(t_kv, block_k)
+    tq_pad = (t_q + bq - 1) // bq * bq
+    tkv_pad = (t_kv + bk - 1) // bk * bk
+    nq, nk = tq_pad // bq, tkv_pad // bk
+
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise+reduce; XLA fuses it
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    qp, kp, vp = _pad_t(q, tq_pad), _pad_t(k, tkv_pad), _pad_t(v, tkv_pad)
+    dop = _pad_t(g, tq_pad)
+    # lse/delta padded along T and broadcast into 128 lanes so each (bq, 128)
+    # tile is layout-friendly
+    pad_q = ((0, 0), (0, 0), (0, tq_pad - t_q))
+    lsep = jnp.pad(lse, pad_q)[..., None] * jnp.ones((1, 1, 1, 128), jnp.float32)
+    deltap = jnp.pad(delta, pad_q)[..., None] * jnp.ones((1, 1, 1, 128),
+                                                         jnp.float32)
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, x, y: (b_, h_, y, 0))
+    k_spec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, x, y: (b_, h_, x, 0))
+    r_spec = pl.BlockSpec((1, 1, bq, 128),
+                          lambda b_, h_, x, y: (b_, h_, y, 0))
+    kv_kernel = functools.partial(
+        _bwd_kv_kernel, scale=scale, block_q=bq, block_k=bk, causal=causal,
+        kv_len=t_kv, nq=nq)
+    dk, dv = pl.pallas_call(
+        kv_kernel,
+        grid=(b, h, nk, nq),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=[k_spec, k_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, tkv_pad, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, tkv_pad, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    q_spec2 = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, x, y: (b_, h_, x, 0))
+    k_spec2 = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, x, y: (b_, h_, y, 0))
+    r_spec2 = pl.BlockSpec((1, 1, bq, 128),
+                           lambda b_, h_, x, y: (b_, h_, x, 0))
+    q_kernel = functools.partial(
+        _bwd_q_kernel, scale=scale, block_q=bq, block_k=bk, causal=causal,
+        kv_len=t_kv, nk=nk)
+    dq = pl.pallas_call(
+        q_kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    return dq[:, :, :t_q], dk[:, :, :t_kv], dv[:, :, :t_kv]
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    return _flash_bwd(causal, scale, block_q, block_k, interpret, res, g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention_fused(q, k, v, causal: bool = False,
+                          scale: float | None = None,
+                          block_q: int = 512, block_k: int = 512,
+                          interpret: bool = False):
+    """Fused flash attention. q, k, v: (B, H, T, D); returns (B, H, T, D).
+
+    Matches ``nn.attention.dot_product_attention(q, k, v, causal_mask)``
+    numerically (softmax(QK^T / sqrt(D)) V) with O(T) memory. Differentiable
+    via the Pallas backward kernels. ``interpret=True`` runs the kernel in
+    the Pallas interpreter (CPU tests).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, bool(causal), float(scale),
+                  int(block_q), int(block_k), bool(interpret))

@@ -44,24 +44,11 @@ def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
 
 
 def flash_attention(q, k, v, causal=False):
-    """Fused attention. On TPU uses the Pallas kernel from
-    ``bigdl_tpu.parallel.flash``; elsewhere falls back to the einsum path."""
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        backend = "cpu"
-    if backend == "tpu":
-        try:
-            from ..parallel.flash import flash_attention as pallas_flash
-            return pallas_flash(q, k, v, causal=causal)
-        except Exception:
-            pass
-    mask = None
-    if causal:
-        t = q.shape[-2]
-        mask = jnp.where(
-            np.tril(np.ones((t, t), np.bool_))[None, None], 0.0, -1e9)
-    return dot_product_attention(q, k, v, mask)
+    """Fused attention. Delegates to the ``bigdl_tpu.parallel.flash``
+    dispatcher: the custom Pallas kernel on TPU-class backends, the einsum
+    path elsewhere (with a logged, never silent, fallback)."""
+    from ..parallel.flash import flash_attention as dispatch
+    return dispatch(q, k, v, causal=causal)
 
 
 def causal_mask(t, dtype=jnp.float32):

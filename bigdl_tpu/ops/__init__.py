@@ -1,0 +1,13 @@
+"""TF-style operation layer (parity: reference ``nn/ops/*.scala`` ~70 ops +
+``nn/tf/*.scala``).
+
+Each op is a small ``Module`` whose forward is a jnp expression — XLA fuses
+them; there is no per-op kernel dispatch like the reference's per-Operation
+``updateOutput``. Multi-input ops take a Table/list input (same convention as
+``CAddTable``). Feature-column ops that are inherently host-side string
+processing (CategoricalColVocaList, CrossCol, MkString, Substr) run on numpy
+object arrays outside jit, mirroring how the reference runs them on the Spark
+driver side rather than in MKL kernels.
+"""
+from .ops import *  # noqa: F401,F403
+from .ops import __all__  # noqa: F401

@@ -1,0 +1,735 @@
+"""TF-style operation modules.
+
+Parity: reference ``nn/ops/`` (Equal.scala, Gather.scala, Select.scala,
+Tile.scala, TopK.scala, OneHot.scala, SegmentSum.scala, BucketizedCol.scala,
+...) and ``nn/tf/`` (Shape.scala, StridedSlice.scala, SplitAndSelect.scala,
+Log1p.scala, ...). Each op lowers to one or a few jnp/lax expressions that
+XLA fuses — none of the reference's per-op Scala updateOutput kernels.
+
+Conventions:
+  * multi-input ops take a ``Table`` or list (like ``nn.CAddTable``);
+  * axis arguments are 0-based here (TF convention) — the reference's nn/ops
+    layer is 0-based too, unlike its 1-based Torch-style nn layer;
+  * ops whose reference semantics are host-side string processing
+    (CategoricalColVocaList, CrossCol, MkString, Substr) accept numpy object
+    arrays and run un-jitted, as data-pipeline stages.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.module import Module
+from ..utils.table import Table
+
+
+def _items(x):
+    return x.to_list() if isinstance(x, Table) else \
+        (list(x) if isinstance(x, (list, tuple)) else [x])
+
+
+class Operation(Module):
+    """Base class: inference-style op (nn/ops/Operation.scala — ops there
+    have no backward; here most are jax-differentiable anyway)."""
+
+    def _op(self, *xs):
+        raise NotImplementedError
+
+    def _apply(self, params, state, x, training, rng):
+        return self._op(*_items(x))
+
+
+def _unary(fn, doc_ref):
+    class _Op(Operation):
+        def _op(self, a):
+            return fn(a)
+    _Op.__doc__ = doc_ref
+    return _Op
+
+
+def _binary(fn, doc_ref):
+    class _Op(Operation):
+        def _op(self, a, b):
+            return fn(a, b)
+    _Op.__doc__ = doc_ref
+    return _Op
+
+
+# -- comparison (nn/ops/Equal.scala, Greater.scala, ...) --------------------
+
+Equal = _binary(lambda a, b: a == b, "nn/ops/Equal.scala")
+NotEqual = _binary(lambda a, b: a != b, "nn/ops/NotEqual.scala")
+Greater = _binary(lambda a, b: a > b, "nn/ops/Greater.scala")
+GreaterEqual = _binary(lambda a, b: a >= b, "nn/ops/GreaterEqual.scala")
+Less = _binary(lambda a, b: a < b, "nn/ops/Less.scala")
+LessEqual = _binary(lambda a, b: a <= b, "nn/ops/LessEqual.scala")
+
+
+class ApproximateEqual(Operation):
+    """nn/ops/ApproximateEqual.scala — |a - b| < tolerance."""
+
+    def __init__(self, tolerance: float = 1e-5, name=None):
+        super().__init__(name=name)
+        self.tolerance = tolerance
+
+    def _op(self, a, b):
+        return jnp.abs(a - b) < self.tolerance
+
+
+# -- logical (nn/ops/LogicalAnd.scala, ...) ---------------------------------
+
+LogicalAnd = _binary(jnp.logical_and, "nn/ops/LogicalAnd.scala")
+LogicalOr = _binary(jnp.logical_or, "nn/ops/LogicalOr.scala")
+LogicalNot = _unary(jnp.logical_not, "nn/ops/LogicalNot.scala")
+
+
+class _Reduction(Operation):
+    """Base for All/Any/Sum/Prod/Max: second input (or ctor arg) gives the
+    reduction indices, TF-style."""
+
+    _fn = None
+
+    def __init__(self, axis=None, keep_dims: bool = False, name=None):
+        super().__init__(name=name)
+        self.axis, self.keep_dims = axis, keep_dims
+
+    def _op(self, a, axis=None):
+        ax = self.axis if axis is None else \
+            tuple(int(i) for i in np.asarray(axis).reshape(-1))
+        if isinstance(ax, int):
+            ax = (ax,)
+        return type(self)._fn(a, axis=ax, keepdims=self.keep_dims)
+
+
+class All(_Reduction):
+    """nn/ops/All.scala"""
+    _fn = staticmethod(jnp.all)
+
+
+class Any(_Reduction):
+    """nn/ops/Any.scala"""
+    _fn = staticmethod(jnp.any)
+
+
+class Sum(_Reduction):
+    """nn/ops/Sum.scala"""
+    _fn = staticmethod(jnp.sum)
+
+
+class Prod(_Reduction):
+    """nn/ops/Prod.scala"""
+    _fn = staticmethod(jnp.prod)
+
+
+class Max(_Reduction):
+    """nn/ops/Max.scala"""
+    _fn = staticmethod(jnp.max)
+
+
+class Min(_Reduction):
+    """tf Min (reference folds into Max.scala pattern)"""
+    _fn = staticmethod(jnp.min)
+
+
+class Mean(_Reduction):
+    """tf Mean (nn/ops reduction family)"""
+    _fn = staticmethod(jnp.mean)
+
+
+# -- elementwise math (nn/ops/Exp.scala, Floor.scala, ...) ------------------
+
+Exp = _unary(jnp.exp, "nn/ops/Exp.scala")
+Expm1 = _unary(jnp.expm1, "nn/ops/Expm1.scala")
+Log1p = _unary(jnp.log1p, "nn/tf/Log1p.scala")
+Floor = _unary(jnp.floor, "nn/ops/Floor.scala")
+Ceil = _unary(jnp.ceil, "nn/ops/Ceil.scala")
+Round = _unary(jnp.round, "nn/ops/Round.scala")
+Rint = _unary(jnp.rint, "nn/ops/Rint.scala")
+Sign = _unary(jnp.sign, "nn/ops/Sign.scala")
+Inv = _unary(lambda a: 1.0 / a, "nn/ops/Inv.scala (reciprocal)")
+Erf = _unary(jax.scipy.special.erf, "nn/ops/Erf.scala")
+Erfc = _unary(jax.scipy.special.erfc, "nn/ops/Erfc.scala")
+Lgamma = _unary(jax.scipy.special.gammaln, "nn/ops/Lgamma.scala")
+Digamma = _unary(jax.scipy.special.digamma, "nn/ops/Digamma.scala")
+IsFinite = _unary(jnp.isfinite, "nn/ops/IsFinite.scala")
+IsInf = _unary(jnp.isinf, "nn/ops/IsInf.scala")
+IsNan = _unary(jnp.isnan, "nn/ops/IsNan.scala")
+
+Pow = _binary(jnp.power, "nn/ops/Pow.scala")
+Maximum = _binary(jnp.maximum, "nn/ops/Maximum.scala")
+Minimum = _binary(jnp.minimum, "nn/ops/Minimum.scala")
+FloorDiv = _binary(jnp.floor_divide, "nn/ops/FloorDiv.scala")
+FloorMod = _binary(jnp.mod, "nn/ops/FloorMod.scala")
+Mod = _binary(jnp.mod, "nn/ops/Mod.scala")
+TruncateDiv = _binary(
+    lambda a, b: jnp.trunc(a / b).astype(a.dtype), "nn/ops/TruncateDiv.scala")
+SquaredDifference = _binary(
+    lambda a, b: jnp.square(a - b), "nn/ops/SquaredDifference.scala")
+
+
+# -- shape/metadata (nn/tf/Shape.scala, nn/ops/Rank.scala) ------------------
+
+Shape = _unary(lambda a: jnp.asarray(a.shape, jnp.int32), "nn/tf/Shape.scala")
+Rank = _unary(lambda a: jnp.asarray(a.ndim, jnp.int32), "nn/ops/Rank.scala")
+
+
+class Cast(Operation):
+    """nn/ops/Cast.scala"""
+
+    def __init__(self, dtype, name=None):
+        super().__init__(name=name)
+        self.dtype = jnp.dtype(dtype)
+
+    def _op(self, a):
+        return a.astype(self.dtype)
+
+
+# -- array ops --------------------------------------------------------------
+
+class Gather(Operation):
+    """nn/ops/Gather.scala — gather rows of ``params`` along ``axis`` by
+    integer ``indices``. Lowers to one XLA gather (jnp.take)."""
+
+    def __init__(self, axis: int = 0, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def _op(self, params_t, indices):
+        return jnp.take(params_t, indices.astype(jnp.int32), axis=self.axis)
+
+
+class Select(Operation):
+    """nn/ops/Select.scala — elementwise cond ? x : y."""
+
+    def _op(self, cond, x, y):
+        return jnp.where(cond, x, y)
+
+
+class Slice(Operation):
+    """nn/ops/Slice.scala — static begin/size slice."""
+
+    def __init__(self, begin, size, name=None):
+        super().__init__(name=name)
+        self.begin = [int(b) for b in begin]
+        self.size = [int(s) for s in size]
+
+    def _op(self, a):
+        idx = tuple(
+            slice(b, a.shape[i] if s == -1 else b + s)
+            for i, (b, s) in enumerate(zip(self.begin, self.size)))
+        return a[idx]
+
+
+class StridedSlice(Operation):
+    """nn/tf/StridedSlice.scala — static begin/end/strides with shrink mask."""
+
+    def __init__(self, begin, end, strides=None, shrink_axis_mask: int = 0,
+                 begin_mask: int = 0, end_mask: int = 0, name=None):
+        super().__init__(name=name)
+        self.begin = [int(b) for b in begin]
+        self.end = [int(e) for e in end]
+        self.strides = [int(s) for s in (strides or [1] * len(self.begin))]
+        self.shrink = shrink_axis_mask
+        self.begin_mask, self.end_mask = begin_mask, end_mask
+
+    def _op(self, a):
+        idx = []
+        for d in range(len(self.begin)):
+            b = None if (self.begin_mask >> d) & 1 else self.begin[d]
+            e = None if (self.end_mask >> d) & 1 else self.end[d]
+            if (self.shrink >> d) & 1:
+                idx.append(self.begin[d])
+            else:
+                idx.append(slice(b, e, self.strides[d]))
+        return a[tuple(idx)]
+
+
+class Tile(Operation):
+    """nn/ops/Tile.scala — second input (or ctor) gives multiples."""
+
+    def __init__(self, multiples=None, name=None):
+        super().__init__(name=name)
+        self.multiples = multiples
+
+    def _op(self, a, multiples=None):
+        m = self.multiples if multiples is None else \
+            [int(x) for x in np.asarray(multiples).reshape(-1)]
+        return jnp.tile(a, m)
+
+
+class OneHot(Operation):
+    """nn/ops/OneHot.scala — indices → one-hot on a new last (or given) axis."""
+
+    def __init__(self, depth: int, on_value: float = 1.0,
+                 off_value: float = 0.0, axis: int = -1, name=None):
+        super().__init__(name=name)
+        self.depth, self.axis = depth, axis
+        self.on_value, self.off_value = on_value, off_value
+
+    def _op(self, indices):
+        oh = jax.nn.one_hot(indices.astype(jnp.int32), self.depth,
+                            axis=self.axis)
+        return oh * (self.on_value - self.off_value) + self.off_value
+
+
+class TopK(Operation):
+    """nn/ops/TopK.scala — returns Table(values, indices)."""
+
+    def __init__(self, k: int, sorted: bool = True, name=None):
+        super().__init__(name=name)
+        self.k = k
+
+    def _op(self, a):
+        v, i = jax.lax.top_k(a, self.k)
+        return Table(v, i.astype(jnp.int32))
+
+
+class InTopK(Operation):
+    """nn/ops/InTopK.scala — targets ∈ top-k(predictions) per row."""
+
+    def __init__(self, k: int, name=None):
+        super().__init__(name=name)
+        self.k = k
+
+    def _op(self, predictions, targets):
+        _, idx = jax.lax.top_k(predictions, self.k)
+        return jnp.any(idx == targets.astype(jnp.int32)[:, None], axis=-1)
+
+
+class ArgMax(Operation):
+    """nn/ops/ArgMax.scala — axis from ctor or second input."""
+
+    def __init__(self, axis: int = 0, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def _op(self, a, axis=None):
+        ax = self.axis if axis is None else int(np.asarray(axis).reshape(()))
+        return jnp.argmax(a, axis=ax).astype(jnp.int32)
+
+
+class BatchMatMul(Operation):
+    """nn/ops/BatchMatMul.scala — batched matmul with optional adjoints.
+    One XLA dot_general → MXU."""
+
+    def __init__(self, adj_x: bool = False, adj_y: bool = False, name=None):
+        super().__init__(name=name)
+        self.adj_x, self.adj_y = adj_x, adj_y
+
+    def _op(self, x, y):
+        if self.adj_x:
+            x = jnp.swapaxes(x, -1, -2)
+        if self.adj_y:
+            y = jnp.swapaxes(y, -1, -2)
+        return jnp.matmul(x, y)
+
+
+class SegmentSum(Operation):
+    """nn/ops/SegmentSum.scala — jax.ops.segment_sum (the TPU-native sparse
+    reduction; also the building block of the sparse layer family)."""
+
+    def __init__(self, num_segments=None, name=None):
+        super().__init__(name=name)
+        self.num_segments = num_segments
+
+    def _op(self, data, segment_ids):
+        n = self.num_segments
+        if n is None:
+            n = int(np.asarray(segment_ids).max()) + 1  # host-side like ref
+        return jax.ops.segment_sum(data, segment_ids.astype(jnp.int32),
+                                   num_segments=n)
+
+
+class Pad(Operation):
+    """nn/ops/Pad.scala — constant padding, paddings as (ndim, 2)."""
+
+    def __init__(self, paddings, constant_value: float = 0.0, name=None):
+        super().__init__(name=name)
+        self.paddings = [tuple(int(x) for x in p) for p in np.asarray(paddings)]
+        self.constant_value = constant_value
+
+    def _op(self, a):
+        return jnp.pad(a, self.paddings, constant_values=self.constant_value)
+
+
+class ExpandDims(Operation):
+    """tf ExpandDims (reference folds into array ops)"""
+
+    def __init__(self, axis: int, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def _op(self, a):
+        return jnp.expand_dims(a, self.axis)
+
+
+class SplitAndSelect(Operation):
+    """nn/tf/SplitAndSelect.scala — split along a dim, return one piece."""
+
+    def __init__(self, dim: int, index: int, num_split: int, name=None):
+        super().__init__(name=name)
+        self.dim, self.index, self.num_split = dim, index, num_split
+
+    def _op(self, a):
+        return jnp.split(a, self.num_split, axis=self.dim)[self.index]
+
+
+class InvertPermutation(Operation):
+    """nn/tf/ArrayOps.scala InvertPermutation"""
+
+    def _op(self, p):
+        return jnp.argsort(p.astype(jnp.int32)).astype(jnp.int32)
+
+
+class ResizeBilinear(Operation):
+    """nn/ops/ResizeBilinear.scala — NHWC bilinear resize via jax.image
+    (lowers to XLA gather/dot, TPU-tiled)."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, data_format: str = "NHWC",
+                 name=None):
+        super().__init__(name=name)
+        self.oh, self.ow = output_height, output_width
+        self.align_corners = align_corners
+        self.data_format = data_format
+
+    def _op(self, a):
+        nhwc = self.data_format == "NHWC"
+        if not nhwc:
+            a = jnp.transpose(a, (0, 2, 3, 1))
+        b, h, w, c = a.shape
+        if self.align_corners and h > 1 and w > 1:
+            # align_corners: endpoints map to endpoints
+            ys = jnp.linspace(0, h - 1, self.oh)
+            xs = jnp.linspace(0, w - 1, self.ow)
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 2)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 2)
+            wy = (ys - y0)[None, :, None, None]
+            wx = (xs - x0)[None, None, :, None]
+            g00 = a[:, y0][:, :, x0]
+            g01 = a[:, y0][:, :, x0 + 1]
+            g10 = a[:, y0 + 1][:, :, x0]
+            g11 = a[:, y0 + 1][:, :, x0 + 1]
+            out = (g00 * (1 - wy) * (1 - wx) + g01 * (1 - wy) * wx +
+                   g10 * wy * (1 - wx) + g11 * wy * wx)
+        else:
+            out = jax.image.resize(a, (b, self.oh, self.ow, c), "bilinear")
+        if not nhwc:
+            out = jnp.transpose(out, (0, 3, 1, 2))
+        return out
+
+
+class Dilation2D(Operation):
+    """nn/ops/Dilation2D.scala — grayscale morphological dilation: NHWC input,
+    (kh, kw, C) filter; out = max over window of (input + filter). Lowered to
+    a reduce_window per tap-free formulation via lax.reduce_window is not
+    expressible (filter varies per tap), so use explicit patch extraction —
+    static shapes, VPU-friendly."""
+
+    def __init__(self, strides, rates, padding: str = "SAME", name=None):
+        super().__init__(name=name)
+        self.strides = [int(s) for s in strides]
+        self.rates = [int(r) for r in rates]
+        self.padding = padding
+
+    def _op(self, a, filt):
+        kh, kw, c = filt.shape
+        sh, sw = self.strides[1], self.strides[2]
+        rh, rw = self.rates[1], self.rates[2]
+        eff_kh, eff_kw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        b, h, w, _ = a.shape
+        if self.padding == "SAME":
+            oh = -(-h // sh)
+            ow = -(-w // sw)
+            ph = max(0, (oh - 1) * sh + eff_kh - h)
+            pw = max(0, (ow - 1) * sw + eff_kw - w)
+            a = jnp.pad(a, ((0, 0), (ph // 2, ph - ph // 2),
+                            (pw // 2, pw - pw // 2), (0, 0)),
+                        constant_values=-jnp.inf)
+        else:
+            oh = (h - eff_kh) // sh + 1
+            ow = (w - eff_kw) // sw + 1
+        outs = []
+        for i in range(kh):
+            for j in range(kw):
+                patch = a[:, i * rh:i * rh + (oh - 1) * sh + 1:sh,
+                          j * rw:j * rw + (ow - 1) * sw + 1:sw, :]
+                outs.append(patch + filt[i, j])
+        return functools.reduce(jnp.maximum, outs)
+
+
+# -- losses / misc ----------------------------------------------------------
+
+class L2Loss(Operation):
+    """nn/ops/L2Loss.scala — sum(x^2) / 2."""
+
+    def _op(self, a):
+        return jnp.sum(jnp.square(a)) / 2.0
+
+
+class CrossEntropy(Operation):
+    """nn/ops/CrossEntropy.scala — per-row softmax cross entropy from
+    (logits, one-hot labels)."""
+
+    def _op(self, logits, labels):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(labels * logp, axis=-1)
+
+
+class RandomUniform(Operation):
+    """nn/ops/RandomUniform.scala — shape input → uniform sample. Uses the
+    module rng (functional: pass rng through apply)."""
+
+    def __init__(self, minval: float = 0.0, maxval: float = 1.0, seed=None,
+                 name=None):
+        super().__init__(name=name)
+        self.minval, self.maxval, self.seed = minval, maxval, seed
+
+    def _apply(self, params, state, x, training, rng):
+        shape = tuple(int(s) for s in np.asarray(_items(x)[0]).reshape(-1))
+        if rng is None:
+            rng = jax.random.PRNGKey(self.seed or 0)
+        return jax.random.uniform(rng, shape, minval=self.minval,
+                                  maxval=self.maxval)
+
+
+class TruncatedNormal(Operation):
+    """nn/ops/TruncatedNormal.scala — shape input → truncated normal."""
+
+    def __init__(self, mean: float = 0.0, stddev: float = 1.0, seed=None,
+                 name=None):
+        super().__init__(name=name)
+        self.mean, self.stddev, self.seed = mean, stddev, seed
+
+    def _apply(self, params, state, x, training, rng):
+        shape = tuple(int(s) for s in np.asarray(_items(x)[0]).reshape(-1))
+        if rng is None:
+            rng = jax.random.PRNGKey(self.seed or 0)
+        return self.mean + self.stddev * jax.random.truncated_normal(
+            rng, -2.0, 2.0, shape)
+
+
+class ModuleToOperation(Operation):
+    """nn/ops/ModuleToOperation.scala — wrap any nn module as an op."""
+
+    def __init__(self, module: Module, name=None):
+        super().__init__(name=name)
+        self.module = module
+
+    def _init_params(self, rng):
+        return self.module._init_params(rng)
+
+    def _init_state(self):
+        return self.module._init_state()
+
+    def _apply(self, params, state, x, training, rng):
+        return self.module._apply(params, state, x, training, rng)
+
+
+class TensorOp(Operation):
+    """nn/ops/TensorOp.scala — chainable pointwise transform built from a
+    function; ``TensorOp.exp().add(1.0)`` style composition."""
+
+    def __init__(self, fn=None, name=None):
+        super().__init__(name=name)
+        self.fn = fn or (lambda t: t)
+
+    def _op(self, a):
+        return self.fn(a)
+
+    def _chain(self, g):
+        f = self.fn
+        return TensorOp(lambda t: g(f(t)), name=self.name)
+
+    def add(self, v):
+        return self._chain(lambda t: t + v)
+
+    def sub(self, v):
+        return self._chain(lambda t: t - v)
+
+    def mul(self, v):
+        return self._chain(lambda t: t * v)
+
+    def div(self, v):
+        return self._chain(lambda t: t / v)
+
+    def exp(self):
+        return self._chain(jnp.exp)
+
+    def log(self):
+        return self._chain(jnp.log)
+
+    def abs(self):
+        return self._chain(jnp.abs)
+
+    def sqrt(self):
+        return self._chain(jnp.sqrt)
+
+    def square(self):
+        return self._chain(jnp.square)
+
+    def pow(self, p):
+        return self._chain(lambda t: jnp.power(t, p))
+
+
+# -- feature-column ops (recommender pipelines) -----------------------------
+
+class BucketizedCol(Operation):
+    """nn/ops/BucketizedCol.scala — numeric → bucket index by boundaries."""
+
+    def __init__(self, boundaries, name=None):
+        super().__init__(name=name)
+        self.boundaries = jnp.asarray(boundaries, jnp.float32)
+
+    def _op(self, a):
+        return jnp.searchsorted(self.boundaries, a.astype(jnp.float32),
+                                side="right").astype(jnp.int32)
+
+
+class CategoricalColHashBucket(Operation):
+    """nn/ops/CategoricalColHashBucket.scala — string/int column → stable
+    hash bucket. Host-side (numpy object arrays), like the reference's
+    driver-side feature columns."""
+
+    def __init__(self, hash_bucket_size: int, name=None):
+        super().__init__(name=name)
+        self.hash_bucket_size = hash_bucket_size
+
+    def _op(self, a):
+        import zlib
+        arr = np.asarray(a)
+        flat = [zlib.crc32(str(x).encode()) % self.hash_bucket_size
+                for x in arr.reshape(-1)]
+        return jnp.asarray(np.array(flat, np.int32).reshape(arr.shape))
+
+
+class CategoricalColVocaList(Operation):
+    """nn/ops/CategoricalColVocaList.scala — vocabulary lookup with optional
+    OOV buckets. Host-side."""
+
+    def __init__(self, vocab, default_value: int = -1, num_oov_buckets: int = 0,
+                 name=None):
+        super().__init__(name=name)
+        self.vocab = {v: i for i, v in enumerate(vocab)}
+        self.default_value = default_value
+        self.num_oov_buckets = num_oov_buckets
+
+    def _op(self, a):
+        import zlib
+        arr = np.asarray(a)
+        n = len(self.vocab)
+
+        def lookup(x):
+            key = x if isinstance(x, str) else str(x)
+            if key in self.vocab:
+                return self.vocab[key]
+            if self.num_oov_buckets > 0:
+                return n + zlib.crc32(key.encode()) % self.num_oov_buckets
+            return self.default_value
+        flat = [lookup(x) for x in arr.reshape(-1)]
+        return jnp.asarray(np.array(flat, np.int32).reshape(arr.shape))
+
+
+class CrossCol(Operation):
+    """nn/ops/CrossCol.scala — hash-cross of several sparse columns.
+    Host-side; inputs are equal-length columns."""
+
+    def __init__(self, hash_bucket_size: int, name=None):
+        super().__init__(name=name)
+        self.hash_bucket_size = hash_bucket_size
+
+    def _op(self, *cols):
+        import zlib
+        arrs = [np.asarray(c) for c in cols]
+        out = []
+        for row in zip(*[a.reshape(-1) for a in arrs]):
+            key = "_X_".join(str(x) for x in row)
+            out.append(zlib.crc32(key.encode()) % self.hash_bucket_size)
+        return jnp.asarray(np.array(out, np.int32).reshape(arrs[0].shape))
+
+
+class IndicatorCol(Operation):
+    """nn/ops/IndicatorCol.scala — category indices → multi-hot row."""
+
+    def __init__(self, feat_len: int, name=None):
+        super().__init__(name=name)
+        self.feat_len = feat_len
+
+    def _op(self, a):
+        oh = jax.nn.one_hot(a.astype(jnp.int32), self.feat_len)
+        if oh.ndim > 2:
+            oh = jnp.max(oh, axis=-2)
+        return oh
+
+
+class Kv2Tensor(Operation):
+    """nn/ops/Kv2Tensor.scala — 'k:v,k:v' strings → dense row. Host-side."""
+
+    def __init__(self, kv_delimiter: str = ",", item_delimiter: str = ":",
+                 feat_len: int = 0, name=None):
+        super().__init__(name=name)
+        self.kv_delimiter, self.item_delimiter = kv_delimiter, item_delimiter
+        self.feat_len = feat_len
+
+    def _op(self, a):
+        arr = np.asarray(a).reshape(-1)
+        out = np.zeros((len(arr), self.feat_len), np.float32)
+        for r, s in enumerate(arr):
+            for item in str(s).split(self.kv_delimiter):
+                if not item:
+                    continue
+                k, _, v = item.partition(self.item_delimiter)
+                idx = int(k)
+                if 0 <= idx < self.feat_len:
+                    out[r, idx] = float(v or 0.0)
+        return jnp.asarray(out)
+
+
+class MkString(Operation):
+    """nn/ops/MkString.scala — join a row's values into one string.
+    Host-side; returns a numpy object array."""
+
+    def __init__(self, str_delimiter: str = ",", name=None):
+        super().__init__(name=name)
+        self.str_delimiter = str_delimiter
+
+    def _op(self, a):
+        arr = np.asarray(a)
+        return np.array([self.str_delimiter.join(str(x) for x in row)
+                         for row in arr.reshape(arr.shape[0], -1)],
+                        dtype=object)
+
+
+class Substr(Operation):
+    """nn/ops/Substr.scala — substring of a string column. Host-side."""
+
+    def __init__(self, pos: int, length: int, name=None):
+        super().__init__(name=name)
+        self.pos, self.length = pos, length
+
+    def _op(self, a):
+        arr = np.asarray(a)
+        return np.array([str(x)[self.pos:self.pos + self.length]
+                         for x in arr.reshape(-1)],
+                        dtype=object).reshape(arr.shape)
+
+
+__all__ = [
+    "Operation", "Equal", "NotEqual", "ApproximateEqual", "Greater",
+    "GreaterEqual", "Less", "LessEqual", "LogicalAnd", "LogicalOr",
+    "LogicalNot", "All", "Any", "Sum", "Prod", "Max", "Min", "Mean",
+    "Exp", "Expm1", "Log1p", "Floor", "Ceil", "Round", "Rint", "Sign",
+    "Inv", "Erf", "Erfc", "Lgamma", "Digamma", "IsFinite", "IsInf",
+    "IsNan", "Pow", "Maximum", "Minimum", "FloorDiv", "FloorMod", "Mod",
+    "TruncateDiv", "SquaredDifference", "Shape", "Rank", "Cast", "Gather",
+    "Select", "Slice", "StridedSlice", "Tile", "OneHot", "TopK", "InTopK",
+    "ArgMax", "BatchMatMul", "SegmentSum", "Pad", "ExpandDims",
+    "SplitAndSelect", "InvertPermutation", "ResizeBilinear", "Dilation2D",
+    "L2Loss", "CrossEntropy", "RandomUniform", "TruncatedNormal",
+    "ModuleToOperation", "TensorOp", "BucketizedCol",
+    "CategoricalColHashBucket", "CategoricalColVocaList", "CrossCol",
+    "IndicatorCol", "Kv2Tensor", "MkString", "Substr",
+]

@@ -1,31 +1,73 @@
-"""Fused flash attention for TPU.
+"""Flash attention dispatch: custom Pallas kernel on TPU, einsum elsewhere.
 
-Uses the Pallas TPU flash-attention kernel (tiled over sequence blocks in
-VMEM, O(T) memory) when running on a TPU backend; the public einsum path in
-``nn.attention`` is the fallback everywhere else (CPU tests, debugging).
-See /opt/skills/guides/pallas_guide.md for the kernel playbook.
+The kernel itself lives in ``bigdl_tpu.kernels.flash_attention`` (hand-written
+Pallas forward + backward, O(T) memory). This module is only the dispatcher:
+
+* TPU-class backends ("tpu", and the axon PJRT plugin's "axon") run the
+  compiled kernel;
+* ``BIGDL_TPU_FLASH=interpret`` forces the same kernel through the Pallas
+  interpreter (how the CPU test suite exercises the kernel code);
+* ``BIGDL_TPU_FLASH=off`` or any non-TPU backend falls back to the reference
+  einsum path in ``nn.attention`` — and the fallback is LOGGED, never silent,
+  so a TPU run that degrades to O(T^2) attention is visible.
 """
 from __future__ import annotations
+
+import logging
+import os
 
 import jax
 import jax.numpy as jnp
 
+logger = logging.getLogger("bigdl_tpu")
+_warned = set()
+
+
+def _warn_once(key, msg, *args):
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning(msg, *args)
+
+
+def _einsum_fallback(q, k, v, causal):
+    import numpy as np
+    from ..nn.attention import dot_product_attention
+    mask = None
+    if causal:
+        t = q.shape[-2]
+        mask = jnp.where(np.tril(np.ones((t, t), np.bool_))[None, None],
+                         0.0, -1e9)
+    return dot_product_attention(q, k, v, mask)
+
 
 def flash_attention(q, k, v, causal: bool = False):
     """q, k, v: (B, H, T, D)."""
+    mode = os.environ.get("BIGDL_TPU_FLASH", "auto")
+    if mode == "off":
+        return _einsum_fallback(q, k, v, causal)
+
+    if mode == "interpret":
+        from ..kernels.flash_attention import flash_attention_fused
+        return flash_attention_fused(q, k, v, causal=causal, interpret=True)
+
     try:
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention as _fa, BlockSizes)
-        t = q.shape[-2]
-        blk = min(512, t)
-        sizes = BlockSizes.get_default()
-        return _fa(q, k, v, causal=causal, block_sizes=sizes)
+        backend = jax.default_backend()
     except Exception:
-        from ..nn.attention import dot_product_attention
-        import numpy as np
-        mask = None
-        if causal:
-            tt = q.shape[-2]
-            mask = jnp.where(np.tril(np.ones((tt, tt), np.bool_))[None, None],
-                             0.0, -1e9)
-        return dot_product_attention(q, k, v, mask)
+        backend = "cpu"
+    if backend in ("tpu", "axon"):
+        try:
+            # import inside the branch: a jax build without pallas must not
+            # break the einsum path for non-TPU callers
+            from ..kernels.flash_attention import flash_attention_fused
+            return flash_attention_fused(q, k, v, causal=causal)
+        except Exception as e:
+            _warn_once(("kernel", backend),
+                       "Pallas flash-attention kernel failed on backend %r "
+                       "(%s); falling back to O(T^2) einsum attention",
+                       backend, e)
+            return _einsum_fallback(q, k, v, causal)
+    _warn_once(("backend", backend),
+               "flash attention: non-TPU backend %r uses the einsum path "
+               "(set BIGDL_TPU_FLASH=interpret to run the Pallas kernel "
+               "in interpreter mode)", backend)
+    return _einsum_fallback(q, k, v, causal)

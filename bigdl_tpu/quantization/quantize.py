@@ -168,9 +168,12 @@ def _quantize_rec(module: Module, params, calibration, path="", used=None):
         for i, child in enumerate(module.modules):
             nm, np_ = _quantize_rec(child, params[str(i)], calibration,
                                     child_path(path, i), used)
+            # containers are rewritten in place (nm is child) but still
+            # return fresh params for quantized descendants — always take
+            # the returned subtree, not only when the object was swapped
+            new_params[str(i)] = np_
             if nm is not child:
                 replacements[i] = nm
-                new_params[str(i)] = np_
         for i, nm in replacements.items():
             old = module.modules[i]
             module.modules[i] = nm

@@ -1,0 +1,182 @@
+"""TF-style op layer tests (parity: reference nn/ops/* behaviors)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import ops
+from bigdl_tpu.utils.table import Table
+
+
+def _f(op, *xs):
+    return np.asarray(op.forward(Table(*xs) if len(xs) > 1 else xs[0]))
+
+
+def test_comparison_and_logical():
+    a = jnp.asarray([1.0, 2.0, 3.0])
+    b = jnp.asarray([1.0, 3.0, 2.0])
+    assert _f(ops.Equal(), a, b).tolist() == [True, False, False]
+    assert _f(ops.NotEqual(), a, b).tolist() == [False, True, True]
+    assert _f(ops.Greater(), a, b).tolist() == [False, False, True]
+    assert _f(ops.LessEqual(), a, b).tolist() == [True, True, False]
+    assert _f(ops.ApproximateEqual(0.5), a, b).tolist() == [True, False, False]
+    t = jnp.asarray([True, False])
+    assert _f(ops.LogicalNot(), t).tolist() == [False, True]
+    assert _f(ops.LogicalAnd(), t, jnp.asarray([True, True])).tolist() == \
+        [True, False]
+
+
+def test_reductions():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    assert _f(ops.Sum(axis=0), x).tolist() == [4.0, 6.0]
+    assert _f(ops.Prod(axis=1), x).tolist() == [2.0, 12.0]
+    assert _f(ops.Max(axis=1), x).tolist() == [2.0, 4.0]
+    assert float(_f(ops.Mean(), x)) == 2.5
+    bools = jnp.asarray([[True, False], [True, True]])
+    assert _f(ops.All(axis=1), bools).tolist() == [False, True]
+    assert _f(ops.Any(axis=0), bools).tolist() == [True, True]
+    # axis via second input (TF style)
+    assert _f(ops.Sum(), x, jnp.asarray([0])).tolist() == [4.0, 6.0]
+
+
+def test_elementwise_math():
+    x = jnp.asarray([0.5, 1.5, -2.5])
+    assert np.allclose(_f(ops.Exp(), x), np.exp([0.5, 1.5, -2.5]))
+    assert np.allclose(_f(ops.Floor(), x), [0.0, 1.0, -3.0])
+    assert np.allclose(_f(ops.Sign(), x), [1.0, 1.0, -1.0])
+    assert np.allclose(_f(ops.SquaredDifference(), x, jnp.zeros(3)),
+                       np.square([0.5, 1.5, -2.5]))
+    assert np.allclose(_f(ops.FloorDiv(), jnp.asarray([7.0]),
+                          jnp.asarray([2.0])), [3.0])
+    assert _f(ops.IsNan(), jnp.asarray([np.nan, 1.0])).tolist() == \
+        [True, False]
+    assert np.allclose(_f(ops.Erf(), jnp.asarray([0.0])), [0.0])
+
+
+def test_shape_cast():
+    x = jnp.zeros((2, 3, 4))
+    assert _f(ops.Shape(), x).tolist() == [2, 3, 4]
+    assert int(_f(ops.Rank(), x)) == 3
+    y = _f(ops.Cast(jnp.int32), jnp.asarray([1.7, 2.2]))
+    assert y.dtype == np.int32 and y.tolist() == [1, 2]
+
+
+def test_gather_select_slice():
+    p = jnp.arange(12.0).reshape(4, 3)
+    idx = jnp.asarray([2, 0])
+    assert np.allclose(_f(ops.Gather(), p, idx), np.asarray(p)[[2, 0]])
+    cond = jnp.asarray([True, False, True])
+    assert _f(ops.Select(), cond, jnp.ones(3), jnp.zeros(3)).tolist() == \
+        [1.0, 0.0, 1.0]
+    s = _f(ops.Slice(begin=[1, 0], size=[2, 2]), p)
+    assert np.allclose(s, np.asarray(p)[1:3, :2])
+    ss = _f(ops.StridedSlice([0, 0], [4, 3], [2, 1]), p)
+    assert np.allclose(ss, np.asarray(p)[::2])
+    shr = _f(ops.StridedSlice([1, 0], [2, 3], shrink_axis_mask=1), p)
+    assert np.allclose(shr, np.asarray(p)[1])
+
+
+def test_tile_onehot_topk():
+    x = jnp.asarray([[1.0, 2.0]])
+    assert _f(ops.Tile([2, 2]), x).shape == (2, 4)
+    oh = _f(ops.OneHot(4), jnp.asarray([0, 3]))
+    assert np.allclose(oh, np.eye(4)[[0, 3]])
+    scores = jnp.asarray([[0.1, 0.9, 0.5], [0.8, 0.2, 0.3]])
+    tk = ops.TopK(2).forward(scores)
+    assert np.asarray(tk[2]).tolist() == [[1, 2], [0, 2]]
+    itk = _f(ops.InTopK(1), scores, jnp.asarray([1, 2]))
+    assert itk.tolist() == [True, False]
+    am = _f(ops.ArgMax(axis=1), scores)
+    assert am.tolist() == [1, 0]
+
+
+def test_batch_matmul_segment_sum():
+    a = jnp.asarray(np.random.RandomState(0).randn(2, 3, 4).astype(np.float32))
+    b = jnp.asarray(np.random.RandomState(1).randn(2, 4, 5).astype(np.float32))
+    out = _f(ops.BatchMatMul(), a, b)
+    assert np.allclose(out, np.matmul(np.asarray(a), np.asarray(b)),
+                       atol=1e-5)
+    outT = _f(ops.BatchMatMul(adj_y=True), a, jnp.swapaxes(b, 1, 2))
+    assert np.allclose(outT, out, atol=1e-5)
+    data = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    seg = jnp.asarray([0, 0, 1])
+    ss = _f(ops.SegmentSum(num_segments=2), data, seg)
+    assert np.allclose(ss, [[4.0, 6.0], [5.0, 6.0]])
+
+
+def test_resize_bilinear():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    out = _f(ops.ResizeBilinear(2, 2), x)
+    assert out.shape == (1, 2, 2, 1)
+    ac = _f(ops.ResizeBilinear(7, 7, align_corners=True), x)
+    assert ac.shape == (1, 7, 7, 1)
+    # align_corners keeps the corner values exactly
+    assert np.isclose(ac[0, 0, 0, 0], 0.0) and np.isclose(ac[0, -1, -1, 0],
+                                                          15.0)
+
+
+def test_dilation2d():
+    x = jnp.zeros((1, 5, 5, 1)).at[0, 2, 2, 0].set(1.0)
+    filt = jnp.zeros((3, 3, 1))
+    out = _f(ops.Dilation2D(strides=[1, 1, 1, 1], rates=[1, 1, 1, 1]),
+             x, filt)
+    assert out.shape == (1, 5, 5, 1)
+    assert float(np.asarray(out)[0, 1:4, 1:4, 0].min()) == 1.0  # dilated peak
+
+
+def test_losses_and_tensor_op():
+    x = jnp.asarray([3.0, 4.0])
+    assert float(_f(ops.L2Loss(), x)) == 12.5
+    logits = jnp.asarray([[2.0, 0.0]])
+    labels = jnp.asarray([[1.0, 0.0]])
+    ce = float(_f(ops.CrossEntropy(), logits, labels)[0])
+    assert np.isclose(ce, -np.log(np.exp(2) / (np.exp(2) + 1)), atol=1e-5)
+    top = ops.TensorOp().exp().add(1.0).log()
+    out = _f(top, jnp.asarray([0.0]))
+    assert np.isclose(out[0], np.log(2.0), atol=1e-6)
+
+
+def test_feature_columns():
+    b = ops.BucketizedCol(boundaries=[0.0, 10.0, 100.0])
+    assert _f(b, jnp.asarray([-5.0, 5.0, 50.0, 500.0])).tolist() == \
+        [0, 1, 2, 3]
+    h = ops.CategoricalColHashBucket(hash_bucket_size=16)
+    out = _f(h, np.array(["a", "b", "a"], dtype=object))
+    assert out[0] == out[2] and 0 <= out.min() and out.max() < 16
+    v = ops.CategoricalColVocaList(["cat", "dog"], num_oov_buckets=2)
+    out = _f(v, np.array(["dog", "bird", "cat"], dtype=object))
+    assert out[0] == 1 and out[2] == 0 and out[1] >= 2
+    c = ops.CrossCol(hash_bucket_size=32)
+    out = np.asarray(c.forward(Table(np.array(["a", "b"], dtype=object),
+                                     np.array(["x", "y"], dtype=object))))
+    assert out.shape == (2,) and (0 <= out).all() and (out < 32).all()
+    ind = ops.IndicatorCol(feat_len=4)
+    out = _f(ind, jnp.asarray([[0, 2]]))
+    assert np.allclose(out, [[1, 0, 1, 0]])
+    kv = ops.Kv2Tensor(feat_len=4)
+    out = _f(kv, np.array(["0:1.5,2:3.0", "1:2.0"], dtype=object))
+    assert np.allclose(out, [[1.5, 0, 3.0, 0], [0, 2.0, 0, 0]])
+    mk = ops.MkString("-")
+    out = mk.forward(np.array([[1, 2], [3, 4]]))
+    assert list(out) == ["1-2", "3-4"]
+    sub = ops.Substr(1, 2)
+    out = sub.forward(np.array(["hello", "world"], dtype=object))
+    assert list(out) == ["el", "or"]
+
+
+def test_random_ops():
+    import jax
+    r = ops.RandomUniform(minval=2.0, maxval=3.0)
+    out = np.asarray(r.apply({}, {}, jnp.asarray([3, 4]), False,
+                             jax.random.PRNGKey(0))[0])
+    assert out.shape == (3, 4) and (out >= 2.0).all() and (out < 3.0).all()
+    t = ops.TruncatedNormal(stddev=1.0)
+    out = np.asarray(t.apply({}, {}, jnp.asarray([100]), False,
+                             jax.random.PRNGKey(1))[0])
+    assert out.shape == (100,) and np.abs(out).max() <= 2.0 + 1e-6
+
+
+def test_module_to_operation():
+    from bigdl_tpu import nn
+    op = ops.ModuleToOperation(nn.ReLU())
+    out = _f(op, jnp.asarray([-1.0, 2.0]))
+    assert out.tolist() == [0.0, 2.0]

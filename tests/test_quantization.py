@@ -168,3 +168,40 @@ def test_fold_then_calibrated_quantize():
     out = np.asarray(q.forward(x))
     err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
     assert err < 0.15, err
+
+
+def test_quantize_nested_containers():
+    """Regression: quantize() must propagate fresh params through containers
+    that are rewritten in place (Concat branches inside Sequential) — r1 lost
+    every quantized-param subtree below depth 1."""
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.quantization import quantize
+    from bigdl_tpu.quantization.quantize import (QuantizedLinear,
+                                                 QuantizedSpatialConvolution)
+    from bigdl_tpu.nn.module import Container
+    branch1 = nn.Sequential(nn.SpatialConvolution(2, 3, 1, 1), nn.ReLU())
+    branch2 = nn.Sequential(nn.SpatialConvolution(2, 5, 3, 3, 1, 1, 1, 1),
+                            nn.ReLU())
+    model = nn.Sequential(
+        nn.SpatialConvolution(1, 2, 3, 3, 1, 1, 1, 1),
+        nn.Concat(2, branch1, branch2),
+        nn.Reshape([8 * 8 * 8], batch_mode=True),
+        nn.Linear(8 * 8 * 8, 4))
+    model.ensure_initialized()
+    model.evaluate()
+    x = np.random.randn(2, 1, 8, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    q = quantize(model)
+
+    def walk(mod, params):
+        if isinstance(mod, (QuantizedLinear, QuantizedSpatialConvolution)):
+            assert "qweight" in params, \
+                f"{type(mod).__name__} kept float params {list(params)}"
+        if isinstance(mod, Container):
+            for i, ch in enumerate(mod.modules):
+                walk(ch, params[str(i)])
+    walk(q, q.params)
+    out = np.asarray(q.forward(x))
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert err < 0.15, err
