@@ -22,6 +22,7 @@ from __future__ import annotations
 import struct
 from typing import Dict, List, Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from .. import nn as N
@@ -199,6 +200,113 @@ def _base_name(inp: str) -> str:
     return inp.split(":")[0]
 
 
+def _out_index(inp: str) -> int:
+    inp = inp.lstrip("^")
+    return int(inp.split(":")[1]) if ":" in inp else 0
+
+
+# ops whose module output is a Table of tensors; consumers select by index
+_MULTI_OUT = {"Split", "SplitV", "Unpack", "Unstack"}
+
+# real frozen graphs compute shape/axis tensors from Consts (Range over a
+# Shape slice, packed dims, ...). Fold those sub-DAGs to Consts up front so
+# the op converters see static values — the TPU-native requirement (static
+# shapes under jit) and the reference's Session-freezing behave the same way.
+_FOLDABLE = {
+    "Identity", "Cast", "Reshape", "Range", "Pack", "ExpandDims", "Squeeze",
+    "ConcatV2", "Concat", "Slice", "StridedSlice", "Add", "AddV2", "Sub",
+    "Mul", "RealDiv", "Floor", "FloorDiv", "Maximum", "Minimum", "Neg",
+    "Shape", "Size", "Rank", "GatherV2", "Gather", "Fill",
+}
+
+
+def _fold_constants(nodes, consts, by_name):
+    changed = True
+    while changed:
+        changed = False
+        for n in nodes:
+            name, op = n["name"], n["op"]
+            if name in consts or op not in _FOLDABLE:
+                continue
+            ins = [i for i in n["inputs"] if not i.startswith("^")]
+            if not ins or not all(_base_name(i) in consts for i in ins):
+                continue
+            vals = [np.asarray(consts[_base_name(i)]) for i in ins]
+            a = n["attrs"]
+            try:
+                consts[name] = _fold_one(op, vals, a)
+                changed = True
+            except Exception:
+                continue
+
+
+def _fold_one(op, vals, attrs):
+    if op == "Identity":
+        return vals[0]
+    if op == "Cast":
+        return vals[0].astype(_DT_NUMPY.get(attrs.get("DstT", 1), np.float32))
+    if op == "Reshape":
+        return vals[0].reshape([int(x) for x in vals[1].reshape(-1)])
+    if op == "Range":
+        return np.arange(int(vals[0]), int(vals[1]), int(vals[2]), np.int32)
+    if op == "Pack":
+        return np.stack(vals, axis=attrs.get("axis", 0))
+    if op == "ExpandDims":
+        return np.expand_dims(vals[0], int(vals[1]))
+    if op == "Squeeze":
+        return np.squeeze(vals[0])
+    if op in ("ConcatV2", "Concat"):
+        axis = int(vals[-1]) if op == "ConcatV2" else int(vals[0])
+        parts = vals[:-1] if op == "ConcatV2" else vals[1:]
+        return np.concatenate(parts, axis=axis)
+    if op == "Slice":
+        begin = vals[1].reshape(-1)
+        size = vals[2].reshape(-1)
+        idx = tuple(slice(int(b), None if s == -1 else int(b) + int(s))
+                    for b, s in zip(begin, size))
+        return vals[0][idx]
+    if op == "StridedSlice":
+        begin, end, strides = [v.reshape(-1) for v in vals[1:4]]
+        shrink = attrs.get("shrink_axis_mask", 0)
+        idx = []
+        for d in range(len(begin)):
+            if (shrink >> d) & 1:
+                idx.append(int(begin[d]))
+            else:
+                idx.append(slice(int(begin[d]), int(end[d]), int(strides[d])))
+        return vals[0][tuple(idx)]
+    if op in ("Add", "AddV2"):
+        return vals[0] + vals[1]
+    if op == "Sub":
+        return vals[0] - vals[1]
+    if op == "Mul":
+        return vals[0] * vals[1]
+    if op == "RealDiv":
+        return vals[0] / vals[1]
+    if op == "Floor":
+        return np.floor(vals[0])
+    if op == "FloorDiv":
+        return np.floor_divide(vals[0], vals[1])
+    if op == "Maximum":
+        return np.maximum(vals[0], vals[1])
+    if op == "Minimum":
+        return np.minimum(vals[0], vals[1])
+    if op == "Neg":
+        return -vals[0]
+    if op == "Shape":
+        return np.asarray(vals[0].shape, np.int32)
+    if op == "Size":
+        return np.asarray(vals[0].size, np.int32)
+    if op == "Rank":
+        return np.asarray(vals[0].ndim, np.int32)
+    if op in ("Gather", "GatherV2"):
+        axis = int(vals[2]) if len(vals) > 2 else 0
+        return np.take(vals[0], vals[1].astype(np.int64), axis=axis)
+    if op == "Fill":
+        return np.full([int(x) for x in vals[0].reshape(-1)], vals[1])
+    raise NotImplementedError(op)
+
+
 def _strides_hw(attrs) -> Tuple[int, int]:
     s = attrs.get("strides", [1, 1, 1, 1])
     if attrs.get("data_format", "NHWC") == "NCHW":
@@ -225,6 +333,7 @@ def load_tf_graph(path_or_bytes, inputs: Optional[List[str]] = None,
     consts: Dict[str, np.ndarray] = {
         n["name"]: n["attrs"].get("value") for n in nodes
         if n["op"] == "Const"}
+    _fold_constants(nodes, consts, by_name)
 
     if inputs is None:
         inputs = [n["name"] for n in nodes if n["op"] == "Placeholder"]
@@ -256,14 +365,27 @@ def load_tf_graph(path_or_bytes, inputs: Optional[List[str]] = None,
             return graph_nodes[name]
         node = by_name[name]
         op, attrs = node["op"], node["attrs"]
-        srcs = [build(i) for i in data_inputs(node)]
+        srcs = [build_output(i) for i in node["inputs"]
+                if not i.startswith("^") and _base_name(i) not in consts]
         cns = const_inputs(node)
         m = _convert_op(node, op, attrs, cns, by_name, consts)
         gn = m(srcs[0] if len(srcs) == 1 else srcs)
         graph_nodes[name] = gn
         return gn
 
-    out_nodes = [build(o) for o in outputs]
+    def build_output(ref: str):
+        """Resolve an input reference, selecting the right output of a
+        multi-output producer (Split/Unpack return a Table)."""
+        base, idx = _base_name(ref), _out_index(ref)
+        gn = build(base)
+        if by_name.get(base, {}).get("op") in _MULTI_OUT:
+            key = f"{base}:{idx}"
+            if key not in graph_nodes:
+                graph_nodes[key] = N.SelectTable(idx + 1)(gn)
+            return graph_nodes[key]
+        return gn
+
+    out_nodes = [build_output(o) for o in outputs]
     g = N.Graph(input_nodes, out_nodes)
     # Graph init re-draws child params; overwrite with the weights each
     # converter loaded onto its module (same pattern as the caffe loader).
@@ -278,6 +400,9 @@ def load_tf_graph(path_or_bytes, inputs: Optional[List[str]] = None,
             state[str(i)] = jax.tree_util.tree_map(jnp.asarray, m.state)
     g.params, g.state = params, state
     g.grad_params = jax.tree_util.tree_map(jnp.zeros_like, params)
+    # frozen GraphDefs are inference graphs: BN must use the loaded moving
+    # stats, dropout must be a no-op
+    g.evaluate()
     return g
 
 
@@ -410,9 +535,196 @@ def _convert_op(node, op, attrs, cns, by_name, consts) -> N.Module:
             if keep:
                 return m
             return N.Sequential(m, N.Squeeze(4), N.Squeeze(3), name=name)
-        raise NotImplementedError(f"Mean over axes {axes}")
+        keep = bool(attrs.get("keep_dims", attrs.get("keepdims", False)))
+        from .. import ops as _ops
+        return _ops.Mean(axis=tuple(axes), keep_dims=keep, name=name)
+    m = _convert_op_extended(node, op, attrs, cns, by_name, consts)
+    if m is not None:
+        return m
     raise NotImplementedError(f"TF op '{op}' (node {name}) not supported; "
                               "supported set in loaders/tensorflow.py")
+
+
+# NHWC dim → NCHW dim for 4-D activations (this loader builds NCHW graphs)
+_NHWC_TO_NCHW = {0: 0, 1: 2, 2: 3, 3: 1, -1: 1}
+
+
+def _tf_axis(axis: int, ndim_hint: int) -> int:
+    """Map a TF NHWC axis to our NCHW layout when the activation is 4-D."""
+    if ndim_hint == 4:
+        return _NHWC_TO_NCHW.get(axis, axis)
+    return axis
+
+
+class _TFSplit(N.Module):
+    """tf Split with NHWC axis semantics: remap to NCHW only when the
+    activation is 4-D (this loader's graphs carry NCHW activations)."""
+
+    def __init__(self, num_split, axis, name=None):
+        super().__init__(name=name)
+        self.num_split, self.axis = num_split, axis
+
+    def _apply(self, params, state, x, training, rng):
+        from ..utils.table import Table
+        ax = _tf_axis(self.axis, x.ndim)
+        return Table(*jnp.split(x, self.num_split, axis=ax))
+
+
+class _ConstBinary(N.Module):
+    """Binary elementwise op with one baked constant operand (the TF graph
+    had a Const input). The constant is stored NCHW-permuted when 4-D."""
+
+    def __init__(self, fn, const, const_is_lhs=False, name=None):
+        super().__init__(name=name)
+        self.fn = fn
+        self.const = jnp.asarray(const)
+        self.const_is_lhs = const_is_lhs
+
+    def _apply(self, params, state, x, training, rng):
+        c = self.const
+        if x.ndim == 4 and c.ndim == 1 and c.shape[0] == x.shape[1]:
+            c = c.reshape(-1, 1, 1)  # channel vector on NCHW activations
+        return self.fn(c, x) if self.const_is_lhs else self.fn(x, c)
+
+
+def _convert_op_extended(node, op, attrs, cns, by_name, consts):
+    """Round-2 op-set growth toward the reference's nn/ops coverage
+    (spark/dl/.../nn/ops/*.scala): elementwise math, comparisons, gather/
+    select/tile/strided-slice, batched matmul, resize, split/pack."""
+    from .. import ops as OPS2
+    import jax.numpy as _jnp
+    name = node["name"]
+
+    simple = {
+        "Sqrt": N.Sqrt, "Square": N.Square, "Neg": N.Negative, "Abs": N.Abs,
+        "Exp": N.Exp, "Log": N.Log, "Elu": N.ELU, "Softplus": N.SoftPlus,
+        "Softsign": N.SoftSign, "LogSoftmax": N.LogSoftMax,
+        "Erf": OPS2.Erf, "Erfc": OPS2.Erfc, "Floor": OPS2.Floor,
+        "Ceil": OPS2.Ceil, "Round": OPS2.Round, "Rint": OPS2.Rint,
+        "Sign": OPS2.Sign, "Expm1": OPS2.Expm1, "Log1p": OPS2.Log1p,
+        "IsFinite": OPS2.IsFinite, "IsInf": OPS2.IsInf, "IsNan": OPS2.IsNan,
+        "Reciprocal": OPS2.Inv, "Inv": OPS2.Inv,
+        "InvertPermutation": OPS2.InvertPermutation,
+    }
+    if op in simple:
+        return simple[op](name=name)
+    if op == "Rsqrt":
+        return OPS2.TensorOp(lambda t: 1.0 / _jnp.sqrt(t), name=name)
+    if op == "LeakyRelu":
+        return N.LeakyReLU(negval=float(attrs.get("alpha", 0.2)), name=name)
+
+    two_input = {
+        "Equal": OPS2.Equal, "NotEqual": OPS2.NotEqual,
+        "Greater": OPS2.Greater, "GreaterEqual": OPS2.GreaterEqual,
+        "Less": OPS2.Less, "LessEqual": OPS2.LessEqual,
+        "LogicalAnd": OPS2.LogicalAnd, "LogicalOr": OPS2.LogicalOr,
+        "SquaredDifference": OPS2.SquaredDifference, "Pow": OPS2.Pow,
+        "FloorDiv": OPS2.FloorDiv, "FloorMod": OPS2.FloorMod,
+        "Mod": OPS2.Mod, "TruncateDiv": OPS2.TruncateDiv,
+    }
+    if op in two_input:
+        if cns:  # one side constant
+            # work out whether the const was lhs or rhs
+            lhs_const = _base_name(node["inputs"][0]) in consts
+            cls = two_input[op]
+            fn = cls()._op
+            return _ConstBinary(fn, cns[0], const_is_lhs=lhs_const, name=name)
+        return two_input[op](name=name)
+    if op == "LogicalNot":
+        return OPS2.LogicalNot(name=name)
+
+    if op in ("RealDiv", "Div", "Maximum", "Minimum"):
+        fn = {"RealDiv": _jnp.divide, "Div": _jnp.divide,
+              "Maximum": _jnp.maximum, "Minimum": _jnp.minimum}[op]
+        if cns:
+            lhs_const = _base_name(node["inputs"][0]) in consts
+            return _ConstBinary(fn, cns[0], const_is_lhs=lhs_const, name=name)
+        table = {"RealDiv": N.CDivTable, "Div": N.CDivTable,
+                 "Maximum": N.CMaxTable, "Minimum": N.CMinTable}[op]
+        return table(name=name)
+    if op == "AddN":
+        return N.CAddTable(name=name)
+
+    if op == "Cast":
+        return OPS2.Cast(_DT_NUMPY.get(attrs.get("DstT", 1), np.float32),
+                         name=name)
+    if op in ("Gather", "GatherV2"):
+        axis = int(cns[-1].reshape(())) if (op == "GatherV2" and
+                                            len(cns) > 0 and
+                                            cns[-1].size == 1) else 0
+        if _base_name(node["inputs"][1]) in consts:
+            # constant indices: bake them in, input is params
+            idx = np.asarray(cns[0]).astype(np.int32)
+            return OPS2.TensorOp(
+                lambda t, _i=idx, _a=axis: _jnp.take(t, _i, axis=_a),
+                name=name)
+        return OPS2.Gather(axis=axis, name=name)
+    if op in ("Select", "SelectV2"):
+        return OPS2.Select(name=name)
+    if op == "Tile":
+        mult = [int(x) for x in cns[0].reshape(-1)]
+        return OPS2.Tile(mult, name=name)
+    if op == "StridedSlice":
+        begin, end, strides = [list(np.asarray(c).reshape(-1).astype(int))
+                               for c in cns[:3]]
+        return OPS2.StridedSlice(
+            begin, end, strides,
+            shrink_axis_mask=attrs.get("shrink_axis_mask", 0),
+            begin_mask=attrs.get("begin_mask", 0),
+            end_mask=attrs.get("end_mask", 0), name=name)
+    if op == "ExpandDims":
+        return OPS2.ExpandDims(int(cns[0].reshape(())), name=name)
+    if op == "Transpose":
+        perm = [int(x) for x in cns[0].reshape(-1)]
+        return OPS2.TensorOp(
+            lambda t, _p=tuple(perm): _jnp.transpose(t, _p), name=name)
+    if op == "ArgMax":
+        axis = int(cns[0].reshape(())) if cns else 0
+        return OPS2.ArgMax(axis=axis, name=name)
+    if op == "OneHot":
+        depth = int(cns[0].reshape(()))
+        on = float(cns[1].reshape(())) if len(cns) > 1 else 1.0
+        off = float(cns[2].reshape(())) if len(cns) > 2 else 0.0
+        return OPS2.OneHot(depth, on, off, axis=attrs.get("axis", -1),
+                           name=name)
+    if op in ("BatchMatMul", "BatchMatMulV2"):
+        return OPS2.BatchMatMul(adj_x=bool(attrs.get("adj_x", False)),
+                                adj_y=bool(attrs.get("adj_y", False)),
+                                name=name)
+    if op == "ResizeBilinear":
+        oh, ow = [int(x) for x in cns[0].reshape(-1)]
+        return OPS2.ResizeBilinear(
+            oh, ow, align_corners=bool(attrs.get("align_corners", False)),
+            data_format="NCHW", name=name)
+    if op == "LRN":
+        radius = int(attrs.get("depth_radius", 5))
+        size = 2 * radius + 1
+        # TF alpha is per-element; ours (caffe-style) divides by size
+        alpha = float(attrs.get("alpha", 1.0)) * size
+        return N.SpatialCrossMapLRN(size, alpha,
+                                    float(attrs.get("beta", 0.5)),
+                                    float(attrs.get("bias", 1.0)), name=name)
+    if op in ("Split", "SplitV"):
+        num = int(attrs.get("num_split", 1))
+        if op == "Split":
+            axis = int(cns[0].reshape(())) if cns else 0
+        else:
+            axis = int(cns[-1].reshape(())) if cns else 0
+        return _TFSplit(num, axis, name=name)
+    if op in ("Pack", "Stack"):
+        return OPS2.Pack(axis=attrs.get("axis", 0), name=name)
+    if op in ("Unpack", "Unstack"):
+        return OPS2.Unpack(int(attrs.get("num", 1)),
+                           axis=attrs.get("axis", 0), name=name)
+    if op == "SegmentSum":
+        return OPS2.SegmentSum(name=name)
+    if op in ("Sum", "Prod", "Max", "Min", "All", "Any"):
+        axes = tuple(int(x) for x in cns[0].reshape(-1)) if cns else None
+        keep = bool(attrs.get("keep_dims", attrs.get("keepdims", False)))
+        cls = {"Sum": OPS2.Sum, "Prod": OPS2.Prod, "Max": OPS2.Max,
+               "Min": OPS2.Min, "All": OPS2.All, "Any": OPS2.Any}[op]
+        return cls(axis=axes, keep_dims=keep, name=name)
+    return None
 
 
 def _is_2d_activation(node, by_name, consts) -> bool:

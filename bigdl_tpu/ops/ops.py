@@ -384,6 +384,40 @@ class InvertPermutation(Operation):
         return jnp.argsort(p.astype(jnp.int32)).astype(jnp.int32)
 
 
+class Pack(Operation):
+    """tf Pack/Stack (nn/tf/ArrayOps family) — stack inputs on ``axis``."""
+
+    def __init__(self, axis: int = 0, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def _op(self, *xs):
+        return jnp.stack(list(xs), axis=self.axis)
+
+
+class Split(Operation):
+    """tf Split — equal split along ``axis``; returns a Table of pieces."""
+
+    def __init__(self, num_split: int, axis: int = 0, name=None):
+        super().__init__(name=name)
+        self.num_split, self.axis = num_split, axis
+
+    def _op(self, a):
+        return Table(*jnp.split(a, self.num_split, axis=self.axis))
+
+
+class Unpack(Operation):
+    """tf Unpack/Unstack — split along ``axis`` and squeeze it; Table out."""
+
+    def __init__(self, num: int, axis: int = 0, name=None):
+        super().__init__(name=name)
+        self.num, self.axis = num, axis
+
+    def _op(self, a):
+        pieces = jnp.split(a, self.num, axis=self.axis)
+        return Table(*[jnp.squeeze(p, axis=self.axis) for p in pieces])
+
+
 class ResizeBilinear(Operation):
     """nn/ops/ResizeBilinear.scala — NHWC bilinear resize via jax.image
     (lowers to XLA gather/dot, TPU-tiled)."""
@@ -727,7 +761,8 @@ __all__ = [
     "TruncateDiv", "SquaredDifference", "Shape", "Rank", "Cast", "Gather",
     "Select", "Slice", "StridedSlice", "Tile", "OneHot", "TopK", "InTopK",
     "ArgMax", "BatchMatMul", "SegmentSum", "Pad", "ExpandDims",
-    "SplitAndSelect", "InvertPermutation", "ResizeBilinear", "Dilation2D",
+    "SplitAndSelect", "InvertPermutation", "Pack", "Split", "Unpack",
+    "ResizeBilinear", "Dilation2D",
     "L2Loss", "CrossEntropy", "RandomUniform", "TruncatedNormal",
     "ModuleToOperation", "TensorOp", "BucketizedCol",
     "CategoricalColHashBucket", "CategoricalColVocaList", "CrossCol",

@@ -379,7 +379,7 @@ def test_tf_unsupported_op_raises():
     from bigdl_tpu.loaders import load_tf_graph
     gd = b"".join([
         _tf_node("x", "Placeholder"),
-        _tf_node("y", "Erf", ["x"]),
+        _tf_node("y", "SomeFakeOpV9", ["x"]),
     ])
     with pytest.raises(NotImplementedError):
         load_tf_graph(gd)
@@ -532,3 +532,143 @@ def test_tf_rank_changing_reshape_order():
     out = np.asarray(m.forward(x))
     expect = np.transpose(x, (0, 2, 3, 1)).reshape(2, 4, 3)
     assert np.allclose(out, expect)
+
+
+# ---------------------------------------------------------------------------
+# TF export (tf_saver) round-trips + extended op set
+# ---------------------------------------------------------------------------
+
+
+def test_tf_save_load_roundtrip_lenet():
+    """save_tf_graph -> load_tf_graph reproduces LeNet-5 outputs."""
+    import numpy as np
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.loaders.tf_saver import save_tf_graph
+    from bigdl_tpu.loaders.tensorflow import load_tf_graph
+    model = LeNet5(10)
+    model.ensure_initialized()
+    model.evaluate()
+    data = save_tf_graph(model, input_shape=(1, 28, 28))
+    loaded = load_tf_graph(data)
+    x = np.random.randn(2, 28, 28).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    out = np.asarray(loaded.forward(x.reshape(2, 1, 28, 28)))
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+def test_tf_save_load_roundtrip_conv_bn_concat():
+    """BN + LRN + Concat branches + SAME pools survive the round trip."""
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.loaders.tf_saver import save_tf_graph
+    from bigdl_tpu.loaders.tensorflow import load_tf_graph
+    branch1 = nn.Sequential(
+        nn.SpatialConvolution(4, 6, 1, 1), nn.ReLU())
+    branch2 = nn.Sequential(
+        nn.SpatialConvolution(4, 6, 3, 3, 1, 1, 1, 1), nn.ReLU())
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, -1, -1),
+        nn.SpatialBatchNormalization(4),
+        nn.ReLU(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1),
+        nn.Concat(2, branch1, branch2),
+        nn.SpatialAveragePooling(1, 1, global_pooling=True),
+        nn.View(12),
+        nn.Linear(12, 5),
+        nn.LogSoftMax())
+    model.training()
+    import numpy as _np
+    for _ in range(2):  # populate BN running stats
+        model.forward(_np.random.randn(4, 3, 8, 8).astype(_np.float32))
+    model.evaluate()
+    data = save_tf_graph(model, input_shape=(3, 8, 8))
+    loaded = load_tf_graph(data)
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    out = np.asarray(loaded.forward(x))
+    assert np.allclose(out, ref, atol=1e-3), np.abs(out - ref).max()
+
+
+def test_tf_save_load_roundtrip_residual():
+    """ConcatTable + CAddTable (residual block) exports to AddV2."""
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.loaders.tf_saver import save_tf_graph
+    from bigdl_tpu.loaders.tensorflow import load_tf_graph
+    block = nn.Sequential(
+        nn.ConcatTable(
+            nn.Sequential(nn.SpatialConvolution(3, 3, 3, 3, 1, 1, 1, 1),
+                          nn.ReLU()),
+            nn.Identity()),
+        nn.CAddTable(),
+        nn.ReLU())
+    block.ensure_initialized()
+    block.evaluate()
+    data = save_tf_graph(block, input_shape=(3, 6, 6))
+    loaded = load_tf_graph(data)
+    x = np.random.randn(2, 3, 6, 6).astype(np.float32)
+    ref = np.asarray(block.forward(x))
+    out = np.asarray(loaded.forward(x))
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+def test_tf_loader_extended_ops_and_folding():
+    """Round-2 op growth: elementwise/comparison ops load, and const
+    sub-DAGs (Shape->Range style) fold to Consts up front."""
+    from bigdl_tpu.loaders import load_tf_graph
+    from bigdl_tpu.loaders.tf_saver import _attr_tensor, _attr_type
+    from bigdl_tpu.loaders import wire as W
+
+    def _t(arr):
+        from bigdl_tpu.loaders.tf_saver import _tensor_proto
+        return W.field_bytes(8, _tensor_proto(np.asarray(arr)))
+
+    gd = b"".join([
+        _tf_node("x", "Placeholder"),
+        _tf_node("sq", "Square", ["x"]),
+        _tf_node("half", "Const", value=_t(np.float32(0.5))),
+        _tf_node("scaled", "Mul", ["sq", "half"]),
+        _tf_node("r", "Rsqrt", ["scaled"]),
+        _tf_node("out", "Neg", ["r"]),
+    ])
+    m = load_tf_graph(gd)
+    x = np.random.RandomState(0).rand(2, 3).astype(np.float32) + 0.5
+    out = np.asarray(m.forward(x))
+    ref = -1.0 / np.sqrt(0.5 * x ** 2)
+    assert np.allclose(out, ref, atol=1e-5), np.abs(out - ref).max()
+
+    # const folding: Range(0, Rank-const, 1) style chain becomes a Const
+    gd2 = b"".join([
+        _tf_node("x", "Placeholder"),
+        _tf_node("c0", "Const", value=_t(np.int32(0))),
+        _tf_node("c2", "Const", value=_t(np.int32(2))),
+        _tf_node("c1", "Const", value=_t(np.int32(1))),
+        _tf_node("axes", "Range", ["c0", "c2", "c1"]),
+        _tf_node("s", "Sum", ["x", "axes"]),
+    ])
+    m2 = load_tf_graph(gd2)
+    x2 = np.arange(6.0).reshape(2, 3).astype(np.float32)
+    assert np.isclose(float(np.asarray(m2.forward(x2))), 15.0)
+
+
+def test_tf_loader_split_multi_output():
+    """Split produces a Table; consumers select outputs by :index."""
+    from bigdl_tpu.loaders import load_tf_graph
+    from bigdl_tpu.loaders import wire as W
+
+    def _t(arr):
+        from bigdl_tpu.loaders.tf_saver import _tensor_proto
+        return W.field_bytes(8, _tensor_proto(np.asarray(arr)))
+
+    gd = b"".join([
+        _tf_node("x", "Placeholder"),
+        _tf_node("axis", "Const", value=_t(np.int32(1))),
+        _tf_node("split", "Split", ["axis", "x"],
+                 num_split=W.field_varint(3, 2)),
+        _tf_node("out", "Sub", ["split", "split:1"]),
+    ])
+    m = load_tf_graph(gd)
+    x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert np.allclose(out, x[:, :3] - x[:, 3:], atol=1e-6)
