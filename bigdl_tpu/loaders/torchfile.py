@@ -259,3 +259,185 @@ def _to_module(obj):
 def load_torch(path: str):
     """Module.loadTorch parity — read a .t7 model file and convert."""
     return _to_module(load_t7(path))
+
+
+# ---------------------------------------------------------------------------
+# Torch7 .t7 serialization writer (save side)
+# Parity: reference ``utils/TorchFile.scala`` saveTorch / Module.saveTorch.
+# ---------------------------------------------------------------------------
+
+_DTYPE_TENSOR_NAMES = {
+    np.dtype(np.float64): ("torch.DoubleTensor", "torch.DoubleStorage"),
+    np.dtype(np.float32): ("torch.FloatTensor", "torch.FloatStorage"),
+    np.dtype(np.int64): ("torch.LongTensor", "torch.LongStorage"),
+    np.dtype(np.int32): ("torch.IntTensor", "torch.IntStorage"),
+    np.dtype(np.int16): ("torch.ShortTensor", "torch.ShortStorage"),
+    np.dtype(np.int8): ("torch.CharTensor", "torch.CharStorage"),
+    np.dtype(np.uint8): ("torch.ByteTensor", "torch.ByteStorage"),
+}
+
+
+class _Writer:
+    def __init__(self, f):
+        self.f = f
+        self._next_index = 1
+
+    def _fresh(self):
+        i = self._next_index
+        self._next_index += 1
+        return i
+
+    def write_int(self, v):
+        self.f.write(struct.pack("<i", int(v)))
+
+    def write_long(self, v):
+        self.f.write(struct.pack("<q", int(v)))
+
+    def write_double(self, v):
+        self.f.write(struct.pack("<d", float(v)))
+
+    def write_string(self, s):
+        b = s.encode("utf-8")
+        self.write_int(len(b))
+        self.f.write(b)
+
+    def write_object(self, v):
+        if v is None:
+            self.write_int(TYPE_NIL)
+        elif isinstance(v, bool):
+            self.write_int(TYPE_BOOLEAN)
+            self.write_int(1 if v else 0)
+        elif isinstance(v, (int, float)):
+            self.write_int(TYPE_NUMBER)
+            self.write_double(v)
+        elif isinstance(v, str):
+            self.write_int(TYPE_STRING)
+            self.write_string(v)
+        elif isinstance(v, np.ndarray):
+            self._write_tensor(v)
+        elif isinstance(v, TorchObject):
+            self.write_int(TYPE_TORCH)
+            self.write_int(self._fresh())
+            self.write_string("V 1")
+            self.write_string(v.torch_typename)
+            self.write_object(v.obj)
+        elif isinstance(v, dict):
+            self.write_int(TYPE_TABLE)
+            self.write_int(self._fresh())
+            self.write_int(len(v))
+            for k, val in v.items():
+                self.write_object(k)
+                self.write_object(val)
+        else:
+            raise TypeError(f"t7 writer: unsupported type {type(v)}")
+
+    def _write_tensor(self, arr):
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype not in _DTYPE_TENSOR_NAMES:
+            arr = arr.astype(np.float32)
+        tname, sname = _DTYPE_TENSOR_NAMES[arr.dtype]
+        self.write_int(TYPE_TORCH)
+        self.write_int(self._fresh())
+        self.write_string("V 1")
+        self.write_string(tname)
+        self.write_int(arr.ndim)
+        for d in arr.shape:
+            self.write_long(d)
+        # contiguous strides in elements
+        stride = 1
+        strides = []
+        for d in reversed(arr.shape):
+            strides.append(stride)
+            stride *= d
+        for s in reversed(strides):
+            self.write_long(s)
+        self.write_long(1)  # storage offset (1-based)
+        # storage
+        self.write_int(TYPE_TORCH)
+        self.write_int(self._fresh())
+        self.write_string("V 1")
+        self.write_string(sname)
+        self.write_long(arr.size)
+        self.f.write(arr.tobytes())
+
+
+def save_t7(obj, path: str) -> None:
+    """Write python objects (numpy arrays as torch tensors) to a .t7 file."""
+    with open(path, "wb") as f:
+        _Writer(f).write_object(obj)
+
+
+def _np(v):
+    return None if v is None else np.asarray(v, np.float32)
+
+
+def _from_module(m, params, state):
+    """bigdl_tpu module → TorchObject tree the legacy format understands."""
+    from .. import nn as N
+    t = type(m).__name__
+
+    if isinstance(m, N.Sequential):
+        mods = {}
+        for i, child in enumerate(m.modules):
+            mods[i + 1] = _from_module(child, params.get(str(i), {}),
+                                       state.get(str(i), {}))
+        return TorchObject("nn.Sequential", {"modules": mods})
+    if type(m) is N.Linear:
+        obj = {"weight": _np(params["weight"])}
+        if m.with_bias:
+            obj["bias"] = _np(params["bias"]).reshape(-1)
+        return TorchObject("nn.Linear", obj)
+    if isinstance(m, N.SpatialConvolution):
+        if m.n_group != 1:
+            raise NotImplementedError("t7 export: grouped conv unsupported")
+        obj = {"weight": _np(params["weight"]),
+               "nOutputPlane": m.n_output_plane,
+               "nInputPlane": m.n_input_plane,
+               "kW": m.kernel_w, "kH": m.kernel_h,
+               "dW": m.stride_w, "dH": m.stride_h,
+               "padW": m.pad_w, "padH": m.pad_h}
+        if m.with_bias:
+            obj["bias"] = _np(params["bias"]).reshape(-1)
+        return TorchObject("nn.SpatialConvolution", obj)
+    if isinstance(m, N.SpatialMaxPooling):
+        return TorchObject("nn.SpatialMaxPooling", {
+            "kW": m.kw, "kH": m.kh, "dW": m.dw, "dH": m.dh,
+            "padW": m.pad_w, "padH": m.pad_h,
+            "ceil_mode": bool(getattr(m, "ceil_mode", False))})
+    if isinstance(m, N.SpatialAveragePooling):
+        return TorchObject("nn.SpatialAveragePooling", {
+            "kW": m.kw, "kH": m.kh, "dW": m.dw, "dH": m.dh,
+            "padW": m.pad_w, "padH": m.pad_h})
+    if isinstance(m, N.SpatialBatchNormalization):
+        obj = {"nOutput": m.n_output, "eps": float(m.eps),
+               "momentum": float(m.momentum),
+               "running_mean": _np(state.get("running_mean")),
+               "running_var": _np(state.get("running_var"))}
+        if m.affine:
+            obj["weight"] = _np(params.get("weight"))
+            obj["bias"] = _np(params.get("bias"))
+        return TorchObject("nn.SpatialBatchNormalization", obj)
+    simple = {"ReLU": "nn.ReLU", "Tanh": "nn.Tanh", "Sigmoid": "nn.Sigmoid",
+              "LogSoftMax": "nn.LogSoftMax", "SoftMax": "nn.SoftMax",
+              "Identity": "nn.Identity"}
+    if t in simple:
+        return TorchObject(simple[t], {})
+    if isinstance(m, N.Dropout):
+        return TorchObject("nn.Dropout", {"p": float(m.p)})
+    if isinstance(m, N.View):
+        return TorchObject("nn.View",
+                           {"size": np.asarray(m.sizes, np.int64)})
+    if isinstance(m, N.Reshape):
+        return TorchObject("nn.Reshape",
+                           {"size": np.asarray(m.size, np.int64)})
+    raise NotImplementedError(f"t7 export: module {t} unsupported")
+
+
+def save_torch(model, path: str) -> None:
+    """Module.saveTorch parity — write a model as a legacy torch .t7 file.
+
+    Round trip: ``load_torch(path)`` rebuilds the model with identical
+    outputs. Covers the same module set the reader converts.
+    """
+    model.ensure_initialized()
+    save_t7(_from_module(model, model.params, model.state), path)

@@ -672,3 +672,137 @@ def test_tf_loader_split_multi_output():
     x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
     out = np.asarray(m.forward(x))
     assert np.allclose(out, x[:, :3] - x[:, 3:], atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Caffe export (caffe_persister) round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_caffe_save_load_roundtrip_convnet(tmp_path):
+    """save_caffe -> load_caffe reproduces a conv/pool/fc net's outputs."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.loaders.caffe_persister import save_caffe
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.SpatialAveragePooling(1, 1, global_pooling=True),
+        nn.View(4),
+        nn.Linear(4, 5),
+        nn.SoftMax())
+    model.ensure_initialized()
+    model.evaluate()
+    pp = str(tmp_path / "net.prototxt")
+    mp = str(tmp_path / "net.caffemodel")
+    save_caffe(model, pp, mp, input_shape=(3, 8, 8))
+    g = load_caffe(pp, mp).evaluate()
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    out = np.asarray(g.forward(x))
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+def test_caffe_save_load_roundtrip_inception_block(tmp_path):
+    """BN(+Scale pair), LRN, Dropout and Concat branches survive the trip."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.loaders.caffe_persister import save_caffe
+    branch1 = nn.Sequential(nn.SpatialConvolution(4, 6, 1, 1), nn.ReLU())
+    branch2 = nn.Sequential(
+        nn.SpatialConvolution(4, 6, 3, 3, 1, 1, 1, 1), nn.ReLU())
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(4),
+        nn.ReLU(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        nn.Concat(2, branch1, branch2),
+        nn.Dropout(0.4),
+        nn.SpatialAveragePooling(1, 1, global_pooling=True),
+        nn.View(12),
+        nn.Linear(12, 5),
+        nn.LogSoftMax())
+    model.training()
+    for _ in range(2):  # populate BN running stats
+        model.forward(np.random.randn(4, 3, 8, 8).astype(np.float32))
+    model.evaluate()
+    pp = str(tmp_path / "net.prototxt")
+    mp = str(tmp_path / "net.caffemodel")
+    save_caffe(model, pp, mp, input_shape=(3, 8, 8))
+    g = load_caffe(pp, mp).evaluate()
+    x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    out = np.asarray(g.forward(x))
+    assert np.allclose(out, ref, atol=1e-3), np.abs(out - ref).max()
+
+
+def test_caffe_save_load_roundtrip_residual(tmp_path):
+    """ConcatTable + CAddTable (residual block) exports to Eltwise SUM."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.loaders.caffe_persister import save_caffe
+    model = nn.Sequential(
+        nn.ConcatTable(
+            nn.Sequential(nn.SpatialConvolution(3, 3, 3, 3, 1, 1, 1, 1),
+                          nn.ReLU()),
+            nn.Identity()),
+        nn.CAddTable(),
+        nn.ReLU(),
+        nn.SpatialAveragePooling(1, 1, global_pooling=True),
+        nn.View(3),
+        nn.Linear(3, 2))
+    model.ensure_initialized()
+    model.evaluate()
+    pp = str(tmp_path / "res.prototxt")
+    mp = str(tmp_path / "res.caffemodel")
+    save_caffe(model, pp, mp, input_shape=(3, 6, 6))
+    g = load_caffe(pp, mp).evaluate()
+    x = np.random.RandomState(2).randn(2, 3, 6, 6).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    out = np.asarray(g.forward(x))
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+# ---------------------------------------------------------------------------
+# Torch t7 export (save_torch / save_t7) round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_t7_save_load_roundtrip_convnet(tmp_path):
+    """save_torch -> load_torch reproduces a conv/pool/fc net's outputs."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.loaders.torchfile import save_torch
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(4),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.View(4 * 4 * 4),
+        nn.Linear(4 * 4 * 4, 5),
+        nn.LogSoftMax())
+    model.training()
+    for _ in range(2):  # populate BN running stats
+        model.forward(np.random.randn(4, 3, 8, 8).astype(np.float32))
+    model.evaluate()
+    path = str(tmp_path / "net.t7")
+    save_torch(model, path)
+    loaded = load_torch(path).evaluate()
+    x = np.random.RandomState(3).randn(2, 3, 8, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    out = np.asarray(loaded.forward(x))
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+def test_t7_save_load_raw_objects(tmp_path):
+    """save_t7/load_t7 round-trips tables, numbers, strings, tensors."""
+    from bigdl_tpu.loaders.torchfile import save_t7
+    arr = np.arange(12, dtype=np.float64).reshape(3, 4)
+    ints = np.array([2, 5], dtype=np.int64)
+    path = str(tmp_path / "obj.t7")
+    save_t7({"x": arr, "n": 7, "s": "hello", "flag": True,
+             "sub": {"ints": ints}}, path)
+    obj = load_t7(path)
+    assert obj["n"] == 7
+    assert obj["s"] == "hello"
+    assert obj["flag"] is True
+    assert np.allclose(obj["x"], arr)
+    assert obj["x"].dtype == np.float64
+    assert np.array_equal(obj["sub"]["ints"], ints)
