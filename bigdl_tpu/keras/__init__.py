@@ -21,3 +21,5 @@ from .layers import (Dense, Activation, Dropout, Flatten, Reshape, Permute,
 Conv2D = Convolution2D
 Conv1D = Convolution1D
 Conv3D = Convolution3D
+from .converter import (model_from_json, load_keras, load_weights,
+                        load_weights_hdf5)

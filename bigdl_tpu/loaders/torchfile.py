@@ -214,7 +214,8 @@ def _to_module(obj):
     if t == "nn.SpatialAveragePooling":
         return N.SpatialAveragePooling(int(g("kW")), int(g("kH")),
                                        int(g("dW", 1)), int(g("dH", 1)),
-                                       int(g("padW", 0)), int(g("padH", 0)))
+                                       int(g("padW", 0)), int(g("padH", 0)),
+                                       ceil_mode=bool(g("ceil_mode")))
     if t == "nn.ReLU":
         return N.ReLU()
     if t == "nn.Tanh":
@@ -390,6 +391,13 @@ def _from_module(m, params, state):
     if isinstance(m, N.SpatialConvolution):
         if m.n_group != 1:
             raise NotImplementedError("t7 export: grouped conv unsupported")
+        if getattr(m, "dilation_w", 1) != 1 or getattr(m, "dilation_h",
+                                                       1) != 1:
+            raise NotImplementedError("t7 export: dilated conv has no "
+                                      "legacy-torch analog")
+        if getattr(m, "format", "NCHW") != "NCHW":
+            raise NotImplementedError("t7 export: NHWC conv unsupported "
+                                      "(legacy torch is NCHW-only)")
         obj = {"weight": _np(params["weight"]),
                "nOutputPlane": m.n_output_plane,
                "nInputPlane": m.n_input_plane,
@@ -399,15 +407,27 @@ def _from_module(m, params, state):
         if m.with_bias:
             obj["bias"] = _np(params["bias"]).reshape(-1)
         return TorchObject("nn.SpatialConvolution", obj)
+    if isinstance(m, (N.SpatialMaxPooling, N.SpatialAveragePooling)):
+        if getattr(m, "format", "NCHW") != "NCHW":
+            raise NotImplementedError("t7 export: NHWC pooling unsupported "
+                                      "(legacy torch is NCHW-only)")
     if isinstance(m, N.SpatialMaxPooling):
         return TorchObject("nn.SpatialMaxPooling", {
             "kW": m.kw, "kH": m.kh, "dW": m.dw, "dH": m.dh,
             "padW": m.pad_w, "padH": m.pad_h,
             "ceil_mode": bool(getattr(m, "ceil_mode", False))})
     if isinstance(m, N.SpatialAveragePooling):
+        if getattr(m, "global_pooling", False):
+            raise NotImplementedError("t7 export: global average pooling "
+                                      "has no legacy-torch analog — use an "
+                                      "explicit kernel size")
+        if not getattr(m, "count_include_pad", True):
+            raise NotImplementedError("t7 export: count_include_pad=False "
+                                      "unsupported")
         return TorchObject("nn.SpatialAveragePooling", {
             "kW": m.kw, "kH": m.kh, "dW": m.dw, "dH": m.dh,
-            "padW": m.pad_w, "padH": m.pad_h})
+            "padW": m.pad_w, "padH": m.pad_h,
+            "ceil_mode": bool(getattr(m, "ceil_mode", False))})
     if isinstance(m, N.SpatialBatchNormalization):
         obj = {"nOutput": m.n_output, "eps": float(m.eps),
                "momentum": float(m.momentum),
