@@ -226,8 +226,24 @@ def _l_batchnorm(cfg):
     if cfg.get("mode", 0) not in (0, 2):
         raise NotImplementedError("keras converter: BatchNormalization "
                                   f"mode={cfg['mode']} unsupported")
-    return L.BatchNormalization(epsilon=float(cfg.get("epsilon", 1e-3)),
-                                momentum=float(cfg.get("momentum", 0.99)))
+    axis = int(cfg.get("axis", -1))
+    bn = L.BatchNormalization(epsilon=float(cfg.get("epsilon", 1e-3)),
+                              momentum=float(cfg.get("momentum", 0.99)))
+    orig_build = bn.build
+
+    def build(s):
+        # with a spatial/temporal input the only convertible case is
+        # channel-axis normalization; axis=-1 there means the *last* axis in
+        # keras, which has no analog here — reject instead of mis-converting
+        if len(s) >= 2 and axis != 1:
+            raise NotImplementedError(
+                f"keras converter: BatchNormalization axis={axis} over a "
+                f"rank-{len(s) + 1} input — only channel-axis (axis=1) "
+                "converts")
+        return orig_build(s)
+
+    bn.build = build
+    return bn
 
 
 def _l_embedding(cfg):
@@ -417,14 +433,19 @@ def layer_from_config(class_name: str, config: Dict):
     return layer
 
 
-def _input_shape_of(config: Dict) -> Optional[Tuple[int, ...]]:
+def _input_shape_of(config: Dict,
+                    class_name: str = "") -> Optional[Tuple[int, ...]]:
     bis = config.get("batch_input_shape")
     if bis:
         return tuple(int(d) for d in bis[1:])
+    if class_name == "Embedding":
+        # Embedding's input_dim is the vocab size, not the input shape;
+        # the sequence length comes from input_length only
+        if config.get("input_length"):
+            return (int(config["input_length"]),)
+        return None
     if config.get("input_dim"):
         return (int(config["input_dim"]),)
-    if config.get("input_length") and config.get("input_dim") is None:
-        return (int(config["input_length"]),)
     return None
 
 
@@ -451,13 +472,15 @@ def _from_sequential(config) -> Tuple[Sequential, List[_Record]]:
     layers = config["layers"] if isinstance(config, dict) else config
     model = Sequential()
     records = []
+    pending_shape = None
     for i, spec in enumerate(layers):
         cls, cfg = spec["class_name"], spec["config"]
         if cls == "InputLayer":
+            pending_shape = _input_shape_of(cfg, cls)
             continue
         layer = layer_from_config(cls, cfg)
-        if i == 0 or not model.layers:
-            shape = _input_shape_of(cfg)
+        if not model.layers:
+            shape = pending_shape or _input_shape_of(cfg, cls)
             if shape is None:
                 raise ValueError("keras converter: first layer carries no "
                                  "batch_input_shape/input_dim")
@@ -521,6 +544,25 @@ def model_from_json(json_def):
 # ---------------------------------------------------------------------------
 # weight conversion (keras get_weights order → our param trees)
 # ---------------------------------------------------------------------------
+
+
+# layer classes that carry no weights in keras 1.2 — everything else is
+# expected to have a _convert branch; a weighted class without one raises at
+# load time instead of silently keeping random init
+_WEIGHTLESS = {
+    "Activation", "Dropout", "Flatten", "Reshape", "Permute", "RepeatVector",
+    "Merge", "Masking", "GaussianNoise", "GaussianDropout",
+    "SpatialDropout1D", "SpatialDropout2D", "SpatialDropout3D",
+    "MaxPooling1D", "MaxPooling2D", "MaxPooling3D",
+    "AveragePooling1D", "AveragePooling2D", "AveragePooling3D",
+    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "GlobalMaxPooling3D",
+    "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+    "GlobalAveragePooling3D",
+    "ZeroPadding1D", "ZeroPadding2D", "ZeroPadding3D",
+    "Cropping1D", "Cropping2D", "Cropping3D",
+    "UpSampling1D", "UpSampling2D", "UpSampling3D",
+    "LeakyReLU", "ELU", "ThresholdedReLU", "SoftMax", "InputLayer",
+}
 
 
 def _iter_paths(module, prefix=()):
@@ -664,10 +706,16 @@ def load_weights(model, weights: Dict[str, List[np.ndarray]],
 
     expecting = []
     for r in records:
-        try:
-            _convert(r, None)  # probe: raises NotImplementedError fast
-        except NotImplementedError:
+        if r.class_name in _WEIGHTLESS:
             continue
+        try:
+            _convert(r, None)  # probe: unsupported classes raise fast
+        except NotImplementedError as e:
+            # a weighted layer we cannot load — refuse rather than leave it
+            # randomly initialized (silent wrong outputs)
+            raise NotImplementedError(
+                f"layer {r.name}: {e}. Drop the layer or load weights "
+                "manually via model.converted_records") from None
         except Exception:
             expecting.append(r)
     if by_name:

@@ -16,9 +16,8 @@ from .activation import (ReLU, ReLU6, LeakyReLU, PReLU, RReLU, SReLU, ELU,
 from .elementwise import (Identity, Echo, Contiguous, Abs, Exp, Log, Sqrt,
                           Square, Negative, Power, AddConstant, MulConstant,
                           GradientReversal, ErrorInfo)
-from .linear import (Linear, SparseLinear, Bilinear, Cosine, Euclidean, Add,
-                     Mul, CMul, CAdd, Scale, Highway, LookupTable,
-                     LookupTableSparse)
+from .linear import (Linear, Bilinear, Cosine, Euclidean, Add,
+                     Mul, CMul, CAdd, Scale, Highway, LookupTable)
 from .conv import (SpatialConvolution, SpatialShareConvolution,
                    SpatialDilatedConvolution, SpatialFullConvolution,
                    SpatialSeparableConvolution, SpatialConvolutionMap,
@@ -41,7 +40,9 @@ from .shape_ops import (Reshape, View, InferReshape, Squeeze, Unsqueeze,
                         Narrow, Select, Index, MaskedSelect, Max, Min, Mean,
                         Sum, Tile, ExpandSize, Cropping2D, Cropping3D, Reverse,
                         Pack, UpSampling1D, UpSampling2D, UpSampling3D,
-                        ResizeBilinear, DenseToSparse)
+                        ResizeBilinear)
+from .sparse import (SparseTensor, SparseLinear, LookupTableSparse,
+                     SparseJoinTable, DenseToSparse, sparse_dense_matmul)
 from .table_ops import (CAddTable, CSubTable, CMulTable, CDivTable, CMaxTable,
                         CMinTable, CAveTable, JoinTable, SplitTable,
                         BifurcateSplitTable, SelectTable, NarrowTable,

@@ -72,12 +72,6 @@ class Linear(Module):
         return y
 
 
-class SparseLinear(Linear):
-    """nn/SparseLinear.scala. TPU note: XLA has no efficient dynamic sparsity;
-    sparse inputs are represented densely (the MXU is fast enough that dense
-    beats gather-scatter for the reference's use cases)."""
-
-
 class Bilinear(Module):
     """y_k = x1^T W_k x2 + b_k over a Table(x1, x2)  (nn/Bilinear.scala)."""
 
@@ -297,8 +291,3 @@ class LookupTable(Module):
         if self.mask_zero:
             out = out * (x != self.padding_value).astype(out.dtype)[..., None]
         return out
-
-
-class LookupTableSparse(LookupTable):
-    """nn/LookupTableSparse.scala — dense representation on TPU (see
-    SparseLinear note)."""

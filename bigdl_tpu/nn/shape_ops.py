@@ -8,7 +8,7 @@ Parity: reference ``nn/Reshape.scala``, ``nn/View.scala``,
 ``nn/Min.scala``, ``nn/Mean.scala``, ``nn/Sum.scala``, ``nn/Tile.scala``,
 ``nn/ExpandSize.scala``, ``nn/Cropping2D.scala``, ``nn/Cropping3D.scala``,
 ``nn/Reverse.scala``, ``nn/Pack.scala``, ``nn/UpSampling1D/2D/3D.scala``,
-``nn/ResizeBilinear.scala``, ``nn/DenseToSparse.scala``.
+``nn/ResizeBilinear.scala`` (DenseToSparse moved to nn/sparse.py).
 
 Dimension arguments are 1-based (torch convention, matching the reference).
 Layers taking ``n_input_dims`` shift the dim by one automatically when a batch
@@ -462,9 +462,3 @@ class ResizeBilinear(Module):
         return jax.image.resize(x, target, method)
 
 
-class DenseToSparse(Module):
-    """nn/DenseToSparse.scala — on TPU dense representation is canonical;
-    this is a tagged identity for API parity."""
-
-    def _apply(self, params, state, x, training, rng):
-        return x

@@ -1,0 +1,222 @@
+"""Real sparse compute: COO tensors + segment_sum kernels.
+
+Parity: reference ``tensor/SparseTensor.scala``, ``nn/SparseLinear.scala``,
+``nn/LookupTableSparse.scala``, ``nn/SparseJoinTable.scala``.
+
+TPU-first design: a :class:`SparseTensor` is a *static-shape* COO triple
+(indices ``(nnz, ndim)`` int32, values ``(nnz,)``, dense shape) registered as
+a JAX pytree, so it traces through ``jit``/``vjp``/``shard_map`` like any
+array. The nnz buffer size is fixed at construction — pad entries carry value
+0 at index 0, which contributes nothing to the linear ops here, so no dynamic
+shapes ever reach XLA. Compute lowers to ``gather`` + ``segment_sum``, the
+TPU-efficient formulation of sparse×dense (one embedding-row gather feeding a
+scatter-add; the MXU is not involved, which is the point — these ops exist
+for wide/recommendation workloads whose feature spaces are far too wide to
+densify)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .module import Module
+from .linear import Linear, LookupTable
+from ..utils.table import Table
+
+
+class SparseTensor:
+    """Static-shape COO sparse tensor (pytree: indices, values leaves)."""
+
+    def __init__(self, indices, values, shape: Sequence[int]):
+        self.indices = indices  # (nnz, ndim) int32
+        self.values = values    # (nnz,)
+        self.shape = tuple(int(s) for s in shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self.values.shape[0])
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @staticmethod
+    def from_dense(arr, nnz: Optional[int] = None) -> "SparseTensor":
+        """Host-side densification cut — pads the buffer to ``nnz``.
+
+        Raises if the actual nonzero count exceeds the budget (silent
+        truncation would drop data); size ``nnz`` for the worst-case batch.
+        """
+        a = np.asarray(arr)
+        idx = np.argwhere(a != 0)
+        vals = a[tuple(idx.T)]
+        if nnz is None:
+            nnz = len(vals)
+        if len(vals) > nnz:
+            raise ValueError(f"{len(vals)} nonzeros exceed nnz budget {nnz}")
+        pad = nnz - len(vals)
+        idx = np.concatenate([idx, np.zeros((pad, a.ndim), idx.dtype)], 0)
+        vals = np.concatenate([vals, np.zeros((pad,), vals.dtype)], 0)
+        return SparseTensor(jnp.asarray(idx, jnp.int32), jnp.asarray(vals),
+                            a.shape)
+
+    @staticmethod
+    def coo(indices, values, shape) -> "SparseTensor":
+        return SparseTensor(jnp.asarray(indices, jnp.int32),
+                            jnp.asarray(values), shape)
+
+    def to_dense(self):
+        flat_shape = int(np.prod(self.shape))
+        strides = np.cumprod([1] + list(self.shape[::-1]))[:-1][::-1]
+        flat_idx = (self.indices * jnp.asarray(strides, jnp.int32)).sum(-1)
+        out = jnp.zeros((flat_shape,), self.values.dtype)
+        # padded entries all hit flat index 0 with value 0 — scatter-add is
+        # safe without a mask
+        out = out.at[flat_idx].add(self.values)
+        return out.reshape(self.shape)
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.values.dtype})")
+
+
+def _st_flatten(st):
+    return (st.indices, st.values), st.shape
+
+
+def _st_unflatten(shape, children):
+    indices, values = children
+    return SparseTensor(indices, values, shape)
+
+
+jax.tree_util.register_pytree_node(SparseTensor, _st_flatten, _st_unflatten)
+
+
+def sparse_dense_matmul(sp: SparseTensor, dense) -> jnp.ndarray:
+    """(B, I) sparse @ (I, O) dense → (B, O), via gather + segment_sum."""
+    if sp.ndim != 2:
+        raise ValueError("sparse_dense_matmul expects a 2-D SparseTensor")
+    rows = sp.indices[:, 0]
+    cols = sp.indices[:, 1]
+    contrib = sp.values[:, None] * jnp.take(dense, cols, axis=0)
+    return jax.ops.segment_sum(contrib, rows, num_segments=sp.shape[0])
+
+
+class SparseLinear(Linear):
+    """nn/SparseLinear.scala — y = sparse_x @ W^T + b.
+
+    Accepts a :class:`SparseTensor` input (gather+segment_sum path) or a
+    dense array (inherited MXU path), matching the reference's contract that
+    SparseLinear only differs from Linear in the input type it takes.
+    """
+
+    def _apply(self, params, state, x, training, rng):
+        if isinstance(x, SparseTensor):
+            y = sparse_dense_matmul(x, params["weight"].T)
+            if self.with_bias:
+                y = y + params["bias"]
+            return y
+        return super()._apply(params, state, x, training, rng)
+
+
+class LookupTableSparse(LookupTable):
+    """nn/LookupTableSparse.scala — embedding_lookup_sparse.
+
+    Input: a 2-D SparseTensor of positive (1-based) ids, or a
+    ``Table(ids, weights)`` of two aligned SparseTensors. Each row's
+    embeddings are combined by ``combiner``: sum, mean, or sqrtn
+    (weighted variants divide by sum(w) / sqrt(sum(w^2))). ``max_norm``
+    L2-clips each embedding before combining. Padded slots (id 0)
+    contribute nothing.
+    """
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 max_norm: float = -1.0, w_regularizer=None, name=None):
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"combiner must be sum/mean/sqrtn, "
+                             f"got {combiner}")
+        super().__init__(n_index, n_output, w_regularizer=w_regularizer,
+                         name=name)
+        self.combiner = combiner
+        self.max_norm = max_norm
+
+    def _apply(self, params, state, x, training, rng):
+        if isinstance(x, Table):
+            ids_sp, w_sp = x[1], x[2]
+        elif isinstance(x, SparseTensor):
+            ids_sp, w_sp = x, None
+        else:  # dense fallback: (B, L) id matrix, 0 = padding
+            ids_sp = SparseTensor.from_dense(np.asarray(x))
+            w_sp = None
+        if ids_sp.ndim != 2:
+            raise ValueError("LookupTableSparse expects 2-D id tensors")
+
+        ids = ids_sp.values.astype(jnp.int32)
+        valid = (ids > 0).astype(params["weight"].dtype)
+        idx = jnp.clip(ids - 1, 0, self.n_index - 1)  # 1-based ids
+        rows = ids_sp.indices[:, 0]
+        w = params["weight"]
+        emb = jnp.take(w, idx, axis=0)
+        if self.max_norm > 0:
+            norms = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+            emb = emb * jnp.minimum(1.0, self.max_norm / (norms + 1e-12))
+        weights = w_sp.values.astype(emb.dtype) if w_sp is not None else valid
+        weights = weights * valid
+        B = ids_sp.shape[0]
+        summed = jax.ops.segment_sum(emb * weights[:, None], rows,
+                                     num_segments=B)
+        if self.combiner == "sum":
+            return summed
+        if self.combiner == "mean":
+            denom = jax.ops.segment_sum(weights, rows, num_segments=B)
+        else:  # sqrtn
+            denom = jnp.sqrt(jax.ops.segment_sum(weights ** 2, rows,
+                                                 num_segments=B))
+        return summed / jnp.maximum(denom, 1e-12)[:, None]
+
+
+class SparseJoinTable(Module):
+    """nn/SparseJoinTable.scala — concat 2-D SparseTensors on ``dimension``
+    (1-based; the reference supports dimension=2, feature concat)."""
+
+    def __init__(self, dimension: int = 2, name=None):
+        super().__init__(name=name)
+        if dimension != 2:
+            raise NotImplementedError("SparseJoinTable joins dimension 2 "
+                                      "(features), matching the reference")
+        self.dimension = dimension
+
+    def _apply(self, params, state, x, training, rng):
+        tensors = [x[i + 1] for i in range(len(x))] if isinstance(x, Table) \
+            else list(x)
+        rows = [t.shape[0] for t in tensors]
+        if len(set(rows)) != 1:
+            raise ValueError("SparseJoinTable inputs need equal row counts")
+        offset = 0
+        idx_parts, val_parts = [], []
+        for t in tensors:
+            if not isinstance(t, SparseTensor):
+                raise TypeError("SparseJoinTable expects SparseTensors")
+            shifted = t.indices.at[:, 1].add(offset)
+            # keep padded entries harmless: zero-value rows may now point at
+            # a shifted column, but value 0 contributes 0 downstream
+            idx_parts.append(shifted)
+            val_parts.append(t.values)
+            offset += t.shape[1]
+        return SparseTensor(jnp.concatenate(idx_parts, 0),
+                            jnp.concatenate(val_parts, 0),
+                            (rows[0], offset))
+
+
+class DenseToSparse(Module):
+    """nn/DenseToSparse.scala — densify cut; host-side conversion with a
+    fixed nnz budget so the result jits downstream."""
+
+    def __init__(self, nnz: Optional[int] = None, name=None):
+        super().__init__(name=name)
+        self.nnz = nnz
+
+    def _apply(self, params, state, x, training, rng):
+        return SparseTensor.from_dense(np.asarray(x), nnz=self.nnz)

@@ -290,3 +290,51 @@ def test_unsupported_layer_class_rejected():
     spec = [_layer("FancyNewLayer", "x", batch_input_shape=[None, 3])]
     with pytest.raises(NotImplementedError):
         model_from_json(_seq_json(spec))
+
+
+def test_sequential_with_inputlayer_first():
+    """Sequential configs emitted with a leading InputLayer convert."""
+    spec = [
+        _layer("InputLayer", "in", batch_input_shape=[None, 3]),
+        _layer("Dense", "d", output_dim=2),
+    ]
+    model = model_from_json(_seq_json(spec))
+    x = np.random.RandomState(8).randn(4, 3).astype(np.float32)
+    assert np.asarray(model._module().evaluate().forward(x)).shape == (4, 2)
+
+
+def test_embedding_input_length_shape():
+    """Embedding without batch_input_shape derives shape from input_length
+    (not the vocab size)."""
+    spec = [
+        _layer("Embedding", "e", input_dim=1000, output_dim=8,
+               input_length=12),
+        _layer("Flatten", "f"),
+        _layer("Dense", "d", output_dim=2),
+    ]
+    model = model_from_json(_seq_json(spec))
+    ids = np.random.RandomState(9).randint(0, 1000, (2, 12)).astype(
+        np.float32)
+    assert np.asarray(model._module().evaluate().forward(ids)).shape == (2, 2)
+
+
+def test_batchnorm_bad_axis_rejected():
+    spec = [
+        _layer("Convolution2D", "c", nb_filter=2, nb_row=3, nb_col=3,
+               dim_ordering="th", batch_input_shape=[None, 3, 8, 8]),
+        _layer("BatchNormalization", "bn", axis=-1),
+    ]
+    with pytest.raises(NotImplementedError):
+        model_from_json(_seq_json(spec))
+
+
+def test_unsupported_weighted_layer_raises_at_load():
+    """A weighted layer without a weight converter refuses load_weights
+    instead of silently keeping random init."""
+    spec = [
+        _layer("MaxoutDense", "mx", output_dim=4, nb_feature=2,
+               batch_input_shape=[None, 3]),
+    ]
+    model = model_from_json(_seq_json(spec))
+    with pytest.raises(NotImplementedError, match="mx"):
+        load_weights(model, {"mx": [np.zeros((2, 3, 4), np.float32)]})
