@@ -227,19 +227,31 @@ def _l_batchnorm(cfg):
         raise NotImplementedError("keras converter: BatchNormalization "
                                   f"mode={cfg['mode']} unsupported")
     axis = int(cfg.get("axis", -1))
-    bn = L.BatchNormalization(epsilon=float(cfg.get("epsilon", 1e-3)),
-                              momentum=float(cfg.get("momentum", 0.99)))
+    eps = float(cfg.get("epsilon", 1e-3))
+    momentum = float(cfg.get("momentum", 0.99))
+    bn = L.BatchNormalization(epsilon=eps, momentum=momentum)
     orig_build = bn.build
 
     def build(s):
-        # with a spatial/temporal input the only convertible case is
-        # channel-axis normalization; axis=-1 there means the *last* axis in
-        # keras, which has no analog here — reject instead of mis-converting
-        if len(s) >= 2 and axis != 1:
-            raise NotImplementedError(
-                f"keras converter: BatchNormalization axis={axis} over a "
-                f"rank-{len(s) + 1} input — only channel-axis (axis=1) "
-                "converts")
+        if len(s) >= 3:
+            # spatial input: only channel-axis normalization converts;
+            # axis=-1 would normalize the last spatial axis in keras
+            if axis != 1:
+                raise NotImplementedError(
+                    f"keras converter: BatchNormalization axis={axis} over "
+                    f"a rank-{len(s) + 1} input — only channel-axis "
+                    "(axis=1) converts")
+            return orig_build(s)
+        if len(s) == 2:
+            # temporal (T, F) input: keras axis=-1/2 normalizes features —
+            # collapse (B, T) through Bottle so feature BN sees (B*T, F)
+            if axis not in (-1, 2):
+                raise NotImplementedError(
+                    f"keras converter: BatchNormalization axis={axis} over "
+                    "a (T, F) input — only feature-axis (-1) converts")
+            return N.Bottle(N.BatchNormalization(s[-1], eps,
+                                                 1.0 - momentum),
+                            n_input_dim=2)
         return orig_build(s)
 
     bn.build = build
@@ -444,6 +456,9 @@ def _input_shape_of(config: Dict,
         if config.get("input_length"):
             return (int(config["input_length"]),)
         return None
+    if config.get("input_length") and config.get("input_dim"):
+        # legacy recurrent-layer spelling: input_shape=(T, features)
+        return (int(config["input_length"]), int(config["input_dim"]))
     if config.get("input_dim"):
         return (int(config["input_dim"]),)
     return None
@@ -625,6 +640,16 @@ def _convert(record: _Record, ws: List[np.ndarray]):
         if len(ws) > 1:
             p["bias"] = ws[1]
         return [(N.SpatialDilatedConvolution, p, {})]
+    if cls == "AtrousConvolution1D":
+        # keras (filter_length, 1, in, out) → the (out, in, filter_length, 1)
+        # dilated spatial conv the layer builds
+        w = ws[0]
+        if w.ndim == 4:
+            w = w.transpose(3, 2, 0, 1)
+        p = {"weight": w}
+        if len(ws) > 1:
+            p["bias"] = ws[1]
+        return [(N.SpatialDilatedConvolution, p, {})]
     if cls == "Embedding":
         return [(N.LookupTable, {"weight": ws[0]}, {})]
     if cls == "BatchNormalization":
@@ -689,11 +714,14 @@ def _assign(tree, path, updates, like_dtype=True):
 
 
 def load_weights(model, weights: Dict[str, List[np.ndarray]],
-                 by_name=False) -> None:
+                 by_name=False, strict=True) -> None:
     """Apply a {layer_name: [arrays]} weight dict to a converted model.
 
     ``by_name=False`` (keras default) matches weighted layers in definition
-    order; ``by_name=True`` matches on layer names only.
+    order; ``by_name=True`` matches on layer names only. ``strict=True``
+    refuses models containing a weighted layer this converter cannot load
+    (rather than leaving it randomly initialized); ``strict=False`` loads
+    what it can and warns loudly about the layers it skipped.
     """
     records = getattr(model, "converted_records", None)
     if records is None:
@@ -705,19 +733,28 @@ def load_weights(model, weights: Dict[str, List[np.ndarray]],
         path_of.setdefault(id(m), path)
 
     expecting = []
+    unsupported = []
     for r in records:
         if r.class_name in _WEIGHTLESS:
             continue
         try:
             _convert(r, None)  # probe: unsupported classes raise fast
         except NotImplementedError as e:
-            # a weighted layer we cannot load — refuse rather than leave it
-            # randomly initialized (silent wrong outputs)
-            raise NotImplementedError(
-                f"layer {r.name}: {e}. Drop the layer or load weights "
-                "manually via model.converted_records") from None
+            if strict:
+                # a weighted layer we cannot load — refuse rather than
+                # leave it randomly initialized (silent wrong outputs)
+                raise NotImplementedError(
+                    f"layer {r.name}: {e}. Pass strict=False to load the "
+                    "rest, or set weights manually via "
+                    "model.converted_records") from None
+            unsupported.append(r.name)
         except Exception:
             expecting.append(r)
+    if unsupported:
+        warnings.warn(
+            "keras converter: weights NOT loaded for layers "
+            f"{unsupported} (unsupported classes) — they keep random "
+            "init")
     if by_name:
         pairs = [(r, weights[r.name]) for r in expecting if r.name in weights]
     else:
@@ -758,9 +795,11 @@ def _read_hdf5_weights(path: str) -> Dict[str, List[np.ndarray]]:
     return out
 
 
-def load_weights_hdf5(model, hdf5_path: str, by_name=False) -> None:
+def load_weights_hdf5(model, hdf5_path: str, by_name=False,
+                      strict=True) -> None:
     """WeightLoader.load_weights_from_hdf5 parity (local files via h5py)."""
-    load_weights(model, _read_hdf5_weights(hdf5_path), by_name=by_name)
+    load_weights(model, _read_hdf5_weights(hdf5_path), by_name=by_name,
+                 strict=strict)
 
 
 def load_keras(json_path: Optional[str] = None,

@@ -216,6 +216,13 @@ class DenseToSparse(Module):
 
     def __init__(self, nnz: Optional[int] = None, name=None):
         super().__init__(name=name)
+        if nnz is None:
+            import warnings
+            warnings.warn(
+                "DenseToSparse without an nnz budget sizes the COO buffer "
+                "per batch — downstream jitted consumers recompile whenever "
+                "the nonzero count changes; pass nnz=<worst case> for "
+                "stable shapes", stacklevel=2)
         self.nnz = nnz
 
     def _apply(self, params, state, x, training, rng):

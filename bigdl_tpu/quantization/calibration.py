@@ -102,11 +102,16 @@ def _walk(module: Module, path: str = ""):
 
 def quantizable_paths(model: Module) -> List[Tuple[str, Module]]:
     """Layers quantize() will convert — same isinstance tests as
-    quantize._quantize_rec (covers SpatialShare/DilatedConvolution too)."""
+    quantize._quantize_rec (covers SpatialShare/DilatedConvolution and
+    SpatialSeparableConvolution too)."""
     from .quantize import QuantizedLinear
+    from ..nn.conv import SpatialSeparableConvolution
+    from ..nn.sparse import SparseLinear
     return [(p, m) for p, m in _walk(model)
-            if (isinstance(m, Linear) and not isinstance(m, QuantizedLinear))
-            or isinstance(m, SpatialConvolution)]
+            if (isinstance(m, Linear) and not isinstance(
+                m, (QuantizedLinear, SparseLinear)))
+            or isinstance(m, (SpatialConvolution,
+                              SpatialSeparableConvolution))]
 
 
 def calibrate(model: Module, batches: Iterable,

@@ -148,6 +148,66 @@ class QuantizedSpatialConvolution(Module):
         return y[0] if squeeze else y
 
 
+class QuantizedSpatialSeparableConvolution(Module):
+    """Int8 depthwise + pointwise conv (parity: reference
+    ``nn/quantized/SpatialDilatedConvolution.scala`` breadth — the separable
+    factorization quantizes both stages; the intermediate is requantized
+    dynamically between them)."""
+
+    def __init__(self, sep, name=None):
+        super().__init__(name=name or sep.name + "_int8")
+        self.cfg = sep
+        self._src_params = None
+
+    @staticmethod
+    def from_float(sep, params, act_scale=None):
+        q = QuantizedSpatialSeparableConvolution(sep)
+        q._src_params = params
+        q._act_scale = act_scale
+        return q
+
+    def _init_params(self, rng):
+        qd, dscale = quantize_weight(self._src_params["depth_weight"], axis=0)
+        qp, pscale = quantize_weight(self._src_params["point_weight"], axis=0)
+        p = {"qdepth": qd, "dscale": dscale.reshape(-1),
+             "qpoint": qp, "pscale": pscale.reshape(-1)}
+        if getattr(self, "_act_scale", None) is not None:
+            p["act_scale"] = jnp.float32(self._act_scale)
+        if self.cfg.has_bias:
+            p["bias"] = jnp.asarray(self._src_params["bias"])
+        return p
+
+    def _apply(self, params, state, x, training, rng):
+        from ..nn.conv import _pad_pair, _resolve_padding
+        c = self.cfg
+        squeeze = False
+        if x.ndim == 3:
+            x, squeeze = x[None], True
+        if "act_scale" in params:
+            xs = params["act_scale"]
+            xq = _static_quantize(x, xs)
+        else:
+            xq, xs = _dynamic_quantize(x)
+        pads = (_pad_pair(c.ph, c.kh, c.sh), _pad_pair(c.pw, c.kw, c.sw))
+        acc = lax.conv_general_dilated(
+            xq, params["qdepth"], (c.sh, c.sw), _resolve_padding(pads),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=c.n_input_channel,
+            preferred_element_type=jnp.int32)
+        mid = acc.astype(jnp.float32) * \
+            (xs * params["dscale"])[None, :, None, None]
+        mq, ms = _dynamic_quantize(mid)
+        acc2 = lax.conv_general_dilated(
+            mq, params["qpoint"], (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.int32)
+        y = acc2.astype(jnp.float32) * \
+            (ms * params["pscale"])[None, :, None, None]
+        if c.has_bias:
+            y = y + params["bias"][None, :, None, None]
+        return y[0] if squeeze else y
+
+
 def _quantize_rec(module: Module, params, calibration, path="", used=None):
     """Return (new_module, new_params) with eligible layers replaced.
     ``calibration`` maps layer paths (child_path keying, shared with
@@ -156,11 +216,20 @@ def _quantize_rec(module: Module, params, calibration, path="", used=None):
     act = (calibration or {}).get(path)
     if act is not None and used is not None:
         used.add(path)
-    if isinstance(module, Linear) and not isinstance(module, QuantizedLinear):
+    from ..nn.sparse import SparseLinear
+    if isinstance(module, Linear) and not isinstance(
+            module, (QuantizedLinear, SparseLinear)):
+        # SparseLinear stays float: its value is the COO input path, which
+        # the dense int8 contraction cannot take
         q = QuantizedLinear.from_float(module, params, act)
         return q, q._init_params(None)
     if isinstance(module, SpatialConvolution):
         q = QuantizedSpatialConvolution.from_float(module, params, act)
+        return q, q._init_params(None)
+    from ..nn.conv import SpatialSeparableConvolution
+    if isinstance(module, SpatialSeparableConvolution):
+        q = QuantizedSpatialSeparableConvolution.from_float(module, params,
+                                                            act)
         return q, q._init_params(None)
     if isinstance(module, Container):
         new_params = dict(params)

@@ -338,3 +338,80 @@ def test_unsupported_weighted_layer_raises_at_load():
     model = model_from_json(_seq_json(spec))
     with pytest.raises(NotImplementedError, match="mx"):
         load_weights(model, {"mx": [np.zeros((2, 3, 4), np.float32)]})
+
+
+def test_batchnorm_temporal_feature_axis():
+    """BN over a (T, F) input with axis=-1 normalizes features (Bottle)."""
+    spec = [
+        _layer("BatchNormalization", "bn", axis=-1, epsilon=1e-3,
+               batch_input_shape=[None, 5, 4]),
+    ]
+    model = model_from_json(_seq_json(spec))
+    rng = np.random.RandomState(10)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    mean = rng.randn(4).astype(np.float32)
+    var = rng.rand(4).astype(np.float32) + 0.5
+    load_weights(model, {"bn": [gamma, beta, mean, var]})
+    x = rng.randn(2, 5, 4).astype(np.float32)
+    out = np.asarray(model._module().evaluate().forward(x))
+    ref = (x - mean) / np.sqrt(var + 1e-3) * gamma + beta
+    assert np.allclose(out, ref, atol=1e-3), np.abs(out - ref).max()
+
+
+def test_recurrent_input_dim_input_length():
+    """LSTM(input_dim=.., input_length=..) derives input_shape (T, F)."""
+    spec = [
+        _layer("LSTM", "l", output_dim=6, input_dim=3, input_length=7),
+        _layer("Dense", "d", output_dim=2),
+    ]
+    model = model_from_json(_seq_json(spec))
+    x = np.random.RandomState(11).randn(2, 7, 3).astype(np.float32)
+    assert np.asarray(model._module().evaluate().forward(x)).shape == (2, 2)
+
+
+def test_atrous_conv1d_weights():
+    """AtrousConvolution1D weights load through the dilated-conv mapping."""
+    import torch
+    import torch.nn.functional as F
+    T, C, OUT, K, RATE = 12, 3, 5, 3, 2
+    spec = [
+        _layer("AtrousConvolution1D", "ac", nb_filter=OUT, filter_length=K,
+               atrous_rate=RATE, batch_input_shape=[None, T, C]),
+    ]
+    model = model_from_json(_seq_json(spec))
+    rng = np.random.RandomState(12)
+    w = rng.randn(K, 1, C, OUT).astype(np.float32)
+    b = rng.randn(OUT).astype(np.float32)
+    load_weights(model, {"ac": [w, b]})
+    x = rng.randn(2, T, C).astype(np.float32)
+    out = np.asarray(model._module().evaluate().forward(x))
+    # torch oracle: conv1d with dilation over (B, C, T)
+    wt = torch.tensor(w[:, 0].transpose(2, 1, 0))  # (OUT, C, K)
+    ref = F.conv1d(torch.tensor(x.transpose(0, 2, 1)), wt, torch.tensor(b),
+                   dilation=RATE).numpy().transpose(0, 2, 1)
+    assert out.shape == ref.shape, (out.shape, ref.shape)
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+
+
+def test_non_strict_load_skips_unsupported():
+    """strict=False loads supported layers and warns for the rest."""
+    import warnings as _w
+    spec = [
+        _layer("Dense", "d", output_dim=4, batch_input_shape=[None, 3]),
+        _layer("MaxoutDense", "mx", output_dim=4, nb_feature=2),
+    ]
+    model = model_from_json(_seq_json(spec))
+    rng = np.random.RandomState(13)
+    w, b = rng.randn(3, 4).astype(np.float32), rng.randn(4).astype(
+        np.float32)
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        load_weights(model, {"d": [w, b]}, by_name=True, strict=False)
+    assert any("mx" in str(r.message) for r in rec)
+    # dense arm got its weights even though maxout was skipped (assert on
+    # the root param tree, which is what forward uses)
+    root = model._module()
+    assert np.allclose(np.asarray(root.params["0"]["weight"]), w.T,
+                       atol=1e-6)
+    assert np.allclose(np.asarray(root.params["0"]["bias"]), b, atol=1e-6)

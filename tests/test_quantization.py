@@ -205,3 +205,76 @@ def test_quantize_nested_containers():
     out = np.asarray(q.forward(x))
     err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
     assert err < 0.15, err
+
+
+def test_quantized_dilated_conv():
+    """SpatialDilatedConvolution quantizes (reference
+    nn/quantized/SpatialDilatedConvolution.scala) with bounded error."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.quantization import quantize
+    m = nn.Sequential(
+        nn.SpatialDilatedConvolution(3, 6, 3, 3, 1, 1, 2, 2,
+                                     dilation_w=2, dilation_h=2),
+        nn.ReLU())
+    m.ensure_initialized()
+    m.evaluate()
+    x = np.random.RandomState(0).randn(2, 3, 12, 12).astype(np.float32)
+    ref = np.asarray(m.forward(x))
+    q = quantize(m)
+    out = np.asarray(q.forward(x))
+    assert out.shape == ref.shape
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert rel < 0.1, rel
+
+
+def test_quantized_separable_conv():
+    """SpatialSeparableConvolution quantizes both stages."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.quantization import quantize
+    from bigdl_tpu.quantization.quantize import \
+        QuantizedSpatialSeparableConvolution
+    m = nn.Sequential(
+        nn.SpatialSeparableConvolution(4, 8, 2, 3, 3, 1, 1, 1, 1),
+        nn.ReLU())
+    m.ensure_initialized()
+    m.evaluate()
+    x = np.random.RandomState(1).randn(2, 4, 10, 10).astype(np.float32)
+    ref = np.asarray(m.forward(x))
+    q = quantize(m)
+    assert isinstance(q.modules[0], QuantizedSpatialSeparableConvolution)
+    out = np.asarray(q.forward(x))
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert rel < 0.15, rel
+
+
+def test_sparse_linear_not_quantized():
+    """SparseLinear keeps its float COO path through quantize()."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.quantization import quantize
+    m = nn.Sequential(nn.SparseLinear(6, 4), nn.ReLU())
+    m.ensure_initialized()
+    q = quantize(m)
+    assert isinstance(q.modules[0], nn.SparseLinear)
+    sp = nn.SparseTensor.from_dense(
+        np.eye(6, dtype=np.float32)[:3])
+    assert np.asarray(q.forward(sp)).shape == (3, 4)
+
+
+def test_quantized_resnet50_accuracy_drop():
+    """Quantized ResNet-50: int8 predictions agree with float top-1 on
+    random-init weights (graph-rewrite over the full bottleneck DAG)."""
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.quantization import quantize
+    model = ResNet(class_num=10, depth=50)
+    model.ensure_initialized()
+    model.evaluate()
+    x = np.random.RandomState(2).randn(2, 3, 64, 64).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    q = quantize(model)
+    out = np.asarray(q.forward(x))
+    assert out.shape == ref.shape
+    # same argmax on a clear majority of rows + bounded logit error
+    agree = (out.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree >= 0.5, agree
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
+    assert rel < 0.25, rel
