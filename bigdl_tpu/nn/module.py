@@ -247,6 +247,96 @@ class Module:
                 return m
         return None
 
+    # -- fine-tuning (parity: AbstractModule.freeze/unfreeze) -----------
+    def freeze(self, *names):
+        """Mark this module (or named descendants) as not-to-be-updated.
+
+        Parity: AbstractModule.freeze — the Optimizer's jitted step zeroes
+        gradients and restores frozen params after each update, so neither
+        gradients nor weight decay move them. The flag is set on every
+        module in the target subtree, so ``freeze()`` then
+        ``unfreeze("head")`` releases just the head. Only modules reachable
+        via ``modules_iter`` participate; for a composite layer holding
+        private children, freeze the composite itself.
+        """
+        targets = self._freeze_targets(names, "freeze")
+        for t in targets:
+            for m in t.modules_iter():
+                m._frozen = True
+        return self
+
+    def unfreeze(self, *names):
+        """Parity: AbstractModule.unfreeze."""
+        targets = self._freeze_targets(names, "unfreeze")
+        for t in targets:
+            for m in t.modules_iter():
+                m._frozen = False
+        return self
+
+    def _freeze_targets(self, names, what):
+        if not names:
+            return [self]
+        targets = []
+        for n in names:
+            m = self.find_module(n)
+            if m is None:
+                raise ValueError(f"{what}: no module named {n}")
+            targets.append(m)
+        return targets
+
+    def is_frozen(self):
+        return getattr(self, "_frozen", False)
+
+    # -- extra (non-gradient) parameters: running stats etc. ------------
+    def get_extra_parameter(self):
+        """State leaves (running stats etc.) as a flat list.
+
+        Parity: AbstractModule.getExtraParameter."""
+        self.ensure_initialized()
+        return jax.tree_util.tree_leaves(self.state)
+
+    def set_extra_parameter(self, extra):
+        """Parity: AbstractModule.setExtraParameter."""
+        self.ensure_initialized()
+        leaves, treedef = jax.tree_util.tree_flatten(self.state)
+        if len(extra) != len(leaves):
+            raise ValueError(f"expected {len(leaves)} extra parameters, "
+                             f"got {len(extra)}")
+        new = []
+        for i, (e, c) in enumerate(zip(extra, leaves)):
+            cur = jnp.asarray(c)
+            arr = jnp.asarray(e, dtype=cur.dtype)
+            if arr.shape != cur.shape:
+                raise ValueError(f"extra parameter {i}: shape {arr.shape} "
+                                 f"does not match {cur.shape}")
+            new.append(arr)
+        self.state = jax.tree_util.tree_unflatten(treedef, new)
+        return self
+
+    # -- conversions (parity: AbstractModule.quantize / save*) ----------
+    def quantize(self, calibration=None):
+        """Int8-inference copy (parity: AbstractModule.quantize)."""
+        from ..quantization.quantize import quantize as _q
+        return _q(self, calibration=calibration)
+
+    def save_torch(self, path):
+        """Parity: AbstractModule.saveTorch."""
+        from ..loaders.torchfile import save_torch as _s
+        _s(self, path)
+        return self
+
+    def save_caffe(self, prototxt_path, caffemodel_path,
+                   input_shape=(3, 224, 224)):
+        """Parity: AbstractModule.saveCaffe."""
+        from ..loaders.caffe_persister import save_caffe as _s
+        _s(self, prototxt_path, caffemodel_path, input_shape=input_shape)
+        return self
+
+    def save_tf(self, input_shape, path=None):
+        """Parity: AbstractModule.saveTF — returns the GraphDef bytes."""
+        from ..loaders.tf_saver import save_tf_graph as _s
+        return _s(self, input_shape, path)
+
     # -- prediction helpers (parity: AbstractModule.predict/predictClass)
     def predict(self, dataset, batch_size=32):
         from ..optim.predictor import Predictor

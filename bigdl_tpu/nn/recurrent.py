@@ -322,6 +322,11 @@ class Recurrent(Module):
             self.cell.evaluate()
         return self
 
+    def modules_iter(self):
+        yield self
+        if self.cell is not None:
+            yield from self.cell.modules_iter()
+
 
 class RecurrentDecoder(Module):
     """Feed output back as next input for seq_length steps

@@ -65,6 +65,38 @@ class Metrics:
         return {k: self.mean(k) for k in self.values}
 
 
+def _frozen_mask(model):
+    """Mask pytree matching ``model.params``: 0.0 under frozen modules
+    (Module.freeze), 1.0 elsewhere; None when nothing is frozen.
+
+    Per-module flags, no ancestor propagation: ``freeze()`` marks whole
+    subtrees, so ``unfreeze("head")`` under a frozen root works."""
+    from ..nn.module import Container
+    from ..nn.recurrent import Recurrent
+    model.ensure_initialized()
+    if not any(getattr(m, "_frozen", False) for m in model.modules_iter()):
+        return None
+
+    def rec(m, p):
+        if isinstance(m, Recurrent) and isinstance(p, dict) and "cell" in p:
+            return {"cell": rec(m.cell, p["cell"])}
+        if isinstance(m, Container) and isinstance(p, dict):
+            out = {}
+            for k, v in p.items():
+                if k.isdigit() and int(k) < len(m.modules):
+                    out[k] = rec(m.modules[int(k)], v)
+                else:
+                    out[k] = _leaf_mask(m, v)
+            return out
+        return _leaf_mask(m, p)
+
+    def _leaf_mask(m, p):
+        val = 0.0 if getattr(m, "_frozen", False) else 1.0
+        return _tmap(lambda a: val, p)
+
+    return rec(model, model.params)
+
+
 def _clip_grads(grads, clip_const=None, clip_norm=None):
     if clip_const is not None:
         lo, hi = clip_const
@@ -184,6 +216,7 @@ class BaseOptimizer:
         reg_tree = regularizer_tree(model)
         clip_const, clip_norm = self.clip_const, self.clip_norm
         optim = self.optim_method
+        frozen_mask = _frozen_mask(model)
 
         def loss_fn(params, mstate, x, y, rng):
             out, new_state = model.apply(params, mstate, x, training=True,
@@ -197,7 +230,14 @@ class BaseOptimizer:
             (loss, new_mstate), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, mstate, x, y, rng)
             grads = _clip_grads(grads, clip_const, clip_norm)
+            if frozen_mask is not None:
+                grads = _tmap(lambda g, m: g * m, grads, frozen_mask)
             new_params, new_opt = optim.update(grads, params, opt_state, lr)
+            if frozen_mask is not None:
+                # weight decay must not move frozen params either — restore
+                new_params = _tmap(
+                    lambda n, o, m: jnp.where(m > 0, n, o),
+                    new_params, params, frozen_mask)
             # NaN/Inf guard inside the compiled step (buffers are donated, so
             # the host can't roll back): a non-finite loss keeps the previous
             # params/opt-state and only the loss reports the failure.
@@ -478,6 +518,13 @@ class DistriOptimizer(BaseOptimizer):
         clip_const, clip_norm = self.clip_const, self.clip_norm
         arp, flat = self._arp, self._flat
         mesh = self.mesh
+        fm = _frozen_mask(model)
+        flat_mask = None
+        if fm is not None:
+            full = _tmap(lambda p, m: jnp.full(jnp.shape(p), m,
+                                               jnp.float32),
+                         model.params, fm)
+            flat_mask = flat.flatten(full)
 
         def loss_fn(flat_w, mstate, x, y, rng):
             params = flat.unflatten(flat_w)
@@ -493,7 +540,11 @@ class DistriOptimizer(BaseOptimizer):
             (loss, new_mstate), gflat = jax.value_and_grad(
                 loss_fn, has_aux=True)(flat_w, mstate, x, y, rng)
             gflat = _clip_grads(gflat, clip_const, clip_norm)
+            if flat_mask is not None:
+                gflat = gflat * flat_mask
             new_flat, new_opt = arp.update(gflat, flat_w, opt_slice, lr)
+            if flat_mask is not None:
+                new_flat = jnp.where(flat_mask > 0, new_flat, flat_w)
             loss = jax.lax.pmean(loss, "data")
             new_mstate = _tmap(lambda t: jax.lax.pmean(t, "data"), new_mstate)
             # same in-step NaN guard as the local path (post-pmean, so every

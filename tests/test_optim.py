@@ -227,3 +227,142 @@ def test_treenn_accuracy():
     tb = np.array([[1], [0]], np.float32)
     accb, nb = m(outb, tb).result()
     assert nb == 2 and accb == 1.0
+
+
+def test_freeze_unfreeze_finetuning():
+    """Module.freeze keeps a layer's params fixed through training (incl.
+    weight decay) and unfreeze releases them (AbstractModule.freeze
+    parity)."""
+    import jax, numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import Optimizer, SGD
+    from bigdl_tpu.optim.trigger import max_epoch
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+
+    model = nn.Sequential(
+        nn.Linear(4, 8, name="backbone"), nn.ReLU(),
+        nn.Linear(8, 2, name="head"), nn.LogSoftMax())
+    model.ensure_initialized()
+    w_backbone = np.asarray(model.params["0"]["weight"]).copy()
+    w_head = np.asarray(model.params["2"]["weight"]).copy()
+    model.freeze("backbone")
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 4).astype(np.float32)
+    ys = (rng.rand(32) < 0.5).astype(np.int32) + 1
+    ds = DataSet.array([Sample(x, np.float32(y)) for x, y in zip(xs, ys)])
+    opt = Optimizer(model=model, training_set=ds,
+                    criterion=nn.ClassNLLCriterion(),
+                    optim_method=SGD(learningrate=0.1, weightdecay=1e-2),
+                    end_trigger=max_epoch(3), batch_size=16)
+    opt.optimize()
+    assert np.allclose(np.asarray(model.params["0"]["weight"]),
+                       w_backbone), "frozen backbone moved"
+    assert not np.allclose(np.asarray(model.params["2"]["weight"]), w_head), \
+        "head did not train"
+
+    model.unfreeze()
+    opt2 = Optimizer(model=model, training_set=ds,
+                     criterion=nn.ClassNLLCriterion(),
+                     optim_method=SGD(learningrate=0.1),
+                     end_trigger=max_epoch(2), batch_size=16)
+    opt2.optimize()
+    assert not np.allclose(np.asarray(model.params["0"]["weight"]),
+                           w_backbone), "unfreeze did not release backbone"
+
+
+def test_module_parity_helpers():
+    """quantize()/save_torch/save_tf/extra-parameter round trips exist on
+    Module (AbstractModule API parity)."""
+    import tempfile, os
+    import numpy as np
+    from bigdl_tpu import nn
+    m = nn.Sequential(nn.SpatialConvolution(1, 2, 3, 3),
+                      nn.SpatialBatchNormalization(2), nn.ReLU())
+    m.training()
+    m.forward(np.random.randn(2, 1, 6, 6).astype(np.float32))
+    m.evaluate()
+    q = m.quantize()
+    assert type(q.modules[0]).__name__.startswith("Quantized")
+    extra = m.get_extra_parameter()
+    assert len(extra) > 0
+    m.set_extra_parameter([np.asarray(e) for e in extra])
+    with tempfile.TemporaryDirectory() as d:
+        m.save_torch(os.path.join(d, "m.t7"))
+        assert os.path.exists(os.path.join(d, "m.t7"))
+        data = m.save_tf(input_shape=(1, 6, 6))
+        assert isinstance(data, bytes) and len(data) > 0
+
+
+def test_freeze_all_then_unfreeze_head():
+    """freeze() marks the whole tree; unfreeze('head') releases just it."""
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import Optimizer, SGD
+    from bigdl_tpu.optim.trigger import max_epoch
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+
+    model = nn.Sequential(
+        nn.Linear(4, 8, name="backbone"), nn.ReLU(),
+        nn.Linear(8, 2, name="head"), nn.LogSoftMax())
+    model.ensure_initialized()
+    w_backbone = np.asarray(model.params["0"]["weight"]).copy()
+    w_head = np.asarray(model.params["2"]["weight"]).copy()
+    model.freeze()
+    model.unfreeze("head")
+
+    rng = np.random.RandomState(1)
+    xs = rng.randn(32, 4).astype(np.float32)
+    ys = (rng.rand(32) < 0.5).astype(np.int32) + 1
+    ds = DataSet.array([Sample(x, np.float32(y)) for x, y in zip(xs, ys)])
+    Optimizer(model=model, training_set=ds,
+              criterion=nn.ClassNLLCriterion(),
+              optim_method=SGD(learningrate=0.1),
+              end_trigger=max_epoch(3), batch_size=16).optimize()
+    assert np.allclose(np.asarray(model.params["0"]["weight"]), w_backbone)
+    assert not np.allclose(np.asarray(model.params["2"]["weight"]), w_head)
+
+
+def test_freeze_zero1_distributed():
+    """Module.freeze holds through the zero1 sharded-update path."""
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim.optimizer import DistriOptimizer
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.trigger import max_epoch
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+
+    model = nn.Sequential(
+        nn.Linear(4, 8, name="backbone"), nn.ReLU(),
+        nn.Linear(8, 2, name="head"), nn.LogSoftMax())
+    model.ensure_initialized()
+    w_backbone = np.asarray(model.params["0"]["weight"]).copy()
+    w_head = np.asarray(model.params["2"]["weight"]).copy()
+    model.freeze("backbone")
+
+    rng = np.random.RandomState(2)
+    xs = rng.randn(64, 4).astype(np.float32)
+    ys = (rng.rand(64) < 0.5).astype(np.int32) + 1
+    ds = DataSet.array([Sample(x, np.float32(y)) for x, y in zip(xs, ys)])
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                          SGD(learningrate=0.1, weightdecay=1e-2),
+                          max_epoch(3), batch_size=32,
+                          parameter_mode="zero1")
+    opt.optimize()
+    assert np.allclose(np.asarray(model.params["0"]["weight"]),
+                       w_backbone, atol=1e-6), "frozen backbone moved (zero1)"
+    assert not np.allclose(np.asarray(model.params["2"]["weight"]), w_head)
+
+
+def test_set_extra_parameter_shape_check():
+    import numpy as np
+    import pytest as _pt
+    from bigdl_tpu import nn
+    m = nn.SpatialBatchNormalization(4)
+    m.ensure_initialized()
+    extra = m.get_extra_parameter()
+    with _pt.raises(ValueError):
+        m.set_extra_parameter([np.zeros(1)] * len(extra))
