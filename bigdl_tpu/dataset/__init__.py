@@ -10,3 +10,5 @@ from . import movielens
 from . import news20
 from . import segmentation
 from .segmentation import RLEMasks, PolyMasks
+from .tfrecord import (read_tfrecords, write_tfrecords, parse_example,
+                       make_example, load_tfrecord_dataset)

@@ -1,0 +1,178 @@
+"""TFRecord reader/writer + tf.Example parser (wire-level, no TF dep).
+
+Parity: reference ``nn/tf/ParsingOps.scala`` (ParseExample /
+ParseSingleExample) and the TFRecord ingestion the reference's TF Session
+feeds through Spark. Framing: each record is
+``uint64 length | masked_crc32c(length) | data | masked_crc32c(data)`` —
+the same masked-crc scheme the visualization event writer emits.
+
+Example proto (tensorflow/core/example/example.proto):
+  Example{1: Features}; Features{1: map<string, Feature>} (repeated map
+  entries key=1 value=2); Feature = oneof bytes_list(1) / float_list(2) /
+  int64_list(3), each with repeated field 1 (packed or unpacked).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ..loaders.wire import (field_bytes, field_packed_float,
+                            field_packed_varint, field_string, iter_fields,
+                            read_float, to_signed, unpack_packed)
+from ..visualization.event_writer import _masked_crc
+
+
+# ---------------------------------------------------------------------------
+# record framing
+# ---------------------------------------------------------------------------
+
+
+def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
+    """Yield raw record payloads from a TFRecord file.
+
+    Truncated files raise IOError regardless of ``verify_crc`` — a short
+    payload must never be yielded as a valid record."""
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(12)
+            if not head:
+                return
+            if len(head) < 12:
+                raise IOError(f"{path}: truncated record header")
+            (length,), (len_crc,) = struct.unpack("<Q", head[:8]), \
+                struct.unpack("<I", head[8:])
+            if verify_crc and _masked_crc(head[:8]) != len_crc:
+                raise IOError(f"{path}: corrupt length crc")
+            data = f.read(length)
+            crc_bytes = f.read(4)
+            if len(data) < length or len(crc_bytes) < 4:
+                raise IOError(f"{path}: truncated record payload")
+            (data_crc,) = struct.unpack("<I", crc_bytes)
+            if verify_crc and _masked_crc(data) != data_crc:
+                raise IOError(f"{path}: corrupt record crc")
+            yield data
+
+
+def write_tfrecords(path: str, records) -> None:
+    """Write raw payloads with TFRecord framing (masked crc32c)."""
+    with open(path, "wb") as f:
+        for data in records:
+            head = struct.pack("<Q", len(data))
+            f.write(head)
+            f.write(struct.pack("<I", _masked_crc(head)))
+            f.write(data)
+            f.write(struct.pack("<I", _masked_crc(data)))
+
+
+# ---------------------------------------------------------------------------
+# tf.Example decode
+# ---------------------------------------------------------------------------
+
+
+def _parse_list(buf: bytes, kind: int):
+    vals: List = []
+    for fnum, wire, val in iter_fields(buf):
+        if fnum != 1:
+            continue
+        if kind == 1:  # bytes_list
+            vals.append(val)
+        elif wire == 2:  # packed floats/ints (shared wire helpers)
+            if kind == 2:
+                vals.extend(unpack_packed(val, "float"))
+            else:
+                vals.extend(to_signed(v) for v in unpack_packed(val,
+                                                                "varint"))
+        elif kind == 2:  # unpacked float (wire 5, bytes)
+            vals.append(read_float(val))
+        else:  # unpacked int64 varint
+            vals.append(to_signed(val))
+    return vals
+
+
+def _parse_feature(buf: bytes):
+    for fnum, wire, val in iter_fields(buf):
+        if fnum in (1, 2, 3):
+            inner = val
+            # each list is a message with repeated field 1
+            vals = _parse_list(inner, fnum)
+            if fnum == 1:
+                return vals
+            dtype = np.float32 if fnum == 2 else np.int64
+            return np.asarray(vals, dtype)
+    return None
+
+
+def parse_example(record: bytes) -> Dict[str, object]:
+    """Decode one serialized tf.Example → {name: np.ndarray | [bytes]}."""
+    out: Dict[str, object] = {}
+    for fnum, wire, val in iter_fields(record):
+        if fnum != 1:  # Example.features
+            continue
+        for f2, w2, feats in iter_fields(val):
+            if f2 != 1:  # Features.feature map entries
+                continue
+            key, feature = None, None
+            for f3, w3, v3 in iter_fields(feats):
+                if f3 == 1:
+                    key = v3.decode("utf-8", "replace")
+                elif f3 == 2:
+                    feature = _parse_feature(v3)
+            if key is not None:
+                out[key] = feature
+    return out
+
+
+def make_example(features: Dict[str, object]) -> bytes:
+    """Encode {name: array | bytes | [bytes]} → serialized tf.Example."""
+    entries = b""
+    for key, value in features.items():
+        if isinstance(value, bytes):
+            value = [value]
+        if isinstance(value, (list, tuple)) and value and \
+                isinstance(value[0], bytes):
+            lst = b"".join(field_bytes(1, b) for b in value)
+            feat = field_bytes(1, lst)
+        else:
+            arr = np.asarray(value)
+            if np.issubdtype(arr.dtype, np.integer):
+                feat = field_bytes(3, field_packed_varint(
+                    1, [int(v) for v in arr.reshape(-1)]))
+            else:
+                feat = field_bytes(2, field_packed_float(
+                    1, arr.reshape(-1).astype(np.float32)))
+        entry = field_string(1, key) + field_bytes(2, feat)
+        entries += field_bytes(1, entry)
+    return field_bytes(1, entries)
+
+
+# ---------------------------------------------------------------------------
+# DataSet integration
+# ---------------------------------------------------------------------------
+
+
+def load_tfrecord_dataset(paths, feature_key: str = "features",
+                          label_key: str = "label",
+                          feature_shape: Optional[tuple] = None):
+    """Read tf.Example TFRecords into Samples (ParseExample parity).
+
+    ``feature_shape`` reshapes the flat float list (TFRecord Examples carry
+    no shape). Returns a list of :class:`Sample`.
+    """
+    from .sample import Sample
+    if isinstance(paths, str):
+        paths = [paths]
+    samples = []
+    for p in paths:
+        for rec in read_tfrecords(p):
+            ex = parse_example(rec)
+            x = np.asarray(ex[feature_key], np.float32)
+            if feature_shape is not None:
+                x = x.reshape(feature_shape)
+            y = ex.get(label_key)
+            if y is not None:
+                y = np.asarray(y, np.float32).reshape(-1)
+                y = y[0] if y.size == 1 else y
+            samples.append(Sample(x, y))
+    return samples

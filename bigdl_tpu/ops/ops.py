@@ -751,8 +751,53 @@ class Substr(Operation):
                         dtype=object).reshape(arr.shape)
 
 
+class RangeOps(Operation):
+    """nn/ops/RangeOps.scala — Table(start, limit, delta) → arange.
+
+    The bounds must be concrete (host values or consts): the output length
+    is data-dependent, which XLA cannot trace — same restriction the
+    graph loader resolves by const-folding Range nodes."""
+
+    def _op(self, start, limit, delta):
+        return jnp.arange(int(np.asarray(start)), int(np.asarray(limit)),
+                          int(np.asarray(delta)))
+
+
+class DepthwiseConv2D(Operation):
+    """nn/ops/DepthwiseConv2D.scala — Table(input, filter) depthwise conv.
+
+    ``filter`` uses the TF layout (kh, kw, in_channels, channel_multiplier);
+    output channels = in_channels * channel_multiplier."""
+
+    def __init__(self, stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+                 data_format="NHWC", name=None):
+        super().__init__(name=name)
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        assert data_format in ("NHWC", "NCHW"), data_format
+        self.data_format = data_format
+
+    def _op(self, x, w):
+        from jax import lax
+        kh, kw, cin, mult = w.shape
+        fmt = self.data_format
+        pads = [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)]
+        if fmt == "NHWC":
+            # HWIO with I=1 and O grouped cin-major (matches group count)
+            rhs, spec = w.reshape(kh, kw, 1, cin * mult), "HWIO"
+        else:
+            rhs = jnp.transpose(w, (2, 3, 0, 1)).reshape(cin * mult, 1,
+                                                         kh, kw)
+            spec = "OIHW"
+        return lax.conv_general_dilated(
+            x, rhs, (self.stride_h, self.stride_w), pads,
+            dimension_numbers=(fmt, spec, fmt),
+            feature_group_count=cin)
+
+
 __all__ = [
-    "Operation", "Equal", "NotEqual", "ApproximateEqual", "Greater",
+    "Operation", "RangeOps", "DepthwiseConv2D",
+    "Equal", "NotEqual", "ApproximateEqual", "Greater",
     "GreaterEqual", "Less", "LessEqual", "LogicalAnd", "LogicalOr",
     "LogicalNot", "All", "Any", "Sum", "Prod", "Max", "Min", "Mean",
     "Exp", "Expm1", "Log1p", "Floor", "Ceil", "Round", "Rint", "Sign",

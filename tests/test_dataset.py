@@ -229,3 +229,79 @@ class TestSegmentation:
                               np.zeros(4))
         assert np.array_equal(S.rle_to_bbox(S.rle_encode(full), 5, 5),
                               [0, 0, 5, 5])
+
+
+# ---------------------------------------------------------------------------
+# TFRecord + tf.Example (nn/tf/ParsingOps.scala parity)
+# ---------------------------------------------------------------------------
+
+
+def test_tfrecord_example_roundtrip(tmp_path):
+    from bigdl_tpu.dataset.tfrecord import (make_example, parse_example,
+                                            read_tfrecords, write_tfrecords)
+    rng = np.random.RandomState(0)
+    feats = rng.randn(12).astype(np.float32)
+    recs = [make_example({"features": feats, "label": np.int64(3),
+                          "name": b"row0"})]
+    path = str(tmp_path / "data.tfrecord")
+    write_tfrecords(path, recs)
+    got = [parse_example(r) for r in read_tfrecords(path)]
+    assert len(got) == 1
+    assert np.allclose(got[0]["features"], feats, atol=1e-6)
+    assert got[0]["label"][0] == 3
+    assert got[0]["name"][0] == b"row0"
+
+
+def test_tfrecord_crc_detects_corruption(tmp_path):
+    import pytest
+    from bigdl_tpu.dataset.tfrecord import (make_example, read_tfrecords,
+                                            write_tfrecords)
+    path = str(tmp_path / "bad.tfrecord")
+    write_tfrecords(path, [make_example({"x": np.float32(1.0)})])
+    raw = bytearray(open(path, "rb").read())
+    raw[-6] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(IOError):
+        list(read_tfrecords(path))
+
+
+def test_tfrecord_dataset_trains(tmp_path):
+    """TFRecord → Samples → one epoch of LeNet-ish training."""
+    from bigdl_tpu.dataset.tfrecord import (load_tfrecord_dataset,
+                                            make_example, write_tfrecords)
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import Optimizer, SGD
+    from bigdl_tpu.optim.trigger import max_epoch
+    rng = np.random.RandomState(1)
+    recs = []
+    for i in range(32):
+        x = rng.randn(1 * 8 * 8).astype(np.float32)
+        recs.append(make_example({"features": x,
+                                  "label": np.int64(i % 2 + 1)}))
+    path = str(tmp_path / "train.tfrecord")
+    write_tfrecords(path, recs)
+    samples = load_tfrecord_dataset(path, feature_shape=(1, 8, 8))
+    assert len(samples) == 32
+    model = nn.Sequential(nn.View(64), nn.Linear(64, 2), nn.LogSoftMax())
+    Optimizer(model=model, training_set=DataSet.array(samples),
+              criterion=nn.ClassNLLCriterion(),
+              optim_method=SGD(learningrate=0.1),
+              end_trigger=max_epoch(1), batch_size=16).optimize()
+
+
+def test_tfrecord_negative_ints_and_truncation(tmp_path):
+    import pytest
+    from bigdl_tpu.dataset.tfrecord import (make_example, parse_example,
+                                            read_tfrecords, write_tfrecords)
+    ex = parse_example(make_example({"label": np.int64(-5),
+                                     "ids": np.array([-1, 2, -3])}))
+    assert ex["label"][0] == -5
+    assert np.array_equal(ex["ids"], [-1, 2, -3])
+    # truncated payload raises even with verify_crc=False
+    path = str(tmp_path / "trunc.tfrecord")
+    write_tfrecords(path, [make_example({"x": np.float32(1.0)})])
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-8])
+    with pytest.raises(IOError):
+        list(read_tfrecords(path, verify_crc=False))

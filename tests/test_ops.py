@@ -180,3 +180,30 @@ def test_module_to_operation():
     op = ops.ModuleToOperation(nn.ReLU())
     out = _f(op, jnp.asarray([-1.0, 2.0]))
     assert out.tolist() == [0.0, 2.0]
+
+
+def test_range_ops():
+    from bigdl_tpu.ops import RangeOps
+    out = np.asarray(RangeOps().forward([np.int32(2), np.int32(14),
+                                         np.int32(3)]))
+    assert np.array_equal(out, np.arange(2, 14, 3))
+
+
+def test_depthwise_conv2d_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    from bigdl_tpu.ops import DepthwiseConv2D
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 10, 10).astype(np.float32)       # NCHW
+    w = rng.randn(3, 3, 6, 2).astype(np.float32)         # kh,kw,in,mult
+    op = DepthwiseConv2D(stride_w=1, stride_h=1, pad_w=1, pad_h=1,
+                         data_format="NCHW")
+    out = np.asarray(op.forward([x, w]))
+    # torch depthwise: weight (in*mult, 1, kh, kw), groups=in, cin-major
+    wt = torch.tensor(w.transpose(2, 3, 0, 1).reshape(12, 1, 3, 3))
+    ref = F.conv2d(torch.tensor(x), wt, padding=1, groups=6).numpy()
+    assert np.allclose(out, ref, atol=1e-4), np.abs(out - ref).max()
+    # NHWC agrees with NCHW
+    op2 = DepthwiseConv2D(pad_w=1, pad_h=1, data_format="NHWC")
+    out2 = np.asarray(op2.forward([x.transpose(0, 2, 3, 1), w]))
+    assert np.allclose(out2.transpose(0, 3, 1, 2), ref, atol=1e-4)
