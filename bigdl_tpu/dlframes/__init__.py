@@ -1,1 +1,2 @@
 from .dl_estimator import DLEstimator, DLModel, DLClassifier, DLClassifierModel
+from .dl_image_reader import DLImageReader, DLImageTransformer

@@ -802,6 +802,64 @@ def load_weights_hdf5(model, hdf5_path: str, by_name=False,
                  strict=strict)
 
 
+def _compile_from_training_config(model, tc) -> None:
+    """Keras 1.2 ``training_config`` attr → model.compile(...).
+
+    Parity: reference ``pyspark/bigdl/keras/optimization.py`` (OptimConverter
+    maps keras optimizers/losses to bigdl ones).
+    """
+    from ..optim import SGD, Adam, Adagrad, Adadelta, Adamax, RMSprop
+    cfg = json.loads(tc) if isinstance(tc, str) else tc
+    opt = cfg.get("optimizer", {})
+    cls = opt.get("class_name", "SGD")
+    oc = opt.get("config", {})
+    lr = float(oc.get("lr", 0.01))
+    decay = float(oc.get("decay", 0.0))
+    builders = {
+        "sgd": lambda: SGD(learningrate=lr, learningrate_decay=decay,
+                           momentum=float(oc.get("momentum", 0.0)),
+                           nesterov=bool(oc.get("nesterov", False))),
+        "adam": lambda: Adam(learningrate=lr, learningrate_decay=decay,
+                             beta1=float(oc.get("beta_1", 0.9)),
+                             beta2=float(oc.get("beta_2", 0.999)),
+                             epsilon=float(oc.get("epsilon", 1e-8))),
+        "rmsprop": lambda: RMSprop(learningrate=lr,
+                                   learningrate_decay=decay,
+                                   decayrate=float(oc.get("rho", 0.9)),
+                                   epsilon=float(oc.get("epsilon", 1e-8))),
+        "adagrad": lambda: Adagrad(learningrate=lr,
+                                   learningrate_decay=decay),
+        "adadelta": lambda: Adadelta(
+            decayrate=float(oc.get("rho", 0.95)),
+            epsilon=float(oc.get("epsilon", 1e-8))),
+        "adamax": lambda: Adamax(learningrate=lr,
+                                 beta1=float(oc.get("beta_1", 0.9)),
+                                 beta2=float(oc.get("beta_2", 0.999))),
+    }
+    builder = builders.get(cls.lower())
+    if builder is None:
+        warnings.warn(f"keras converter: optimizer {cls} unsupported; "
+                      "model left uncompiled")
+        return
+    loss = cfg.get("loss", "categorical_crossentropy")
+    from .topology import _LOSSES
+    # validate BEFORE compile: a failed compile must not leave the model
+    # half-mutated (optimizer set, loss missing)
+    if not isinstance(loss, str) or loss.lower() not in _LOSSES:
+        warnings.warn(f"keras converter: loss {loss!r} has no mapping; "
+                      "model left uncompiled")
+        return
+    metrics = []
+    for m in cfg.get("metrics") or []:
+        if m in ("accuracy", "acc"):
+            metrics.append(m)
+        else:
+            warnings.warn(f"keras converter: metric {m!r} unsupported — "
+                          "dropped (reference OptimConverter rejects it "
+                          "too)")
+    model.compile(optimizer=builder(), loss=loss, metrics=metrics or None)
+
+
 def load_keras(json_path: Optional[str] = None,
                hdf5_path: Optional[str] = None):
     """One-call loader: JSON definition (+ optional HDF5 weights) → model.
@@ -810,19 +868,34 @@ def load_keras(json_path: Optional[str] = None,
     ``load_keras(json_path=..., hdf5_path=...)`` — definition + weights;
     ``load_keras(hdf5_path=...)`` — full-model HDF5 (``model_config`` attr).
     """
+    def _dec(v):
+        return v.decode() if isinstance(v, bytes) else v
+
+    mc = tc = weights = None
+    if hdf5_path is not None:
+        import h5py
+        with h5py.File(hdf5_path, "r") as f:  # one open for everything
+            mc = _dec(f.attrs.get("model_config"))
+            tc = _dec(f.attrs.get("training_config"))
+            g = f["model_weights"] if "model_weights" in f else f
+            weights = {}
+            for ln in (n.decode() if isinstance(n, bytes) else n
+                       for n in g.attrs["layer_names"]):
+                grp = g[ln]
+                wn = [n.decode() if isinstance(n, bytes) else n
+                      for n in grp.attrs.get("weight_names", [])]
+                weights[ln] = [np.asarray(grp[n]) for n in wn]
+
     if json_path is not None:
         with open(json_path) as f:
             model = model_from_json(f.read())
-    elif hdf5_path is not None:
-        import h5py
-        with h5py.File(hdf5_path, "r") as f:
-            cfg = f.attrs.get("model_config")
-            if cfg is None:
-                raise ValueError("hdf5 has no model_config; pass json_path")
-            model = model_from_json(cfg.decode()
-                                    if isinstance(cfg, bytes) else cfg)
+    elif mc is not None:
+        model = model_from_json(mc)
     else:
-        raise ValueError("need json_path or hdf5_path")
-    if hdf5_path is not None:
-        load_weights_hdf5(model, hdf5_path)
+        raise ValueError("hdf5 has no model_config; pass json_path"
+                         if hdf5_path else "need json_path or hdf5_path")
+    if weights is not None:
+        load_weights(model, weights)
+        if tc is not None:
+            _compile_from_training_config(model, tc)
     return model

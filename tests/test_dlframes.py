@@ -51,3 +51,68 @@ def test_dlframes_with_pandas():
     out = fitted.transform(df)
     assert "prediction" in out.columns
     assert len(out) == 100
+
+
+def test_dl_image_reader_and_transformer(tmp_path):
+    """DLImageReader.read_images + DLImageTransformer parity (pandas-based
+    image schema)."""
+    from PIL import Image
+    import numpy as np
+    from bigdl_tpu.dlframes.dl_image_reader import (DLImageReader,
+                                                    DLImageTransformer)
+    from bigdl_tpu.transform.vision import Resize, ChannelNormalize
+    rng = np.random.RandomState(0)
+    d = tmp_path / "imgs"
+    d.mkdir()
+    for i in range(3):
+        arr = rng.randint(0, 255, (12 + i, 10, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(str(d / f"im{i}.png"))
+    (d / "notes.txt").write_text("not an image")
+
+    df = DLImageReader.read_images(str(d))
+    assert len(df) == 3
+    row = df["image"][0]
+    assert row["nChannels"] == 3 and row["data"].shape[2] == 3
+
+    t = DLImageTransformer(Resize(8, 8) | ChannelNormalize(
+        0.0, 0.0, 0.0, 255.0, 255.0, 255.0))
+    out = t.transform(df)
+    res = out["output"][0]
+    assert res["height"] == 8 and res["width"] == 8
+    assert float(np.asarray(res["data"]).max()) <= 1.0
+
+
+def test_keras_training_config_compiles(tmp_path):
+    """Full-model HDF5 with training_config compiles the converted model
+    (OptimConverter parity) and fit runs."""
+    import json as _json
+    import numpy as np
+    import h5py
+    from bigdl_tpu.keras import load_keras
+    from bigdl_tpu.optim import RMSprop
+    spec = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense", "config": {
+            "name": "d", "output_dim": 3, "activation": "softmax",
+            "batch_input_shape": [None, 4]}}]}
+    rng = np.random.RandomState(0)
+    w, b = rng.randn(4, 3).astype(np.float32), np.zeros(3, np.float32)
+    path = str(tmp_path / "full.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = _json.dumps(spec).encode()
+        f.attrs["training_config"] = _json.dumps({
+            "optimizer": {"class_name": "RMSprop",
+                          "config": {"lr": 0.003, "rho": 0.8}},
+            "loss": "categorical_crossentropy",
+            "metrics": ["accuracy"]}).encode()
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"d"]
+        g = mw.create_group("d")
+        g.attrs["weight_names"] = [b"d_W", b"d_b"]
+        g.create_dataset("d_W", data=w)
+        g.create_dataset("d_b", data=b)
+    model = load_keras(hdf5_path=path)
+    assert isinstance(model.optim_method, RMSprop)
+    assert abs(model.optim_method.learningrate - 0.003) < 1e-9
+    x = rng.randn(32, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    model.fit(x, y, batch_size=16, nb_epoch=1)
