@@ -9,3 +9,5 @@ from .sharding import (replicated, data_sharding, shard_batch, shard_params,
 from .ring_attention import ring_attention
 from .failure import (probe_mesh, MeshProbeResult, Heartbeat,
                       StragglerMonitor)
+from .pipeline import gpipe, stack_stage_params, unstack_stage_params
+from .moe import moe_ffn, top1_routing

@@ -1,0 +1,94 @@
+"""Mixture-of-Experts with expert parallelism over an ``expert`` mesh axis.
+
+TPU-first addition beyond the reference (BigDL 0.x has no MoE). Switch-style
+top-1 routing with the Mesh-TensorFlow dispatch/combine formulation
+(PAPERS.md: Mesh-TensorFlow, arXiv:1811.02084): routing builds dense
+(tokens, experts, capacity) dispatch/combine tensors so the data movement is
+two einsums plus ``all_to_all`` over ICI — no dynamic shapes, MXU-friendly.
+
+Layout: the ``expert`` axis doubles as the token (data) axis — each device
+holds its local token slice AND exactly one expert (E = axis size).
+``all_to_all`` exchanges expert minibatches: device d sends the tokens it
+routed to expert e to e's owner and receives every device's tokens for its
+own expert.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_routing(logits, capacity: int):
+    """Switch routing: (tokens, E) logits → dispatch (t, E, C) bool,
+    combine (t, E, C) float, aux load-balance loss."""
+    t, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)              # (t,)
+    expert = jnp.argmax(probs, axis=-1)         # (t,)
+    onehot = jax.nn.one_hot(expert, E, dtype=logits.dtype)  # (t, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0          # (t, E)
+    pos_of_token = jnp.sum(pos * onehot, axis=-1)            # (t,)
+    keep = pos_of_token < capacity
+    pos_clip = jnp.clip(pos_of_token, 0, capacity - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_clip, capacity,
+                                dtype=logits.dtype)          # (t, C)
+    dispatch = (onehot * keep[:, None])[:, :, None] * \
+        pos_onehot[:, None, :]                               # (t, E, C)
+    combine = dispatch * gate[:, None, None]
+    # load-balance auxiliary loss (Switch Transformer eq. 4)
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def moe_ffn(expert_fn: Callable, axis: str = "expert",
+            capacity_factor: float = 1.25):
+    """Build the per-device expert-parallel MoE apply.
+
+    ``expert_fn(expert_params, x) -> y`` is one expert's FFN over a (n, d)
+    batch. Returns ``run(router_w, expert_params, x)`` for use inside
+    ``shard_map`` over ``axis``:
+
+    * ``router_w``: (d, E) gating weights — replicated (``P()``).
+    * ``expert_params``: this device's expert params (leading expert axis
+      sharded over ``axis``; the size-1 local slice is squeezed here —
+      exactly one expert per device).
+    * ``x``: (t_local, d) local token slice (sharded over ``axis``).
+    * returns ((t_local, d) outputs, aux_loss) — aux averaged over the mesh.
+    """
+
+    def run(router_w, expert_params, x):
+        E = lax.axis_size(axis)
+        tloc, d = x.shape
+        def _squeeze(a):
+            if a.ndim and a.shape[0] != 1:
+                raise ValueError(
+                    "moe_ffn supports exactly one expert per device: "
+                    f"local expert-param slice has leading dim {a.shape[0]} "
+                    "(shard the stacked expert axis over the mesh axis)")
+            return a[0] if a.ndim else a
+        expert_params = jax.tree_util.tree_map(_squeeze, expert_params)
+        capacity = max(1, int(capacity_factor * tloc / E + 0.999))
+
+        logits = x @ router_w                                # (t, E)
+        dispatch, combine, aux = top1_routing(logits, capacity)
+
+        expert_in = jnp.einsum("td,tec->ecd", x, dispatch)   # (E, C, d)
+        # exchange: slice e of my queues → expert e's owner; I receive every
+        # device's queue for MY expert, stacked on the source axis
+        recv = lax.all_to_all(expert_in, axis, split_axis=0,
+                              concat_axis=0, tiled=True)     # (E, C, d)
+        out = expert_fn(expert_params,
+                        recv.reshape(E * capacity, d))       # (E*C, d)
+        back = lax.all_to_all(out.reshape(E, capacity, -1), axis,
+                              split_axis=0, concat_axis=0,
+                              tiled=True)                    # (E, C, d)
+        y = jnp.einsum("tec,ecd->td", combine, back)
+        return y, lax.pmean(aux, axis)
+
+    return run

@@ -1,0 +1,93 @@
+"""Pipeline parallelism (GPipe schedule) over a ``pipe`` mesh axis.
+
+TPU-first addition beyond the reference (BigDL 0.x has no pipeline
+parallelism; its scale axis is Spark data parallelism only). The design is
+the SPMD collective-pipeline formulation: every device holds ONE stage's
+parameters (a homogeneous stack, e.g. transformer blocks), activations hop
+stage→stage over ICI via ``ppermute`` inside a ``lax.scan`` over schedule
+ticks, and microbatches fill the pipe GPipe-style (bubble =
+(S-1)/(S-1+M)). Autodiff through ``scan``+``ppermute`` gives the backward
+schedule for free — the transpose of a forward hop is the reverse hop, so
+``jax.grad`` of a pipelined loss is itself a pipelined program.
+
+Use inside ``shard_map``: stage params enter with their leading stage axis
+sharded over ``pipe`` (spec ``P('pipe')``), the microbatched input
+replicated (``P()``).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(stage_fn: Callable, axis: str = "pipe"):
+    """Build the per-device pipelined apply.
+
+    ``stage_fn(stage_params, x) -> y`` must be shape-preserving (homogeneous
+    stages — the transformer-block case). Returns ``run(params, x_stack)``
+    for use inside ``shard_map`` over ``axis``:
+
+    * ``params``: this device's stage parameters (leading stage axis of the
+      stacked tree already stripped to size 1 by the shard_map spec; leaves
+      are squeezed here).
+    * ``x_stack``: (n_micro, micro_batch, ...) — replicated.
+    * returns (n_micro, micro_batch, ...) — the last stage's outputs,
+      broadcast to every device (masked psum), so downstream loss code is
+      ordinary SPMD.
+    """
+
+    def run(params, x_stack):
+        n_stages = lax.axis_size(axis)
+        idx = lax.axis_index(axis)
+        n_micro = x_stack.shape[0]
+        ticks = n_micro + n_stages - 1
+        params = jax.tree_util.tree_map(
+            lambda a: a[0] if a.ndim and a.shape[0] == 1 else a, params)
+
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        # the carry varies per device from tick 1 on; mark the initial
+        # zeros as axis-varying so the scan carry type is stable
+        def _vary(a):
+            try:
+                return lax.pcast(a, to="varying")
+            except (AttributeError, TypeError):  # older jax spelling
+                return lax.pvary(a, axis)
+        zeros = _vary(jnp.zeros_like(x_stack[0]))
+        outs0 = _vary(jnp.zeros_like(x_stack))
+
+        def tick(carry, t):
+            recv, outs = carry
+            mb = jnp.clip(t, 0, n_micro - 1)
+            inp = jnp.where(idx == 0, x_stack[mb], recv)
+            out = stage_fn(params, inp)
+            # the last stage finishes microbatch m at tick t = m + S - 1
+            m = t - (n_stages - 1)
+            mclip = jnp.clip(m, 0, n_micro - 1)
+            valid = jnp.logical_and(idx == n_stages - 1, m >= 0)
+            outs = outs.at[mclip].set(
+                jnp.where(valid, out, outs[mclip]))
+            recv_next = lax.ppermute(out, axis, perm)
+            return (recv_next, outs), None
+
+        (_, outs), _ = lax.scan(tick, (zeros, outs0), jnp.arange(ticks))
+        # broadcast the last stage's outputs to the whole mesh
+        is_last = (idx == n_stages - 1).astype(x_stack.dtype)
+        return lax.psum(outs * is_last, axis)
+
+    return run
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage param trees along a new leading axis
+    (the axis ``shard_map`` shards over ``pipe``)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0),
+                                  *per_stage_params)
+
+
+def unstack_stage_params(stacked, n_stages: int):
+    """Inverse of :func:`stack_stage_params`."""
+    return [jax.tree_util.tree_map(lambda a, i=i: a[i], stacked)
+            for i in range(n_stages)]
