@@ -86,9 +86,10 @@ def bench_resnet50():
     # the axon PJRT plugin registers the real chip under platform name
     # "axon", not "tpu" — treat both as TPU-class
     on_tpu = backend in ("tpu", "axon")
-    batch = 256 if on_tpu else 4
-    steps = 20 if on_tpu else 2
-    warmup = 3 if on_tpu else 1
+    # env overrides make on-chip batch/step sweeps cheap (BENCH_*)
+    batch = int(os.environ.get("BENCH_BATCH", 256 if on_tpu else 4))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
+    warmup = int(os.environ.get("BENCH_WARMUP", 3 if on_tpu else 1))
     size = 224 if on_tpu else 64
 
     engine.set_seed(0)

@@ -43,6 +43,7 @@ from .shape_ops import (Reshape, View, InferReshape, Squeeze, Unsqueeze,
                         ResizeBilinear)
 from .sparse import (SparseTensor, SparseLinear, LookupTableSparse,
                      SparseJoinTable, DenseToSparse, sparse_dense_matmul)
+from .moe import MixtureOfExperts
 from .table_ops import (CAddTable, CSubTable, CMulTable, CDivTable, CMaxTable,
                         CMinTable, CAveTable, JoinTable, SplitTable,
                         BifurcateSplitTable, SelectTable, NarrowTable,

@@ -1,0 +1,63 @@
+"""Mixture-of-Experts layer (single-program form).
+
+The SPMD expert-parallel counterpart is :func:`bigdl_tpu.parallel.moe.moe_ffn`
+(same dispatch/combine math over a device mesh). This module form drops into
+any Sequential/Graph like an ordinary FFN.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .module import Module
+from ..parallel.moe import expert_capacity, top1_routing
+class MixtureOfExperts(Module):
+    """Switch-style MoE FFN as an ordinary layer (single-program form).
+
+    Top-1 routing with capacity + load-balance loss over (B, T, D) or
+    (N, D) inputs; experts are (D→hidden→D) FFNs evaluated via the same
+    dense dispatch/combine einsums as :func:`parallel.moe.moe_ffn` (which is
+    the expert-parallel shard_map form of this layer). The auxiliary loss is
+    stored in ``state['aux_loss']`` after each forward so optimizers can
+    regularize routing.
+    """
+
+    def __init__(self, hidden_size: int, n_experts: int,
+                 ffn_hidden: Optional[int] = None,
+                 capacity_factor: float = 1.25, name=None):
+        super().__init__(name=name)
+        self.hidden_size = hidden_size
+        self.n_experts = n_experts
+        self.ffn_hidden = ffn_hidden or 4 * hidden_size
+        self.capacity_factor = capacity_factor
+
+    def _init_params(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        d, h, E = self.hidden_size, self.ffn_hidden, self.n_experts
+        s1, s2 = 1.0 / np.sqrt(d), 1.0 / np.sqrt(h)
+        return {"router": jax.random.normal(k1, (d, E)) * s1,
+                "w1": jax.random.normal(k2, (E, d, h)) * s1,
+                "w2": jax.random.normal(k3, (E, h, d)) * s2}
+
+    def _init_state(self):
+        return {"aux_loss": jnp.zeros(())}
+
+    def _apply(self, params, state, x, training, rng):
+        shape = x.shape
+        t = int(np.prod(shape[:-1]))
+        h = x.reshape(t, shape[-1])
+        capacity = expert_capacity(t, self.n_experts,
+                                   self.capacity_factor)
+        logits = h @ params["router"]
+        dispatch, combine, aux = top1_routing(logits, capacity)
+        expert_in = jnp.einsum("td,tec->ecd", h, dispatch)
+        mid = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in,
+                                     params["w1"]))
+        out = jnp.einsum("ech,ehd->ecd", mid, params["w2"])
+        y = jnp.einsum("tec,ecd->td", combine, out)
+        new_state = dict(state)
+        new_state["aux_loss"] = aux
+        return y.reshape(shape), new_state

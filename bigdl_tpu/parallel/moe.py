@@ -14,11 +14,17 @@ own expert.
 """
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def expert_capacity(tokens: int, n_experts: int, factor: float) -> int:
+    """Per-expert queue length: ceil(factor * tokens / n_experts), min 1."""
+    return max(1, math.ceil(factor * tokens / n_experts))
 
 
 def top1_routing(logits, capacity: int):
@@ -73,7 +79,7 @@ def moe_ffn(expert_fn: Callable, axis: str = "expert",
                     "(shard the stacked expert axis over the mesh axis)")
             return a[0] if a.ndim else a
         expert_params = jax.tree_util.tree_map(_squeeze, expert_params)
-        capacity = max(1, int(capacity_factor * tloc / E + 0.999))
+        capacity = expert_capacity(tloc, E, capacity_factor)
 
         logits = x @ router_w                                # (t, E)
         dispatch, combine, aux = top1_routing(logits, capacity)

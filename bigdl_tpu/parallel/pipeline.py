@@ -52,7 +52,7 @@ def gpipe(stage_fn: Callable, axis: str = "pipe"):
         # zeros as axis-varying so the scan carry type is stable
         def _vary(a):
             try:
-                return lax.pcast(a, to="varying")
+                return lax.pcast(a, axis, to="varying")
             except (AttributeError, TypeError):  # older jax spelling
                 return lax.pvary(a, axis)
         zeros = _vary(jnp.zeros_like(x_stack[0]))

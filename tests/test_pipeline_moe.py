@@ -164,3 +164,23 @@ def test_moe_grads_flow():
     gr, gw = jax.jit(jax.grad(loss, argnums=(0, 1)))(router_w, {"w": ws}, x)
     assert float(jnp.abs(gw["w"]).sum()) > 0
     assert float(jnp.abs(gr).sum()) > 0
+
+
+def test_mixture_of_experts_layer():
+    """nn.MixtureOfExperts: shapes, aux loss recorded, trains by grad."""
+    from bigdl_tpu.nn import MixtureOfExperts
+    m = MixtureOfExperts(hidden_size=8, n_experts=4, ffn_hidden=16,
+                         capacity_factor=2.0)
+    m.ensure_initialized()
+    x = np.random.RandomState(0).randn(2, 6, 8).astype(np.float32)
+    out = m.forward(x)
+    assert np.asarray(out).shape == (2, 6, 8)
+    assert float(m.state["aux_loss"]) > 0
+
+    def loss(p):
+        y, st = m.apply(p, m.state, x, training=True)
+        return jnp.mean(y ** 2) + 0.01 * st["aux_loss"]
+
+    g = jax.grad(loss)(m.params)
+    assert all(float(jnp.abs(l).sum()) > 0
+               for l in jax.tree_util.tree_leaves(g))
