@@ -227,4 +227,3 @@ class DenseToSparse(Module):
 
     def _apply(self, params, state, x, training, rng):
         return SparseTensor.from_dense(np.asarray(x), nnz=self.nnz)
-

@@ -12,6 +12,6 @@ from .validation import (ValidationMethod, ValidationResult, AccuracyResult,
                          LossResult, Top1Accuracy, Top5Accuracy, Loss, MAE,
                          HitRatio, NDCG, TreeNNAccuracy)
 from .optimizer import (Optimizer, LocalOptimizer, DistriOptimizer,
-                        BaseOptimizer, Metrics)
-from .evaluator import Evaluator
+                        ParallelOptimizer, BaseOptimizer, Metrics)
+from .evaluator import Evaluator, LocalValidator, DistriValidator
 from .predictor import Predictor, PredictionService

@@ -41,3 +41,12 @@ class Evaluator:
                 r = m(out, mb.get_target())
                 results[i] = r if results[i] is None else results[i] + r
         return results
+
+
+class LocalValidator(Evaluator):
+    """Name parity: optim/LocalValidator.scala (same engine here)."""
+
+
+class DistriValidator(Evaluator):
+    """Name parity: optim/DistriValidator.scala — validation batches shard
+    over the engine mesh exactly like training ones (XLA owns the split)."""

@@ -566,6 +566,13 @@ class DistriOptimizer(BaseOptimizer):
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
 
 
+class ParallelOptimizer(DistriOptimizer):
+    """Name parity: optim/ParallelOptimizer.scala — the reference's
+    layer-wise-parallel gradient aggregation variant. Under XLA the jitted
+    step already aggregates all gradients in one fused program, so this is
+    the same engine as DistriOptimizer."""
+
+
 class Optimizer(BaseOptimizer):
     """Factory with the reference's signature (optim/Optimizer.scala apply):
     picks Local vs Distri from the engine mesh size."""

@@ -96,3 +96,96 @@ def test_quantized_roundtrip(tmp_path):
     m.ensure_initialized()
     q = quantize(m)
     _roundtrip(q, np.random.randn(2, 6).astype(np.float32), tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# Comprehensive per-layer catalog (ModuleSerializationTest breadth, §4):
+# every layer family round-trips save/load with identical outputs.
+# ---------------------------------------------------------------------------
+
+_CATALOG = [
+    # linear family
+    (lambda: nn.Bilinear(3, 4, 5), ("table", [(2, 3), (2, 4)])),
+    (lambda: nn.Cosine(4, 3), (2, 4)),
+    (lambda: nn.Euclidean(4, 3), (2, 4)),
+    (lambda: nn.Add(4), (2, 4)),
+    (lambda: nn.Mul(), (2, 4)),
+    (lambda: nn.CMul([1, 4]), (2, 4)),
+    (lambda: nn.CAdd([1, 4]), (2, 4)),
+    (lambda: nn.Scale([1, 4]), (2, 4)),
+    (lambda: nn.Highway(4), (2, 4)),
+    (lambda: nn.LookupTable(10, 6), (2, 5)),
+    # conv family
+    (lambda: nn.SpatialDilatedConvolution(2, 3, 3, 3, 1, 1, 2, 2,
+                                          dilation_w=2, dilation_h=2),
+     (1, 2, 10, 10)),
+    (lambda: nn.SpatialFullConvolution(3, 2, 3, 3, 2, 2), (1, 3, 5, 5)),
+    (lambda: nn.SpatialSeparableConvolution(2, 4, 2, 3, 3), (1, 2, 8, 8)),
+    (lambda: nn.TemporalConvolution(3, 5, 2), (2, 7, 3)),
+    (lambda: nn.VolumetricConvolution(2, 3, 2, 2, 2), (1, 2, 5, 5, 5)),
+    (lambda: nn.LocallyConnected2D(2, 3, 6, 6, 3, 3), (1, 2, 6, 6)),
+    # pooling
+    (lambda: nn.SpatialMaxPooling(2, 2, 2, 2), (1, 3, 6, 6)),
+    (lambda: nn.SpatialAveragePooling(2, 2, 2, 2), (1, 3, 6, 6)),
+    (lambda: nn.TemporalMaxPooling(2, 2), (2, 6, 3)),
+    (lambda: nn.VolumetricMaxPooling(2, 2, 2, 2, 2, 2), (1, 2, 4, 4, 4)),
+    # norm
+    (lambda: nn.SpatialBatchNormalization(3), (2, 3, 5, 5)),
+    (lambda: nn.LayerNormalization(6), (2, 6)),
+    (lambda: nn.SpatialCrossMapLRN(3, 1.0, 0.75), (1, 4, 5, 5)),
+    (lambda: nn.Normalize(2.0), (2, 5)),
+    # activations (parameterised + stateless sample)
+    (lambda: nn.PReLU(), (2, 3)),
+    (lambda: nn.SReLU((4,)), (2, 4)),
+    (lambda: nn.RReLU(), (2, 4)),
+    (lambda: nn.ELU(0.5), (2, 4)),
+    (lambda: nn.Maxout(4, 3, 2), (2, 4)),
+    (lambda: nn.SoftMax(), (2, 4)),
+    (lambda: nn.HardTanh(), (2, 4)),
+    # shape ops
+    (lambda: nn.Reshape([6]), (2, 2, 3)),
+    (lambda: nn.Transpose([(2, 3)]), (2, 3, 4)),
+    (lambda: nn.Squeeze(2), (2, 1, 3)),
+    (lambda: nn.Unsqueeze(2), (2, 3)),
+    (lambda: nn.Padding(2, 2, 2), (2, 3)),
+    (lambda: nn.Narrow(2, 2, 2), (2, 4)),
+    (lambda: nn.Replicate(3), (2, 4)),
+    (lambda: nn.UpSampling2D((2, 2)), (1, 2, 3, 3)),
+    (lambda: nn.Cropping2D((1, 1), (1, 1)), (1, 2, 6, 6)),
+    # table ops
+    (lambda: nn.CAddTable(), ("table", [(2, 3), (2, 3)])),
+    (lambda: nn.CMaxTable(), ("table", [(2, 3), (2, 3)])),
+    (lambda: nn.JoinTable(2), ("table", [(2, 3), (2, 4)])),
+    (lambda: nn.DotProduct(), ("table", [(2, 3), (2, 3)])),
+    (lambda: nn.PairwiseDistance(), ("table", [(2, 3), (2, 3)])),
+    (lambda: nn.MM(), ("table", [(2, 3, 4), (2, 4, 5)])),
+    # recurrent variants
+    (lambda: nn.Recurrent(nn.GRU(3, 5)), (2, 6, 3)),
+    (lambda: nn.Recurrent(nn.RnnCell(3, 4)), (2, 6, 3)),
+    (lambda: nn.BiRecurrent().add(nn.LSTM(3, 4)), (2, 6, 3)),
+    (lambda: nn.TimeDistributed(nn.Linear(3, 2)), (2, 5, 3)),
+    # containers
+    (lambda: nn.Concat(2, nn.Linear(4, 2), nn.Linear(4, 3)), (2, 4)),
+    (lambda: nn.ConcatTable(nn.Linear(4, 2), nn.Identity()), (2, 4)),
+    (lambda: nn.Bottle(nn.Linear(4, 3)), (2, 5, 4)),
+    (lambda: nn.MapTable(nn.Linear(3, 2)), ("table", [(2, 3), (2, 3)])),
+    # misc
+    (lambda: nn.MixtureOfExperts(6, 2, ffn_hidden=8), (4, 6)),
+    (lambda: nn.SparseLinear(6, 3), (2, 6)),
+    (lambda: nn.GradientReversal(), (2, 4)),
+    (lambda: nn.Echo(), (2, 4)),
+]
+
+
+@pytest.mark.parametrize("case_idx", range(len(_CATALOG)))
+def test_catalog_roundtrip(case_idx, tmp_path):
+    factory, shape = _CATALOG[case_idx]
+    rng = np.random.RandomState(case_idx)
+    if isinstance(shape, tuple) and shape and shape[0] == "table":
+        x = Table(*[rng.randn(*s).astype(np.float32) for s in shape[1]])
+    else:
+        x = rng.randn(*shape).astype(np.float32)
+    m = factory()
+    if isinstance(m, nn.LookupTable):
+        x = np.abs(x) * 3 + 1
+    _roundtrip(m, x, tmp_path)
