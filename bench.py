@@ -192,6 +192,50 @@ def _json_lines(out: str):
     return found
 
 
+_TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench_tpu_cache.json")
+
+
+def _cache_tpu_lines(lines):
+    """Remember the last successful on-TPU measurement so a tunnel outage at
+    bench time degrades to stale-but-real evidence instead of none."""
+    tpu = [l for l in lines if l.get("backend") in ("tpu", "axon")]
+    if not tpu:
+        return
+    existing = {}
+    try:  # a corrupt cache resets rather than blocking the fresh write
+        with open(_TPU_CACHE) as f:
+            existing = {l["metric"]: l for l in json.load(f)
+                        if isinstance(l, dict) and "metric" in l}
+    except (OSError, ValueError):
+        pass
+    try:
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        for l in tpu:
+            existing[l["metric"]] = dict(l, measured_at=stamp)
+        tmp = _TPU_CACHE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(list(existing.values()), f, indent=1)
+        os.replace(tmp, _TPU_CACHE)  # atomic: no torn cache on crash
+    except (OSError, ValueError, KeyError):
+        pass  # a failed cache update must never fail the bench itself
+
+
+def _cached_tpu_lines(which):
+    try:
+        with open(_TPU_CACHE) as f:
+            cached = json.load(f)
+    except (OSError, ValueError):
+        return []
+    keys = {"headline": ("resnet50_",),
+            "secondary": ("lenet_", "vgg16_", "lstm_", "inception_")}
+    out = []
+    for l in cached:
+        if l.get("metric", "").startswith(keys.get(which, ())):
+            out.append(dict(l, cached=True))
+    return out
+
+
 def _cpu_env():
     env = os.environ.copy()
     # Strip axon registration so sitecustomize cannot hang at interpreter
@@ -217,19 +261,34 @@ def _run_child(which: str, env, timeout: float):
 
 
 def _orchestrate(which: str):
-    """Run a child config: TPU with timeout, retry, then CPU fallback."""
+    """Run a child config: TPU with timeout, retry, then cached-TPU result
+    (a previous real measurement, flagged ``cached``), then CPU fallback."""
     attempts = [
         (os.environ.copy(), 800.0, "tpu attempt 1"),
         (os.environ.copy(), 420.0, "tpu attempt 2"),
-        (_cpu_env(), 420.0, "cpu fallback"),
     ]
     errors = []
-    for env, tmo, label in attempts:
+    for i, (env, tmo, label) in enumerate(attempts):
         lines, err = _run_child(which, env, tmo)
-        if lines:
+        if lines and any(l.get("backend") in ("tpu", "axon")
+                         for l in lines):
+            _cache_tpu_lines(lines)
             return lines
-        errors.append(f"{label}: {err}")
-        time.sleep(10)
+        if lines:  # plugin silently degraded to CPU — cached real-TPU
+            # numbers (below) beat a low-fidelity CPU measurement
+            errors.append(f"{label}: degraded to cpu backend")
+        else:
+            errors.append(f"{label}: {err}")
+        if i + 1 < len(attempts):
+            time.sleep(10)
+    cached = _cached_tpu_lines(which)
+    if cached:
+        return [dict(l, tunnel_error="; ".join(errors)[-200:])
+                for l in cached]
+    lines, err = _run_child(which, _cpu_env(), 420.0)
+    if lines:
+        return lines
+    errors.append(f"cpu fallback: {err}")
     # Even the CPU fallback failed: emit a line anyway so the driver
     # records *something* parseable rather than rc!=0.
     return [{"metric": "bench_failed", "value": 0, "unit": "error",
