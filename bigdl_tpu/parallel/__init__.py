@@ -1,4 +1,4 @@
-from .mesh import (make_mesh, data_parallel_mesh, get_default_mesh,
+from .mesh import (make_mesh, make_hybrid_mesh, data_parallel_mesh, get_default_mesh,
                    set_default_mesh, axis_size)
 from .collective import (all_reduce_sum, all_reduce_mean, all_gather,
                          reduce_scatter, ppermute_ring, all_to_all, psum,

@@ -27,11 +27,61 @@ _default_mesh: Optional[Mesh] = None
 
 def make_mesh(shape: Sequence[int], axes: Sequence[str],
               devices=None) -> Mesh:
-    devices = list(devices if devices is not None else jax.devices())
+    """Build a mesh whose device layout follows the physical ICI topology.
+
+    When the requested shape covers every visible device,
+    ``mesh_utils.create_device_mesh`` arranges them so neighboring mesh
+    coordinates are ICI neighbors (ring collectives then ride ICI links
+    instead of hopping the fabric arbitrarily). Falls back to a plain
+    reshape for device subsets or host-only backends.
+    """
+    explicit = devices is not None
+    devices = list(devices if explicit else jax.devices())
     n = int(np.prod(shape))
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    # topology-aware layout only when WE chose the devices — an explicit
+    # caller-supplied ordering must be honored verbatim
+    if n == len(devices) and not explicit:
+        try:
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_device_mesh(tuple(shape),
+                                                devices=devices)
+            return Mesh(arr, tuple(axes))
+        except Exception:  # non-TPU topologies
+            pass
     arr = np.array(devices[:n]).reshape(tuple(shape))
+    return Mesh(arr, tuple(axes))
+
+
+def make_hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
+                     axes: Sequence[str]) -> Mesh:
+    """Multi-slice/multi-host mesh over DCN × ICI.
+
+    ``ici_shape``, ``dcn_shape`` and ``axes`` must have the same length;
+    axis i has total size ``ici_shape[i] * dcn_shape[i]``, with the DCN
+    factor spanning slices/hosts and the ICI factor staying inside one
+    slice. E.g. 2 hosts × 8 chips, dp-over-DCN + tp-over-ICI::
+
+        make_hybrid_mesh(ici_shape=(1, 8), dcn_shape=(2, 1),
+                         axes=("data", "model"))   # mesh (2, 8)
+
+    Put data parallelism on the DCN factor and tensor/sequence parallelism
+    on the ICI factor — gradient all-reduce tolerates DCN latency;
+    per-layer collectives do not (scaling-book recipe). Wraps
+    ``mesh_utils.create_hybrid_device_mesh``.
+    """
+    if not (len(ici_shape) == len(dcn_shape) == len(axes)):
+        raise ValueError("ici_shape, dcn_shape and axes must align "
+                         f"(got {ici_shape}, {dcn_shape}, {axes})")
+    from jax.experimental import mesh_utils
+    devices = jax.devices()
+    # TPU slices expose slice_index; hosts-only backends (and single-slice
+    # multi-process runs) group by process instead
+    granule_by_process = not hasattr(devices[0], "slice_index")
+    arr = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici_shape), tuple(dcn_shape), devices=devices,
+        process_is_granule=granule_by_process)
     return Mesh(arr, tuple(axes))
 
 

@@ -250,3 +250,16 @@ def test_zero1_nan_resume_and_checkpoint_layout(tmp_path):
     snap = [f for f in os.listdir(tmp_path) if f.endswith(".bigdl")][0]
     payload = pickle.load(open(os.path.join(tmp_path, snap), "rb"))
     assert payload["params"]["0"]["weight"].shape == (3, 6)
+
+
+def test_make_mesh_topology_aware_and_hybrid():
+    """make_mesh uses the physical-topology layout when covering all
+    devices; make_hybrid_mesh builds the ICI x DCN split (single-host: DCN
+    axes of size 1)."""
+    from bigdl_tpu.parallel import make_mesh, make_hybrid_mesh
+    m = make_mesh((4, 2), ("data", "model"))
+    assert dict(m.shape) == {"data": 4, "model": 2}
+    assert len({d.id for d in m.devices.flat}) == 8
+    h = make_hybrid_mesh(ici_shape=(1, 8), dcn_shape=(1, 1),
+                         axes=("data", "model"))
+    assert dict(h.shape) == {"data": 1, "model": 8}
