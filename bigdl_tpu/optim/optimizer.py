@@ -132,6 +132,8 @@ class BaseOptimizer:
         self.clip_norm = None
         self.nan_policy = "error"  # or "skip" / "resume"
         self.max_nan_retries = 10  # consecutive non-finite steps before abort
+        self.sync_policy = "sync"  # or "async" (lagged loss reads)
+        self._pending_loss = None
         self.metrics = Metrics()
         self._step_fn = None
 
@@ -172,6 +174,18 @@ class BaseOptimizer:
 
     def disable_gradclip(self):
         self.clip_const = self.clip_norm = None
+        return self
+
+    def set_sync_policy(self, policy: str):
+        """'sync' (default) reads each step's loss immediately — the host
+        blocks on the device every iteration. 'async' reads the PREVIOUS
+        step's loss instead, so the next batch is prepared and enqueued
+        while the device still computes (loss logging, NaN detection and
+        min-loss triggers lag one step; the in-step NaN guard keeps params
+        safe on-device either way). Use 'async' for device-bound training.
+        """
+        assert policy in ("sync", "async")
+        self.sync_policy = policy
         return self
 
     def set_nan_policy(self, policy: str):
@@ -312,6 +326,7 @@ class BaseOptimizer:
             opt_state = self.optim_method.init_state(params)
         params, opt_state, mstate = self._prepare(params, opt_state, mstate)
         self._step_fn = self._build_step()
+        self._pending_loss = None  # never consume a dead run's loss
 
         optim = self.optim_method
         state = optim.state  # {'neval', 'epoch', ...}
@@ -330,7 +345,13 @@ class BaseOptimizer:
                 loss, params, opt_state, mstate = self._step_fn(
                     params, opt_state, mstate, x, y,
                     jnp.asarray(lr, jnp.float32), rng)
-                loss_val = float(loss)
+                if self.sync_policy == "async":
+                    # examine the PREVIOUS step's loss: the device keeps
+                    # computing while the host preps the next batch
+                    prev, self._pending_loss = self._pending_loss, loss
+                    loss_val = float(prev if prev is not None else loss)
+                else:
+                    loss_val = float(loss)
                 t2 = time.time()
                 if not np.isfinite(loss_val):
                     nan_streak += 1
@@ -356,6 +377,7 @@ class BaseOptimizer:
                         self.optim_method.state.update(
                             payload["optim_host_state"])
                         params, opt_state, mstate =                             self._restore_step_state(payload)
+                        self._pending_loss = None  # refers to pre-restore
                         self.metrics.add("nan_resumes", 1.0)
                         continue
                     # 'skip': the in-step guard already kept the previous
@@ -390,6 +412,17 @@ class BaseOptimizer:
                 if self.end_trigger(state):
                     done = True
 
+        if self._pending_loss is not None:  # drain the lagged async read
+            final = float(self._pending_loss)
+            self._pending_loss = None
+            if np.isfinite(final):
+                state["loss"] = final
+            elif self.nan_policy == "error":
+                raise FloatingPointError(
+                    f"non-finite loss {final} on the final step "
+                    "(async lagged read)")
+            else:
+                self.metrics.add("nan_skips", 1.0)
         self.model.params, self.model.state = \
             self._collect(params, mstate, opt_state)
         self.model.grad_params = _tmap(jnp.zeros_like, self.model.params)

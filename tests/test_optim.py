@@ -366,3 +366,71 @@ def test_set_extra_parameter_shape_check():
     extra = m.get_extra_parameter()
     with _pt.raises(ValueError):
         m.set_extra_parameter([np.zeros(1)] * len(extra))
+
+
+def test_async_sync_policy_trains_like_sync():
+    """set_sync_policy('async') reaches the same solution (lagged loss
+    reads only change WHEN the host observes, not what the device runs)."""
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD
+    from bigdl_tpu.optim.trigger import max_epoch
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.utils import engine
+
+    def build_and_train(policy):
+        engine.set_seed(7)
+        rng = np.random.RandomState(3)
+        xs = rng.randn(64, 4).astype(np.float32)
+        ys = (xs[:, 0] > 0).astype(np.int32) + 1
+        ds = DataSet.array([Sample(x, np.float32(y))
+                            for x, y in zip(xs, ys)])
+        m = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+        opt = LocalOptimizer(m, ds, nn.ClassNLLCriterion(),
+                             SGD(learningrate=0.2), max_epoch(4), 16)
+        opt.set_sync_policy(policy)
+        opt.optimize()
+        return np.asarray(m.params["0"]["weight"]), \
+            opt.optim_method.state["loss"]
+
+    w_sync, l_sync = build_and_train("sync")
+    w_async, l_async = build_and_train("async")
+    assert np.allclose(w_sync, w_async, atol=1e-5)
+    assert np.isfinite(l_async)
+    assert abs(l_sync - l_async) < 1e-5  # drained final loss matches
+
+
+def test_async_sync_policy_nan_detection_lags_but_fires():
+    """A NaN produced mid-run is detected via the LAGGED read (step k's
+    blow-up observed at step k+1), and a NaN pending on the FINAL step is
+    caught by the post-loop drain — neither is swallowed."""
+    import numpy as np
+    import pytest as _pt
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD
+    from bigdl_tpu.optim.trigger import max_epoch
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+
+    def diverging_opt(n_samples, epochs):
+        # step 1 is finite; the huge LR explodes params so step 2+ is
+        # non-finite — the first NaN is only ever seen via a lagged read
+        rng = np.random.RandomState(0)
+        xs = (rng.randn(n_samples, 4) * 100).astype(np.float32)
+        ys = rng.randn(n_samples, 1).astype(np.float32) * 100
+        ds = DataSet.array([Sample(x, y) for x, y in zip(xs, ys)])
+        m = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 1))
+        opt = LocalOptimizer(m, ds, nn.MSECriterion(),
+                             SGD(learningrate=1e12), max_epoch(epochs), 16)
+        opt.set_sync_policy("async")
+        return opt
+
+    # many steps: lagged detection mid-run
+    with _pt.raises(FloatingPointError):
+        diverging_opt(64, 4).optimize()
+
+    # exactly 2 steps: the NaN loss of the final step is pending when the
+    # loop ends — the drain must raise, not silently return
+    with _pt.raises(FloatingPointError):
+        diverging_opt(32, 1).optimize()
