@@ -1,5 +1,9 @@
 """Mixture-of-Experts layer (single-program form).
 
+TPU-first addition beyond the reference (BigDL 0.x has no MoE; its
+closest relative is the gating ``nn/MixtureTable.scala``, which this
+generalizes with learned top-1 routing and capacity).
+
 The SPMD expert-parallel counterpart is :func:`bigdl_tpu.parallel.moe.moe_ffn`
 (same dispatch/combine math over a device mesh). This module form drops into
 any Sequential/Graph like an ordinary FFN.

@@ -263,3 +263,42 @@ def test_make_mesh_topology_aware_and_hybrid():
     h = make_hybrid_mesh(ici_shape=(1, 8), dcn_shape=(1, 1),
                          axes=("data", "model"))
     assert dict(h.shape) == {"data": 1, "model": 8}
+
+
+def test_distri_validation_and_summary_during_training(tmp_path):
+    """set_validation + train/val summaries fire during DistriOptimizer
+    training (zero1) and the event files are readable back."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim.optimizer import DistriOptimizer
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.trigger import max_epoch, several_iteration
+    from bigdl_tpu.optim.validation import Top1Accuracy, Loss
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 6).astype(np.float32)
+    ys = (xs[:, 0] > 0).astype(np.int32) + 1
+    xs[ys == 2] += 1.5
+    samples = [Sample(x, np.float32(y)) for x, y in zip(xs, ys)]
+    ds = DataSet.array(samples)
+
+    model = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2),
+                          nn.LogSoftMax())
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                          SGD(learningrate=0.2), max_epoch(3),
+                          batch_size=32, parameter_mode="zero1")
+    opt.set_validation(several_iteration(2), ds,
+                       [Top1Accuracy(), Loss(nn.ClassNLLCriterion())], 32)
+    ts = TrainSummary(str(tmp_path), "run1")
+    vs = ValidationSummary(str(tmp_path), "run1")
+    opt.set_train_summary(ts)
+    opt.set_val_summary(vs)
+    opt.optimize()
+
+    scalars = ts.read_scalar("Loss")
+    assert len(scalars) >= 3
+    acc = vs.read_scalar("Top1Accuracy")
+    assert acc, "validation summary empty"
+    assert acc[-1][1] > 0.6, acc[-1]
