@@ -11,3 +11,4 @@ from .failure import (probe_mesh, MeshProbeResult, Heartbeat,
                       StragglerMonitor)
 from .pipeline import gpipe, stack_stage_params, unstack_stage_params
 from .moe import moe_ffn, top1_routing
+from .ring_flash import ring_flash_attention, make_ring_flash_attention

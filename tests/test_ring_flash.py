@@ -1,0 +1,105 @@
+"""Ring-flash attention: forward + hand-derived ring backward vs dense
+oracle on the 8-virtual-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from bigdl_tpu.parallel.flash import _einsum_fallback as _dense_ref_impl
+from bigdl_tpu.parallel.ring_flash import make_ring_flash_attention
+
+
+def _mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("seq",))
+
+
+def _dense_ref(q, k, v, causal):
+    return _dense_ref_impl(q, k, v, causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_forward_matches_dense(causal):
+    B, H, T, D = 2, 3, 64, 16
+    rng = np.random.RandomState(0 if causal else 1)
+    q, k, v = [jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+               for _ in range(3)]
+    out = make_ring_flash_attention(_mesh(), "seq", causal)(q, k, v)
+    ref = _dense_ref(q, k, v, causal)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-4), \
+        np.abs(np.asarray(out) - np.asarray(ref)).max()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_backward_matches_dense(causal):
+    """The custom ring backward (dK/dV riding the ring) equals autodiff of
+    the dense attention for all three inputs."""
+    B, H, T, D = 1, 2, 32, 8
+    rng = np.random.RandomState(2 if causal else 3)
+    q, k, v = [jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+               for _ in range(3)]
+    tgt = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    ring = make_ring_flash_attention(_mesh(), "seq", causal)
+
+    def loss_ring(q, k, v):
+        return jnp.sum((ring(q, k, v) - tgt) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum((_dense_ref(q, k, v, causal) - tgt) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", g_ring, g_dense):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=5e-3), \
+            (name, np.abs(np.asarray(a) - np.asarray(b)).max())
+
+
+def test_ring_flash_trains_end_to_end():
+    """One SGD step through ring-flash attention reduces the loss."""
+    B, H, T, D = 1, 2, 64, 8
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    wq, wk, wv = [jnp.asarray(rng.randn(D, D) * 0.3, jnp.float32)
+                  for _ in range(3)]
+    tgt = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    ring = make_ring_flash_attention(_mesh(), "seq", causal=True)
+
+    def loss(params):
+        wq, wk, wv = params
+        out = ring(x @ wq, x @ wk, x @ wv)
+        return jnp.mean((out - tgt) ** 2)
+
+    params = (wq, wk, wv)
+    l0, g = jax.jit(jax.value_and_grad(loss))(params)
+    params = jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg, params, g)
+    l1 = jax.jit(loss)(params)
+    assert float(l1) < float(l0), (float(l0), float(l1))
+
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_interpret_kernel_path(causal, monkeypatch):
+    """BIGDL_TPU_FLASH=interpret drives the ring through the actual Pallas
+    kernels (forward AND backward) on CPU."""
+    monkeypatch.setenv("BIGDL_TPU_FLASH", "interpret")
+    B, H, T, D = 1, 1, 32, 8
+    rng = np.random.RandomState(5 if causal else 6)
+    q, k, v = [jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+               for _ in range(3)]
+    ring = make_ring_flash_attention(_mesh(4), "seq", causal)
+    out = ring(q, k, v)
+    ref = _dense_ref(q, k, v, causal)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-3), \
+        np.abs(np.asarray(out) - np.asarray(ref)).max()
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_ref(q, k, v, causal) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ring, g_dense):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=2e-2), \
+            (name, np.abs(np.asarray(a) - np.asarray(b)).max())
