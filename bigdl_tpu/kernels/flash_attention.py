@@ -107,7 +107,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _sds(shape, dtype, vma):
+    """ShapeDtypeStruct, carrying varying-mesh-axes when the caller runs
+    inside a strict-VMA shard_map (parallel/ring_flash.py)."""
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+        except TypeError:  # older jax: no vma kwarg (and no strict check)
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               vma=None):
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
     bq = _pick_block(t_q, block_q)
@@ -134,8 +146,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
                          lambda b_, h_, i, j: (b_, h_, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, tq_pad, 128), jnp.float32),
+            _sds((b, h, tq_pad, d), q.dtype, vma),
+            _sds((b, h, tq_pad, 128), jnp.float32, vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -237,7 +249,12 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g,
+               delta=None, out_dtype=None, vma=None):
+    """``delta``/``out_dtype`` are for block-composed callers
+    (parallel/ring_flash.py): a ring backward precomputes the global
+    rowsum(dO*O) once and needs f32 gradient outputs so per-hop
+    accumulation does not round at the input dtype."""
     q, k, v, o, lse = res
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
@@ -247,8 +264,11 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     tkv_pad = (t_kv + bk - 1) // bk * bk
     nq, nk = tq_pad // bq, tkv_pad // bk
 
-    # delta_i = rowsum(dO_i * O_i) — cheap elementwise+reduce; XLA fuses it
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if delta is None:
+        # delta_i = rowsum(dO_i * O_i) — cheap elementwise+reduce; XLA
+        # fuses it
+        delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1)
 
     qp, kp, vp = _pad_t(q, tq_pad), _pad_t(k, tkv_pad), _pad_t(v, tkv_pad)
     dop = _pad_t(g, tq_pad)
@@ -271,8 +291,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         grid=(b, h, nk, nq),
         in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
         out_specs=[k_spec, k_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, tkv_pad, d), k.dtype),
-                   jax.ShapeDtypeStruct((b, h, tkv_pad, d), v.dtype)],
+        out_shape=[_sds((b, h, tkv_pad, d), out_dtype or k.dtype, vma),
+                   _sds((b, h, tkv_pad, d), out_dtype or v.dtype, vma)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
@@ -290,7 +310,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         grid=(b, h, nq, nk),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=q_spec2,
-        out_shape=jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
+        out_shape=_sds((b, h, tq_pad, d), out_dtype or q.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap)

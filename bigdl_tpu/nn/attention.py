@@ -68,12 +68,25 @@ class Attention(Module):
 
     def __init__(self, hidden_size: int, num_heads: int,
                  attention_dropout: float = 0.0, use_flash: bool = True,
-                 name=None):
+                 seq_axis=None, causal: bool = False, name=None):
+        """``seq_axis``: name of a mesh axis the sequence dim is sharded
+        over — attention then runs the ring-flash path
+        (parallel/ring_flash.py: ppermute K/V rotation, Pallas blocks,
+        O(T/n) memory). Only valid inside ``shard_map`` over that axis;
+        self-attention only, masking via ``causal`` (additive masks
+        cannot cross the ring)."""
         super().__init__(name=name)
         assert hidden_size % num_heads == 0
+        if seq_axis is not None and attention_dropout > 0:
+            raise ValueError(
+                "seq-parallel attention does not support attention "
+                "dropout (the ring kernel has no dropout path) — set "
+                "attention_dropout=0")
         self.hidden_size, self.num_heads = hidden_size, num_heads
         self.attention_dropout = attention_dropout
         self.use_flash = use_flash
+        self.seq_axis = seq_axis
+        self.causal = causal
 
     def _init_params(self, rng):
         k = jax.random.split(rng, 4)
@@ -95,8 +108,18 @@ class Attention(Module):
         q = self._split(qx @ params["wq"])
         k = self._split(kx @ params["wk"])
         v = self._split(kx @ params["wv"])
-        o = dot_product_attention(q, k, v, mask, self.attention_dropout, rng,
-                                  training)
+        if self.seq_axis is not None:
+            if mask is not None:
+                raise ValueError(
+                    "seq-parallel attention supports causal masking only "
+                    "(set causal=True); additive masks cannot cross the "
+                    "ring")
+            from ..parallel.ring_flash import ring_flash_attention
+            o = ring_flash_attention(q, k, v, axis=self.seq_axis,
+                                     causal=self.causal)
+        else:
+            o = dot_product_attention(q, k, v, mask,
+                                      self.attention_dropout, rng, training)
         b, h, t, d = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(b, t, h * d)
         return o @ params["wo"]

@@ -40,12 +40,30 @@ def _einsum_fallback(q, k, v, causal):
     return dot_product_attention(q, k, v, mask)
 
 
-def flash_attention(q, k, v, causal: bool = False):
-    """q, k, v: (B, H, T, D)."""
+def flash_mode() -> str:
+    """Resolved dispatch mode: 'pallas' | 'interpret' | 'einsum'.
+
+    The ONE policy decision shared by every flash consumer (this
+    dispatcher and parallel/ring_flash.py): BIGDL_TPU_FLASH=off forces
+    einsum, =interpret runs the Pallas kernels in the interpreter, and
+    otherwise TPU-class backends get the compiled kernels."""
     mode = os.environ.get("BIGDL_TPU_FLASH", "auto")
     if mode == "off":
-        return _einsum_fallback(q, k, v, causal)
+        return "einsum"
+    if mode == "interpret":
+        return "interpret"
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    return "pallas" if backend in ("tpu", "axon") else "einsum"
 
+
+def flash_attention(q, k, v, causal: bool = False):
+    """q, k, v: (B, H, T, D)."""
+    mode = flash_mode()
+    if os.environ.get("BIGDL_TPU_FLASH") == "off":
+        return _einsum_fallback(q, k, v, causal)  # explicit: no warning
     if mode == "interpret":
         from ..kernels.flash_attention import flash_attention_fused
         return flash_attention_fused(q, k, v, causal=causal, interpret=True)
@@ -54,7 +72,7 @@ def flash_attention(q, k, v, causal: bool = False):
         backend = jax.default_backend()
     except Exception:
         backend = "cpu"
-    if backend in ("tpu", "axon"):
+    if mode == "pallas":
         try:
             # import inside the branch: a jax build without pallas must not
             # break the einsum path for non-TPU callers

@@ -26,36 +26,16 @@ never silently.
 from __future__ import annotations
 
 import functools
-import logging
 import math
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-logger = logging.getLogger("bigdl_tpu")
-_warned = set()
-NEG_INF = -1e30
-
-
-def _warn_once(key, msg, *args):
-    if key not in _warned:
-        _warned.add(key)
-        logger.warning(msg, *args)
-
-
-def _block_mode() -> str:
-    mode = os.environ.get("BIGDL_TPU_FLASH", "auto")
-    if mode == "off":
-        return "einsum"
-    if mode == "interpret":
-        return "interpret"
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        backend = "cpu"
-    return "pallas" if backend in ("tpu", "axon") else "einsum"
+# ONE shared dispatch policy + warn-once registry (parallel/flash.py) and
+# the kernels' own masking constant — no second copy to drift
+from .flash import _warn_once, flash_mode as _block_mode
+from ..kernels.flash_attention import NEG_INF
 
 
 # ---------------------------------------------------------------------------
@@ -78,7 +58,7 @@ def _block_attn_einsum(q, kb, vb, scale, causal_diag):
     return o, lse
 
 
-def _block_attn(q, kb, vb, scale, diag: bool, causal: bool):
+def _block_attn(q, kb, vb, scale, diag: bool, causal: bool, axis=None):
     """(o, lse) for one K/V block. ``diag`` — block holds the same global
     positions as q (triangular mask applies)."""
     use_causal = causal and diag
@@ -87,7 +67,8 @@ def _block_attn(q, kb, vb, scale, diag: bool, causal: bool):
         try:
             from ..kernels.flash_attention import _flash_fwd
             return _flash_fwd(q, kb, vb, use_causal, scale, 512, 512,
-                              mode == "interpret")
+                              mode == "interpret",
+                              vma={axis} if axis else None)
         except Exception as e:  # pragma: no cover - depends on backend
             _warn_once("ring_fwd", "ring-flash forward kernel failed (%s); "
                        "falling back to einsum blocks", e)
@@ -110,29 +91,40 @@ def _block_bwd_einsum(q, kb, vb, lse, delta, do, scale, causal_diag):
     return dq, dk, dv
 
 
-def _block_bwd(q, kb, vb, o, lse, do, scale, diag: bool, causal: bool):
-    """One block's (dq, dk, dv) contributions, f32, from GLOBAL (o, lse)."""
+def _block_bwd(q, kb, vb, o, lse, delta, do, scale, diag: bool,
+               causal: bool, axis=None):
+    """One block's (dq, dk, dv) contributions, f32, from GLOBAL (o, lse)
+    and precomputed GLOBAL delta = rowsum(dO*O) (hoisted out of the ring
+    scan — it is hop-invariant)."""
     use_causal = causal and diag
     mode = _block_mode()
     if mode in ("pallas", "interpret"):
         try:
             from ..kernels.flash_attention import _flash_bwd
-            dq, dk, dv = _flash_bwd(use_causal, scale, 512, 512,
-                                    mode == "interpret",
-                                    (q, kb, vb, o, lse), do)
-            return (dq.astype(jnp.float32), dk.astype(jnp.float32),
-                    dv.astype(jnp.float32))
+            # out_dtype=f32: per-hop contributions must not round at the
+            # input dtype before the ring accumulators sum them
+            return _flash_bwd(use_causal, scale, 512, 512,
+                              mode == "interpret", (q, kb, vb, o, lse), do,
+                              delta=delta, out_dtype=jnp.float32,
+                              vma={axis} if axis else None)
         except Exception as e:  # pragma: no cover - depends on backend
             _warn_once("ring_bwd", "ring-flash backward kernel failed "
                        "(%s); falling back to einsum blocks", e)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)
     return _block_bwd_einsum(q, kb, vb, lse, delta, do, scale, use_causal)
 
 
 # ---------------------------------------------------------------------------
 # ring forward / backward
 # ---------------------------------------------------------------------------
+
+
+def _vary(x, axis):
+    """Mark a fresh constant as varying over ``axis`` (strict-VMA
+    shard_map requires cond branches / scan carries to agree)."""
+    try:
+        return lax.pcast(x, axis, to="varying")
+    except (AttributeError, TypeError):  # older jax spelling
+        return lax.pvary(x, axis)
 
 
 def _merge(o, lse, o_i, lse_i):
@@ -162,7 +154,8 @@ def _ring_fwd(q, k, v, axis, causal):
         if causal:
             b, h, tb, d = q.shape
             zeros = (jnp.zeros_like(q),
-                     jnp.full((b, h, tb), NEG_INF, jnp.float32))
+                     _vary(jnp.full((b, h, tb), NEG_INF, jnp.float32),
+                           axis))
             # later blocks fully invisible: skip the compute entirely;
             # diagonal needs the triangular mask; earlier fully visible
             o_i, lse_i = lax.cond(
@@ -171,11 +164,12 @@ def _ring_fwd(q, k, v, axis, causal):
                 lambda: lax.cond(
                     src == idx,
                     lambda: _block_attn(q, k_blk, v_blk, scale, True,
-                                        True),
+                                        True, axis),
                     lambda: _block_attn(q, k_blk, v_blk, scale, False,
-                                        True)))
+                                        True, axis)))
         else:
-            o_i, lse_i = _block_attn(q, k_blk, v_blk, scale, False, False)
+            o_i, lse_i = _block_attn(q, k_blk, v_blk, scale, False, False,
+                                     axis)
         o, lse = _merge(o, lse, o_i, lse_i.astype(lse.dtype))
         k_next = lax.ppermute(k_blk, axis, perm)
         v_next = lax.ppermute(v_blk, axis, perm)
@@ -183,7 +177,7 @@ def _ring_fwd(q, k, v, axis, causal):
 
     b, h, tb, _ = q.shape
     o0 = jnp.zeros_like(q)
-    lse0 = jnp.full((b, h, tb), NEG_INF, jnp.float32)
+    lse0 = _vary(jnp.full((b, h, tb), NEG_INF, jnp.float32), axis)
     (k_f, v_f, o, lse), _ = lax.scan(step, (k, v, o0, lse0),
                                      jnp.arange(n))
     return o, lse
@@ -205,26 +199,28 @@ def _ring_vjp_bwd(axis, causal, res, do):
     idx = lax.axis_index(axis)
     scale = 1.0 / math.sqrt(q.shape[-1])
     perm = [(i, (i + 1) % n) for i in range(n)]
+    # hop-invariant: compute the global rowsum(dO*O) once, not per hop
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)
 
     def step(carry, s):
         k_blk, v_blk, dk_blk, dv_blk, dq = carry
         src = (idx - s) % n
-        zeros = (jnp.zeros(q.shape, jnp.float32),
-                 jnp.zeros(k.shape, jnp.float32),
-                 jnp.zeros(v.shape, jnp.float32))
+        zeros = (jnp.zeros_like(dq), jnp.zeros_like(dk_blk),
+                 jnp.zeros_like(dv_blk))
         if causal:
             dq_i, dk_i, dv_i = lax.cond(
                 src > idx,
                 lambda: zeros,
                 lambda: lax.cond(
                     src == idx,
-                    lambda: _block_bwd(q, k_blk, v_blk, o, lse, do, scale,
-                                       True, True),
-                    lambda: _block_bwd(q, k_blk, v_blk, o, lse, do, scale,
-                                       False, True)))
+                    lambda: _block_bwd(q, k_blk, v_blk, o, lse, delta, do,
+                                       scale, True, True, axis),
+                    lambda: _block_bwd(q, k_blk, v_blk, o, lse, delta, do,
+                                       scale, False, True, axis)))
         else:
-            dq_i, dk_i, dv_i = _block_bwd(q, k_blk, v_blk, o, lse, do,
-                                          scale, False, False)
+            dq_i, dk_i, dv_i = _block_bwd(q, k_blk, v_blk, o, lse, delta,
+                                          do, scale, False, False, axis)
         dq = dq + dq_i
         dk_blk = dk_blk + dk_i
         dv_blk = dv_blk + dv_i
@@ -234,9 +230,9 @@ def _ring_vjp_bwd(axis, causal, res, do):
         dv_next = lax.ppermute(dv_blk, axis, perm)
         return (k_next, v_next, dk_next, dv_next, dq), None
 
-    init = (k, v, jnp.zeros(k.shape, jnp.float32),
-            jnp.zeros(v.shape, jnp.float32),
-            jnp.zeros(q.shape, jnp.float32))
+    init = (k, v, _vary(jnp.zeros(k.shape, jnp.float32), axis),
+            _vary(jnp.zeros(v.shape, jnp.float32), axis),
+            _vary(jnp.zeros(q.shape, jnp.float32), axis))
     (k_f, v_f, dk, dv, dq), _ = lax.scan(step, init, jnp.arange(n))
     # after n hops every dK/dV block is back on its owner; cast once
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
@@ -253,5 +249,4 @@ def make_ring_flash_attention(mesh, axis: str = "seq",
     spec = P(None, None, axis, None)
     return shard_map(
         functools.partial(ring_flash_attention, axis=axis, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

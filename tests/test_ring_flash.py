@@ -80,8 +80,11 @@ def test_ring_flash_trains_end_to_end():
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_interpret_kernel_path(causal, monkeypatch):
     """BIGDL_TPU_FLASH=interpret drives the ring through the actual Pallas
-    kernels (forward AND backward) on CPU."""
+    kernels (forward AND backward) on CPU — and fails loudly if the
+    kernels silently fell back to einsum."""
+    import bigdl_tpu.parallel.flash as _flash_mod
     monkeypatch.setenv("BIGDL_TPU_FLASH", "interpret")
+    _flash_mod._warned.clear()
     B, H, T, D = 1, 1, 32, 8
     rng = np.random.RandomState(5 if causal else 6)
     q, k, v = [jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
@@ -103,3 +106,43 @@ def test_ring_flash_interpret_kernel_path(causal, monkeypatch):
     for name, a, b in zip("qkv", g_ring, g_dense):
         assert np.allclose(np.asarray(a), np.asarray(b), atol=2e-2), \
             (name, np.abs(np.asarray(a) - np.asarray(b)).max())
+    # a silent kernel->einsum fallback would leave warn-once entries
+    assert not {k for k in _flash_mod._warned
+                if k in ("ring_fwd", "ring_bwd")}, _flash_mod._warned
+
+
+def test_attention_module_seq_parallel_matches_dense():
+    """nn.Attention(seq_axis='seq', causal=True) inside shard_map equals
+    the same module's dense path — long-context through the MODEL API."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.attention import causal_mask
+
+    H, NH, T, B = 32, 4, 64, 2
+    dense_attn = nn.Attention(H, NH)
+    dense_attn.ensure_initialized()
+    sp_attn = nn.Attention(H, NH, seq_axis="seq", causal=True)
+    sp_attn.ensure_initialized()
+    sp_attn.params = dense_attn.params  # same weights
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(B, T, H), jnp.float32)
+    mask = causal_mask(T)
+    from bigdl_tpu.utils.table import Table
+    ref = np.asarray(dense_attn.evaluate().forward(Table(x, x, mask)))
+
+    mesh = _mesh(8)
+    spec = P(None, "seq", None)
+
+    def inner(p, xx):
+        out, _ = sp_attn.apply(p, {}, xx, False, None)
+        return out
+
+    out = jax.jit(shard_map(
+        inner, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), sp_attn.params),
+                  spec),
+        out_specs=spec))(sp_attn.params, x)
+    assert np.allclose(np.asarray(out), ref, atol=2e-4), \
+        np.abs(np.asarray(out) - ref).max()
