@@ -1,0 +1,110 @@
+"""Long-context causal LM training with sequence parallelism.
+
+The full recipe: token/position embedding, N transformer blocks whose
+self-attention is ring-flash over the ``seq`` mesh axis, loss, and the
+jitted train step — all inside ONE ``shard_map``, with the sequence dim
+sharded end to end. Each device touches T/n tokens; attention memory is
+O(T/n) per device in forward AND backward (parallel/ring_flash.py), so
+the trainable context grows linearly with the mesh.
+
+Positions are GLOBAL: each shard offsets its position encoding by
+``axis_index * T_local`` — the one detail that differs from single-device
+code.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     PYTHONPATH=. python examples/long_context_ring.py
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.nn.attention import position_encoding
+from bigdl_tpu.parallel.ring_flash import ring_flash_attention
+
+VOCAB, D, HEADS, LAYERS = 64, 32, 4, 2
+T, B = 1024, 2          # 128 tokens per device on the 8-device mesh
+N_DEV = 8
+
+
+def init_params(rng):
+    ks = jax.random.split(rng, 2 + 5 * LAYERS)
+    g = lambda k, s: jax.random.normal(k, s) * (1.0 / np.sqrt(s[0]))
+    p = {"emb": jax.random.normal(ks[0], (VOCAB, D)) * 0.02,
+         "out": g(ks[1], (D, VOCAB)), "blocks": []}
+    for i in range(LAYERS):
+        k = ks[2 + 5 * i: 7 + 5 * i]
+        p["blocks"].append({
+            "wq": g(k[0], (D, D)), "wk": g(k[1], (D, D)),
+            "wv": g(k[2], (D, D)), "wo": g(k[3], (D, D)),
+            "w1": g(k[4], (D, 4 * D)),
+            "w2": jax.random.normal(k[4], (4 * D, D)) * 0.02})
+    return p
+
+
+def forward(params, ids):
+    """ids: (B, T_local) inside shard_map over 'seq'."""
+    tb = ids.shape[1]
+    offset = lax.axis_index("seq") * tb          # global positions
+    pos = lax.dynamic_slice_in_dim(
+        position_encoding(T, D), offset * 1, tb, axis=0)
+    def rms(z):
+        return z * jax.lax.rsqrt(jnp.mean(z * z, -1, keepdims=True) + 1e-6)
+
+    h = params["emb"][ids] + pos[None]
+    for blk in params["blocks"]:
+        n = rms(h)
+        q = (n @ blk["wq"]).reshape(B, tb, HEADS, -1).transpose(0, 2, 1, 3)
+        k = (n @ blk["wk"]).reshape(B, tb, HEADS, -1).transpose(0, 2, 1, 3)
+        v = (n @ blk["wv"]).reshape(B, tb, HEADS, -1).transpose(0, 2, 1, 3)
+        a = ring_flash_attention(q, k, v, axis="seq", causal=True)
+        a = a.transpose(0, 2, 1, 3).reshape(B, tb, D)
+        h = h + a @ blk["wo"]
+        h = h + jax.nn.relu(rms(h) @ blk["w1"]) @ blk["w2"]
+    return rms(h) @ params["out"]
+
+
+def loss_fn(params, ids, targets):
+    logits = forward(params, ids)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1).mean()
+    return lax.pmean(nll, "seq")
+
+
+def main():
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("seq",))
+    rng = np.random.RandomState(0)
+    # synthetic corpus with local structure the LM can learn
+    ids = np.cumsum(rng.randint(0, 3, (B, T + 1)), axis=1) % VOCAB
+    x = jnp.asarray(ids[:, :-1], jnp.int32)
+    y = jnp.asarray(ids[:, 1:], jnp.int32)
+
+    params = init_params(jax.random.PRNGKey(0))
+    pspec = jax.tree_util.tree_map(lambda _: P(), params)
+    sspec = P(None, "seq")
+
+    step = jax.jit(shard_map(
+        jax.value_and_grad(loss_fn), mesh=mesh,
+        in_specs=(pspec, sspec, sspec),
+        out_specs=(P(), pspec)))
+
+    first = last = None
+    for it in range(60):
+        loss, grads = step(params, x, y)
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.3 * g,
+                                        params, grads)
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+        if it % 20 == 0:
+            print(f"iter {it:2d}  nll {float(loss):.4f}")
+    print(f"nll {first:.4f} -> {last:.4f} over T={T} on {N_DEV} shards")
+    # infra demo, not a convergence benchmark: plain SGD on a tiny LM —
+    # the point is that gradients flow correctly through the sharded ring
+    assert last < first * 0.9, "no learning"
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
