@@ -27,6 +27,52 @@ def _conv(nin, nout, k, stride=1, pad=0, fmt="NCHW"):
                               format=fmt)
 
 
+class SpaceToDepthStem(SpatialConvolution):
+    """The 7x7/2 ImageNet stem, computed as a mathematically identical
+    4x4/1 conv over a 2x2 space-to-depth input (NHWC (N,H,W,3) →
+    (N,H/2,W/2,12)).
+
+    The MLPerf-style TPU optimisation: a 3-channel stride-2 conv packs the
+    MXU poorly (contraction size 7*7*3), while the transformed conv
+    contracts 4*4*12 over a stride-1 window. Parameters are stored in the
+    ORIGINAL (64,3,7,7) OIHW layout — checkpoints/serialization stay
+    interchangeable with the plain stem — and the equivalent kernel is
+    rebuilt on the fly (a 38 KB transpose, free next to the conv).
+
+    Derivation: y[oh,ow] convolves x at rows 2*oh+kh-3, kh∈[0,7). Writing
+    kh-3 = 2t+dh (dh∈{0,1}) gives taps t∈{-2..1} over s2d row oh+t and
+    sub-row dh, i.e. a 4-tap stride-1 conv with padding (2,1) whose kernel
+    is the 7x7 kernel zero-padded to 8x8 (one leading row/col) and
+    2x2-blocked to (4,4,12,nout).
+    """
+
+    def __init__(self, n_output_plane: int = 64, name=None):
+        super().__init__(3, n_output_plane, 7, 7, 2, 2, 3, 3,
+                         with_bias=False, init_method=MsraFiller(False),
+                         format="NHWC", name=name)
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x, squeeze = x[None], True
+        n, h, w, c = x.shape
+        assert c == 3 and h % 2 == 0 and w % 2 == 0, (
+            f"SpaceToDepthStem wants NHWC with even H,W and C=3, got {x.shape}")
+        x2 = x.reshape(n, h // 2, 2, w // 2, 2, 3) \
+              .transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 12)
+        wk = params["weight"]  # (nout, 3, 7, 7) OIHW, reference layout
+        wk = jnp.transpose(wk, (2, 3, 1, 0))  # HWIO (7,7,3,nout)
+        wk = jnp.pad(wk, ((1, 0), (1, 0), (0, 0), (0, 0)))  # (8,8,3,nout)
+        wk = wk.reshape(4, 2, 4, 2, 3, -1).transpose(0, 2, 1, 3, 4, 5) \
+               .reshape(4, 4, 12, -1)
+        from jax import lax
+        y = lax.conv_general_dilated(
+            x2, wk.astype(x2.dtype), window_strides=(1, 1),
+            padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y[0] if squeeze else y
+
+
 def _bn(n, zero_gamma=False, fmt="NCHW"):
     bn = SpatialBatchNormalization(n, data_format=fmt)
     if zero_gamma:
@@ -87,17 +133,24 @@ _IMAGENET_CFG = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
 def ResNet(class_num: int = 1000, depth: int = 50,
            shortcut_type: str = ShortcutType.B, data_set: str = "ImageNet",
            zero_init_residual: bool = True, with_log_softmax: bool = False,
-           format: str = "NCHW"):
+           format: str = "NCHW", stem: str = "conv7"):
     """Factory with the reference's signature
     (models/resnet/ResNet.scala apply(classNum, opt)). ``format='NHWC'``
     builds the channels-last variant (identical params; activations NHWC —
-    the layout XLA:TPU tiles convs fastest in; see bench.py)."""
+    the layout XLA:TPU tiles convs fastest in; see bench.py).
+    ``stem='s2d'`` (NHWC only) computes the same stem via a space-to-depth
+    reparameterization (SpaceToDepthStem) — identical math and params,
+    faster MXU packing."""
     if data_set.lower() == "cifar10":
         return ResNetCifar(class_num, depth, shortcut_type)
     fmt = format
     blocks = _IMAGENET_CFG[depth]
     model = Sequential()
-    model.add(_conv(3, 64, 7, 2, 3, fmt))
+    if stem == "s2d":
+        assert fmt == "NHWC", "space-to-depth stem is the NHWC/TPU path"
+        model.add(SpaceToDepthStem(64))
+    else:
+        model.add(_conv(3, 64, 7, 2, 3, fmt))
     model.add(_bn(64, fmt=fmt))
     model.add(ReLU())
     model.add(SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=fmt))

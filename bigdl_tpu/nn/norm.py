@@ -58,8 +58,13 @@ class BatchNormalization(Module):
         bshape[ch] = self.n_output
         xf = x.astype(jnp.float32)  # stats always in f32 (bf16-safe)
         if training:
+            # one-pass stats: E[x²]−E[x]² lets XLA fuse both reductions into a
+            # single read of the activation; jnp.var's two dependent passes
+            # cost a second full HBM sweep per BN layer (profiled ~20% of the
+            # ResNet-50 step). f32 accumulation keeps it bf16-safe.
             mean = jnp.mean(xf, axis=ax)
-            var = jnp.var(xf, axis=ax)
+            var = jnp.maximum(jnp.mean(jnp.square(xf), axis=ax)
+                              - jnp.square(mean), 0.0)
             n = x.size // self.n_output
             unbiased = var * n / max(n - 1, 1)
             new_state = {

@@ -29,17 +29,17 @@ N_DEV = 8
 
 
 def init_params(rng):
-    ks = jax.random.split(rng, 2 + 5 * LAYERS)
+    ks = jax.random.split(rng, 2 + 6 * LAYERS)
     g = lambda k, s: jax.random.normal(k, s) * (1.0 / np.sqrt(s[0]))
     p = {"emb": jax.random.normal(ks[0], (VOCAB, D)) * 0.02,
          "out": g(ks[1], (D, VOCAB)), "blocks": []}
     for i in range(LAYERS):
-        k = ks[2 + 5 * i: 7 + 5 * i]
+        k = ks[2 + 6 * i: 8 + 6 * i]
         p["blocks"].append({
             "wq": g(k[0], (D, D)), "wk": g(k[1], (D, D)),
             "wv": g(k[2], (D, D)), "wo": g(k[3], (D, D)),
             "w1": g(k[4], (D, 4 * D)),
-            "w2": jax.random.normal(k[4], (4 * D, D)) * 0.02})
+            "w2": jax.random.normal(k[5], (4 * D, D)) * 0.02})
     return p
 
 

@@ -1,0 +1,136 @@
+"""A/B experiments for the ResNet-50 train-step time on the real chip.
+
+Variants:
+  base      — current bench step (per-tensor SGD update, two-pass BN stats)
+  noupd     — forward+backward only (upper bound for optimizer-update cost)
+  flat      — SGD on ONE flattened f32 master vector; per-tensor bf16 views
+              recreated each step (one big elementwise update instead of ~160
+              tiny layout-copy fusions)
+
+Usage: python tools/ab_step.py [variant ...]
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def build():
+    import os
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models import ResNet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.utils import engine
+
+    engine.set_seed(0)
+    model = ResNet(class_num=1000, depth=50, format="NHWC",
+                   stem=os.environ.get("STEM", "conv7"))
+    params, mstate = model.init(jax.random.PRNGKey(0))
+    crit = CrossEntropyCriterion()
+    rng = np.random.RandomState(0)
+    batch = int(os.environ.get("BATCH", 256))
+    x = jnp.asarray(rng.randn(batch, 224, 224, 3), jnp.bfloat16)
+    y = jnp.asarray(rng.randint(1, 1001, size=(batch,)).astype(np.int32))
+    return jax, jnp, model, crit, params, mstate, x, y, batch
+
+
+def loss_and_grads(jax, jnp, model, crit, mstate, x, y):
+    def f(p):
+        p16 = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16)
+            if a.dtype == jnp.float32 else a, p)
+        out, new_state = model.apply(p16, mstate, x, training=True,
+                                     rng=jax.random.PRNGKey(0))
+        return crit._forward(out.astype(jnp.float32), y), new_state
+    return f
+
+
+def timeit(jax, step, args, steps=20, warmup=3):
+    carry = args
+    for _ in range(warmup):
+        out = step(*carry)
+        carry = tuple(out[1:])
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step(*carry)
+        carry = tuple(out[1:])
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def run_variant(name):
+    jax, jnp, model, crit, params, mstate, x, y, batch = build()
+    lr = jnp.float32(0.1)
+    mom = 0.9
+
+    if name == "base":
+        vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def step(params, vel, mstate):
+            f = loss_and_grads(jax, jnp, model, crit, mstate, x, y)
+            (loss, new_mstate), g = jax.value_and_grad(f, has_aux=True)(params)
+            new_vel = jax.tree_util.tree_map(
+                lambda v, gg: mom * v + gg, vel, g)
+            new_p = jax.tree_util.tree_map(
+                lambda p, v: p - lr * v, params, new_vel)
+            return loss, new_p, new_vel, new_mstate
+
+        jit = jax.jit(step, donate_argnums=(0, 1, 2)) \
+                 .lower(params, vel, mstate).compile()
+        args = (params, vel, mstate)
+
+    elif name == "noupd":
+        def step(params, mstate):
+            f = loss_and_grads(jax, jnp, model, crit, mstate, x, y)
+            (loss, new_mstate), g = jax.value_and_grad(f, has_aux=True)(params)
+            gnorm = sum(jnp.sum(jnp.square(l))
+                        for l in jax.tree_util.tree_leaves(g))
+            return loss + 0 * gnorm, params, new_mstate
+
+        jit = jax.jit(step, donate_argnums=(0, 1)) \
+                 .lower(params, mstate).compile()
+        args = (params, mstate)
+
+    elif name == "flat":
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        shapes = [l.shape for l in leaves]
+        sizes = [int(np.prod(s)) for s in shapes]
+        offs = np.cumsum([0] + sizes)
+        flat = jnp.concatenate([l.reshape(-1) for l in leaves])
+        velf = jnp.zeros_like(flat)
+
+        def unflatten(vec):
+            return jax.tree_util.tree_unflatten(
+                treedef, [jax.lax.dynamic_slice(vec, (int(o),), (s,))
+                          .reshape(sh) for o, s, sh in
+                          zip(offs[:-1], sizes, shapes)])
+
+        def step(flat, velf, mstate):
+            def f(fv):
+                p = unflatten(fv.astype(jnp.bfloat16))
+                out, new_state = model.apply(p, mstate, x, training=True,
+                                             rng=jax.random.PRNGKey(0))
+                return crit._forward(out.astype(jnp.float32), y), new_state
+            (loss, new_mstate), g = jax.value_and_grad(f, has_aux=True)(flat)
+            new_vel = mom * velf + g
+            new_flat = flat - lr * new_vel
+            return loss, new_flat, new_vel, new_mstate
+
+        jit = jax.jit(step, donate_argnums=(0, 1, 2)) \
+                 .lower(flat, velf, mstate).compile()
+        args = (flat, velf, mstate)
+
+    else:
+        raise SystemExit(f"unknown variant {name}")
+
+    dt = timeit(jax, jit, args)
+    print(f"{name}: {dt * 1000:.2f} ms/step  {batch / dt:.1f} img/s")
+
+
+if __name__ == "__main__":
+    for v in (sys.argv[1:] or ["base", "noupd", "flat"]):
+        run_variant(v)

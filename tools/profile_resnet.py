@@ -1,0 +1,105 @@
+"""Capture an on-chip profile of the ResNet-50 train step and print the
+top time-consuming HLO ops (parsed from the xplane trace via
+tensorboard_plugin_profile). Dev tool behind the perf push to SURVEY §6's
+>=50% MFU target; run on the real chip:
+
+    python tools/profile_resnet.py [batch]
+"""
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+
+def capture(batch: int = 256, logdir: str = "/tmp/bigdl_prof"):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bigdl_tpu.models import ResNet
+    from bigdl_tpu.nn import CrossEntropyCriterion
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.utils import engine
+
+    engine.set_seed(0)
+    model = ResNet(class_num=1000, depth=50, format="NHWC")
+    params, mstate = model.init(jax.random.PRNGKey(0))
+    crit = CrossEntropyCriterion()
+    optim = SGD(learningrate=0.1, momentum=0.9)
+    opt_state = optim.init_state(params)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, 224, 224, 3), jnp.bfloat16)
+    y = jnp.asarray(rng.randint(1, 1001, size=(batch,)).astype(np.int32))
+
+    def train_step(params, opt_state, mstate, x, y, lr):
+        def loss_fn(p):
+            p16 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if a.dtype == jnp.float32 else a, p)
+            out, new_state = model.apply(p16, mstate, x, training=True,
+                                         rng=jax.random.PRNGKey(0))
+            return crit._forward(out.astype(jnp.float32), y), new_state
+        (loss, new_mstate), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt = optim.update(grads, params, opt_state, lr)
+        return loss, new_params, new_opt, new_mstate
+
+    lr = jnp.float32(0.1)
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2)) \
+              .lower(params, opt_state, mstate, x, y, lr).compile()
+    for _ in range(3):
+        loss, params, opt_state, mstate = step(params, opt_state, mstate,
+                                               x, y, lr)
+    float(loss)
+    with jax.profiler.trace(logdir):
+        for _ in range(5):
+            loss, params, opt_state, mstate = step(params, opt_state,
+                                                   mstate, x, y, lr)
+        float(loss)
+    return logdir
+
+
+def report(logdir: str, top: int = 45):
+    """Aggregate device-plane event durations by op name from the raw
+    xplane trace (the tensorboard profile plugin in this image mismatches
+    the TF build, so parse the proto directly)."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xplanes = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                        recursive=True)
+    assert xplanes, f"no xplane under {logdir}"
+    xplane = max(xplanes, key=os.path.getmtime)
+    space = xplane_pb2.XSpace()
+    with open(xplane, "rb") as f:
+        space.ParseFromString(f.read())
+
+    from collections import defaultdict
+    for plane in space.planes:
+        if "TPU" not in plane.name and "device" not in plane.name.lower():
+            continue
+        meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
+        dur = defaultdict(float)
+        cnt = defaultdict(int)
+        total = 0.0
+        for line in plane.lines:
+            if "step" in line.name.lower():
+                continue  # step lines double-count op time
+            for ev in line.events:
+                name = meta.get(ev.metadata_id, str(ev.metadata_id))
+                dur[name] += ev.duration_ps
+                cnt[name] += 1
+                total += ev.duration_ps
+        if not dur:
+            continue
+        print(f"== plane: {plane.name} (total {total/1e12*1000:.2f} ms over "
+              f"{len(dur)} distinct ops)")
+        for name, d in sorted(dur.items(), key=lambda kv: -kv[1])[:top]:
+            print(f"  {d/total*100:5.1f}%  {d/1e9:9.3f} ms  x{cnt[name]:<4d} "
+                  f"{name[:110]}")
+
+
+if __name__ == "__main__":
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    logdir = capture(b)
+    report(logdir)
