@@ -170,6 +170,9 @@ def child_main(which: str):
     elif which == "secondary":
         from bench_extra import bench_secondary
         results = bench_secondary()
+    elif which.startswith("secondary:"):
+        from bench_extra import bench_one
+        results = [bench_one(which.split(":", 1)[1])]
     else:
         raise SystemExit(f"unknown child config {which!r}")
     for r in results:
@@ -229,8 +232,11 @@ def _cached_tpu_lines(which, max_age_days: float = 14.0):
             cached = json.load(f)
     except (OSError, ValueError):
         return []
+    from bench_extra import CONFIGS
     keys = {"headline": ("resnet50_",),
-            "secondary": ("lenet_", "vgg16_", "lstm_", "inception_")}
+            "secondary": tuple(p for _, p in CONFIGS.values())}
+    for k, (_, prefix) in CONFIGS.items():
+        keys[f"secondary:{k}"] = (prefix,)
     out = []
     for l in cached:
         if not l.get("metric", "").startswith(keys.get(which, ())):
@@ -316,8 +322,12 @@ def main():
     for line in _orchestrate("headline"):
         print(json.dumps(line), flush=True)
     if "--all" in sys.argv:
-        for line in _orchestrate("secondary"):
-            print(json.dumps(line), flush=True)
+        # one child per config: a slow compile in one config can't starve
+        # the rest, and each gets the full retry/cache/fallback ladder
+        from bench_extra import CONFIGS
+        for key in CONFIGS:
+            for line in _orchestrate(f"secondary:{key}"):
+                print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

@@ -165,12 +165,119 @@ def bench_inception_int8(on_tpu):
             "vs_baseline": round(v / _BASE["inception_v1_int8"], 3)}
 
 
+def bench_transformer_lm(on_tpu):
+    """GPT-style TransformerLM train step, bf16 compute + f32 master params.
+
+    Not a BASELINE.json config (the reference has no transformer benchmark)
+    but the honest MFU showcase: matmul-dominated, so the MXU packs far
+    better than ResNet's stage-1 convs. Reports tokens/sec and MFU from
+    XLA's compiled cost analysis."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.nn import (CrossEntropyCriterion,
+                              TimeDistributedMaskCriterion)
+    from bigdl_tpu.optim import SGD
+
+    # batch 8: the f32 loss logits (B*T, 32000) plus their softmax temps are
+    # the HBM high-water mark; 16x1024 OOMed a 16 GB v5e
+    batch = _sized(on_tpu, 8, 2)
+    seqlen = _sized(on_tpu, 1024, 32)
+    steps, warmup = _sized(on_tpu, 15, 2), _sized(on_tpu, 3, 1)
+    model = TransformerLM(vocab_size=32000, hidden_size=1024, num_heads=16,
+                          filter_size=4096,
+                          num_layers=_sized(on_tpu, 12, 2), max_len=seqlen)
+    crit = TimeDistributedMaskCriterion(CrossEntropyCriterion(),
+                                        padding_value=0)
+    optim = SGD(learningrate=0.01, momentum=0.9)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 32000, size=(batch, seqlen + 1)).astype(np.float32)
+    x = jnp.asarray(ids[:, :-1])
+    y = jnp.asarray(ids[:, 1:])
+
+    params, mstate = model.init(jax.random.PRNGKey(0))
+    opt_state = optim.init_state(params)
+
+    def train_step(params, opt_state, mstate, x, y, lr):
+        def loss_fn(p):
+            p16 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if a.dtype == jnp.float32 else a, p)
+            out, new_state = model.apply(p16, mstate, x, training=True,
+                                         rng=jax.random.PRNGKey(0))
+            return crit._forward(out.astype(jnp.float32), y), new_state
+        (loss, new_mstate), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt = optim.update(grads, params, opt_state, lr)
+        return loss, new_params, new_opt, new_mstate
+
+    lr = jnp.float32(0.01)
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2)) \
+              .lower(params, opt_state, mstate, x, y, lr).compile()
+    flops_per_step = None
+    try:
+        ca = step.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops_per_step = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    carry = [params, opt_state, mstate]
+    for _ in range(warmup):
+        loss, *carry = step(*carry, x, y, lr)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, *carry = step(*carry, x, y, lr)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    assert final == final, "NaN loss in transformer bench"
+    v = batch * seqlen * steps / dt
+    # vs_baseline is null: the reference has no transformer config, and a
+    # ratio against the LSTM anchor would be a meaningless cross-model number
+    r = {"metric": "transformer_lm_train_tokens_per_sec", "value": round(v, 1),
+         "unit": "tokens/sec", "vs_baseline": None}
+    if flops_per_step and on_tpu:
+        from bench import _peak_flops
+        peak = _peak_flops(jax.devices()[0].device_kind)
+        r["mfu"] = round(flops_per_step * steps / dt / peak, 4)
+    return r
+
+
+# config key -> (bench fn name, metric prefix). The metric prefix is the
+# single source of truth bench.py uses for its per-config cache lookup.
+CONFIGS = {
+    "lenet": ("bench_lenet", "lenet_"),
+    "vgg": ("bench_vgg", "vgg16_"),
+    "lstm": ("bench_lstm_ptb", "lstm_"),
+    "inception_int8": ("bench_inception_int8", "inception_"),
+    "transformer": ("bench_transformer_lm", "transformer_"),
+}
+
+
+def bench_one(key: str):
+    """Run ONE named config (bench.py runs each in its own child process so
+    a slow compile in one config can't eat the others' timeout budget).
+    Exceptions propagate: a failed config must exit rc!=0 so the bench.py
+    orchestrator's retry -> cached-TPU -> CPU ladder engages."""
+    from bench import _init_backend_with_retry
+    backend = _init_backend_with_retry()
+    on_tpu = backend in ("tpu", "axon")
+    r = globals()[CONFIGS[key][0]](on_tpu)
+    r["backend"] = backend
+    return r
+
+
 def bench_secondary():
     from bench import _init_backend_with_retry
     backend = _init_backend_with_retry()
     on_tpu = backend in ("tpu", "axon")
     results = []
-    for fn in (bench_lenet, bench_vgg, bench_lstm_ptb, bench_inception_int8):
+    for fn in (bench_lenet, bench_vgg, bench_lstm_ptb, bench_inception_int8,
+               bench_transformer_lm):
         try:
             r = fn(on_tpu)
         except Exception as e:  # one broken config must not hide the rest

@@ -1,4 +1,5 @@
-from .topology import Sequential, Model, Input, KerasLayer, KerasNode
+from .topology import (Sequential, Model, Input, InputLayer, KerasLayer,
+                       KerasNode)
 from .layers import (Dense, Activation, Dropout, Flatten, Reshape, Permute,
                      RepeatVector, Convolution2D, Convolution1D, MaxPooling2D,
                      AveragePooling2D, GlobalAveragePooling2D,

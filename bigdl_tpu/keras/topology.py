@@ -63,6 +63,12 @@ def Input(shape: Sequence[int], name=None) -> KerasNode:
     return KerasNode(N.Input(name=name), tuple(shape))
 
 
+def InputLayer(input_shape: Sequence[int], name=None) -> KerasNode:
+    """pyspark nn/keras/layer.py InputLayer — keyword-arg spelling of
+    ``Input`` used by Sequential models and the JSON converter."""
+    return Input(input_shape, name=name)
+
+
 _OPTIMIZERS = {
     "sgd": lambda: SGD(learningrate=0.01),
     "adam": lambda: Adam(),

@@ -3,11 +3,14 @@ pyspark frontend parity with ``pyspark/bigdl/nn/layer.py`` and
 ``criterion.py`` — same class names, positional args, snake_case kwargs)."""
 
 from .module import Module, Container, Criterion, Node
-from .init import (InitializationMethod, Zeros, Ones, ConstInit, RandomUniform,
+from .init import (InitializationMethod, Zeros, Ones, ConstInit,
+                   ConstInitMethod, RandomUniform,
                    RandomNormal, Xavier, MsraFiller, BilinearFiller)
 from .containers import (Sequential, Concat, ConcatTable, ParallelTable,
                          MapTable, Bottle)
 from .graph_container import Graph, Input
+from .dynamic_graph import (StaticGraph, Model, DynamicGraph, Switch, Merge,
+                            NOT_TAKEN)
 from .activation import (ReLU, ReLU6, LeakyReLU, PReLU, RReLU, SReLU, ELU,
                          GELU, SoftPlus, SoftSign, Sigmoid, LogSigmoid, Tanh,
                          TanhShrink, HardTanh, Clamp, HardSigmoid, HardShrink,
@@ -15,7 +18,7 @@ from .activation import (ReLU, ReLU6, LeakyReLU, PReLU, RReLU, SReLU, ELU,
                          BinaryThreshold, Maxout)
 from .elementwise import (Identity, Echo, Contiguous, Abs, Exp, Log, Sqrt,
                           Square, Negative, Power, AddConstant, MulConstant,
-                          GradientReversal, ErrorInfo)
+                          GradientReversal, ErrorInfo, L1Penalty)
 from .linear import (Linear, Bilinear, Cosine, Euclidean, Add,
                      Mul, CMul, CAdd, Scale, Highway, LookupTable)
 from .conv import (SpatialConvolution, SpatialShareConvolution,

@@ -1,0 +1,138 @@
+"""Dynamic (data-dependent control flow) graph execution.
+
+Parity: reference ``nn/DynamicGraph.scala`` + ``nn/ops/ControlOps.scala``
+(Switch/Merge, the TF control-flow primitives its Scheduler executes) and
+``nn/StaticGraph.scala`` (our ``Graph`` — re-exported as ``StaticGraph``).
+
+TPU-first design: the reference runs a readiness Scheduler so branches whose
+predicate is false never execute. Under XLA everything traced must have
+static shape/control, so this module makes the split explicit:
+
+* ``StaticGraph`` (= ``Graph``): straight-line traced DAG — the jittable,
+  TPU path. Data-dependent branching inside it should use ``lax.cond`` via
+  ops that lower to it.
+* ``DynamicGraph``: *eager* execution on concrete arrays. Predicates are
+  read on the host, untaken branches are skipped entirely (the reference
+  Scheduler's behavior), so side-effect-free inference over loaded TF
+  graphs with control flow works exactly like the reference. It is by
+  design not jittable; training through data-dependent branches should use
+  the static path (see README "Design deltas"). Cyclic control flow (TF
+  while-loop frames, reference ``FrameManager``/``Scheduler`` machinery) is
+  deliberately not reproduced — ``lax.while_loop``/``lax.scan`` are the XLA
+  citizens for loops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .graph_container import Graph
+from .module import Module
+from ..utils.table import Table
+
+StaticGraph = Graph  # nn/StaticGraph.scala — Graph IS the static graph here
+Model = Graph  # pyspark nn/layer.py:696 — `Model(inputs, outputs)` graph API
+
+
+class _NotTaken:
+    """Sentinel flowing out of the untaken side of a Switch."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<not-taken>"
+
+
+NOT_TAKEN = _NotTaken()
+
+
+def _contains_sentinel(v):
+    if v is NOT_TAKEN:
+        return True
+    if isinstance(v, Table):
+        return any(_contains_sentinel(e) for e in v.to_list())
+    if isinstance(v, (list, tuple)):
+        return any(_contains_sentinel(e) for e in v)
+    return False
+
+
+class Switch(Module):
+    """nn/ops/ControlOps.scala SwitchOps: input Table(data, pred) →
+    Table(out_on_false, out_on_true); the untaken slot carries NOT_TAKEN.
+
+    The predicate must be concrete (host-readable) — this op is the reason
+    DynamicGraph is eager. Use inside a DynamicGraph (or standalone outside
+    jit)."""
+
+    def _apply(self, params, state, x, training, rng):
+        data, pred = x[1], x[2]
+        taken = bool(np.asarray(pred))
+        return Table(NOT_TAKEN if taken else data,
+                     data if taken else NOT_TAKEN), state
+
+
+class Merge(Module):
+    """nn/ops/ControlOps.scala MergeOps: forwards its single available
+    (non-NOT_TAKEN) input; errors if zero or more than one is available."""
+
+    def _apply(self, params, state, x, training, rng):
+        items = x.to_list() if isinstance(x, Table) else [x]
+        avail = [v for v in items if not _contains_sentinel(v)]
+        if len(avail) != 1:
+            raise ValueError(
+                f"Merge expects exactly one taken branch, got {len(avail)}")
+        return avail[0], state
+
+
+class DynamicGraph(Graph):
+    """Eager Graph: same construction API as Graph/StaticGraph, but
+    ``apply`` executes node-by-node on concrete values, skipping any node
+    whose inputs contain the NOT_TAKEN sentinel (except Merge, which fires
+    on its single taken input). Equivalent to the reference Scheduler for
+    acyclic control flow."""
+
+    jittable = False
+
+    def _apply(self, params, state, x, training, rng):
+        import jax
+
+        values = {}
+        if len(self.input_nodes) == 1:
+            values[id(self.input_nodes[0])] = x
+        else:
+            items = x.to_list() if isinstance(x, Table) else list(x)
+            if len(items) != len(self.input_nodes):
+                raise ValueError(
+                    f"graph expects {len(self.input_nodes)} inputs, "
+                    f"got {len(items)}")
+            for node, item in zip(self.input_nodes, items):
+                values[id(node)] = item
+
+        new_state = dict(state)
+        for n in self.topo:
+            if n.module is None:
+                if id(n) not in values:
+                    raise ValueError(f"unbound input node {n}")
+                continue
+            ins = [values[id(p)] for p in n.prevs]
+            arg = ins[0] if len(ins) == 1 else Table(*ins)
+            mi = n.mod_idx
+            mod = self.modules[mi]
+            # Shallow check on the DIRECT inputs: a Table that merely
+            # contains a sentinel slot (a Switch output) is still a live
+            # value — SelectTable picks a slot out of it, and a picked
+            # sentinel then propagates through here on the next hop.
+            if (not isinstance(mod, Merge)
+                    and any(v is NOT_TAKEN for v in ins)):
+                # untaken branch: skip execution, propagate the sentinel
+                values[id(n)] = NOT_TAKEN
+                continue
+            sub_rng = None if rng is None else jax.random.fold_in(rng, mi)
+            out, new_state[str(mi)] = mod.apply(
+                params[str(mi)], state[str(mi)], arg, training, sub_rng)
+            values[id(n)] = out
+
+        outs = [values[id(o)] for o in self.output_nodes]
+        for o in outs:
+            if _contains_sentinel(o):
+                raise ValueError("graph output is on an untaken branch")
+        return (outs[0] if len(outs) == 1 else Table(*outs)), new_state

@@ -136,6 +136,47 @@ class GradientReversal(Module):
         return _grad_reverse(x, self.the_lambda)
 
 
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _l1_penalty(x, m, provide_output):
+    return x
+
+
+def _l1_penalty_fwd(x, m, provide_output):
+    return x, (x, m)
+
+
+def _l1_penalty_bwd(provide_output, res, g):
+    x, m = res
+    gi = m * jnp.sign(x)
+    return ((gi + g) if provide_output else gi, None)
+
+
+_l1_penalty.defvjp(_l1_penalty_fwd, _l1_penalty_bwd)
+
+
+class L1Penalty(Module):
+    """Identity forward; backward adds the gradient of an L1 activation
+    penalty, ``m * sign(input)`` (nn/L1Penalty.scala:43-58 — its
+    ``updateGradInput`` is ``sign(input)*m (+ gradOutput)``). The
+    reference also stashes the penalty value in a mutable ``loss`` field;
+    functionally the penalty manifests purely through the gradient, which
+    is what training sees."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True, name=None):
+        super().__init__(name=name)
+        self.l1weight = float(l1weight)
+        self.size_average = size_average
+        self.provide_output = provide_output
+
+    def _apply(self, params, state, x, training, rng):
+        m = self.l1weight / (x.size if self.size_average else 1.0)
+        return _l1_penalty(x, jnp.asarray(m, x.dtype), self.provide_output)
+
+
 class ErrorInfo:
     """Parity placeholder for nn/ErrorInfo.scala messages."""
     constrainEachInputAsVectorOrBatch = \

@@ -105,3 +105,7 @@ class BilinearFiller(InitializationMethod):
         w = np.zeros(shape, dtype=np.float32)
         w[..., :, :] = vals.reshape(kh, kw)
         return jnp.asarray(w, dtype)
+
+
+# pyspark nn/initialization_method.py spelling
+ConstInitMethod = ConstInit

@@ -28,12 +28,27 @@ from ..utils.table import Table
 
 class Cell(Module):
     """Base recurrent cell: subclasses define ``init_hidden`` and
-    ``step(params, x_t, h) -> (out_t, new_h)``."""
+    ``step(params, x_t, h) -> (out_t, new_h)``.
+
+    Cells whose input-to-hidden projection is independent of the hidden
+    state additionally implement ``precompute(params, xt)`` (one large
+    (T*B, in) @ (in, gates) MXU matmul over ALL timesteps) and
+    ``step_pre(params, pre_t, h)``; ``Recurrent`` then scans only the
+    hidden-to-hidden recurrence. On TPU this replaces T small matmuls
+    inside the sequential loop with one big one outside it."""
 
     def init_hidden(self, batch_size: int, dtype=jnp.float32):
         raise NotImplementedError
 
     def step(self, params, x_t, h):
+        raise NotImplementedError
+
+    def precompute(self, params, xt):
+        """Hoisted input projection for (T, B, ...) inputs, or None if the
+        cell has no hoistable part (then Recurrent scans ``step``)."""
+        return None
+
+    def step_pre(self, params, pre_t, h):
         raise NotImplementedError
 
     def _apply(self, params, state, x, training, rng):
@@ -71,12 +86,17 @@ class RnnCell(Cell):
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
     def step(self, params, x_t, h):
+        return self.step_pre(params, self.precompute(params, x_t), h)
+
+    def precompute(self, params, xt):
+        return xt @ params["w_i"] + params["bias"]
+
+    def step_pre(self, params, pre_t, h):
         act = self.activation if callable(self.activation) else jnp.tanh
         if isinstance(self.activation, str):
-            import jax as _jax
-            act = {"tanh": jnp.tanh, "relu": _jax.nn.relu,
-                   "sigmoid": _jax.nn.sigmoid}[self.activation]
-        nh = act(x_t @ params["w_i"] + h @ params["w_h"] + params["bias"])
+            act = {"tanh": jnp.tanh, "relu": jax.nn.relu,
+                   "sigmoid": jax.nn.sigmoid}[self.activation]
+        nh = act(pre_t + h @ params["w_h"])
         return nh, nh
 
 
@@ -107,17 +127,19 @@ class LSTM(Cell):
                      jnp.zeros((batch_size, H), dtype))
 
     def step(self, params, x_t, h):
+        return self.step_pre(params, self.precompute(params, x_t), h)
+
+    def precompute(self, params, xt):
+        return xt @ params["w_i"] + params["bias"]
+
+    def step_pre(self, params, pre_t, h):
         act = self.activation or jnp.tanh
         inner = self.inner_activation or jax.nn.sigmoid
         hx, cx = h[1], h[2]
-        z = x_t @ params["w_i"] + hx @ params["w_h"] + params["bias"]
+        z = pre_t + hx @ params["w_h"]
         i, f, g, o = jnp.split(z, 4, axis=-1)
-        i = inner(i)
-        f = inner(f)
-        o = inner(o)
-        g = act(g)
-        c = f * cx + i * g
-        hnew = o * act(c)
+        c = inner(f) * cx + inner(i) * act(g)
+        hnew = inner(o) * act(c)
         return hnew, Table(hnew, c)
 
 
@@ -146,13 +168,18 @@ class LSTMPeephole(Cell):
                      jnp.zeros((batch_size, H), dtype))
 
     def step(self, params, x_t, h):
+        return self.step_pre(params, self.precompute(params, x_t), h)
+
+    def precompute(self, params, xt):
+        return xt @ params["w_i"] + params["bias"]
+
+    def step_pre(self, params, pre_t, h):
         hx, cx = h[1], h[2]
-        z = x_t @ params["w_i"] + hx @ params["w_h"] + params["bias"]
+        z = pre_t + hx @ params["w_h"]
         i, f, g, o = jnp.split(z, 4, axis=-1)
         i = jax.nn.sigmoid(i + params["p_i"] * cx)
         f = jax.nn.sigmoid(f + params["p_f"] * cx)
-        g = jnp.tanh(g)
-        c = f * cx + i * g
+        c = f * cx + i * jnp.tanh(g)
         o = jax.nn.sigmoid(o + params["p_o"] * c)
         hnew = o * jnp.tanh(c)
         return hnew, Table(hnew, c)
@@ -180,9 +207,14 @@ class GRU(Cell):
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
     def step(self, params, x_t, h):
+        return self.step_pre(params, self.precompute(params, x_t), h)
+
+    def precompute(self, params, xt):
+        return xt @ params["w_i"] + params["bias"]
+
+    def step_pre(self, params, pre_t, h):
         H = self.hidden_size
-        zi = x_t @ params["w_i"] + params["bias"]
-        zr, zz, zn = zi[..., :H], zi[..., H:2 * H], zi[..., 2 * H:]
+        zr, zz, zn = (pre_t[..., :H], pre_t[..., H:2 * H], pre_t[..., 2 * H:])
         hh = h @ params["w_h"]
         r = jax.nn.sigmoid(zr + hh[..., :H])
         z = jax.nn.sigmoid(zz + hh[..., H:])
@@ -279,6 +311,20 @@ class MultiRNNCell(Cell):
             new_hs.append(nh)
         return out, Table(*new_hs)
 
+    def precompute(self, params, xt):
+        # only the FIRST cell sees the sequence input; its projection is
+        # hoistable, the rest consume the previous cell's per-step output
+        return self.cells[0].precompute(params["0"], xt)
+
+    def step_pre(self, params, pre_t, h):
+        new_hs = []
+        out, nh = self.cells[0].step_pre(params["0"], pre_t, h[1])
+        new_hs.append(nh)
+        for i, c in enumerate(self.cells[1:], start=1):
+            out, nh = c.step(params[str(i)], out, h[i + 1])
+            new_hs.append(nh)
+        return out, Table(*new_hs)
+
 
 class Recurrent(Module):
     """Run a cell over (batch, time, ...) via lax.scan (nn/Recurrent.scala)."""
@@ -303,11 +349,21 @@ class Recurrent(Module):
         h0 = self.cell.init_hidden(x.shape[0], x.dtype)
         xt = jnp.moveaxis(x, 1, 0)  # (T, B, ...)
 
-        def body(h, x_t):
-            out, nh = self.cell.step(params["cell"], x_t, h)
-            return nh, out
+        pre = self.cell.precompute(params["cell"], xt)
+        if pre is not None:
+            # input projection hoisted: one (T*B, in)@(in, gates) MXU matmul
+            # outside the loop; the scan carries only the h2h recurrence
+            def body(h, pre_t):
+                out, nh = self.cell.step_pre(params["cell"], pre_t, h)
+                return nh, out
 
-        _, ys = lax.scan(body, h0, xt)
+            _, ys = lax.scan(body, h0, pre)
+        else:
+            def body(h, x_t):
+                out, nh = self.cell.step(params["cell"], x_t, h)
+                return nh, out
+
+            _, ys = lax.scan(body, h0, xt)
         return jnp.moveaxis(ys, 0, 1)
 
     def training(self):
@@ -378,11 +434,19 @@ class BiRecurrent(Module):
         h0 = self.cell.init_hidden(x.shape[0], x.dtype)
         xt = jnp.moveaxis(x, 1, 0)
 
-        def body(h, x_t):
-            out, nh = self.cell.step(cell_params, x_t, h)
-            return nh, out
+        pre = self.cell.precompute(cell_params, xt)
+        if pre is not None:  # hoisted input projection (see Cell docstring)
+            def body(h, pre_t):
+                out, nh = self.cell.step_pre(cell_params, pre_t, h)
+                return nh, out
 
-        _, ys = lax.scan(body, h0, xt)
+            _, ys = lax.scan(body, h0, pre)
+        else:
+            def body(h, x_t):
+                out, nh = self.cell.step(cell_params, x_t, h)
+                return nh, out
+
+            _, ys = lax.scan(body, h0, xt)
         return jnp.moveaxis(ys, 0, 1)
 
     def _apply(self, params, state, x, training, rng):

@@ -7,7 +7,9 @@ from .optim_method import (OptimMethod, SGD, Adam, ParallelAdam, Adagrad,
 from .regularizer import (Regularizer, L1Regularizer, L2Regularizer,
                           L1L2Regularizer)
 from .trigger import (Trigger, every_epoch, several_iteration, max_epoch,
-                      max_iteration, max_score, min_loss, and_, or_)
+                      max_iteration, max_score, min_loss, and_, or_,
+                      EveryEpoch, SeveralIteration, MaxEpoch, MaxIteration,
+                      MaxScore, MinLoss, TriggerAnd, TriggerOr)
 from .validation import (ValidationMethod, ValidationResult, AccuracyResult,
                          LossResult, Top1Accuracy, Top5Accuracy, Loss, MAE,
                          HitRatio, NDCG, TreeNNAccuracy)
@@ -15,3 +17,7 @@ from .optimizer import (Optimizer, LocalOptimizer, DistriOptimizer,
                         ParallelOptimizer, BaseOptimizer, Metrics)
 from .evaluator import Evaluator, LocalValidator, DistriValidator
 from .predictor import Predictor, PredictionService
+
+# pyspark optim/optimizer.py also exposes these from the optim namespace
+from ..visualization import TrainSummary, ValidationSummary  # noqa: E402
+from ..nn.criterion import ActivityRegularization  # noqa: E402

@@ -76,3 +76,16 @@ Trigger.max_score = staticmethod(max_score)
 Trigger.min_loss = staticmethod(min_loss)
 Trigger.and_ = staticmethod(and_)
 Trigger.or_ = staticmethod(or_)
+
+
+# pyspark API spellings (reference pyspark/bigdl/optim/optimizer.py:
+# EveryEpoch/SeveralIteration/MaxEpoch/MaxIteration/MaxScore/MinLoss/
+# TriggerAnd/TriggerOr construct the same trigger objects)
+EveryEpoch = every_epoch
+SeveralIteration = several_iteration
+MaxEpoch = max_epoch
+MaxIteration = max_iteration
+MaxScore = max_score
+MinLoss = min_loss
+TriggerAnd = and_
+TriggerOr = or_

@@ -457,3 +457,96 @@ def test_inception_v2_shapes():
     m2 = Inception_v2(class_num=7)
     m2.evaluate()
     assert m2.forward(x).shape == (1, 21)
+
+
+def test_dynamic_graph_switch_merge():
+    # data-dependent branch: pred chooses between x*2 (true) and -x (false);
+    # the untaken side must not execute (eager scheduler parity:
+    # nn/DynamicGraph.scala + nn/ops/ControlOps.scala)
+    calls = []
+
+    class Probe(nn.Identity):
+        def _apply(self, params, state, x, training, rng):
+            calls.append(self.name)
+            return x
+
+    def build():
+        calls.clear()
+        data, pred = nn.Input(), nn.Input()
+        sw = nn.Switch()(data, pred)
+        f = nn.MulConstant(-1.0)(nn.SelectTable(1)(sw))
+        f = Probe(name="false_branch")(f)
+        t = nn.MulConstant(2.0)(nn.SelectTable(2)(sw))
+        t = Probe(name="true_branch")(t)
+        out = nn.Merge()(f, t)
+        return nn.DynamicGraph([data, pred], out)
+
+    x = np.arange(4, dtype=np.float32)
+    g = build()
+    y = g.forward(Table(x, np.bool_(True)))
+    np.testing.assert_allclose(np.asarray(y), x * 2)
+    assert calls == ["true_branch"]
+
+    g2 = build()
+    y = g2.forward(Table(x, np.bool_(False)))
+    np.testing.assert_allclose(np.asarray(y), -x)
+    assert calls == ["false_branch"]
+
+    # StaticGraph is Graph
+    assert nn.StaticGraph is nn.Graph
+
+
+def test_l1_penalty():
+    m = nn.L1Penalty(0.5)
+    x = np.random.randn(3, 4).astype(np.float32)
+    y = m.forward(x)
+    np.testing.assert_allclose(np.asarray(y), x)  # identity forward
+
+    # grad of sum(f(x)) = 1 + 0.5*sign(x)  (provide_output=True)
+    import jax
+    import jax.numpy as jnp
+    p, st = m.init()
+    gfn = jax.grad(lambda xx: jnp.sum(m.apply(p, st, xx, False, None)[0]))
+    g = gfn(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), 1.0 + 0.5 * np.sign(x),
+                               rtol=1e-6)
+
+    # size_average divides by nElement; provide_output=False drops gradOutput
+    m2 = nn.L1Penalty(2.0, size_average=True, provide_output=False)
+    p2, st2 = m2.init()
+    g2 = jax.grad(lambda xx: jnp.sum(m2.apply(p2, st2, xx, False, None)[0]))(
+        jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g2), 2.0 / x.size * np.sign(x),
+                               rtol=1e-6)
+
+
+def test_recurrent_hoisted_projection_matches_step():
+    # Recurrent scans step_pre when the cell offers precompute (input
+    # projection hoisted out of the loop); must be numerically identical
+    # to the per-step path for every hoistable cell type.
+    import jax
+    import jax.numpy as jnp
+
+    cells = [
+        nn.LSTM(6, 8),
+        nn.GRU(6, 8),
+        nn.RnnCell(6, 8),
+        nn.LSTMPeephole(6, 8),
+        nn.MultiRNNCell([nn.LSTM(6, 8), nn.LSTM(8, 8)]),
+    ]
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 7, 6), np.float32)
+    for cell in cells:
+        rec = nn.Recurrent(cell)
+        p, st = rec.init(jax.random.PRNGKey(0))
+        assert cell.precompute(p["cell"], jnp.moveaxis(x, 1, 0)) is not None
+        y_pre, _ = rec.apply(p, st, x, False, None)
+        # oracle: explicit per-timestep python loop over cell.step
+        h = cell.init_hidden(3, x.dtype)
+        outs = []
+        for t in range(x.shape[1]):
+            out, h = cell.step(p["cell"], x[:, t], h)
+            outs.append(out)
+        y_step = jnp.stack(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(y_pre), np.asarray(y_step),
+                                   atol=1e-5,
+                                   err_msg=type(cell).__name__)
