@@ -152,13 +152,17 @@ class _Trainable:
         return cls if zero_based_label else cls + 1
 
     def evaluate(self, x, y, batch_size=32):
+        """Keras semantics: returns [loss, *metric values] (scalar loss if
+        no metrics were compiled)."""
         model = self._module()
         ds = DataSet.array(self._to_samples(x, y))
-        methods = [Top1Accuracy() if m in ("accuracy", "acc") else m
-                   for m in self.metrics] or [LossMetric(self.loss)]
+        methods = [LossMetric(self.loss)] + \
+            [Top1Accuracy() if m in ("accuracy", "acc") else m
+             for m in self.metrics]
         from ..optim import Evaluator
-        return [r.result()[0] for r in
+        vals = [r.result()[0] for r in
                 Evaluator(model).evaluate(ds, methods, batch_size)]
+        return vals if len(vals) > 1 else vals[0]
 
     def summary(self):
         m = self._module()

@@ -348,8 +348,16 @@ def _load_weights(graph, modules_by_name, blobs):
             if inner is not None:
                 ikey = str(m.modules.index(inner))
                 sub = dict(p[ikey])
-                sub["weight"] = jnp.asarray(
-                    bl[0].reshape(np.asarray(sub["weight"]).shape))
+                want = np.asarray(sub["weight"]).shape
+                if bl[0].size != int(np.prod(want)):
+                    # the graph builder guessed the flattened input dim from
+                    # channel tracking (caffe flattens implicitly; spatial
+                    # extent is invisible in the prototxt). The weight blob
+                    # knows the truth: (num_output, true_flat_in).
+                    true_in = bl[0].size // want[0]
+                    inner.input_size = true_in
+                    want = (want[0], true_in)
+                sub["weight"] = jnp.asarray(bl[0].reshape(want))
                 if len(bl) > 1 and "bias" in sub:
                     sub["bias"] = jnp.asarray(bl[1].reshape(-1))
                 p[ikey] = sub

@@ -85,54 +85,24 @@ class Merge(Module):
 
 class DynamicGraph(Graph):
     """Eager Graph: same construction API as Graph/StaticGraph, but
-    ``apply`` executes node-by-node on concrete values, skipping any node
-    whose inputs contain the NOT_TAKEN sentinel (except Merge, which fires
-    on its single taken input). Equivalent to the reference Scheduler for
-    acyclic control flow."""
+    execution skips any node whose inputs contain the NOT_TAKEN sentinel
+    (except Merge, which fires on its single taken input). Equivalent to
+    the reference Scheduler for acyclic control flow. Implemented as the
+    two Graph hooks — the traversal itself lives once, in Graph._apply."""
 
     jittable = False
 
-    def _apply(self, params, state, x, training, rng):
-        import jax
+    def _shortcut(self, mod, ins):
+        # Shallow check on the DIRECT inputs: a Table that merely contains
+        # a sentinel slot (a Switch output) is still a live value —
+        # SelectTable picks a slot out of it, and a picked sentinel then
+        # propagates through here on the next hop.
+        if (not isinstance(mod, Merge)
+                and any(v is NOT_TAKEN for v in ins)):
+            return NOT_TAKEN  # untaken branch: skip, propagate sentinel
+        return Graph._EXECUTE
 
-        values = {}
-        if len(self.input_nodes) == 1:
-            values[id(self.input_nodes[0])] = x
-        else:
-            items = x.to_list() if isinstance(x, Table) else list(x)
-            if len(items) != len(self.input_nodes):
-                raise ValueError(
-                    f"graph expects {len(self.input_nodes)} inputs, "
-                    f"got {len(items)}")
-            for node, item in zip(self.input_nodes, items):
-                values[id(node)] = item
-
-        new_state = dict(state)
-        for n in self.topo:
-            if n.module is None:
-                if id(n) not in values:
-                    raise ValueError(f"unbound input node {n}")
-                continue
-            ins = [values[id(p)] for p in n.prevs]
-            arg = ins[0] if len(ins) == 1 else Table(*ins)
-            mi = n.mod_idx
-            mod = self.modules[mi]
-            # Shallow check on the DIRECT inputs: a Table that merely
-            # contains a sentinel slot (a Switch output) is still a live
-            # value — SelectTable picks a slot out of it, and a picked
-            # sentinel then propagates through here on the next hop.
-            if (not isinstance(mod, Merge)
-                    and any(v is NOT_TAKEN for v in ins)):
-                # untaken branch: skip execution, propagate the sentinel
-                values[id(n)] = NOT_TAKEN
-                continue
-            sub_rng = None if rng is None else jax.random.fold_in(rng, mi)
-            out, new_state[str(mi)] = mod.apply(
-                params[str(mi)], state[str(mi)], arg, training, sub_rng)
-            values[id(n)] = out
-
-        outs = [values[id(o)] for o in self.output_nodes]
-        for o in outs:
-            if _contains_sentinel(o):
-                raise ValueError("graph output is on an untaken branch")
-        return (outs[0] if len(outs) == 1 else Table(*outs)), new_state
+    def _check_output(self, out):
+        if _contains_sentinel(out):
+            raise ValueError("graph output is on an untaken branch")
+        return out

@@ -60,6 +60,18 @@ class Graph(Container):
                 raise ValueError(f"input node {inp} not connected to outputs")
         return order
 
+    # sentinel: "no shortcut, execute the node normally" (see _shortcut)
+    _EXECUTE = object()
+
+    def _shortcut(self, mod, ins):
+        """Hook for subclasses (DynamicGraph): return a value to use INSTEAD
+        of executing ``mod`` on ``ins``, or Graph._EXECUTE to run it."""
+        return Graph._EXECUTE
+
+    def _check_output(self, out):
+        """Hook: validate a graph output value before returning it."""
+        return out
+
     def _apply(self, params, state, x, training, rng):
         values = {}
         if len(self.input_nodes) == 1:
@@ -81,12 +93,17 @@ class Graph(Container):
             ins = [values[id(p)] for p in n.prevs]
             arg = ins[0] if len(ins) == 1 else Table(*ins)
             mi = n.mod_idx
+            mod = self.modules[mi]
+            short = self._shortcut(mod, ins)
+            if short is not Graph._EXECUTE:
+                values[id(n)] = short
+                continue
             sub_rng = None if rng is None else jax.random.fold_in(rng, mi)
-            out, new_state[str(mi)] = self.modules[mi].apply(
+            out, new_state[str(mi)] = mod.apply(
                 params[str(mi)], state[str(mi)], arg, training, sub_rng)
             values[id(n)] = out
 
-        outs = [values[id(o)] for o in self.output_nodes]
+        outs = [self._check_output(values[id(o)]) for o in self.output_nodes]
         return (outs[0] if len(outs) == 1 else Table(*outs)), new_state
 
     def node(self, name):

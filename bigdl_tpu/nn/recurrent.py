@@ -65,6 +65,28 @@ def _uniform(rng, shape, stdv):
     return jax.random.uniform(rng, shape, minval=-stdv, maxval=stdv)
 
 
+def _scan_cell(cell, cell_params, h0, xt):
+    """lax.scan a cell over (T, B, ...) inputs, via the hoisted
+    input-projection path when the cell offers one (Cell docstring).
+    Shared by Recurrent and BiRecurrent so the two can't diverge."""
+    pre = cell.precompute(cell_params, xt)
+    if pre is not None:
+        # input projection hoisted: one (T*B, in)@(in, gates) MXU matmul
+        # outside the loop; the scan carries only the h2h recurrence
+        def body(h, pre_t):
+            out, nh = cell.step_pre(cell_params, pre_t, h)
+            return nh, out
+
+        _, ys = lax.scan(body, h0, pre)
+    else:
+        def body(h, x_t):
+            out, nh = cell.step(cell_params, x_t, h)
+            return nh, out
+
+        _, ys = lax.scan(body, h0, xt)
+    return ys
+
+
 class RnnCell(Cell):
     """Vanilla RNN cell (nn/RNN.scala): h' = act(W x + U h + b)."""
 
@@ -348,22 +370,7 @@ class Recurrent(Module):
         self._infer_spatial(x)
         h0 = self.cell.init_hidden(x.shape[0], x.dtype)
         xt = jnp.moveaxis(x, 1, 0)  # (T, B, ...)
-
-        pre = self.cell.precompute(params["cell"], xt)
-        if pre is not None:
-            # input projection hoisted: one (T*B, in)@(in, gates) MXU matmul
-            # outside the loop; the scan carries only the h2h recurrence
-            def body(h, pre_t):
-                out, nh = self.cell.step_pre(params["cell"], pre_t, h)
-                return nh, out
-
-            _, ys = lax.scan(body, h0, pre)
-        else:
-            def body(h, x_t):
-                out, nh = self.cell.step(params["cell"], x_t, h)
-                return nh, out
-
-            _, ys = lax.scan(body, h0, xt)
+        ys = _scan_cell(self.cell, params["cell"], h0, xt)
         return jnp.moveaxis(ys, 0, 1)
 
     def training(self):
@@ -433,20 +440,7 @@ class BiRecurrent(Module):
     def _run(self, cell_params, x):
         h0 = self.cell.init_hidden(x.shape[0], x.dtype)
         xt = jnp.moveaxis(x, 1, 0)
-
-        pre = self.cell.precompute(cell_params, xt)
-        if pre is not None:  # hoisted input projection (see Cell docstring)
-            def body(h, pre_t):
-                out, nh = self.cell.step_pre(cell_params, pre_t, h)
-                return nh, out
-
-            _, ys = lax.scan(body, h0, pre)
-        else:
-            def body(h, x_t):
-                out, nh = self.cell.step(cell_params, x_t, h)
-                return nh, out
-
-            _, ys = lax.scan(body, h0, xt)
+        ys = _scan_cell(self.cell, cell_params, h0, xt)
         return jnp.moveaxis(ys, 0, 1)
 
     def _apply(self, params, state, x, training, rng):

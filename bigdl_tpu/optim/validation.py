@@ -75,14 +75,33 @@ def _to_class_pred(output):
     return np.argmax(out, axis=-1) + 1  # 1-based
 
 
+def _target_classes(target, n_classes):
+    """1-based class indices from either index targets or one-hot rows
+    (the keras categorical_crossentropy path feeds one-hot; reference
+    Top1Accuracy does the same 2-D discrimination,
+    ValidationMethod.scala:183-190).
+
+    A trailing dim equal to n_classes is NOT enough to call it one-hot —
+    integer sequence labels shaped (B, T) with T == C would misread. Only
+    rows that are actually indicator vectors (0/1 entries, row-sum 1)
+    take the argmax path."""
+    t = np.asarray(target)
+    if t.ndim >= 2 and t.shape[-1] == n_classes and n_classes > 1:
+        flat = t.reshape(-1, n_classes)
+        is_01 = np.logical_or(flat == 0, flat == 1).all()
+        if is_01 and np.all(flat.sum(-1) == 1):
+            return np.argmax(flat, axis=-1) + 1
+    return t.reshape(-1)
+
+
 class Top1Accuracy(ValidationMethod):
     """optim/ValidationMethod.scala:170."""
 
     def __call__(self, output, target):
         out = np.asarray(output)
-        t = np.asarray(target).reshape(-1)
-        if out.ndim == 1 and t.size == 1:
+        if out.ndim == 1:
             out = out[None]
+        t = _target_classes(target, out.shape[-1])
         pred = np.argmax(out, axis=-1) + 1
         correct = int(np.sum(pred == t.astype(np.int64)))
         return AccuracyResult(correct, t.size)
@@ -96,9 +115,9 @@ class Top5Accuracy(ValidationMethod):
 
     def __call__(self, output, target):
         out = np.asarray(output)
-        t = np.asarray(target).reshape(-1).astype(np.int64)
-        if out.ndim == 1 and t.size == 1:
+        if out.ndim == 1:
             out = out[None]
+        t = _target_classes(target, out.shape[-1]).astype(np.int64)
         top5 = np.argsort(-out, axis=-1)[:, :5] + 1
         correct = int(np.sum(np.any(top5 == t[:, None], axis=-1)))
         return AccuracyResult(correct, t.size)

@@ -1,0 +1,51 @@
+"""Keras-API LeNet on (synthetic) MNIST (reference: pyspark/bigdl/examples/
+keras + models/lenet — the keras-1.2 Sequential workflow).
+
+Demonstrates the full keras front-end: Sequential -> compile(optimizer,
+loss, metrics) -> fit -> evaluate -> predict_classes, plus round-tripping
+the architecture through ``model_from_json`` (keras/converter.py).
+
+Run: JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/keras_mnist.py
+"""
+import numpy as np
+
+from bigdl_tpu.keras import Sequential
+from bigdl_tpu.keras.layers import (Convolution2D, MaxPooling2D, Flatten,
+                                    Dense, Dropout, Activation)
+from bigdl_tpu.dataset import mnist
+
+
+def build():
+    model = Sequential()
+    model.add(Convolution2D(6, 5, 5, activation="tanh",
+                            input_shape=(1, 28, 28)))
+    model.add(MaxPooling2D())
+    model.add(Convolution2D(12, 5, 5, activation="tanh"))
+    model.add(MaxPooling2D())
+    model.add(Flatten())
+    model.add(Dense(100, activation="tanh"))
+    model.add(Dropout(0.1))
+    model.add(Dense(10))
+    model.add(Activation("softmax"))
+    return model
+
+
+def main():
+    imgs, labels = mnist.load(n_synthetic=512)
+    x = (imgs.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0)
+    y = np.eye(10, dtype=np.float32)[labels.astype(int) % 10]  # one-hot
+
+    model = build()
+    model.compile(optimizer="adam", loss="categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=64, nb_epoch=3)
+    loss, acc = model.evaluate(x, y, batch_size=64)
+    print(f"train-set loss {loss:.4f}  acc {acc:.3f}")
+    preds = model.predict_classes(x[:8])
+    print("first predictions:", preds.tolist())
+    assert np.isfinite(loss)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

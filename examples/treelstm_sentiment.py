@@ -1,0 +1,97 @@
+"""TreeLSTM sentiment classification (reference: example/treeLSTMSentiment).
+
+Synthetic stand-in for the SST task (zero-egress sandbox): random word
+vectors arranged into random binary parse trees; the label is the sign of
+the summed leaf embeddings' first component. A BinaryTreeLSTM encodes each
+tree bottom-up (level-synchronous lax.scan sweep — nn/tree_lstm.py), the
+ROOT hidden state feeds a linear softmax head, and the whole train step is
+one jit. TreeNNAccuracy (root-node accuracy) is the validation metric, as
+in the reference.
+
+Run: JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/treelstm_sentiment.py
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.optim import SGD
+
+EMB, HID, N_LEAVES, BATCH = 8, 16, 4, 32
+N_NODES = 2 * N_LEAVES - 1  # full binary tree
+
+
+def random_tree(rng):
+    """Left-branching random binary tree over N_LEAVES leaves in the
+    (left, right, leaf_idx) node-table format of nn/tree_lstm.py
+    (1-based children; 0 = leaf; -1 row = padding)."""
+    tree = np.zeros((N_NODES, 3), np.float32)
+    # internal nodes 1..N_LEAVES-1 (node 1 is root, 1-based)
+    # chain: node i has children (node i+1, leaf) — a left spine
+    order = rng.permutation(N_LEAVES)
+    for i in range(N_LEAVES - 1):
+        left = i + 2 if i < N_LEAVES - 2 else N_LEAVES + i  # next internal or a leaf slot
+        right = N_LEAVES + i if i < N_LEAVES - 2 else N_LEAVES + i + 1
+        tree[i] = [left, right, 0]
+    # leaf slots N_LEAVES..2*N_LEAVES-1 (1-based) hold word indices
+    for j in range(N_LEAVES):
+        row = N_LEAVES - 1 + j
+        tree[row] = [0, 0, order[j] + 1]  # 1-based word index
+    return tree
+
+
+def make_batch(rng, n):
+    words = rng.randn(n, N_LEAVES, EMB).astype(np.float32) * 0.5
+    trees = np.stack([random_tree(rng) for _ in range(n)])
+    labels = (words[:, :, 0].sum(axis=1) > 0).astype(np.int32) + 1  # 1/2
+    return words, trees, labels
+
+
+def main():
+    rng = np.random.RandomState(0)
+    tree_lstm = nn.BinaryTreeLSTM(EMB, HID)
+    head = nn.Sequential(nn.Linear(HID, 2), nn.LogSoftMax())
+    crit = nn.ClassNLLCriterion()
+
+    p1, s1 = tree_lstm.init(jax.random.PRNGKey(0))
+    p2, s2 = head.init(jax.random.PRNGKey(1))
+    optim = SGD(learningrate=0.5, momentum=0.9)
+    params = {"tree": p1, "head": p2}
+    opt_state = optim.init_state(params)
+
+    def loss_fn(params, words, trees, y):
+        nodes, _ = tree_lstm.apply(params["tree"], s1, (words, trees),
+                                   training=True, rng=None)
+        root = nodes[:, 0]  # root is node index 0 (1-based node 1)
+        logp, _ = head.apply(params["head"], s2, root, training=True,
+                             rng=None)
+        return crit._forward(logp, y), logp
+
+    @jax.jit
+    def step(params, opt_state, words, trees, y):
+        (loss, logp), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, words, trees, y)
+        params, opt_state = optim.update(g, params, opt_state,
+                                         jnp.float32(0.5))
+        acc = jnp.mean((jnp.argmax(logp, -1) + 1) == y)
+        return params, opt_state, loss, acc
+
+    first = last = None
+    for it in range(60):
+        words, trees, labels = make_batch(rng, BATCH)
+        params, opt_state, loss, acc = step(
+            params, opt_state, jnp.asarray(words), jnp.asarray(trees),
+            jnp.asarray(labels))
+        if it == 0:
+            first = float(loss)
+        last, last_acc = float(loss), float(acc)
+        if it % 15 == 0:
+            print(f"iter {it:3d}  nll {float(loss):.4f}  acc {float(acc):.2f}")
+
+    print(f"nll {first:.4f} -> {last:.4f}; final batch acc {last_acc:.2f}")
+    assert last < first and last_acc > 0.8, "tree LSTM failed to learn"
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,7 @@ def test_sequential_fit_mnist():
     model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"])
     model.fit(x, y, batch_size=32, nb_epoch=3)
-    acc = model.evaluate(x, y)[0]
+    loss, acc = model.evaluate(x, y)  # keras order: [loss, *metrics]
     assert acc > 0.8, acc
     pred = model.predict_classes(x[:16])
     assert pred.shape == (16,)

@@ -847,3 +847,26 @@ def test_tf_session_train_and_predict(tmp_path):
     acc = (after.argmax(-1) == ys).mean()
     assert acc > 0.8, acc
     assert not np.allclose(before, after[:9])  # training changed the graph
+
+
+def test_caffe_innerproduct_spatial_input_roundtrip():
+    """InnerProduct after conv/pool stacks has spatial extent >1x1; the
+    loader must recover the true flattened input dim from the weight blob
+    (prototxt can't express it)."""
+    import tempfile, os
+    import numpy as np
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.loaders import save_caffe, load_caffe
+
+    model = LeNet5(10)
+    model.ensure_initialized()
+    model.evaluate()
+    x = np.random.RandomState(3).randn(2, 1, 28, 28).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    tmp = tempfile.mkdtemp()
+    proto = os.path.join(tmp, "m.prototxt")
+    cm = os.path.join(tmp, "m.caffemodel")
+    save_caffe(model, proto, cm, input_shape=(1, 28, 28))
+    loaded = load_caffe(proto, cm).evaluate()
+    out = np.asarray(loaded.forward(x))
+    np.testing.assert_allclose(out, ref, atol=1e-4)

@@ -174,6 +174,7 @@ _CATALOG = [
     (lambda: nn.SparseLinear(6, 3), (2, 6)),
     (lambda: nn.GradientReversal(), (2, 4)),
     (lambda: nn.Echo(), (2, 4)),
+    (lambda: nn.L1Penalty(0.5, size_average=True), (2, 4)),
 ]
 
 

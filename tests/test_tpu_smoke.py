@@ -66,12 +66,20 @@ k = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
 v = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
 
 def timed(fn, iters=20):
-    f = jax.jit(fn)
-    out = f(q, k, v); jax.block_until_ready(out)
+    # block_until_ready is unreliable over the axon tunnel (returns before
+    # device completion): chain each iteration's input on the previous
+    # output and sync with a host read, like bench.py does.
+    @jax.jit
+    def step(a, b, c):
+        out = fn(a, b, c)
+        s = (out.astype(jnp.float32).mean() * 1e-30).astype(a.dtype)
+        return out, a + s, b + s, c + s
+    out, a, b, c = step(q, k, v)
+    float(out.astype(jnp.float32).mean())
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = f(q, k, v)
-    jax.block_until_ready(out)
+        out, a, b, c = step(a, b, c)
+    float(out.astype(jnp.float32).mean())  # sync the whole chain
     return out, (time.perf_counter() - t0) / iters
 
 o1, t_flash = timed(lambda a, b, c: flash_attention(a, b, c, causal=True))
