@@ -504,8 +504,8 @@ class DistriOptimizer(BaseOptimizer):
             flat_w, opt_state = self._arp.prepare(params)
             self._flat = self._arp.flat
             mstate = shard_params(mstate, self.mesh)
-            return jax.device_put(
-                flat_w, NamedSharding(self.mesh, P())), opt_state, mstate
+            from ..parallel.sharding import put_global
+            return put_global(flat_w, self.mesh, P()), opt_state, mstate
         params = shard_params(params, self.mesh)
         opt_state = shard_params(opt_state, self.mesh)
         mstate = shard_params(mstate, self.mesh)
@@ -530,12 +530,12 @@ class DistriOptimizer(BaseOptimizer):
         if self.parameter_mode == "zero1" and self._arp is not None:
             # reuse the existing FlatParameter/AllReduceParameter — the
             # compiled step closes over them; only re-place the data
-            flat_w = jax.device_put(self._flat.flatten(params),
-                                    NamedSharding(self.mesh, P()))
+            from ..parallel.sharding import put_global
+            flat_w = put_global(self._flat.flatten(params), self.mesh, P())
             opt_specs = self._arp.state_specs()
             opt_state = jax.tree_util.tree_map(
-                lambda a, sp: jax.device_put(
-                    a, NamedSharding(self.mesh, sp)), opt_state, opt_specs)
+                lambda a, sp: put_global(a, self.mesh, sp),
+                opt_state, opt_specs)
             return flat_w, opt_state, mstate
         return (shard_params(params, self.mesh),
                 shard_params(opt_state, self.mesh), mstate)

@@ -101,6 +101,7 @@ class Top1Accuracy(ValidationMethod):
         out = np.asarray(output)
         if out.ndim == 1:
             out = out[None]
+        out = out.reshape(-1, out.shape[-1])  # (B*T..., C)
         t = _target_classes(target, out.shape[-1])
         pred = np.argmax(out, axis=-1) + 1
         correct = int(np.sum(pred == t.astype(np.int64)))
@@ -117,6 +118,7 @@ class Top5Accuracy(ValidationMethod):
         out = np.asarray(output)
         if out.ndim == 1:
             out = out[None]
+        out = out.reshape(-1, out.shape[-1])  # (B*T..., C)
         t = _target_classes(target, out.shape[-1]).astype(np.int64)
         top5 = np.argsort(-out, axis=-1)[:, :5] + 1
         correct = int(np.sum(np.any(top5 == t[:, None], axis=-1)))

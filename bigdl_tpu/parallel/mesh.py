@@ -76,9 +76,14 @@ def make_hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
                          f"(got {ici_shape}, {dcn_shape}, {axes})")
     from jax.experimental import mesh_utils
     devices = jax.devices()
-    # TPU slices expose slice_index; hosts-only backends (and single-slice
-    # multi-process runs) group by process instead
-    granule_by_process = not hasattr(devices[0], "slice_index")
+    # The DCN granule is whatever the topology actually has dcn_total of:
+    # multi-slice TPU pods group by slice_index; multi-process hosts
+    # (including CPU rendezvous, where every device reports slice 0)
+    # group by process.
+    dcn_total = int(np.prod(dcn_shape))
+    has_slice = hasattr(devices[0], "slice_index")
+    n_slices = len({d.slice_index for d in devices}) if has_slice else 0
+    granule_by_process = (not has_slice) or (n_slices != dcn_total)
     arr = mesh_utils.create_hybrid_device_mesh(
         tuple(ici_shape), tuple(dcn_shape), devices=devices,
         process_is_granule=granule_by_process)

@@ -20,21 +20,52 @@ def data_sharding(mesh: Mesh, axis: str = "data"):
     return NamedSharding(mesh, P(axis))
 
 
+def is_multi_process(mesh: Mesh) -> bool:
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def put_global(x, mesh: Mesh, spec) -> jax.Array:
+    """Place a host array THAT EVERY PROCESS HOLDS IN FULL (params,
+    optimizer state — same seed everywhere) onto the mesh with ``spec``.
+
+    Single-controller: plain device_put. Multi-controller: device_put
+    cannot address remote devices, so each process materialises only its
+    addressable shards via make_array_from_callback slicing its full copy
+    (the multi-host analog of the reference broadcasting the model to every
+    Spark executor, DistriOptimizer.scala init)."""
+    sh = NamedSharding(mesh, spec)
+    if is_multi_process(mesh):
+        xa = np.asarray(x)
+        return jax.make_array_from_callback(xa.shape, sh,
+                                            lambda idx: xa[idx])
+    # single-controller: hand device-resident arrays straight to
+    # device_put (on-device reshard, no host round trip)
+    return jax.device_put(x, sh)
+
+
 def shard_batch(batch, mesh: Mesh, axis: str = "data"):
-    """Device-put a host batch with the leading dim split over ``axis``."""
+    """Device-put a host batch with the leading dim split over ``axis``.
+
+    Multi-controller: each process's batch is its LOCAL share (each Spark
+    partition reads its own split in the reference); the global batch is
+    the concatenation over processes."""
     sh = data_sharding(mesh, axis)
+    multi = is_multi_process(mesh)
 
     def put(x):
         if x is None:
             return None
-        return jax.device_put(np.asarray(x), sh)
+        x = np.asarray(x)
+        if multi:
+            return jax.make_array_from_process_local_data(sh, x)
+        return jax.device_put(x, sh)
     return jax.tree_util.tree_map(put, batch)
 
 
 def shard_params(params, mesh: Mesh):
-    """Replicate params across the mesh."""
-    sh = replicated(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), params)
+    """Replicate params across the mesh (multi-controller safe)."""
+    return jax.tree_util.tree_map(
+        lambda x: put_global(x, mesh, P()), params)
 
 
 def tp_linear_rules(axis: str = "model"):

@@ -1,0 +1,142 @@
+"""True multi-process (multi-controller) distributed tests.
+
+The rest of the suite emulates N devices inside ONE process; the reference's
+distributed substrate, however, is genuinely multi-node (Spark executors +
+BlockManager). This test spawns TWO separate JAX processes that rendezvous
+through ``jax.distributed.initialize`` (gRPC coordinator — the DCN analog),
+each owning 4 virtual CPU devices of an 8-device global mesh, and checks:
+
+  * process_allgather sees every process (failure-detection heartbeat path)
+  * a shard_mapped psum over the GLOBAL mesh reduces across process
+    boundaries (the cross-host gradient all-reduce of DistriOptimizer)
+  * make_hybrid_mesh builds the DCN x ICI mesh in a real multi-process
+    topology (process_is_granule path)
+
+Skipped automatically if the coordinator cannot bind (sandboxes without
+localhost sockets).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_DRIVER = r"""
+import os, sys
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n,
+                           process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental import multihost_utils
+
+assert len(jax.devices()) == 4 * n, jax.devices()
+assert len(jax.local_devices()) == 4
+
+# 1) coordinator-level allgather (heartbeat path)
+seen = multihost_utils.process_allgather(jnp.asarray([float(pid)]))
+assert sorted(np.asarray(seen).reshape(-1).tolist()) == [float(i) for i in
+                                                         range(n)], seen
+
+# 2) cross-process psum over the global mesh
+mesh = Mesh(np.array(jax.devices()), ("data",))
+sharding = NamedSharding(mesh, P("data"))
+local = np.full((4 * n // n,), float(pid + 1), np.float32)  # 4 per process
+garr = jax.make_array_from_process_local_data(sharding, local)
+out = jax.jit(shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                        in_specs=P("data"), out_specs=P()),
+              out_shardings=NamedSharding(mesh, P()))(garr)
+# psum of per-device values: 4 devices carrying 1.0 + 4 carrying 2.0 = 12
+total = float(np.asarray(jax.device_get(
+    out.addressable_shards[0].data)).reshape(-1)[0])
+assert total == 12.0, total
+
+# 3) hybrid DCN x ICI mesh in a real 2-process topology
+from bigdl_tpu.parallel.mesh import make_hybrid_mesh
+hmesh = make_hybrid_mesh(ici_shape=(1, 4), dcn_shape=(n, 1),
+                         axes=("data", "model"))
+assert hmesh.devices.shape == (n, 4)
+# the ICI (model) axis must stay inside one process
+for row in hmesh.devices:
+    assert len({d.process_index for d in row}) == 1, hmesh.devices
+
+# 4) full DistriOptimizer training across processes: each process feeds its
+# LOCAL data split (the reference's per-partition reads); gradients psum
+# over the global 'data' axis spanning both processes
+from bigdl_tpu import nn
+from bigdl_tpu.models import LeNet5
+from bigdl_tpu.optim import DistriOptimizer, SGD, MaxIteration
+from bigdl_tpu.dataset import DataSet, mnist
+
+dmesh = Mesh(np.array(jax.devices()), ("data",))
+imgs, labels = mnist.load(n_synthetic=64)
+# per-process split: each controller feeds a DIFFERENT half of the data
+imgs, labels = imgs[pid * 32:(pid + 1) * 32], labels[pid * 32:(pid + 1) * 32]
+ds = DataSet.array(mnist.to_samples(imgs, labels))
+opt = DistriOptimizer(LeNet5(10), ds, nn.ClassNLLCriterion(),
+                      SGD(learningrate=0.01), MaxIteration(2),
+                      batch_size=8, mesh=dmesh)
+opt.optimize()
+loss = float(opt.optim_method.state["loss"])
+assert np.isfinite(loss), loss
+# every process must agree on the replicated loss/params
+agreed = multihost_utils.process_allgather(jnp.asarray([loss]))
+assert np.allclose(np.asarray(agreed).reshape(-1), loss), agreed
+
+# 5) ZeRO-1 sharded-optimizer variant over the same 2-process mesh
+ds2 = DataSet.array(mnist.to_samples(imgs, labels))
+opt2 = DistriOptimizer(LeNet5(10), ds2, nn.ClassNLLCriterion(),
+                       SGD(learningrate=0.01), MaxIteration(2),
+                       batch_size=8, mesh=dmesh,
+                       parameter_mode="zero1", compress="bf16")
+opt2.optimize()
+assert np.isfinite(float(opt2.optim_method.state["loss"]))
+
+print(f"MULTIHOST_OK_{pid}")
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed():
+    try:
+        port = _free_port()
+    except OSError:
+        pytest.skip("no localhost sockets in this sandbox")
+    n = 2
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # driver sets its own device count
+    # strip the axon TPU plugin registration: a multi-process CPU
+    # rendezvous must never claim the real chip (cf. bench.py _cpu_env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _DRIVER, str(pid), str(n), str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(n)]
+    outs = []
+    for pid, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for p2 in procs:
+                p2.kill()
+            raise
+        outs.append((pid, proc.returncode, out, err))
+    for pid, rc, out, err in outs:
+        assert rc == 0, f"process {pid} failed:\n{err[-3000:]}"
+        assert f"MULTIHOST_OK_{pid}" in out

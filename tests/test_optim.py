@@ -434,3 +434,25 @@ def test_async_sync_policy_nan_detection_lags_but_fires():
     # loop ends — the drain must raise, not silently return
     with _pt.raises(FloatingPointError):
         diverging_opt(32, 1).optimize()
+
+
+def test_accuracy_sequence_labels_and_onehot():
+    """Top1/Top5 accept (B,T,C) outputs with integer (B,T) sequence labels
+    (even when T == C) AND one-hot (B,C) targets."""
+    from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy
+    rng = np.random.RandomState(0)
+    # sequence labels, T == C == 10, B=3
+    out = rng.randn(3, 10, 10).astype(np.float32)
+    t = rng.randint(1, 11, size=(3, 10))
+    r = Top1Accuracy()(out, t)
+    expect = int(np.sum(np.argmax(out.reshape(-1, 10), -1) + 1
+                        == t.reshape(-1)))
+    assert r.correct == expect and r.count == 30
+    r5 = Top5Accuracy()(out, t)
+    assert r5.count == 30 and r5.correct >= r.correct
+    # one-hot rows (keras categorical path)
+    oh = np.eye(10, dtype=np.float32)[t.reshape(-1) - 1][:30]
+    out2 = rng.randn(30, 10).astype(np.float32)
+    r2 = Top1Accuracy()(out2, oh)
+    expect2 = int(np.sum(np.argmax(out2, -1) + 1 == t.reshape(-1)))
+    assert r2.correct == expect2 and r2.count == 30
