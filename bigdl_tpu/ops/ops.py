@@ -797,6 +797,8 @@ class DepthwiseConv2D(Operation):
 
 __all__ = [
     "Operation", "RangeOps", "DepthwiseConv2D",
+    "Compare", "Assert", "NoOp", "ControlDependency", "BiasAdd",
+    "TensorModuleWrapper",
     "Equal", "NotEqual", "ApproximateEqual", "Greater",
     "GreaterEqual", "Less", "LessEqual", "LogicalAnd", "LogicalOr",
     "LogicalNot", "All", "Any", "Sum", "Prod", "Max", "Min", "Mean",
@@ -813,3 +815,58 @@ __all__ = [
     "CategoricalColHashBucket", "CategoricalColVocaList", "CrossCol",
     "IndicatorCol", "Kv2Tensor", "MkString", "Substr",
 ]
+
+
+class Compare(Operation):
+    """Abstract base of the comparison ops (nn/ops/Compare.scala) — kept
+    for API parity; concrete subclasses implement ``_cmp``."""
+
+    def _cmp(self, a, b):
+        raise NotImplementedError
+
+    def _op(self, a, b):
+        return self._cmp(jnp.asarray(a), jnp.asarray(b))
+
+
+class Assert(Operation):
+    """nn/tf/Assert — eager-checks a concrete predicate, passes data
+    through. Under jit the check is skipped (XLA has no host asserts);
+    DynamicGraph/eager paths enforce it."""
+
+    def _op(self, pred, *data):
+        import jax.errors
+        try:
+            ok = bool(np.asarray(pred).all())
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            # traced under jit — no concrete value; the check is skipped
+            # (XLA has no host asserts). Any OTHER error in evaluating the
+            # predicate must surface, not silently disable the assertion.
+            return data[0] if len(data) == 1 else Table(*data)
+        assert ok, "Assert op failed"
+        return data[0] if len(data) == 1 else Table(*data)
+
+
+class NoOp(Operation):
+    """nn/tf/NoOp — control-dependency placeholder; identity."""
+
+    def _op(self, *xs):
+        return xs[0] if xs else jnp.zeros(())
+
+
+class ControlDependency(NoOp):
+    """nn/tf/ControlDependency — on XLA, data dependencies ARE the
+    schedule; this passes its first input through unchanged."""
+
+
+class BiasAdd(Operation):
+    """nn/tf/BiasAdd — add a 1-D bias over the trailing (channel) dim."""
+
+    def _op(self, x, bias):
+        return x + jnp.asarray(bias).reshape(
+            (1,) * (jnp.asarray(x).ndim - 1) + (-1,))
+
+
+class TensorModuleWrapper(ModuleToOperation):
+    """nn/tf/TensorModuleWrapper — alias of ModuleToOperation here (both
+    lift a TensorModule into the op world)."""

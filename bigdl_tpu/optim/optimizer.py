@@ -275,8 +275,8 @@ class BaseOptimizer:
         path = os.path.join(self.checkpoint_path, f"checkpoint{tag}.bigdl")
         payload = {
             "params": _tmap(np.asarray, self._params_for_checkpoint(params)),
-            "opt_state": _tmap(np.asarray, opt_state),
-            "model_state": _tmap(np.asarray, mstate),
+            "opt_state": self._to_host(opt_state),
+            "model_state": self._to_host(mstate),
             "optim_host_state": dict(self.optim_method.state),
             "epoch": state["epoch"], "neval": state["neval"],
         }
@@ -446,6 +446,10 @@ class BaseOptimizer:
         self._fire_mid_epoch(state, params, opt_state, mstate)
 
     # hooks overridden by DistriOptimizer
+    def _to_host(self, tree):
+        """Fetch a tree to host numpy for checkpointing."""
+        return _tmap(np.asarray, tree)
+
     def _prepare(self, params, opt_state, mstate):
         return params, opt_state, mstate
 
@@ -491,12 +495,43 @@ class DistriOptimizer(BaseOptimizer):
     def _num_shards(self):
         return self.mesh.shape["data"]
 
+    def _to_host(self, tree):
+        # ZeRO-1 opt state is sharded P('data') across processes in
+        # multi-controller runs; np.asarray on non-addressable shards
+        # raises. gather_to_host reshards to replicated first (collective
+        # — checkpoint triggers fire symmetrically on every process).
+        from ..parallel.sharding import gather_to_host
+        return gather_to_host(tree, self.mesh)
+
+    def _check_split_agreement(self):
+        """Multi-controller: every process feeds its own data split; if
+        the per-process batch counts differ, the extra steps on the larger
+        split would block forever in the cross-process psum. Fail loudly
+        at setup instead of deadlocking mid-epoch."""
+        from ..parallel.sharding import is_multi_process
+        if not is_multi_process(self.mesh):
+            return
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        src = self._batched()
+        n = getattr(src, "batches_per_epoch", 0)
+        n = int(n() if callable(n) else (n or 0))
+        counts = np.asarray(multihost_utils.process_allgather(
+            jnp.asarray([n], jnp.int32))).reshape(-1)
+        if len(set(counts.tolist())) > 1:
+            raise ValueError(
+                "per-process dataset splits disagree on batches/epoch "
+                f"{counts.tolist()}; pad or trim the local splits so every "
+                "process takes the same number of steps (uneven splits "
+                "deadlock in the cross-process gradient psum)")
+
     def _place_batch(self, x, y):
         from ..parallel.sharding import shard_batch
         return (shard_batch(x, self.mesh), shard_batch(y, self.mesh))
 
     def _prepare(self, params, opt_state, mstate):
-        from ..parallel.sharding import shard_params
+        from ..parallel.sharding import shard_params, put_global
+        self._check_split_agreement()
         if self.parameter_mode == "zero1":
             from ..parallel.allreduce import AllReduceParameter
             self._arp = AllReduceParameter(self.optim_method, self.mesh,
@@ -504,7 +539,6 @@ class DistriOptimizer(BaseOptimizer):
             flat_w, opt_state = self._arp.prepare(params)
             self._flat = self._arp.flat
             mstate = shard_params(mstate, self.mesh)
-            from ..parallel.sharding import put_global
             return put_global(flat_w, self.mesh, P()), opt_state, mstate
         params = shard_params(params, self.mesh)
         opt_state = shard_params(opt_state, self.mesh)
@@ -522,7 +556,7 @@ class DistriOptimizer(BaseOptimizer):
         return params
 
     def _restore_step_state(self, payload):
-        from ..parallel.sharding import shard_params
+        from ..parallel.sharding import shard_params, put_global
         params = _tmap(jnp.asarray, payload["params"])
         opt_state = _tmap(jnp.asarray, payload["opt_state"])
         mstate = shard_params(_tmap(jnp.asarray, payload["model_state"]),
@@ -530,7 +564,6 @@ class DistriOptimizer(BaseOptimizer):
         if self.parameter_mode == "zero1" and self._arp is not None:
             # reuse the existing FlatParameter/AllReduceParameter — the
             # compiled step closes over them; only re-place the data
-            from ..parallel.sharding import put_global
             flat_w = put_global(self._flat.flatten(params), self.mesh, P())
             opt_specs = self._arp.state_specs()
             opt_state = jax.tree_util.tree_map(

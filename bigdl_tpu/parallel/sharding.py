@@ -43,6 +43,21 @@ def put_global(x, mesh: Mesh, spec) -> jax.Array:
     return jax.device_put(x, sh)
 
 
+def gather_to_host(tree, mesh: Mesh):
+    """Fetch a (possibly cross-process sharded) tree to host numpy.
+
+    Multi-controller: leaves sharded over remote devices are not
+    addressable, so first jit-reshard everything to replicated (a
+    collective — every process must call this at the same point, which
+    holds for symmetric triggers like checkpoints), then fetch."""
+    import jax.tree_util as jtu
+    if is_multi_process(mesh):
+        rep = NamedSharding(mesh, P())
+        tree = jax.jit(lambda t: t, out_shardings=jtu.tree_map(
+            lambda _: rep, tree))(tree)
+    return jtu.tree_map(lambda a: np.asarray(a), tree)
+
+
 def shard_batch(batch, mesh: Mesh, axis: str = "data"):
     """Device-put a host batch with the leading dim split over ``axis``.
 

@@ -207,3 +207,34 @@ def test_depthwise_conv2d_matches_torch():
     op2 = DepthwiseConv2D(pad_w=1, pad_h=1, data_format="NHWC")
     out2 = np.asarray(op2.forward([x.transpose(0, 2, 3, 1), w]))
     assert np.allclose(out2.transpose(0, 3, 1, 2), ref, atol=1e-4)
+
+
+def test_tf_wrapper_ops():
+    """nn/tf wrapper parity: Assert/NoOp/ControlDependency/BiasAdd/
+    TensorModuleWrapper/Compare."""
+    import pytest
+    from bigdl_tpu import nn
+    from bigdl_tpu.utils.table import Table
+
+    x = np.ones((2, 3, 4), np.float32)
+    b = np.arange(4, dtype=np.float32)
+    out = np.asarray(ops.BiasAdd().forward(Table(x, b)))
+    assert np.allclose(out, 1.0 + b)
+
+    assert np.allclose(np.asarray(
+        ops.NoOp().forward(x)), x)
+    assert np.allclose(np.asarray(
+        ops.ControlDependency().forward(x)), x)
+
+    y = ops.Assert().forward(Table(np.bool_(True), x))
+    assert np.allclose(np.asarray(y), x)
+    with pytest.raises(AssertionError):
+        ops.Assert().forward(Table(np.bool_(False), x))
+
+    w = ops.TensorModuleWrapper(nn.AddConstant(2.0))
+    assert np.allclose(np.asarray(w.forward(x)), x + 2.0)
+
+    class Gt(ops.Compare):
+        def _cmp(self, a, b):
+            return a > b
+    assert bool(np.asarray(Gt().forward(Table(np.float32(3), np.float32(1)))))
