@@ -133,7 +133,8 @@ _IMAGENET_CFG = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
 def ResNet(class_num: int = 1000, depth: int = 50,
            shortcut_type: str = ShortcutType.B, data_set: str = "ImageNet",
            zero_init_residual: bool = True, with_log_softmax: bool = False,
-           format: str = "NCHW", stem: str = "conv7"):
+           format: str = "NCHW", stem: str = "conv7",
+           pool_grad: str = "exact"):
     """Factory with the reference's signature
     (models/resnet/ResNet.scala apply(classNum, opt)). ``format='NHWC'``
     builds the channels-last variant (identical params; activations NHWC —
@@ -153,7 +154,8 @@ def ResNet(class_num: int = 1000, depth: int = 50,
         model.add(_conv(3, 64, 7, 2, 3, fmt))
     model.add(_bn(64, fmt=fmt))
     model.add(ReLU())
-    model.add(SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=fmt))
+    model.add(SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=fmt,
+                                grad_mode=pool_grad))
     nin = 64
     for stage, n_blocks in enumerate(blocks):
         nmid = 64 * (2 ** stage)

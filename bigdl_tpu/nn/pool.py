@@ -74,16 +74,59 @@ class _Pool2D(Module):
 
 
 class SpatialMaxPooling(_Pool2D):
-    """nn/SpatialMaxPooling.scala (NCHW)."""
+    """nn/SpatialMaxPooling.scala (NCHW or NHWC).
+
+    ``grad_mode``:
+      * ``"exact"`` (default) — reduce_window forward; backward is XLA's
+        select_and_scatter (gradient to the FIRST max, torch semantics).
+      * ``"fast"`` — the forward is computed as a maximum-tree over the
+        k*k shifted strided slices; identical outputs, but the backward
+        autodiffs through ``jnp.maximum`` selects (scatter-free, fuses as
+        elementwise on TPU — select_and_scatter is ~1.5 ms/step of the
+        ResNet-50 profile). Tie-breaking differs: exact ties split the
+        gradient 50/50 instead of picking the first.
+    """
+
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0,
+                 format="NCHW", grad_mode: str = "exact", name=None):
+        super().__init__(kw, kh, dw, dh, pad_w, pad_h, format=format,
+                         name=name)
+        assert grad_mode in ("exact", "fast"), grad_mode
+        self.grad_mode = grad_mode
+
+    def _fast_pool(self, x, ph, pw):
+        """max over k*k shifted strided slices (scatter-free backward)."""
+        if self.format == "NHWC":
+            pad_cfg = [(0, 0, 0), ph + (0,), pw + (0,), (0, 0, 0)]
+            hax, wax = 1, 2
+        else:
+            pad_cfg = [(0, 0, 0), (0, 0, 0), ph + (0,), pw + (0,)]
+            hax, wax = 2, 3
+        xp = lax.pad(x, jnp.asarray(-jnp.inf, x.dtype), pad_cfg)
+        hp, wp = xp.shape[hax], xp.shape[wax]
+        out_h = (hp - self.kh) // self.dh + 1
+        out_w = (wp - self.kw) // self.dw + 1
+        y = None
+        for i in range(self.kh):
+            for j in range(self.kw):
+                sl = [slice(None)] * x.ndim
+                sl[hax] = slice(i, i + (out_h - 1) * self.dh + 1, self.dh)
+                sl[wax] = slice(j, j + (out_w - 1) * self.dw + 1, self.dw)
+                piece = xp[tuple(sl)]
+                y = piece if y is None else jnp.maximum(y, piece)
+        return y
 
     def _apply(self, params, state, x, training, rng):
         squeeze = False
         if x.ndim == 3:
             x, squeeze = x[None], True
         ph, pw = self._pads(x)
-        dims, strides, pads = self._window(self.kh, self.kw, self.dh,
-                                           self.dw, ph, pw)
-        y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+        if self.grad_mode == "fast":
+            y = self._fast_pool(x, ph, pw)
+        else:
+            dims, strides, pads = self._window(self.kh, self.kw, self.dh,
+                                               self.dw, ph, pw)
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
         return y[0] if squeeze else y
 
 

@@ -365,3 +365,86 @@ class ImageFrameToSample(Transformer):
                 yield Sample(item["image"], item.get("label"))
             else:
                 yield Sample(item)
+
+
+class ChannelOrder(FeatureTransformer):
+    """augmentation/ChannelOrder.scala — swap RGB<->BGR (the reference
+    flips the OpenCV BGR order to the RGB order nets trained on).
+    No-op on grayscale (HW) images — the last axis there is width."""
+
+    def transform_image(self, img, rng):
+        return img[..., ::-1] if img.ndim == 3 else img
+
+
+class Crop(FeatureTransformer):
+    """augmentation/Crop.scala base — crop by an explicit roi
+    (x1, y1, x2, y2), normalized coords by default, clipped to bounds."""
+
+    def __init__(self, roi, normalized: bool = True, is_clip: bool = True,
+                 **kw):
+        super().__init__(**kw)
+        self.roi, self.normalized, self.is_clip = tuple(roi), normalized, \
+            is_clip
+
+    def generate_roi(self, img, rng):
+        return self.roi
+
+    def transform_image(self, img, rng):
+        h, w = img.shape[:2]
+        x1, y1, x2, y2 = self.generate_roi(img, rng)
+        if self.normalized:
+            x1, y1, x2, y2 = x1 * w, y1 * h, x2 * w, y2 * h
+        if self.is_clip:
+            x1, x2 = max(0, x1), min(w, x2)
+            y1, y2 = max(0, y1), min(h, y2)
+        elif not (0 <= x1 < x2 <= w and 0 <= y1 < y2 <= h):
+            # without clipping an out-of-bounds roi cannot be represented
+            # by a numpy view (negative indices would WRAP); fail loudly
+            raise ValueError(
+                f"crop roi ({x1},{y1},{x2},{y2}) outside {w}x{h} image "
+                "(set is_clip=True to clamp)")
+        return img[int(y1):int(y2), int(x1):int(x2)]
+
+
+class RandomCropper(FeatureTransformer):
+    """augmentation/RandomCropper.scala — crop to (cropWidth, cropHeight)
+    at a random (or center) position, with optional random mirror."""
+
+    def __init__(self, crop_width: int, crop_height: int,
+                 mirror: bool = True, cropper_method: str = "random", **kw):
+        super().__init__(**kw)
+        assert cropper_method in ("random", "center"), cropper_method
+        # one source of truth for the offset math: delegate to the
+        # existing crop transformers
+        self._crop = (RandomCrop if cropper_method == "random"
+                      else CenterCrop)(crop_width, crop_height)
+        self.mirror = mirror
+        self.cropper_method = cropper_method
+
+    def transform_image(self, img, rng):
+        out = self._crop.transform_image(img, rng)
+        if self.mirror and rng.rand() < 0.5:
+            out = out[:, ::-1]
+        return out
+
+
+class RandomResize(FeatureTransformer):
+    """augmentation/RandomResize.scala — resize so the SHORTER side is a
+    uniform random size in [min_size, max_size], keeping aspect."""
+
+    def __init__(self, min_size: int, max_size: int, **kw):
+        super().__init__(**kw)
+        self.min_size, self.max_size = min_size, max_size
+
+    def transform_image(self, img, rng):
+        h, w = img.shape[:2]
+        short = rng.randint(self.min_size, self.max_size + 1)
+        if h < w:
+            oh, ow = short, int(round(w / h * short))
+        else:
+            oh, ow = int(round(h / w * short)), short
+        return _resize_bilinear(img, oh, ow)
+
+
+# reference name for the inception-style scale/aspect crop
+RandomAlterAspect = RandomResizedCrop

@@ -305,3 +305,33 @@ def test_tfrecord_negative_ints_and_truncation(tmp_path):
     open(path, "wb").write(raw[:-8])
     with pytest.raises(IOError):
         list(read_tfrecords(path, verify_crc=False))
+
+
+def test_vision_transform_longtail():
+    """ChannelOrder/Crop/RandomCropper/RandomResize/RandomAlterAspect
+    (augmentation/*.scala parity additions)."""
+    from bigdl_tpu.transform.vision import (ChannelOrder, Crop,
+                                            RandomCropper, RandomResize,
+                                            RandomAlterAspect)
+    img = np.arange(8 * 10 * 3, dtype=np.float32).reshape(8, 10, 3)
+    rng = np.random.RandomState(0)
+
+    out = ChannelOrder().transform_image(img, rng)
+    assert np.allclose(out[..., 0], img[..., 2])
+
+    out = Crop((0.25, 0.25, 0.75, 0.75)).transform_image(img, rng)
+    assert out.shape == (4, 5, 3)
+    out = Crop((1, 2, 7, 6), normalized=False).transform_image(img, rng)
+    assert out.shape == (4, 6, 3)
+
+    out = RandomCropper(4, 4, mirror=True).transform_image(img, rng)
+    assert out.shape == (4, 4, 3)
+    out = RandomCropper(4, 4, cropper_method="center",
+                        mirror=False).transform_image(img, rng)
+    assert np.allclose(out, img[2:6, 3:7])
+
+    out = RandomResize(4, 6).transform_image(img, rng)
+    assert min(out.shape[:2]) in (4, 5, 6)
+
+    out = RandomAlterAspect(size=5).transform_image(img, rng)
+    assert out.shape[:2] == (5, 5)

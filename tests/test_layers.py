@@ -550,3 +550,28 @@ def test_recurrent_hoisted_projection_matches_step():
         np.testing.assert_allclose(np.asarray(y_pre), np.asarray(y_step),
                                    atol=1e-5,
                                    err_msg=type(cell).__name__)
+
+
+def test_maxpool_fast_grad_mode():
+    """grad_mode='fast' (shifted-max tree): identical forward; identical
+    backward on tie-free inputs."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    for fmt, shape in (("NCHW", (2, 3, 11, 13)), ("NHWC", (2, 11, 13, 3))):
+        x = jnp.asarray(rng.rand(*shape) * 10, jnp.float32)  # tie-free
+        for args in ((3, 3, 2, 2, 1, 1), (2, 2, 2, 2, 0, 0),
+                     (3, 2, 1, 2, 0, 1)):
+            exact = nn.SpatialMaxPooling(*args, format=fmt)
+            fast = nn.SpatialMaxPooling(*args, format=fmt, grad_mode="fast")
+            y1 = exact.forward(np.asarray(x))
+            y2 = fast.forward(np.asarray(x))
+            np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                       err_msg=f"{fmt} {args}")
+            p, st = exact.init()
+            g1 = jax.grad(lambda xx: jnp.sum(
+                exact.apply(p, st, xx, False, None)[0] ** 2))(x)
+            g2 = jax.grad(lambda xx: jnp.sum(
+                fast.apply(p, st, xx, False, None)[0] ** 2))(x)
+            np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                       atol=1e-5, err_msg=f"{fmt} {args}")

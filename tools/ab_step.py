@@ -27,7 +27,8 @@ def build():
 
     engine.set_seed(0)
     model = ResNet(class_num=1000, depth=50, format="NHWC",
-                   stem=os.environ.get("STEM", "conv7"))
+                   stem=os.environ.get("STEM", "conv7"),
+                   pool_grad=os.environ.get("POOL_GRAD", "exact"))
     params, mstate = model.init(jax.random.PRNGKey(0))
     crit = CrossEntropyCriterion()
     rng = np.random.RandomState(0)
