@@ -99,7 +99,16 @@ class Module:
     def apply(self, params: Params, state: State, x, training: bool = False,
               rng=None):
         """Pure forward. Returns ``(output, new_state)``."""
-        out = self._apply(params, state, x, training, rng)
+        try:
+            out = self._apply(params, state, x, training, rng)
+        except Exception as e:
+            # LayerException parity (utils/LayerException.scala): errors
+            # deep inside a model carry the failing layer's identity.
+            # add_note keeps the original exception type/traceback intact.
+            if hasattr(e, "add_note"):
+                e.add_note(f"Layer info: {self.name} "
+                           f"({type(self).__name__})")
+            raise
         if isinstance(out, tuple) and len(out) == 2 and isinstance(out[1], dict):
             return out
         return out, state

@@ -575,3 +575,15 @@ def test_maxpool_fast_grad_mode():
                 fast.apply(p, st, xx, False, None)[0] ** 2))(x)
             np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                        atol=1e-5, err_msg=f"{fmt} {args}")
+
+
+def test_layer_exception_context_notes():
+    """utils/LayerException.scala parity: errors inside a model carry the
+    failing layer's identity (PEP-678 notes; type/message unchanged)."""
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                      nn.Linear(9, 2, name="bad_fc"))
+    with pytest.raises(Exception) as ei:
+        m.forward(np.zeros((2, 4), np.float32))
+    notes = getattr(ei.value, "__notes__", [])
+    assert any("bad_fc" in n for n in notes), notes
+    assert any("Sequential" in n for n in notes), notes
