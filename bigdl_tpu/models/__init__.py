@@ -8,5 +8,6 @@ from .inception import (Inception_v1, Inception_v1_NoAuxClassifier,
 from .rnn import PTBModel, SimpleRNN
 from .autoencoder import Autoencoder
 from .transformer_lm import TransformerLM
+from .moe_lm import MoETransformerLM
 from .recommender import NeuralCF, WideAndDeep
 from .textclassifier import TextClassifier

@@ -1,0 +1,101 @@
+"""Switch-MoE Transformer language model.
+
+TPU-first addition beyond the reference (BigDL 0.x predates MoE; its
+gating ancestor is ``nn/MixtureTable.scala``). Decoder-only causal LM in
+the Switch-Transformer layout: every ``moe_every``-th block replaces its
+dense FFN with a top-1-routed :class:`bigdl_tpu.nn.MixtureOfExperts`
+(capacity + load-balance loss). The summed auxiliary router loss is
+surfaced in ``state['aux_loss']`` so training adds
+``aux_weight * aux_loss`` to the objective; for the expert-PARALLEL
+sharded form of the same math see ``parallel/moe.py`` (used by
+``__graft_entry__.dryrun_multichip``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.attention import (LayerNormalization, TransformerBlock,
+                            causal_mask, embed_ids)
+from ..nn.moe import MixtureOfExperts
+from ..nn.module import Module
+from ..utils.table import Table
+
+
+class MoETransformerLM(Module):
+    """GPT-style decoder with MoE FFNs on a stride (Switch-Transformer)."""
+
+    def __init__(self, vocab_size: int, hidden_size: int = 256,
+                 num_heads: int = 4, filter_size: int = 1024,
+                 num_layers: int = 4, n_experts: int = 4,
+                 moe_every: int = 2, capacity_factor: float = 1.25,
+                 max_len: int = 2048, name=None):
+        super().__init__(name=name)
+        self.vocab_size, self.hidden_size = vocab_size, hidden_size
+        self.max_len = max_len
+        self.blocks = []
+        self.moe_idx = set(range(moe_every - 1, num_layers, moe_every))
+        for i in range(num_layers):
+            if i in self.moe_idx:
+                self.blocks.append(_MoEBlock(hidden_size, num_heads,
+                                             filter_size, n_experts,
+                                             capacity_factor))
+            else:
+                self.blocks.append(TransformerBlock(hidden_size, num_heads,
+                                                    filter_size))
+        self.ln_f = LayerNormalization(hidden_size)
+
+    def _init_params(self, rng):
+        k = jax.random.split(rng, 2 + len(self.blocks))
+        p = {"embed": 0.02 * jax.random.normal(
+                k[0], (self.vocab_size, self.hidden_size)),
+             "ln_f": self.ln_f._init_params(k[1])}
+        for i, blk in enumerate(self.blocks):
+            p[f"block{i}"] = blk._init_params(k[2 + i])
+        return p
+
+    def _init_state(self):
+        return {"aux_loss": jnp.zeros(())}
+
+    def _apply(self, params, state, x, training, rng):
+        ids = x
+        h = embed_ids(params["embed"], ids, self.hidden_size)
+        mask = causal_mask(ids.shape[1])
+        aux = jnp.zeros((), h.dtype)
+        for i, blk in enumerate(self.blocks):
+            r = jax.random.fold_in(rng, i) if rng is not None else None
+            if i in self.moe_idx:
+                h, a = blk.apply_with_aux(params[f"block{i}"], h, mask,
+                                          training, r)
+                aux = aux + a
+            else:
+                h = blk._apply(params[f"block{i}"], {}, Table(h, mask),
+                               training, r)
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h, training, None)
+        logits = h @ params["embed"].T  # tied output projection
+        return logits, {"aux_loss": aux}
+
+
+class _MoEBlock(TransformerBlock):
+    """TransformerBlock whose FFN slot holds a MixtureOfExperts — the
+    attention sublayer, param layout and rng handling are inherited, so
+    the two block types cannot drift."""
+
+    def __init__(self, hidden_size: int, num_heads: int, filter_size: int,
+                 n_experts: int, capacity_factor: float, name=None):
+        super().__init__(hidden_size, num_heads, filter_size, name=name)
+        self.ffn = MixtureOfExperts(hidden_size, n_experts,
+                                    ffn_hidden=filter_size,
+                                    capacity_factor=capacity_factor)
+
+    def apply_with_aux(self, params, h, mask, training, rng):
+        h = self._attn_sublayer(params, h, mask, training, rng)
+        n, _ = self.ln2.apply(params["ln2"], {}, h, training, None)
+        f, st = self.ffn.apply(params["ffn"], self.ffn._init_state(), n,
+                               training, None)
+        return h + f, st["aux_loss"]
+
+    def _apply(self, params, state, x, training, rng):
+        h, mask = (x[1], x[2]) if isinstance(x, Table) else (x, None)
+        out, _ = self.apply_with_aux(params, h, mask, training, rng)
+        return out

@@ -158,6 +158,14 @@ def position_encoding(length, hidden_size, dtype=jnp.float32):
     return jnp.asarray(pe, dtype)
 
 
+def embed_ids(embed, ids, hidden_size):
+    """Token embedding + sqrt(d) scale + sinusoidal positions (the LM
+    input head shared by Transformer and MoETransformerLM)."""
+    h = jnp.take(embed, ids.astype(jnp.int32), axis=0)
+    h = h * math.sqrt(hidden_size)
+    return h + position_encoding(ids.shape[1], hidden_size)
+
+
 class TransformerBlock(Module):
     """Pre-LN transformer block: self-attn (+ optional cross-attn) + FFN."""
 
@@ -185,6 +193,14 @@ class TransformerBlock(Module):
             p["ln3"] = self.ln3._init_params(k[5])
         return p
 
+    def _attn_sublayer(self, params, h, mask, training, rng):
+        """ln1 → self-attention → residual (shared with MoE blocks)."""
+        r1 = jax.random.fold_in(rng, 1) if rng is not None else None
+        n, _ = self.ln1.apply(params["ln1"], {}, h, training, None)
+        a, _ = self.attn.apply(params["attn"], {}, Table(n, n, mask),
+                               training, r1)
+        return h + a
+
     def _apply(self, params, state, x, training, rng):
         if isinstance(x, Table):
             h, mask = x[1], x[2]
@@ -194,10 +210,7 @@ class TransformerBlock(Module):
             h, mask, enc, enc_mask = x, None, None, None
         r1 = jax.random.fold_in(rng, 1) if rng is not None else None
         r2 = jax.random.fold_in(rng, 2) if rng is not None else None
-        n, _ = self.ln1.apply(params["ln1"], {}, h, training, None)
-        a, _ = self.attn.apply(params["attn"], {}, Table(n, n, mask),
-                               training, r1)
-        h = h + a
+        h = self._attn_sublayer(params, h, mask, training, rng)
         if self.with_cross and enc is not None:
             n, _ = self.ln3.apply(params["ln3"], {}, h, training, None)
             c, _ = self.cross.apply(params["cross"], {},
@@ -247,9 +260,7 @@ class Transformer(Module):
         return p
 
     def _embed(self, params, ids):
-        h = jnp.take(params["embed"], ids.astype(jnp.int32), axis=0)
-        h = h * math.sqrt(self.hidden_size)
-        return h + position_encoding(ids.shape[1], self.hidden_size)
+        return embed_ids(params["embed"], ids, self.hidden_size)
 
     def _stack(self, blocks, prefix, params, h, mask, training, rng,
                enc=None, enc_mask=None):
