@@ -29,11 +29,36 @@ from ..visualization.event_writer import _masked_crc
 # ---------------------------------------------------------------------------
 
 
-def read_tfrecords(path: str, verify_crc: bool = True) -> Iterator[bytes]:
+def read_tfrecords(path: str, verify_crc: bool = True,
+                   use_native: bool = True) -> Iterator[bytes]:
     """Yield raw record payloads from a TFRecord file.
 
+    ``use_native`` routes through the C++ reader (native/prefetcher.cpp
+    tfr_* — one file read, table-driven crc32c) when the native library is
+    available; the pure-python loop below is the behavioral reference.
     Truncated files raise IOError regardless of ``verify_crc`` — a short
     payload must never be yielded as a valid record."""
+    # the native reader materialises the whole file; for big shards keep
+    # the O(one record) streaming python path
+    _NATIVE_MAX_BYTES = 256 << 20
+    if use_native:
+        try:
+            import os as _os
+            small = _os.path.getsize(path) <= _NATIVE_MAX_BYTES
+        except OSError:
+            small = True  # let the reader raise the typed error itself
+        recs = None
+        if small:
+            try:
+                from ..native import read_tfrecords_native
+                recs = read_tfrecords_native(path, verify_crc)
+            except (IOError, OSError):
+                raise
+            except Exception:
+                recs = None  # toolchain missing etc. — python fallback
+        if recs is not None:
+            yield from recs
+            return
     with open(path, "rb") as f:
         while True:
             head = f.read(12)

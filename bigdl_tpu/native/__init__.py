@@ -71,6 +71,17 @@ def load_library():
         lib.pf_destroy.argtypes = [ctypes.c_void_p]
         lib.pf_decode_failures.restype = ctypes.c_int64
         lib.pf_decode_failures.argtypes = [ctypes.c_void_p]
+        lib.tfr_open.restype = ctypes.c_void_p
+        lib.tfr_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tfr_count.restype = ctypes.c_int64
+        lib.tfr_count.argtypes = [ctypes.c_void_p]
+        lib.tfr_error.restype = ctypes.c_char_p
+        lib.tfr_error.argtypes = [ctypes.c_void_p]
+        lib.tfr_record_len.restype = ctypes.c_int64
+        lib.tfr_record_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.tfr_record_data.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.tfr_record_data.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.tfr_close.argtypes = [ctypes.c_void_p]
         lib.jd_available.restype = ctypes.c_int
         if lib.jd_available():
             u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -279,3 +290,31 @@ class JpegFolderPrefetcher(NativePrefetcher):
         self.queue_capacity = queue_capacity
         self._rng = np.random.RandomState(seed)
         self._epoch_open = False
+
+
+def read_tfrecords_native(path: str, verify_crc: bool = True):
+    """Read a whole TFRecord file via the C++ reader. Returns a list of
+    ``bytes``; raises IOError on corrupt/truncated files. None if the
+    native library is unavailable (caller falls back to the pure-python
+    reader in dataset/tfrecord.py)."""
+    lib = load_library()
+    if lib is None:
+        return None
+    # surface the same typed errors (FileNotFoundError/PermissionError with
+    # errno) the pure-python open() path raises
+    open(path, "rb").close()
+    h = lib.tfr_open(os.fsencode(path), 1 if verify_crc else 0)
+    if not h:
+        raise IOError(f"cannot open {path}")
+    try:
+        err = ctypes.string_at(lib.tfr_error(h)).decode()
+        if err:
+            raise IOError(f"{path}: {err}")
+        out = []
+        for i in range(lib.tfr_count(h)):
+            n = lib.tfr_record_len(h, i)
+            ptr = lib.tfr_record_data(h, i)
+            out.append(ctypes.string_at(ptr, n))
+        return out
+    finally:
+        lib.tfr_close(h)

@@ -466,3 +466,116 @@ int64_t pf_decode_failures(void* h) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// TFRecord reader: varint-free fixed framing
+//   uint64 length | masked_crc32c(length) | data | masked_crc32c(data)
+// (utils analog: the reference reads its records on the JVM; this is the
+// native fast path behind bigdl_tpu/dataset/tfrecord.py.)
+// ---------------------------------------------------------------------------
+
+namespace tfrec {
+
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? (0x82f63b78u ^ (c >> 1)) : (c >> 1);
+      t[i] = c;
+    }
+  }
+};
+
+inline uint32_t crc32c(const uint8_t* data, size_t n) {
+  static const Crc32cTable tab;
+  uint32_t c = 0xffffffffu;
+  for (size_t i = 0; i < n; ++i)
+    c = tab.t[(c ^ data[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+inline uint32_t masked(uint32_t crc) {
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+struct Reader {
+  std::vector<uint8_t> buf;           // whole file
+  std::vector<size_t> offs, lens;     // per-record views into buf
+  std::string error;
+};
+
+}  // namespace tfrec
+
+extern "C" {
+
+void* tfr_open(const char* path, int verify_crc) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* r = new tfrec::Reader();
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  r->buf.resize(size_t(sz));
+  size_t got = fread(r->buf.data(), 1, r->buf.size(), f);
+  fclose(f);
+  if (got != r->buf.size()) { r->error = "short read"; return r; }
+  size_t pos = 0, n = r->buf.size();
+  const uint8_t* b = r->buf.data();
+  while (pos < n) {
+    if (pos + 12 > n) { r->error = "truncated header"; break; }
+    uint64_t len;
+    memcpy(&len, b + pos, 8);
+    uint32_t len_crc;
+    memcpy(&len_crc, b + pos + 8, 4);
+    if (verify_crc && tfrec::masked(tfrec::crc32c(b + pos, 8)) != len_crc) {
+      r->error = "corrupt length crc";
+      break;
+    }
+    // overflow-safe: a huge corrupt length must read as truncation, not
+    // wrap uint64 and pass the bound check (OOB read)
+    size_t remaining = n - pos - 12;
+    if (len > remaining || remaining - size_t(len) < 4) {
+      r->error = "truncated record";
+      break;
+    }
+    if (verify_crc) {
+      uint32_t data_crc;
+      memcpy(&data_crc, b + pos + 12 + len, 4);
+      if (tfrec::masked(tfrec::crc32c(b + pos + 12, size_t(len))) !=
+          data_crc) {
+        r->error = "corrupt data crc";
+        break;
+      }
+    }
+    r->offs.push_back(pos + 12);
+    r->lens.push_back(size_t(len));
+    pos += 12 + len + 4;
+  }
+  return r;
+}
+
+int64_t tfr_count(void* h) {
+  return int64_t(static_cast<tfrec::Reader*>(h)->offs.size());
+}
+
+const char* tfr_error(void* h) {
+  return static_cast<tfrec::Reader*>(h)->error.c_str();
+}
+
+int64_t tfr_record_len(void* h, int64_t i) {
+  auto* r = static_cast<tfrec::Reader*>(h);
+  if (i < 0 || size_t(i) >= r->lens.size()) return -1;
+  return int64_t(r->lens[size_t(i)]);
+}
+
+const uint8_t* tfr_record_data(void* h, int64_t i) {
+  auto* r = static_cast<tfrec::Reader*>(h);
+  if (i < 0 || size_t(i) >= r->offs.size()) return nullptr;
+  return r->buf.data() + r->offs[size_t(i)];
+}
+
+void tfr_close(void* h) { delete static_cast<tfrec::Reader*>(h); }
+
+}  // extern "C"

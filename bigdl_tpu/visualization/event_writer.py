@@ -39,8 +39,14 @@ def crc32c(data: bytes) -> int:
 
 
 def _masked_crc(data: bytes) -> int:
+    # TFRecord/event-file masking: rotate right 15 THEN add kMaskDelta
+    # (0xa282ead8). Omitting the delta produces files that are
+    # self-consistent but rejected by real TensorFlow/TensorBoard
+    # ("corrupted record at 0") — caught by cross-checking against
+    # tf.data.TFRecordDataset in tests/test_native.py.
     crc = crc32c(data)
-    return ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+    rot = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+    return (rot + 0xA282EAD8) & 0xFFFFFFFF
 
 
 # ---------------------------------------------------------------------------

@@ -190,3 +190,49 @@ def test_catalog_roundtrip(case_idx, tmp_path):
     if isinstance(m, nn.LookupTable):
         x = np.abs(x) * 3 + 1
     _roundtrip(m, x, tmp_path)
+
+
+def test_random_composition_roundtrip(tmp_path):
+    """Fuzz: random Sequential compositions of common layers must survive
+    save/load with identical outputs (seeded, deterministic)."""
+    rng = np.random.RandomState(1234)
+
+    def rand_model(seed):
+        r = np.random.RandomState(seed)
+        dim = int(r.randint(3, 9))
+        layers = [nn.Linear(6, dim)]
+        cur = dim
+        for _ in range(int(r.randint(2, 6))):
+            choice = r.randint(0, 8)
+            if choice == 0:
+                nxt = int(r.randint(3, 9))
+                layers.append(nn.Linear(cur, nxt))
+                cur = nxt
+            elif choice == 1:
+                layers.append(nn.ReLU())
+            elif choice == 2:
+                layers.append(nn.Tanh())
+            elif choice == 3:
+                layers.append(nn.BatchNormalization(cur))
+            elif choice == 4:
+                layers.append(nn.AddConstant(float(r.randn())))
+            elif choice == 5:
+                layers.append(nn.L1Penalty(0.1))
+            elif choice == 6:
+                layers.append(nn.LayerNormalization(cur))
+            else:
+                layers.append(nn.Highway(cur))
+        return nn.Sequential(*layers)
+
+    for i in range(8):
+        m = rand_model(int(rng.randint(0, 10_000)))
+        m.ensure_initialized()
+        m.evaluate()
+        x = np.random.RandomState(i).randn(4, 6).astype(np.float32)
+        ref = np.asarray(m.forward(x))
+        path = str(tmp_path / f"fuzz{i}.bigdl")
+        m.save(path)
+        m2 = nn.Module.load(path).evaluate()
+        out = np.asarray(m2.forward(x))
+        np.testing.assert_allclose(out, ref, atol=1e-5,
+                                   err_msg=f"model {i}: {m}")

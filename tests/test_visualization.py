@@ -57,3 +57,24 @@ def test_summary_read_scalar(tmp_path):
     import os
     assert os.path.isdir(os.path.join(str(tmp_path), "app", "train"))
     assert os.path.isdir(os.path.join(str(tmp_path), "app", "validation"))
+
+
+def test_event_file_readable_by_real_tensorflow(tmp_path):
+    """Our TB event files parse with TensorFlow's own summary_iterator
+    (crc framing + Event proto wire compat)."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    from bigdl_tpu.visualization import TrainSummary
+
+    s = TrainSummary(str(tmp_path), "run1")
+    s.add_scalar("Loss", 1.5, 1)
+    s.add_scalar("Loss", 0.5, 2)
+    s.close()
+    import glob
+    f = glob.glob(str(tmp_path) + "/run1/**/events*", recursive=True)[0]
+    vals = []
+    for ev in tf.compat.v1.train.summary_iterator(f):
+        for v in ev.summary.value:
+            if v.tag == "Loss":
+                vals.append((ev.step, v.simple_value))
+    assert (1, 1.5) in vals and (2, 0.5) in vals
