@@ -870,3 +870,30 @@ def test_caffe_innerproduct_spatial_input_roundtrip():
     loaded = load_caffe(proto, cm).evaluate()
     out = np.asarray(loaded.forward(x))
     np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_exported_graphdef_executes_in_real_tensorflow():
+    """save_tf_graph output must not just round-trip through OUR loader —
+    real TensorFlow must import AND execute it with identical outputs."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.loaders import save_tf_graph
+
+    m = LeNet5(10)
+    m.ensure_initialized()
+    m.evaluate()
+    x = np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32)
+    ref = np.asarray(m.forward(x))
+    gd_bytes = save_tf_graph(m, (1, 28, 28))
+
+    gd = tf.compat.v1.GraphDef()
+    gd.ParseFromString(gd_bytes)
+    with tf.Graph().as_default() as g:
+        tf.import_graph_def(gd, name="")
+        inp = g.get_tensor_by_name("input:0")
+        out = g.get_tensor_by_name(gd.node[-1].name + ":0")
+        with tf.compat.v1.Session(graph=g) as sess:
+            tf_out = sess.run(out, {inp: x.transpose(0, 2, 3, 1)})
+    np.testing.assert_allclose(np.asarray(tf_out).reshape(ref.shape), ref,
+                               atol=1e-5)
