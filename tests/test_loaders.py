@@ -897,3 +897,31 @@ def test_exported_graphdef_executes_in_real_tensorflow():
             tf_out = sess.run(out, {inp: x.transpose(0, 2, 3, 1)})
     np.testing.assert_allclose(np.asarray(tf_out).reshape(ref.shape), ref,
                                atol=1e-5)
+
+
+def test_load_graph_written_by_real_tensorflow():
+    """The TF GraphDef loader must execute graphs REAL TensorFlow builds,
+    not just our own exporter's output."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    from bigdl_tpu.loaders import load_tf_graph
+
+    tf1 = tf.compat.v1
+    g = tf.Graph()
+    with g.as_default():
+        rng = np.random.RandomState(0)
+        x = tf1.placeholder(tf.float32, [None, 8, 8, 3], name="input")
+        w = tf.constant(rng.randn(3, 3, 3, 4).astype(np.float32))
+        y = tf.nn.conv2d(x, w, strides=[1, 1, 1, 1], padding="SAME")
+        y = tf.nn.bias_add(y, tf.constant(rng.randn(4).astype(np.float32)))
+        y = tf.nn.relu(y)
+        y = tf1.reshape(y, [-1, 8 * 8 * 4])
+        wd = tf.constant(rng.randn(8 * 8 * 4, 5).astype(np.float32))
+        y = tf.nn.softmax(tf1.matmul(y, wd), name="probs")
+    xin = np.random.RandomState(1).randn(2, 8, 8, 3).astype(np.float32)
+    with tf1.Session(graph=g) as sess:
+        ref = sess.run("probs:0", {"input:0": xin})
+
+    m = load_tf_graph(g.as_graph_def().SerializeToString()).evaluate()
+    ours = np.asarray(m.forward(xin.transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(ours.reshape(ref.shape), ref, atol=1e-5)
