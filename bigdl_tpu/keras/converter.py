@@ -425,8 +425,102 @@ _FACTORIES = {
 }
 
 
+_MODERN_CLASS = {
+    "Conv1D": "Convolution1D", "Conv2D": "Convolution2D",
+    "Conv3D": "Convolution3D", "Conv2DTranspose": "Deconvolution2D",
+    "SeparableConv2D": "SeparableConvolution2D",
+}
+
+
+def _as_list(v):
+    return [v] if isinstance(v, (int, float)) else list(v)
+
+
+def _modernize(class_name: str, cfg: Dict):
+    """Accept keras 2.x/3.x (tf.keras / ``model.to_json()`` today) config
+    keys alongside the keras-1.2 names the reference converter targets —
+    translate the modern spelling into the 1.2 one this module dispatches
+    on. Weight layouts are NOT translated (load_weights_hdf5 stays 1.2).
+    Translation is COMPLETE for what it claims: anything it cannot express
+    in 1.2 terms surfaces through the existing guards (e.g. channels_last
+    conv/pool stacks hit _check_th) rather than converting silently wrong.
+    """
+    cfg = dict(cfg)
+    ren = {"units": "output_dim", "use_bias": "bias", "rate": "p",
+           "batch_shape": "batch_input_shape",
+           "recurrent_activation": "inner_activation",
+           "negative_slope": "alpha"}
+    for new, old in ren.items():
+        if new in cfg and old not in cfg:
+            cfg[old] = cfg.pop(new)
+    # data_format appears on conv/pool/global-pool/upsampling/locally
+    # classes in keras 2/3 — translate for ALL of them so the tf-ordering
+    # guard actually fires instead of being bypassed
+    if cfg.get("data_format") == "channels_last":
+        cfg.setdefault("dim_ordering", "tf")
+    elif cfg.get("data_format") == "channels_first":
+        cfg.setdefault("dim_ordering", "th")
+    if isinstance(cfg.get("axis"), (list, tuple)):  # tf.keras 2.x BN axis
+        cfg["axis"] = int(cfg["axis"][0])
+    if class_name in _MODERN_CLASS:
+        dil = cfg.get("dilation_rate", 1)
+        dil = _as_list(dil)
+        if any(int(d) != 1 for d in dil):
+            # keras-1.2 spells dilation as a separate Atrous class
+            if class_name == "Conv1D":
+                class_name, cfg["atrous_rate"] = "AtrousConvolution1D",                     int(dil[0])
+            elif class_name == "Conv2D":
+                class_name = "AtrousConvolution2D"
+                cfg["atrous_rate"] = [int(d) for d in (dil * 2)[:2]]
+            else:
+                raise NotImplementedError(
+                    f"keras converter: dilated {class_name} has no "
+                    "keras-1.2 equivalent")
+        if "filters" in cfg:
+            cfg.setdefault("nb_filter", int(cfg["filters"]))
+        ks = cfg.get("kernel_size")
+        if ks is not None:
+            ks = _as_list(ks)
+            if class_name in ("Conv1D", "AtrousConvolution1D"):
+                cfg.setdefault("filter_length", int(ks[0]))
+            elif class_name == "Conv3D" and len(ks) >= 3:
+                cfg.setdefault("kernel_dim1", int(ks[0]))
+                cfg.setdefault("kernel_dim2", int(ks[1]))
+                cfg.setdefault("kernel_dim3", int(ks[2]))
+            elif len(ks) >= 2:
+                cfg.setdefault("nb_row", int(ks[0]))
+                cfg.setdefault("nb_col", int(ks[1]))
+        if "strides" in cfg:
+            st = _as_list(cfg["strides"])
+            if class_name in ("Conv1D", "AtrousConvolution1D"):
+                cfg.setdefault("subsample_length", int(st[0]))
+            else:
+                cfg.setdefault("subsample", st)
+        if "padding" in cfg:
+            cfg.setdefault("border_mode", cfg["padding"])
+        class_name = _MODERN_CLASS.get(class_name, class_name)
+    if class_name in ("MaxPooling2D", "AveragePooling2D", "MaxPooling3D",
+                      "AveragePooling3D") and "padding" in cfg:
+        cfg.setdefault("border_mode", cfg["padding"])
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        if "pool_size" in cfg and "pool_length" not in cfg:
+            cfg["pool_length"] = int(_as_list(cfg["pool_size"])[0])
+        if "strides" in cfg and "stride" not in cfg:
+            st = cfg["strides"]
+            cfg["stride"] = None if st is None else int(_as_list(st)[0])
+        if "padding" in cfg:
+            cfg.setdefault("border_mode", cfg["padding"])
+    return class_name, cfg
+
+
 def layer_from_config(class_name: str, config: Dict):
-    """One Keras-1.2 layer config → a bigdl_tpu.keras layer (unbuilt)."""
+    """One Keras-1.2 layer config → a bigdl_tpu.keras layer (unbuilt);
+    modern (keras 2/3) config spellings accepted via _modernize."""
+    return _layer_from_modern(*_modernize(class_name, config))
+
+
+def _layer_from_modern(class_name: str, config: Dict):
+    """layer_from_config for an ALREADY-modernized (class_name, config)."""
     if class_name == "TimeDistributed":
         inner = config["layer"]
         return L.TimeDistributed(layer_from_config(inner["class_name"],
@@ -489,11 +583,11 @@ def _from_sequential(config) -> Tuple[Sequential, List[_Record]]:
     records = []
     pending_shape = None
     for i, spec in enumerate(layers):
-        cls, cfg = spec["class_name"], spec["config"]
+        cls, cfg = _modernize(spec["class_name"], spec["config"])
         if cls == "InputLayer":
             pending_shape = _input_shape_of(cfg, cls)
             continue
-        layer = layer_from_config(cls, cfg)
+        layer = _layer_from_modern(cls, cfg)
         if not model.layers:
             shape = pending_shape or _input_shape_of(cfg, cls)
             if shape is None:
@@ -506,11 +600,27 @@ def _from_sequential(config) -> Tuple[Sequential, List[_Record]]:
     return model, records
 
 
+def _parent_names(node) -> List[str]:
+    """Parent layer names from ONE inbound node, accepting both formats:
+    keras-1.2 ``[["layer", 0, 0], ...]`` and keras 2/3
+    ``{"args": [{"config": {"keras_history": ["layer", 0, 0]}}, ...]}``."""
+    if isinstance(node, dict):  # keras 2/3
+        out = []
+        args = node.get("args", [])
+        for a in (args[0] if args and isinstance(args[0], list) else args):
+            if isinstance(a, dict):
+                hist = a.get("config", {}).get("keras_history")
+                if hist:
+                    out.append(hist[0])
+        return out
+    return [ref[0] for ref in node]
+
+
 def _from_model(config) -> Tuple[Model, List[_Record]]:
     nodes: Dict[str, KerasNode] = {}
     records = []
     for spec in config["layers"]:
-        cls, cfg = spec["class_name"], spec["config"]
+        cls, cfg = _modernize(spec["class_name"], spec["config"])
         name = spec.get("name", cfg.get("name"))
         inbound = spec.get("inbound_nodes", [])
         if cls == "InputLayer":
@@ -521,8 +631,8 @@ def _from_model(config) -> Tuple[Model, List[_Record]]:
             raise NotImplementedError(
                 f"keras converter: layer {name} applied {len(inbound)} "
                 "times — shared layers are unsupported")
-        parents = [nodes[ref[0]] for ref in inbound[0]]
-        layer = layer_from_config(cls, cfg)
+        parents = [nodes[pn] for pn in _parent_names(inbound[0])]
+        layer = _layer_from_modern(cls, cfg)
         layer.name = name
         if isinstance(layer, L.Merge):
             nodes[name] = layer(parents)
@@ -533,8 +643,15 @@ def _from_model(config) -> Tuple[Model, List[_Record]]:
                     f"{len(parents)} inputs")
             nodes[name] = layer(parents[0])
         records.append(_Record(name, cls, cfg, layer))
-    ins = [nodes[ref[0]] for ref in config["input_layers"]]
-    outs = [nodes[ref[0]] for ref in config["output_layers"]]
+    def refs(entry):
+        # keras-1.2: [["name", 0, 0], ...]; keras 2/3 collapses a single
+        # ref to a flat ["name", 0, 0]
+        if entry and isinstance(entry[0], str):
+            return [entry[0]]
+        return [ref[0] for ref in entry]
+
+    ins = [nodes[n] for n in refs(config["input_layers"])]
+    outs = [nodes[n] for n in refs(config["output_layers"])]
     return Model(ins, outs), records
 
 
@@ -546,6 +663,8 @@ def model_from_json(json_def):
     """
     spec = json.loads(json_def) if isinstance(json_def, str) else json_def
     cls = spec["class_name"]
+    if cls == "Functional":  # keras 2/3 name for the graph Model
+        cls = "Model"
     if cls == "Sequential":
         model, records = _from_sequential(spec["config"])
     elif cls in ("Model", "Graph"):

@@ -415,3 +415,71 @@ def test_non_strict_load_skips_unsupported():
     assert np.allclose(np.asarray(root.params["0"]["weight"]), w.T,
                        atol=1e-6)
     assert np.allclose(np.asarray(root.params["0"]["bias"]), b, atol=1e-6)
+
+
+def test_model_from_json_accepts_modern_tf_keras():
+    """model_from_json ingests today's tf.keras ``model.to_json()``
+    (keras 2/3 config spellings: units/use_bias/rate/batch_shape,
+    Functional class name) — definitions only; weight HDF5 stays 1.2."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    from bigdl_tpu.keras.converter import model_from_json
+
+    m = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(8, activation="relu"),
+        keras.layers.Dropout(0.2),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    ours = model_from_json(m.to_json())
+    x = np.random.randn(3, 4).astype(np.float32)
+    out = np.asarray(ours._module().evaluate().forward(x))
+    assert out.shape == (3, 2)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+    # functional ("Functional" class name in keras 2/3)
+    inp = keras.layers.Input(shape=(6,))
+    h = keras.layers.Dense(5, activation="tanh")(inp)
+    out_l = keras.layers.Dense(3)(h)
+    fm = keras.Model(inp, out_l)
+    ours2 = model_from_json(fm.to_json())
+    y = np.asarray(ours2._module().evaluate().forward(
+        np.random.randn(2, 6).astype(np.float32)))
+    assert y.shape == (2, 3)
+
+
+def test_modern_keras_edge_configs():
+    """The modern-config translation is complete where it claims to be:
+    1D pool sizes honored, channels_last pooling rejected loudly, dilation
+    maps to the Atrous classes, LeakyReLU negative_slope honored."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    from bigdl_tpu.keras.converter import model_from_json
+
+    m = keras.Sequential([keras.layers.Input((12, 3)),
+                          keras.layers.MaxPooling1D(pool_size=4)])
+    ours = model_from_json(m.to_json())
+    out = ours._module().evaluate().forward(
+        np.random.randn(2, 12, 3).astype(np.float32))
+    assert out.shape == (2, 3, 3)
+
+    m2 = keras.Sequential([keras.layers.Input((6, 6, 3)),
+                           keras.layers.MaxPooling2D()])
+    with pytest.raises(NotImplementedError):
+        model_from_json(m2.to_json())  # channels_last must be loud
+
+    m3 = keras.Sequential([
+        keras.layers.Input((3, 8, 8)),
+        keras.layers.Conv2D(4, 3, dilation_rate=2,
+                            data_format="channels_first")])
+    out3 = model_from_json(m3.to_json())._module().evaluate().forward(
+        np.random.randn(2, 3, 8, 8).astype(np.float32))
+    assert out3.shape == (2, 4, 4, 4)
+
+    m4 = keras.Sequential([keras.layers.Input((4,)),
+                           keras.layers.LeakyReLU(negative_slope=0.01)])
+    y = model_from_json(m4.to_json())._module().evaluate().forward(
+        -np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(np.asarray(y), -0.01, rtol=1e-5)
