@@ -276,6 +276,31 @@ def _run_child(which: str, env, timeout: float):
     return None, f"rc={proc.returncode}: {tail}"
 
 
+_TUNNEL_STATE = {"probed": False, "alive": True}
+
+
+def _tunnel_alive(timeout: float = 75.0) -> bool:
+    """One cheap probe per bench run: can a child process actually init the
+    TPU backend? During a tunnel outage backend init HANGS (the axon
+    registration prepends 'axon' to jax_platforms regardless of env), so
+    without this the two long TPU attempts burn ~20 min before the cached
+    lines get served — and a driver-side timeout could kill us first."""
+    if _TUNNEL_STATE["probed"]:
+        return _TUNNEL_STATE["alive"]
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            env=os.environ.copy(), capture_output=True, text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        alive = proc.returncode == 0 and "ok" in proc.stdout
+    except subprocess.TimeoutExpired:
+        alive = False
+    _TUNNEL_STATE.update(probed=True, alive=alive)
+    return alive
+
+
 def _orchestrate(which: str):
     """Run a child config: TPU with timeout, retry, then cached-TPU result
     (a previous real measurement, flagged ``cached``), then CPU fallback."""
@@ -283,7 +308,11 @@ def _orchestrate(which: str):
         (os.environ.copy(), 800.0, "tpu attempt 1"),
         (os.environ.copy(), 420.0, "tpu attempt 2"),
     ]
-    errors = []
+    errors_pre = []
+    if not _tunnel_alive():
+        attempts = []  # tunnel dead: straight to cache / CPU fallback
+        errors_pre.append("tunnel probe: backend init hung/failed")
+    errors = list(errors_pre)
     degraded = None
     for i, (env, tmo, label) in enumerate(attempts):
         lines, err = _run_child(which, env, tmo)
