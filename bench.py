@@ -276,21 +276,26 @@ def _run_child(which: str, env, timeout: float):
     return None, f"rc={proc.returncode}: {tail}"
 
 
+# Lazily-probed tunnel state shared across the configs of one bench run.
+# "dead" is only concluded AFTER a real TPU attempt has already failed AND
+# a dedicated probe child (which must see an actual TPU/axon device) also
+# fails — then later attempts/configs skip straight to the cache ladder.
+# During a tunnel outage backend init HANGS in every child (the axon
+# registration prepends 'axon' to jax_platforms regardless of env), so
+# without this a --all run burns ~20 min per config before its cached
+# lines get served — and a driver-side timeout could kill the run first.
 _TUNNEL_STATE = {"probed": False, "alive": True}
 
 
-def _tunnel_alive(timeout: float = 75.0) -> bool:
-    """One cheap probe per bench run: can a child process actually init the
-    TPU backend? During a tunnel outage backend init HANGS (the axon
-    registration prepends 'axon' to jax_platforms regardless of env), so
-    without this the two long TPU attempts burn ~20 min before the cached
-    lines get served — and a driver-side timeout could kill us first."""
+def _tunnel_alive(timeout: float = 90.0) -> bool:
     if _TUNNEL_STATE["probed"]:
         return _TUNNEL_STATE["alive"]
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
+             "import jax; ds = jax.devices(); "
+             "assert any(d.platform in ('tpu', 'axon') for d in ds), ds; "
+             "print('ok')"],
             env=os.environ.copy(), capture_output=True, text=True,
             timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -308,11 +313,10 @@ def _orchestrate(which: str):
         (os.environ.copy(), 800.0, "tpu attempt 1"),
         (os.environ.copy(), 420.0, "tpu attempt 2"),
     ]
-    errors_pre = []
-    if not _tunnel_alive():
-        attempts = []  # tunnel dead: straight to cache / CPU fallback
-        errors_pre.append("tunnel probe: backend init hung/failed")
-    errors = list(errors_pre)
+    errors = []
+    if _TUNNEL_STATE["probed"] and not _TUNNEL_STATE["alive"]:
+        attempts = []  # a previous config already proved the tunnel dead
+        errors.append("tunnel probe: backend init hung/failed")
     degraded = None
     for i, (env, tmo, label) in enumerate(attempts):
         lines, err = _run_child(which, env, tmo)
@@ -327,6 +331,13 @@ def _orchestrate(which: str):
             break  # a second TPU attempt would degrade identically
         errors.append(f"{label}: {err}")
         if i + 1 < len(attempts):
+            # the attempt failed on its own 800s budget: one probe child
+            # decides whether a retry can possibly succeed (healthy runs
+            # never pay for the probe)
+            if not _tunnel_alive():
+                errors.append("tunnel probe: backend init hung/failed — "
+                              "skipping retry")
+                break
             time.sleep(10)
     cached = _cached_tpu_lines(which)
     if cached:
