@@ -1,0 +1,39 @@
+"""Opt-in smoke runs of every example script (each is self-asserting).
+
+    BIGDL_TPU_EXAMPLES=1 python -m pytest tests/test_examples.py -q
+
+Off by default: the examples run real (small) training loops and add
+minutes; CI-style suites exercise the same code paths through the unit
+tests. Each example must exit 0 — they all end in hard asserts.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = sorted(
+    f for f in os.listdir(os.path.join(_REPO, "examples"))
+    if f.endswith(".py"))
+
+
+@pytest.mark.skipif(os.environ.get("BIGDL_TPU_EXAMPLES") != "1",
+                    reason="example smoke runs are opt-in "
+                           "(BIGDL_TPU_EXAMPLES=1)")
+@pytest.mark.parametrize("script", _EXAMPLES)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # examples must not need the chip
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if script in ("long_context_ring.py", "transformer_lm_distributed.py",
+                  "wide_deep_sparse.py"):
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run([sys.executable,
+                           os.path.join(_REPO, "examples", script)],
+                          env=env, capture_output=True, text=True,
+                          timeout=1200, cwd=_REPO)
+    assert proc.returncode == 0, \
+        f"{script} failed:\n{proc.stderr[-3000:]}"
