@@ -37,7 +37,17 @@ def read_tfrecords(path: str, verify_crc: bool = True,
     tfr_* — one file read, table-driven crc32c) when the native library is
     available; the pure-python loop below is the behavioral reference.
     Truncated files raise IOError regardless of ``verify_crc`` — a short
-    payload must never be yielded as a valid record."""
+    payload must never be yielded as a valid record.
+
+    Error-surfacing contract for corrupt files: the native reader validates
+    the WHOLE file before yielding anything (IOError raised eagerly, zero
+    records seen), while the streaming python path yields the valid leading
+    records and raises at the corruption point. Incremental consumers that
+    need the eager behavior should not pass ``use_native=False``; consumers
+    that need the lazy prefix should. Files written by pre-round-2 builds
+    of this repo used an unmasked rotate-only CRC (missing TFRecord's
+    kMaskDelta) — those are detected and reported as such rather than as
+    generic corruption."""
     # the native reader materialises the whole file; for big shards keep
     # the O(one record) streaming python path
     _NATIVE_MAX_BYTES = 256 << 20
@@ -52,7 +62,12 @@ def read_tfrecords(path: str, verify_crc: bool = True,
             try:
                 from ..native import read_tfrecords_native
                 recs = read_tfrecords_native(path, verify_crc)
-            except (IOError, OSError):
+            except (IOError, OSError) as e:
+                # upgrade the native reader's generic corruption error when
+                # the file is actually legacy-framed (pre-round-2 builds)
+                legacy = _first_record_is_legacy(path)
+                if legacy:
+                    raise IOError(legacy) from e
                 raise
             except Exception:
                 recs = None  # toolchain missing etc. — python fallback
@@ -69,15 +84,46 @@ def read_tfrecords(path: str, verify_crc: bool = True,
             (length,), (len_crc,) = struct.unpack("<Q", head[:8]), \
                 struct.unpack("<I", head[8:])
             if verify_crc and _masked_crc(head[:8]) != len_crc:
-                raise IOError(f"{path}: corrupt length crc")
+                raise IOError(_crc_error(path, "length", head[:8], len_crc))
             data = f.read(length)
             crc_bytes = f.read(4)
             if len(data) < length or len(crc_bytes) < 4:
                 raise IOError(f"{path}: truncated record payload")
             (data_crc,) = struct.unpack("<I", crc_bytes)
             if verify_crc and _masked_crc(data) != data_crc:
-                raise IOError(f"{path}: corrupt record crc")
+                raise IOError(_crc_error(path, "record", data, data_crc))
             yield data
+
+
+def _first_record_is_legacy(path: str):
+    """If the file's first length-crc matches the legacy rotate-only scheme,
+    return the actionable message (else None). Used to upgrade the native
+    reader's generic corruption IOError."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(12)
+    except OSError:
+        return None
+    if len(head) < 12:
+        return None
+    (found,) = struct.unpack("<I", head[8:])
+    msg = _crc_error(path, "length", head[:8], found)
+    return msg if "legacy" in msg else None
+
+
+def _crc_error(path: str, what: str, payload: bytes, found_crc: int) -> str:
+    """Distinguish real corruption from the legacy pre-round-2 framing
+    (rotate-only CRC, missing TFRecord's kMaskDelta) so old files get an
+    actionable message instead of a generic corruption error."""
+    from ..visualization.event_writer import crc32c
+    crc = crc32c(payload)
+    legacy = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF  # rot15, no delta
+    if legacy == found_crc:
+        return (f"{path}: {what} crc uses the legacy unmasked scheme of "
+                f"pre-round-2 bigdl_tpu builds — rewrite the file with the "
+                f"current version (write_tfrecords), or read with "
+                f"verify_crc=False")
+    return f"{path}: corrupt {what} crc"
 
 
 def write_tfrecords(path: str, records) -> None:

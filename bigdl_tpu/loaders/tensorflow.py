@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from .. import nn as N
 from .wire import iter_fields, read_varint, to_signed, unpack_packed
@@ -206,7 +207,7 @@ def _out_index(inp: str) -> int:
 
 
 # ops whose module output is a Table of tensors; consumers select by index
-_MULTI_OUT = {"Split", "SplitV", "Unpack", "Unstack"}
+_MULTI_OUT = {"Split", "SplitV", "Unpack", "Unstack", "TopKV2", "TopK"}
 
 # real frozen graphs compute shape/axis tensors from Consts (Range over a
 # Shape slice, packed dims, ...). Fold those sub-DAGs to Consts up front so
@@ -724,7 +725,99 @@ def _convert_op_extended(node, op, attrs, cns, by_name, consts):
         cls = {"Sum": OPS2.Sum, "Prod": OPS2.Prod, "Max": OPS2.Max,
                "Min": OPS2.Min, "All": OPS2.All, "Any": OPS2.Any}[op]
         return cls(axis=axes, keep_dims=keep, name=name)
+    if op == "Conv2DBackpropInput":
+        # tf.nn.conv2d_transpose (deconv) — reference analog:
+        # utils/tf/loaders/Conv2DBackpropInput.scala:30 → SpatialFullConv.
+        # inputs: [output_sizes(const), filter(const HWIO, fwd-conv layout:
+        # I = deconv OUTPUT channels, O = deconv INPUT channels), activation]
+        out_sizes = [int(x) for x in cns[0].reshape(-1)]
+        w = cns[1]
+        sh, sw = _strides_hw(attrs)
+        return _TFDeconv(w, (sh, sw), attrs.get("padding", b"SAME"),
+                         out_sizes, name=name)
+    if op in ("TopKV2", "TopK"):
+        # k is the 2nd input (const) for V2, an attr for V1
+        k = int(cns[0].reshape(())) if cns else int(attrs.get("k", 1))
+        return OPS2.TopK(k, name=name)
+    if op == "RandomShuffle":
+        return _TFRandomShuffle(seed=int(attrs.get("seed", 0)), name=name)
     return None
+
+
+class _TFDeconv(N.Module):
+    """Conv2DBackpropInput as a transposed conv: ``lax.conv_transpose`` with
+    ``transpose_kernel=True`` IS the gradient-of-conv. The per-dimension
+    padding is computed from the graph's static ``output_sizes`` with TF's
+    own forward-conv padding formula (asymmetric SAME included), so ANY
+    output size TF accepts (``ceil(out/stride) == in`` for SAME,
+    ``ceil((out-k+1)/stride) == in`` for VALID — including non-divisible
+    sizes whose trailing pixels no forward window touches) reproduces
+    exactly; trailing untouched pixels get the zero gradient TF gives them.
+    Activations here are NCHW (this loader's layout); the TF filter stays
+    HWIO."""
+
+    def __init__(self, w_hwio, strides, padding, out_sizes, name=None):
+        super().__init__(name=name)
+        self._strides = tuple(int(s) for s in strides)
+        pad = padding.decode() if isinstance(padding, bytes) else str(padding)
+        assert pad in ("SAME", "VALID"), f"deconv padding {pad!r}"
+        self._same = pad == "SAME"
+        self._out_sizes = out_sizes  # NHWC [n, h, w, c] from the graph
+        self._init_w = np.asarray(w_hwio, np.float32)
+
+    def _init_params(self, rng):
+        return {"weight": jnp.asarray(self._init_w)}
+
+    def _apply(self, params, state, x, training, rng):
+        squeeze = False
+        if x.ndim == 3:
+            x, squeeze = x[None], True
+        kh, kw = self._init_w.shape[:2]
+        pads, tails = [], []
+        for o, i, k, s in zip(self._out_sizes[1:3], x.shape[2:4],
+                              (kh, kw), self._strides):
+            # TF forward-conv padding for input size o → output size i
+            total = max((i - 1) * s + k - o, 0) if self._same else 0
+            pl = total // 2
+            # conv_transpose's explicit padding applies to the DILATED input;
+            # grad-of-conv with forward padding p needs k-1-p there
+            pads.append((k - 1 - pl, k - 1 - (total - pl)))
+            # m = grad size the transposed conv yields; for any TF-valid
+            # (o, i) pair m <= o and the o-m tail pixels are untouched by
+            # every forward window → zero gradient
+            m = (i - 1) * s + k - total
+            assert m <= o, (f"deconv output_sizes {o} inconsistent with "
+                            f"input {i}, kernel {k}, stride {s}")
+            tails.append(o - m)
+        y = lax.conv_transpose(
+            x, params["weight"].astype(x.dtype), strides=self._strides,
+            padding=pads, dimension_numbers=("NCHW", "HWIO", "NCHW"),
+            transpose_kernel=True)
+        if any(tails):
+            y = jnp.pad(y, ((0, 0), (0, 0), (0, tails[0]), (0, tails[1])))
+        assert y.shape[1] == self._out_sizes[3], (
+            f"deconv channels {y.shape[1]} != output_sizes "
+            f"{self._out_sizes[3]}")
+        return y[0] if squeeze else y
+
+
+class _TFRandomShuffle(N.Module):
+    """RandomShuffle (utils/tf/loaders/RandomShuffle.scala): permute along
+    dim 0. Uses the apply-time rng when given (training pipelines); without
+    an rng (deterministic inference) it is the identity permutation, which
+    is a valid sample and keeps frozen-graph evaluation reproducible."""
+
+    def __init__(self, seed: int = 0, name=None):
+        super().__init__(name=name)
+        self._seed = seed
+
+    def _apply(self, params, state, x, training, rng):
+        if rng is None:
+            return x
+        import jax as _jax
+        if self._seed:  # TF seeded shuffle: same permutation per graph seed
+            rng = _jax.random.fold_in(_jax.random.PRNGKey(self._seed), 0)
+        return _jax.random.permutation(rng, x, axis=0)
 
 
 def _is_2d_activation(node, by_name, consts) -> bool:

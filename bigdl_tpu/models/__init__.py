@@ -7,7 +7,7 @@ from .inception import (Inception_v1, Inception_v1_NoAuxClassifier,
                         Inception_v2, Inception_v2_NoAuxClassifier)
 from .rnn import PTBModel, SimpleRNN
 from .autoencoder import Autoencoder
-from .transformer_lm import TransformerLM
+from .transformer_lm import TransformerLM, lm_loss_chunked
 from .moe_lm import MoETransformerLM
 from .recommender import NeuralCF, WideAndDeep
 from .textclassifier import TextClassifier

@@ -117,7 +117,15 @@ class Attention(Module):
             from ..parallel.ring_flash import ring_flash_attention
             o = ring_flash_attention(q, k, v, axis=self.seq_axis,
                                      causal=self.causal)
+        elif (self.causal and mask is None and self.use_flash
+              and not (training and self.attention_dropout > 0.0
+                       and rng is not None)):
+            # the fused O(T)-memory path: Pallas kernel on TPU backends,
+            # einsum+mask fallback elsewhere (parallel/flash dispatcher)
+            o = flash_attention(q, k, v, causal=True)
         else:
+            if self.causal and mask is None:
+                mask = causal_mask(q.shape[2])
             o = dot_product_attention(q, k, v, mask,
                                       self.attention_dropout, rng, training)
         b, h, t, d = o.shape
@@ -171,9 +179,11 @@ class TransformerBlock(Module):
 
     def __init__(self, hidden_size: int, num_heads: int, filter_size: int,
                  attn_dropout: float = 0.0, ffn_dropout: float = 0.0,
-                 with_cross: bool = False, name=None):
+                 with_cross: bool = False, causal: bool = False,
+                 use_flash: bool = True, name=None):
         super().__init__(name=name)
-        self.attn = Attention(hidden_size, num_heads, attn_dropout)
+        self.attn = Attention(hidden_size, num_heads, attn_dropout,
+                              use_flash=use_flash, causal=causal)
         self.ffn = FeedForwardNetwork(hidden_size, filter_size, ffn_dropout)
         self.ln1 = LayerNormalization(hidden_size)
         self.ln2 = LayerNormalization(hidden_size)
@@ -230,14 +240,27 @@ class Transformer(Module):
                  num_heads: int = 4, filter_size: int = 1024,
                  num_hidden_layers: int = 2, postprocess_dropout: float = 0.0,
                  attention_dropout: float = 0.0, relu_dropout: float = 0.0,
-                 mode: str = "lm", max_len: int = 2048, name=None):
+                 mode: str = "lm", max_len: int = 2048,
+                 use_flash: bool = True, remat: bool = False, name=None):
+        """``use_flash``: LM-mode self-attention goes through the fused
+        O(T)-memory flash path (Pallas on TPU) instead of materialising the
+        (B,H,T,T) score matrix. ``remat``: each block is wrapped in
+        ``jax.checkpoint`` so the backward pass recomputes block internals
+        instead of storing them — activation memory drops from
+        O(layers * intermediates) to O(layers * block_inputs)."""
         super().__init__(name=name)
         self.vocab_size, self.hidden_size = vocab_size, hidden_size
         self.mode, self.max_len = mode, max_len
         self.dropout_p = postprocess_dropout
+        self.remat = remat
+        # LM mode: causal masking is a block property (flash-friendly);
+        # translation mode keeps additive masks (padding masks cannot be
+        # expressed as the flash kernel's static causal pattern)
         self.blocks = [TransformerBlock(hidden_size, num_heads, filter_size,
                                         attention_dropout, relu_dropout,
-                                        with_cross=(mode == "translation"))
+                                        with_cross=(mode == "translation"),
+                                        causal=(mode == "lm"),
+                                        use_flash=use_flash)
                        for _ in range(num_hidden_layers)]
         if mode == "translation":
             self.enc_blocks = [TransformerBlock(hidden_size, num_heads,
@@ -266,9 +289,24 @@ class Transformer(Module):
                enc=None, enc_mask=None):
         for i, blk in enumerate(blocks):
             r = jax.random.fold_in(rng, i) if rng is not None else None
-            arg = Table(h, mask) if enc is None else Table(h, mask, enc,
-                                                           enc_mask)
-            h = blk._apply(params[f"{prefix}{i}"], {}, arg, training, r)
+            def run(p, h, enc=enc, blk=blk, r=r):
+                arg = Table(h, mask) if enc is None else Table(h, mask, enc,
+                                                               enc_mask)
+                return blk._apply(p, {}, arg, training, r)
+            if self.remat:
+                run = jax.checkpoint(run)
+            h = run(params[f"{prefix}{i}"], h)
+        return h
+
+    def hidden_states(self, params, x, training=False, rng=None):
+        """Final-LayerNorm hidden states (B, T, H) — the LM trunk without
+        the vocab projection, so callers can fuse projection+loss in
+        chunks (see models.transformer_lm.lm_loss_chunked) instead of
+        materialising (B, T, vocab) logits."""
+        assert self.mode == "lm", "hidden_states is the LM-mode trunk"
+        h = self._embed(params, x)
+        h = self._stack(self.blocks, "block", params, h, None, training, rng)
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h, training, None)
         return h
 
     def _apply(self, params, state, x, training, rng):
@@ -282,11 +320,8 @@ class Transformer(Module):
             mask = causal_mask(tgt.shape[1])
             h = self._stack(self.blocks, "block", params, h, mask, training,
                             rng, enc, src_mask)
-        else:
-            ids = x
-            h = self._embed(params, ids)
-            mask = causal_mask(ids.shape[1])
-            h = self._stack(self.blocks, "block", params, h, mask, training,
-                            rng)
-        h, _ = self.ln_f.apply(params["ln_f"], {}, h, training, None)
+            h, _ = self.ln_f.apply(params["ln_f"], {}, h, training, None)
+            return h @ params["embed"].T  # tied output projection
+        # LM mode: causal masking lives inside the blocks (flash path)
+        h = self.hidden_states(params, x, training, rng)
         return h @ params["embed"].T  # tied output projection

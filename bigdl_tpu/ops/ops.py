@@ -843,7 +843,8 @@ class Assert(Operation):
             # (XLA has no host asserts). Any OTHER error in evaluating the
             # predicate must surface, not silently disable the assertion.
             return data[0] if len(data) == 1 else Table(*data)
-        assert ok, "Assert op failed"
+        if not ok:  # a plain `assert` would be stripped under python -O
+            raise ValueError("Assert op failed")
         return data[0] if len(data) == 1 else Table(*data)
 
 

@@ -1,6 +1,7 @@
 """Dataset / transformer / vision / text pipeline tests (modeled on the
 reference's dataset + transform specs)."""
 import numpy as np
+import pytest
 
 from bigdl_tpu.dataset import (DataSet, Sample, MiniBatch, PaddingParam,
                                SampleToMiniBatch, mnist, cifar, text)
@@ -335,3 +336,27 @@ def test_vision_transform_longtail():
 
     out = RandomAlterAspect(size=5).transform_image(img, rng)
     assert out.shape[:2] == (5, 5)
+
+
+def test_tfrecord_legacy_crc_detected(tmp_path):
+    """Files written by pre-round-2 builds (rotate-only CRC, no kMaskDelta)
+    raise an actionable 'legacy' error, not generic corruption."""
+    import struct
+    from bigdl_tpu.visualization.event_writer import crc32c
+    from bigdl_tpu.dataset.tfrecord import read_tfrecords
+
+    def legacy_crc(data):
+        crc = crc32c(data)
+        return ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+
+    p = tmp_path / "legacy.tfrecord"
+    data = b"payload"
+    head = struct.pack("<Q", len(data))
+    with open(p, "wb") as f:
+        f.write(head + struct.pack("<I", legacy_crc(head)))
+        f.write(data + struct.pack("<I", legacy_crc(data)))
+    with pytest.raises(IOError, match="legacy"):
+        list(read_tfrecords(str(p), use_native=False))
+    # verify_crc=False reads it fine (the documented escape hatch)
+    assert list(read_tfrecords(str(p), verify_crc=False,
+                               use_native=False)) == [data]

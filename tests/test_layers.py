@@ -587,3 +587,22 @@ def test_layer_exception_context_notes():
     notes = getattr(ei.value, "__notes__", [])
     assert any("bad_fc" in n for n in notes), notes
     assert any("Sequential" in n for n in notes), notes
+
+
+def test_batchnorm_large_mean_stable():
+    """Shifted one-pass BN stats survive mean >> std (ADVICE r2: plain
+    E[x^2]-E[x]^2 catastrophically cancels for un-normalized inputs).
+    After one step the running mean becomes the shift, so the SECOND
+    step's variance must match the two-pass reference closely."""
+    import jax
+    from bigdl_tpu.nn import BatchNormalization
+    rng = np.random.RandomState(0)
+    x = (1e4 + rng.randn(64, 8).astype(np.float32))
+    bn = BatchNormalization(8, momentum=1.0)  # running stats = batch stats
+    params, state = bn.init(jax.random.PRNGKey(0))
+    _, state = bn.apply(params, state, jnp.asarray(x), training=True)
+    # second pass: shift == true mean, cancellation-free
+    _, state2 = bn.apply(params, state, jnp.asarray(x), training=True)
+    ref_var = x.var(axis=0, ddof=1)
+    got = np.asarray(state2["running_var"])
+    assert np.allclose(got, ref_var, rtol=1e-3), (got, ref_var)
