@@ -100,6 +100,10 @@ def load_library():
                 ctypes.POINTER(ctypes.c_char_p),
                 ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
                 ctypes.c_int, f32p, f32p]
+            lib.je_encode.restype = ctypes.c_int
+            lib.je_encode.argtypes = [u8p, ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_int, ctypes.c_int, u8p,
+                                      ctypes.c_long]
         _lib = lib
         return _lib
 
@@ -232,6 +236,32 @@ def decode_jpeg(data) -> np.ndarray:
     if got < 0:
         raise ValueError("JPEG decode failed")
     return out
+
+
+def encode_jpeg(img: np.ndarray, quality: int = 90) -> bytes:
+    """Native JPEG encode: (H, W, 3) RGB or (H, W)/(H, W, 1) gray uint8 →
+    JPEG bytes. The decode path's inverse — lets datasets/benchmarks create
+    real JPEG files with zero Python imaging dependencies."""
+    lib = load_library()
+    if lib is None or not lib.jd_available():
+        raise RuntimeError("native JPEG encode unavailable")
+    img = np.ascontiguousarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    if img.dtype != np.uint8 or img.ndim != 3 or img.shape[2] not in (1, 3):
+        raise ValueError(  # not assert: must survive python -O
+            f"want uint8 HWC with 1 or 3 channels, got {img.dtype} "
+            f"{img.shape}")
+    h, w, c = img.shape
+    cap = h * w * c + (1 << 16)
+    out = np.empty((cap,), np.uint8)
+    n = lib.je_encode(img.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                      w, h, c, int(quality),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                      cap)
+    if n < 0:
+        raise ValueError("JPEG encode failed")
+    return out[:n].tobytes()
 
 
 def decode_jpeg_resize_norm(data, height: int, width: int, mean,

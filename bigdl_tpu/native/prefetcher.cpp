@@ -443,6 +443,49 @@ int jd_decode_resize_chw(const uint8_t* buf, long len, int th, int tw,
   }
 }
 
+// Encode (h, w, c) uint8 pixels (c = 3 RGB or 1 gray) to JPEG in the
+// caller's buffer. Returns the byte count, or -1 (error / buffer too
+// small). Completes the decode path so fixtures/datasets can be produced
+// without any Python imaging dependency.
+int je_encode(const uint8_t* pix, int w, int h, int c, int quality,
+              uint8_t* out, long out_cap) {
+  if (c != 1 && c != 3) return -1;
+  jpeg_compress_struct cinfo;
+  JpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_err_exit;
+  // volatile: locals modified after setjmp are indeterminate in the
+  // longjmp path otherwise (the classic libjpeg cleanup bug)
+  unsigned char* volatile mem = nullptr;
+  unsigned long mem_len = 0;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    if (mem) free(mem);
+    return -1;
+  }
+  jpeg_create_compress(&cinfo);
+  jpeg_mem_dest(&cinfo, (unsigned char**)&mem, &mem_len);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = c;
+  cinfo.in_color_space = c == 3 ? JCS_RGB : JCS_GRAYSCALE;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  const long stride = long(w) * c;
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row = const_cast<uint8_t*>(pix + cinfo.next_scanline * stride);
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  long n = long(mem_len);
+  if (n > out_cap) { free(mem); return -1; }
+  std::memcpy(out, mem, n);
+  free(mem);
+  return int(n);
+}
+
 // JPEG-folder prefetcher: paths decoded+resized by worker threads.
 void* pf_create_jpeg(const char** paths, const int64_t* labels, int n,
                      int target_h, int target_w, const float* mean,

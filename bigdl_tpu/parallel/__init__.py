@@ -7,7 +7,7 @@ from .allreduce import AllReduceParameter, FP16CompressPolicy
 from .sharding import (replicated, data_sharding, shard_batch, shard_params,
                        tp_linear_rules)
 from .ring_attention import ring_attention
-from .failure import (probe_mesh, MeshProbeResult, Heartbeat,
+from .failure import (probe_mesh, MeshProbeResult, Heartbeat, HeartbeatLost,
                       StragglerMonitor)
 from .pipeline import gpipe, stack_stage_params, unstack_stage_params
 from .moe import moe_ffn, top1_routing

@@ -29,6 +29,28 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _run_with_timeout(fn, timeout_s: float) -> Dict:
+    """Run ``fn`` on a daemon watchdog thread. Returns {'value': ...} on
+    success, {'error': str} if fn raised, {'timeout': True} if it did not
+    finish — the shared machinery behind probe_mesh and Heartbeat (a hung
+    collective cannot be cancelled; the daemon thread is abandoned and the
+    caller escalates)."""
+    result: Dict = {}
+
+    def run():
+        try:
+            result["value"] = fn()
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            result["error"] = f"{type(e).__name__}: {e}"
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        return {"timeout": True}
+    return result
+
+
 class MeshProbeResult:
     def __init__(self, ok: bool, n_devices: int, latency_s: float,
                  error: Optional[str] = None):
@@ -58,39 +80,44 @@ def probe_mesh(mesh, timeout_s: float = 30.0) -> MeshProbeResult:
             return s
         probe = shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
                           check_vma=False)
-        return int(jax.jit(probe)(jnp.ones(())))
+        t0 = time.time()
+        val = int(jax.jit(probe)(jnp.ones(())))
+        return val, time.time() - t0
 
-    result: Dict = {}
-
-    def run():
-        try:
-            t0 = time.time()
-            val = ones_sum()
-            result["latency"] = time.time() - t0
-            result["val"] = val
-        except Exception as e:  # noqa: BLE001 — report, don't crash
-            result["error"] = f"{type(e).__name__}: {e}"
-
-    th = threading.Thread(target=run, daemon=True)
     t0 = time.time()
-    th.start()
-    th.join(timeout_s)
-    if th.is_alive():
+    result = _run_with_timeout(ones_sum, timeout_s)
+    if result.get("timeout"):
         return MeshProbeResult(False, n, time.time() - t0,
                                f"collective did not complete in {timeout_s}s")
     if "error" in result:
         return MeshProbeResult(False, n, time.time() - t0, result["error"])
-    ok = result["val"] == n
-    return MeshProbeResult(ok, n, result["latency"],
+    val, latency = result["value"]
+    ok = val == n
+    return MeshProbeResult(ok, n, latency,
                            None if ok else
-                           f"psum returned {result['val']}, expected {n}")
+                           f"psum returned {val}, expected {n}")
+
+
+class HeartbeatLost(RuntimeError):
+    """A heartbeat exchange did not complete: a peer process is dead or
+    unresponsive (the all-gather hung past the timeout, or the coordination
+    service surfaced the peer's failure as an error). The training loop
+    should halt cleanly — checkpoint and exit — rather than stall inside
+    the next collective."""
 
 
 class Heartbeat:
     """Multi-host liveness: each process contributes an incrementing counter
     via an all-gather across processes; a host whose counter stops advancing
     for ``stale_after`` beats is reported dead. Single-process runs are a
-    no-op (always healthy)."""
+    no-op (always healthy).
+
+    A DEAD peer does not advance a counter — it hangs the all-gather itself.
+    ``beat(timeout_s=...)`` therefore runs the exchange on a watchdog thread:
+    a hang past the timeout, or a coordination-service error, raises
+    :class:`HeartbeatLost` (detection), converting an indefinite stall into
+    a clean halt. The timed-out gather thread is a daemon — it cannot be
+    cancelled, which is fine because detection is followed by process exit."""
 
     def __init__(self, stale_after: int = 3):
         self.stale_after = stale_after
@@ -110,10 +137,29 @@ class Heartbeat:
             np.array(value, np.int64))
         return [int(v) for v in np.asarray(out).reshape(-1)]
 
-    def beat(self) -> List[int]:
-        """Advance the local counter, exchange, and return stale host ids."""
+    def _gather_with_timeout(self, value: int, timeout_s: float) -> List[int]:
+        result = _run_with_timeout(lambda: self._gather(value), timeout_s)
+        if result.get("timeout"):
+            raise HeartbeatLost(
+                f"heartbeat exchange did not complete in {timeout_s}s — "
+                f"a peer process is dead or unresponsive")
+        if "error" in result:
+            # peer death often surfaces as a coordination-service error
+            raise HeartbeatLost(
+                f"heartbeat exchange failed ({result['error']}) — "
+                f"a peer process died")
+        return result["value"]
+
+    def beat(self, timeout_s: Optional[float] = None) -> List[int]:
+        """Advance the local counter, exchange, and return stale host ids.
+
+        With ``timeout_s``, a hung or failed exchange raises
+        :class:`HeartbeatLost` instead of stalling forever."""
         self.beat_no += 1
-        counters = self._gather(self.beat_no)
+        if timeout_s is not None:
+            counters = self._gather_with_timeout(self.beat_no, timeout_s)
+        else:
+            counters = self._gather(self.beat_no)
         stale = []
         for pid, c in enumerate(counters):
             if c > self.counters.get(pid, -1):

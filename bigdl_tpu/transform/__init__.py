@@ -1,1 +1,2 @@
 from . import vision
+from .image_frame import ImageFrame, LocalImageFrame, MTImageFeatureToBatch

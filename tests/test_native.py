@@ -213,3 +213,18 @@ def test_tfrecord_interop_with_real_tensorflow(tmp_path):
     assert list(read_tfrecords(theirs)) == [b"alpha", b"beta"]
     assert list(read_tfrecords(theirs, use_native=False)) == \
         [b"alpha", b"beta"]
+
+
+def test_native_jpeg_encode_roundtrip():
+    """je_encode inverse of jd_decode (smooth image: JPEG-friendly)."""
+    import pytest
+    from bigdl_tpu import native
+    if not native.jpeg_available():
+        pytest.skip("no libjpeg")
+    h, w = 24, 30
+    yy, xx = np.mgrid[0:h, 0:w]
+    img = np.stack([yy * 255 // h, xx * 255 // w,
+                    (yy + xx) * 255 // (h + w)], axis=-1).astype(np.uint8)
+    back = native.decode_jpeg(native.encode_jpeg(img, quality=95))
+    assert back.shape == img.shape
+    assert np.abs(back.astype(int) - img.astype(int)).mean() < 3.0
