@@ -73,39 +73,29 @@ def _init_backend_with_retry():
     raise last
 
 
-def bench_resnet50():
+def _build_resnet_step(batch, size):
+    """Compile the ResNet-50 train step (fwd + CE loss + bwd + momentum
+    SGD, donated buffers). Returns (step, carry, lr, flops_per_step) —
+    shared by the synthetic headline and the real-data config."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
     from bigdl_tpu.models import ResNet
     from bigdl_tpu.nn import CrossEntropyCriterion
     from bigdl_tpu.optim import SGD
     from bigdl_tpu.utils import engine
 
-    backend = _init_backend_with_retry()
-    # the axon PJRT plugin registers the real chip under platform name
-    # "axon", not "tpu" — treat both as TPU-class
-    on_tpu = backend in ("tpu", "axon")
-    # env overrides make on-chip batch/step sweeps cheap (BENCH_*)
-    batch = int(os.environ.get("BENCH_BATCH", 256 if on_tpu else 4))
-    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
-    warmup = int(os.environ.get("BENCH_WARMUP", 3 if on_tpu else 1))
-    size = 224 if on_tpu else 64
-
     engine.set_seed(0)
     # NHWC: TPU-native conv layout (channels-last); f32 master params,
     # bf16 compute inside the step (MXU path), f32 SGD update.
-    model = ResNet(class_num=1000, depth=50, format="NHWC")
+    # BENCH_FUSED=1 swaps bottlenecks for the Pallas fused
+    # BN+ReLU+matmul+stats blocks (models/resnet.py FusedBottleneck) —
+    # the on-chip A/B lever for the conv-stack MFU push.
+    fused = "pallas" if os.environ.get("BENCH_FUSED") == "1" else "none"
+    model = ResNet(class_num=1000, depth=50, format="NHWC", fused=fused)
     params, mstate = model.init(jax.random.PRNGKey(0))
     crit = CrossEntropyCriterion()
     optim = SGD(learningrate=0.1, momentum=0.9)
     opt_state = optim.init_state(params)
-
-    rng = np.random.RandomState(0)
-    x_host = rng.randn(batch, size, size, 3).astype(np.float32)
-    y_host = rng.randint(1, 1001, size=(batch,)).astype(np.int32)
-    x = jnp.asarray(x_host, jnp.bfloat16)
-    y = jnp.asarray(y_host)
 
     def train_step(params, opt_state, mstate, x, y, lr):
         def loss_fn(p):
@@ -120,6 +110,8 @@ def bench_resnet50():
         new_params, new_opt = optim.update(grads, params, opt_state, lr)
         return loss, new_params, new_opt, new_mstate
 
+    x = jnp.zeros((batch, size, size, 3), jnp.bfloat16)
+    y = jnp.zeros((batch,), jnp.int32)
     lr = jnp.float32(0.1)
     # AOT-compile once and reuse the executable for the timed loop (a plain
     # jit call after .lower().compile() would trace+compile a second time).
@@ -137,15 +129,36 @@ def bench_resnet50():
     if not flops_per_step:
         # analytic fallback: 4.09 GMAC fwd/image * 2 flops/MAC * 3 (train)
         flops_per_step = 2 * 4.089e9 * 3 * batch * (size / 224.0) ** 2
+    return step, [params, opt_state, mstate], lr, flops_per_step
+
+
+def bench_resnet50():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    backend = _init_backend_with_retry()
+    # the axon PJRT plugin registers the real chip under platform name
+    # "axon", not "tpu" — treat both as TPU-class
+    on_tpu = backend in ("tpu", "axon")
+    # env overrides make on-chip batch/step sweeps cheap (BENCH_*)
+    batch = int(os.environ.get("BENCH_BATCH", 256 if on_tpu else 4))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
+    warmup = int(os.environ.get("BENCH_WARMUP", 3 if on_tpu else 1))
+    size = 224 if on_tpu else 64
+
+    step, carry, lr, flops_per_step = _build_resnet_step(batch, size)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, size, size, 3).astype(np.float32),
+                    jnp.bfloat16)
+    y = jnp.asarray(rng.randint(1, 1001, size=(batch,)).astype(np.int32))
 
     for _ in range(warmup):
-        loss, params, opt_state, mstate = step(params, opt_state, mstate,
-                                               x, y, lr)
+        loss, *carry = step(*carry, x, y, lr)
     float(loss)  # full sync (block_until_ready is unreliable over the tunnel)
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss, params, opt_state, mstate = step(params, opt_state, mstate,
-                                               x, y, lr)
+        loss, *carry = step(*carry, x, y, lr)
     final_loss = float(loss)  # forces the whole chained step sequence
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss)
@@ -159,6 +172,135 @@ def bench_resnet50():
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
         "mfu": round(mfu, 4),
+        "backend": backend,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+_JPEG_DIR = os.environ.get("BENCH_JPEG_DIR", "/tmp/bigdl_tpu_bench_jpegs")
+
+
+def _ensure_jpeg_folder(n_images: int, jpeg_size: int):
+    """Create (once) a folder of real JPEGs via the native libjpeg encoder:
+    smooth random blobs + noise so files have photo-like entropy, 1000
+    synthetic classes in the filename."""
+    import numpy as np
+    from bigdl_tpu.native import encode_jpeg
+
+    # per-config subfolder: different (count, size) configs must never
+    # validate against each other's files
+    cfg_dir = os.path.join(_JPEG_DIR, f"{n_images}x{jpeg_size}")
+    tag = os.path.join(cfg_dir, ".complete")
+    if os.path.exists(tag):
+        paths = sorted(
+            os.path.join(cfg_dir, f) for f in os.listdir(cfg_dir)
+            if f.endswith(".jpg"))
+        if len(paths) >= n_images:
+            labels = [int(os.path.basename(p).split("_")[0])
+                      for p in paths[:n_images]]
+            return paths[:n_images], labels
+    os.makedirs(cfg_dir, exist_ok=True)
+    rng = np.random.RandomState(0)
+    yy, xx = np.mgrid[0:jpeg_size, 0:jpeg_size].astype(np.float32)
+    paths, labels = [], []
+    for i in range(n_images):
+        label = int(rng.randint(1, 1001))
+        fx, fy, ph = rng.rand(3, 3) * 0.1, rng.rand(3, 3) * 0.1, \
+            rng.rand(3, 3) * 6.28
+        img = np.zeros((jpeg_size, jpeg_size, 3), np.float32)
+        for c in range(3):
+            for k in range(3):
+                img[:, :, c] += np.sin(fx[c, k] * xx + fy[c, k] * yy
+                                       + ph[c, k])
+        img = (img - img.min()) / (np.ptp(img) + 1e-6) * 235.0
+        img += rng.randn(jpeg_size, jpeg_size, 3) * 10.0
+        img = np.clip(img, 0, 255).astype(np.uint8)
+        p = os.path.join(cfg_dir, f"{label}_{i:05d}.jpg")
+        with open(p, "wb") as f:
+            f.write(encode_jpeg(img, quality=90))
+        paths.append(p)
+        labels.append(label)
+    with open(tag, "w") as f:
+        f.write("ok")
+    return paths, labels
+
+
+def bench_resnet50_realdata():
+    """ResNet-50 train fed by the C++ libjpeg prefetcher over a folder of
+    REAL JPEG files (decode + bilinear resize + normalize on host worker
+    threads), with double-buffered host→device transfer: the next batch is
+    fetched and device_put while the chip runs the current step (the
+    reference's executor-side ImageNet pipeline, TrainImageNet.scala).
+    Reports images/sec plus the fraction of wall time the host spent
+    blocked on the input pipeline (input_wait_frac ~0 ⇒ compute-bound)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bigdl_tpu.native import JpegFolderPrefetcher
+
+    backend = _init_backend_with_retry()
+    on_tpu = backend in ("tpu", "axon")
+    batch = int(os.environ.get("BENCH_BATCH", 256 if on_tpu else 4))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
+    warmup = int(os.environ.get("BENCH_WARMUP", 3 if on_tpu else 1))
+    size = 224 if on_tpu else 64
+    n_images = batch * 8 if on_tpu else batch * 4
+    jpeg_size = 256 if on_tpu else 96
+
+    paths, labels = _ensure_jpeg_folder(n_images, jpeg_size)
+    pf = JpegFolderPrefetcher(
+        paths, labels, size, size, mean=(124.0, 117.0, 104.0),
+        std=(59.0, 57.0, 57.0), batch_size=batch,
+        n_workers=int(os.environ.get("BENCH_JPEG_WORKERS", 8)),
+        queue_capacity=4)
+
+    step, carry, lr, flops_per_step = _build_resnet_step(batch, size)
+
+    def batches():
+        """Endless stream of device-resident (x, y); prefetcher epochs are
+        restarted transparently. NCHW→NHWC happens ON DEVICE (a cheap
+        layout op) so the host path is decode → bf16 cast → async put."""
+        while True:
+            for mb in pf.data(train=True):
+                xh = np.asarray(mb.input, np.float32)  # (B, C, H, W)
+                x = jnp.transpose(jnp.asarray(xh, jnp.bfloat16),
+                                  (0, 2, 3, 1))
+                y = jnp.asarray(np.asarray(mb.target), jnp.int32)
+                yield x, y
+
+    def pull(it, wait):
+        """next(it) is where the host blocks on the input pipeline."""
+        t0 = time.perf_counter()
+        out = next(it)
+        wait[0] += time.perf_counter() - t0
+        return out
+
+    wait = [0.0]
+    it = batches()
+    nxt = pull(it, wait)
+    for _ in range(warmup):
+        x, y = nxt
+        loss, *carry = step(*carry, x, y, lr)
+        nxt = pull(it, wait)
+    float(loss)
+    wait[0] = 0.0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        x, y = nxt
+        loss, *carry = step(*carry, x, y, lr)   # async dispatch
+        nxt = pull(it, wait)                    # overlaps the device step
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+    img_per_sec = batch * steps / dt
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    return {
+        "metric": "realdata_resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+        "mfu": round(flops_per_step * steps / dt / peak, 4),
+        "input_wait_frac": round(wait[0] / dt, 4),
         "backend": backend,
         "device": jax.devices()[0].device_kind,
     }

@@ -266,6 +266,13 @@ def bench_transformer_lm(on_tpu):
     return r
 
 
+def bench_realdata(on_tpu):
+    """ResNet-50 fed from real JPEG files via the C++ prefetcher — the
+    implementation lives next to the synthetic headline in bench.py."""
+    from bench import bench_resnet50_realdata
+    return bench_resnet50_realdata()
+
+
 # config key -> (bench fn name, metric prefix). The metric prefix is the
 # single source of truth bench.py uses for its per-config cache lookup.
 CONFIGS = {
@@ -274,6 +281,7 @@ CONFIGS = {
     "lstm": ("bench_lstm_ptb", "lstm_"),
     "inception_int8": ("bench_inception_int8", "inception_"),
     "transformer": ("bench_transformer_lm", "transformer_"),
+    "realdata": ("bench_realdata", "realdata_"),
 }
 
 
@@ -296,7 +304,7 @@ def bench_secondary():
     on_tpu = backend in ("tpu", "axon")
     results = []
     for fn in (bench_lenet, bench_vgg, bench_lstm_ptb, bench_inception_int8,
-               bench_transformer_lm):
+               bench_transformer_lm, bench_realdata):
         try:
             r = fn(on_tpu)
         except Exception as e:  # one broken config must not hide the rest

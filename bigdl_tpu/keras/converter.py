@@ -9,10 +9,14 @@ into the :mod:`bigdl_tpu.keras` API, and loads weights from Keras HDF5 files
 carries running stats in its weight list).
 
 Channels-first (``dim_ordering="th"``, the reference default) is supported
-end-to-end. ``"tf"``-ordered convolution stacks are rejected with a clear
-error rather than silently mis-converted (the flatten order after a conv
-differs between orderings, so weight-exact conversion needs a transposed
-pipeline the reference does not implement either).
+end-to-end. ``"tf"``-ordered (channels-last — including every modern
+tf.keras export) spatial stacks are converted through a transposed-weight
+pipeline: the model is BUILT channels-first (3-D input shapes transposed
+(H, W, C) → (C, H, W) — feed NCHW arrays), conv kernels are transposed at
+load ((kh, kw, in, out) → (out, in, kh, kw)), and a Dense following a
+Flatten gets its kernel rows permuted from the keras (h, w, c) flatten
+order to our (c, h, w) order — beyond the reference, whose converter
+assumes "th" (pyspark/bigdl/keras/converter.py).
 """
 from __future__ import annotations
 
@@ -40,12 +44,8 @@ def _act(cfg, key="activation"):
     return None if a in (None, "linear") else a
 
 
-def _check_th(cfg, cls):
-    if cfg.get("dim_ordering", "th") == "tf":
-        raise NotImplementedError(
-            f"keras converter: {cls} with dim_ordering='tf' — re-export the "
-            "model channels-first (th); weight-exact tf conversion is "
-            "unsupported (flatten order differs)")
+def _is_tf(cfg) -> bool:
+    return cfg.get("dim_ordering", "th") == "tf"
 
 
 def _pair(v, default):
@@ -92,7 +92,6 @@ def _l_conv1d(cfg):
 
 
 def _l_conv2d(cfg):
-    _check_th(cfg, "Convolution2D")
     return L.Convolution2D(int(cfg["nb_filter"]), int(cfg["nb_row"]),
                            int(cfg["nb_col"]), activation=_act(cfg),
                            border_mode=cfg.get("border_mode", "valid"),
@@ -101,7 +100,6 @@ def _l_conv2d(cfg):
 
 
 def _l_conv3d(cfg):
-    _check_th(cfg, "Convolution3D")
     return L.Convolution3D(int(cfg["nb_filter"]), int(cfg["kernel_dim1"]),
                            int(cfg["kernel_dim2"]), int(cfg["kernel_dim3"]),
                            activation=_act(cfg),
@@ -119,7 +117,6 @@ def _l_atrous1d(cfg):
 
 
 def _l_atrous2d(cfg):
-    _check_th(cfg, "AtrousConvolution2D")
     return L.AtrousConvolution2D(
         int(cfg["nb_filter"]), int(cfg["nb_row"]), int(cfg["nb_col"]),
         activation=_act(cfg), border_mode=cfg.get("border_mode", "valid"),
@@ -128,7 +125,6 @@ def _l_atrous2d(cfg):
 
 
 def _l_separable2d(cfg):
-    _check_th(cfg, "SeparableConvolution2D")
     return L.SeparableConvolution2D(
         int(cfg["nb_filter"]), int(cfg["nb_row"]), int(cfg["nb_col"]),
         activation=_act(cfg), border_mode=cfg.get("border_mode", "valid"),
@@ -138,7 +134,6 @@ def _l_separable2d(cfg):
 
 
 def _l_deconv2d(cfg):
-    _check_th(cfg, "Deconvolution2D")
     return L.Deconvolution2D(int(cfg["nb_filter"]), int(cfg["nb_row"]),
                              int(cfg["nb_col"]), activation=_act(cfg),
                              border_mode=cfg.get("border_mode", "valid"),
@@ -147,14 +142,12 @@ def _l_deconv2d(cfg):
 
 
 def _l_maxpool2d(cfg):
-    _check_th(cfg, "MaxPooling2D")
     return L.MaxPooling2D(pool_size=_pair(cfg.get("pool_size"), (2, 2)),
                           strides=_pair(cfg.get("strides"), None) or None,
                           border_mode=cfg.get("border_mode", "valid"))
 
 
 def _l_avgpool2d(cfg):
-    _check_th(cfg, "AveragePooling2D")
     return L.AveragePooling2D(pool_size=_pair(cfg.get("pool_size"), (2, 2)),
                               strides=_pair(cfg.get("strides"), None) or None,
                               border_mode=cfg.get("border_mode", "valid"))
@@ -214,7 +207,6 @@ def _l_upsample1d(cfg):
 
 
 def _l_upsample2d(cfg):
-    _check_th(cfg, "UpSampling2D")
     return L.UpSampling2D(size=_pair(cfg.get("size"), (2, 2)))
 
 
@@ -232,15 +224,24 @@ def _l_batchnorm(cfg):
     bn = L.BatchNormalization(epsilon=eps, momentum=momentum)
     orig_build = bn.build
 
+    tf_model = bool(cfg.get("_model_tf_ordered"))
+
     def build(s):
         if len(s) >= 3:
-            # spatial input: only channel-axis normalization converts;
-            # axis=-1 would normalize the last spatial axis in keras
-            if axis != 1:
+            # spatial input: only channel-axis normalization converts. In a
+            # th model that is axis=1; in a tf-ordered model keras axis
+            # -1/3 IS the channel axis (our models are built channels-first
+            # either way, so both land on our axis 1)
+            # keras channel axis for channels-last is the LAST axis:
+            # -1 or len(s) counting the batch dim (3 for rank-4 inputs,
+            # 4 for rank-5) — never a fixed 3, which is the W axis of a
+            # volumetric input
+            channel_axes = (-1, len(s)) if tf_model else (1,)
+            if axis not in channel_axes:
                 raise NotImplementedError(
                     f"keras converter: BatchNormalization axis={axis} over "
                     f"a rank-{len(s) + 1} input — only channel-axis "
-                    "(axis=1) converts")
+                    f"({'-1/' + str(len(s)) if tf_model else '1'}) converts")
             return orig_build(s)
         if len(s) == 2:
             # temporal (T, F) input: keras axis=-1/2 normalizes features —
@@ -349,12 +350,10 @@ def _l_globalavgpool1d(cfg):
 
 
 def _l_globalmaxpool2d(cfg):
-    _check_th(cfg, "GlobalMaxPooling2D")
     return L.GlobalMaxPooling2D()
 
 
 def _l_globalavgpool2d(cfg):
-    _check_th(cfg, "GlobalAveragePooling2D")
     return L.GlobalAveragePooling2D()
 
 
@@ -375,7 +374,6 @@ def _l_locallyconnected1d(cfg):
 
 
 def _l_locallyconnected2d(cfg):
-    _check_th(cfg, "LocallyConnected2D")
     return L.LocallyConnected2D(int(cfg["nb_filter"]), int(cfg["nb_row"]),
                                 int(cfg["nb_col"]), activation=_act(cfg),
                                 border_mode=cfg.get("border_mode", "valid"),
@@ -442,8 +440,10 @@ def _modernize(class_name: str, cfg: Dict):
     translate the modern spelling into the 1.2 one this module dispatches
     on. Weight layouts are NOT translated (load_weights_hdf5 stays 1.2).
     Translation is COMPLETE for what it claims: anything it cannot express
-    in 1.2 terms surfaces through the existing guards (e.g. channels_last
-    conv/pool stacks hit _check_th) rather than converting silently wrong.
+    in 1.2 terms surfaces through the existing guards (per-class
+    NotImplementedError at definition or weight-load time) rather than
+    converting silently wrong. channels_last spellings map to
+    dim_ordering="tf" and ride the transposed-weight pipeline.
     """
     cfg = dict(cfg)
     ren = {"units": "output_dim", "use_bias": "bias", "rate": "p",
@@ -571,10 +571,27 @@ class _Record:
         self.class_name = class_name
         self.config = config
         self.keras_layer = keras_layer
+        self.input_shape = None    # OUR shape of this layer's input
+        self.parent_names = None   # functional-graph parents (else None)
 
     @property
     def module(self):
         return self.keras_layer.built_module
+
+
+def _specs_tf_ordered(specs) -> bool:
+    """True when any spatial layer in the definition is channels-last."""
+    return any(_is_tf(_modernize(sp["class_name"], sp["config"])[1])
+               for sp in specs)
+
+
+def _maybe_nchw(shape, tf_ordered: bool):
+    """tf-ordered spatial input → the channels-first shape this model is
+    built with: (H, W, C) → (C, H, W), (D, H, W, C) → (C, D, H, W) (the
+    converted model consumes channels-first arrays)."""
+    if tf_ordered and shape is not None and len(shape) in (3, 4):
+        return (shape[-1],) + tuple(shape[:-1])
+    return shape
 
 
 def _from_sequential(config) -> Tuple[Sequential, List[_Record]]:
@@ -582,21 +599,28 @@ def _from_sequential(config) -> Tuple[Sequential, List[_Record]]:
     model = Sequential()
     records = []
     pending_shape = None
+    tf_ordered = _specs_tf_ordered(layers)
     for i, spec in enumerate(layers):
         cls, cfg = _modernize(spec["class_name"], spec["config"])
         if cls == "InputLayer":
             pending_shape = _input_shape_of(cfg, cls)
             continue
+        if tf_ordered:
+            cfg["_model_tf_ordered"] = True  # BN channel-axis detection
         layer = _layer_from_modern(cls, cfg)
         if not model.layers:
             shape = pending_shape or _input_shape_of(cfg, cls)
             if shape is None:
                 raise ValueError("keras converter: first layer carries no "
                                  "batch_input_shape/input_dim")
-            layer.input_shape = shape
+            layer.input_shape = _maybe_nchw(shape, tf_ordered)
+        in_shape = (layer.input_shape if not model.layers
+                    else model.shapes[-1])
         model.add(layer)
-        records.append(_Record(cfg.get("name", f"layer_{i}"), cls, cfg,
-                               layer))
+        rec = _Record(cfg.get("name", f"layer_{i}"), cls, cfg, layer)
+        rec.input_shape = in_shape  # ours (channels-first for tf models)
+        records.append(rec)
+    model._tf_ordered = tf_ordered
     return model, records
 
 
@@ -619,19 +643,23 @@ def _parent_names(node) -> List[str]:
 def _from_model(config) -> Tuple[Model, List[_Record]]:
     nodes: Dict[str, KerasNode] = {}
     records = []
+    tf_ordered = _specs_tf_ordered(config["layers"])
     for spec in config["layers"]:
         cls, cfg = _modernize(spec["class_name"], spec["config"])
         name = spec.get("name", cfg.get("name"))
         inbound = spec.get("inbound_nodes", [])
         if cls == "InputLayer":
-            shape = _input_shape_of(cfg)
+            shape = _maybe_nchw(_input_shape_of(cfg), tf_ordered)
             nodes[name] = Input(shape, name=name)
             continue
         if len(inbound) != 1:
             raise NotImplementedError(
                 f"keras converter: layer {name} applied {len(inbound)} "
                 "times — shared layers are unsupported")
-        parents = [nodes[pn] for pn in _parent_names(inbound[0])]
+        parent_names = _parent_names(inbound[0])
+        parents = [nodes[pn] for pn in parent_names]
+        if tf_ordered:
+            cfg["_model_tf_ordered"] = True  # BN channel-axis detection
         layer = _layer_from_modern(cls, cfg)
         layer.name = name
         if isinstance(layer, L.Merge):
@@ -642,7 +670,10 @@ def _from_model(config) -> Tuple[Model, List[_Record]]:
                     f"keras converter: non-Merge layer {name} has "
                     f"{len(parents)} inputs")
             nodes[name] = layer(parents[0])
-        records.append(_Record(name, cls, cfg, layer))
+        rec = _Record(name, cls, cfg, layer)
+        rec.input_shape = parents[0].shape if len(parents) == 1 else None
+        rec.parent_names = parent_names
+        records.append(rec)
     def refs(entry):
         # keras-1.2: [["name", 0, 0], ...]; keras 2/3 collapses a single
         # ref to a flat ["name", 0, 0]
@@ -652,7 +683,9 @@ def _from_model(config) -> Tuple[Model, List[_Record]]:
 
     ins = [nodes[n] for n in refs(config["input_layers"])]
     outs = [nodes[n] for n in refs(config["output_layers"])]
-    return Model(ins, outs), records
+    model = Model(ins, outs)
+    model._tf_ordered = tf_ordered
+    return model, records
 
 
 def model_from_json(json_def):
@@ -736,7 +769,12 @@ def _convert(record: _Record, ws: List[np.ndarray]):
             p["bias"] = ws[1]
         return [(N.Linear, p, {})]
     if cls == "Convolution2D":
-        p = {"weight": ws[0]}
+        # th stores (out, in, kh, kw) — our layout; tf (incl. every modern
+        # tf.keras export) stores (kh, kw, in, out)
+        w = ws[0]
+        if _is_tf(cfg):
+            w = w.transpose(3, 2, 0, 1)
+        p = {"weight": w}
         if len(ws) > 1:
             p["bias"] = ws[1]
         return [(N.SpatialConvolution, p, {})]
@@ -750,12 +788,18 @@ def _convert(record: _Record, ws: List[np.ndarray]):
             p["bias"] = ws[1]
         return [(N.TemporalConvolution, p, {})]
     if cls == "Convolution3D":
-        p = {"weight": ws[0]}
+        w = ws[0]
+        if _is_tf(cfg):  # (kd, kh, kw, in, out) → (out, in, kd, kh, kw)
+            w = w.transpose(4, 3, 0, 1, 2)
+        p = {"weight": w}
         if len(ws) > 1:
             p["bias"] = ws[1]
         return [(N.VolumetricConvolution, p, {})]
     if cls == "AtrousConvolution2D":
-        p = {"weight": ws[0]}
+        w = ws[0]
+        if _is_tf(cfg):
+            w = w.transpose(3, 2, 0, 1)
+        p = {"weight": w}
         if len(ws) > 1:
             p["bias"] = ws[1]
         return [(N.SpatialDilatedConvolution, p, {})]
@@ -832,6 +876,67 @@ def _assign(tree, path, updates, like_dtype=True):
         node[k] = jnp.asarray(v, dtype=cur.dtype)
 
 
+# records whose presence between Flatten and Dense does not disturb the
+# flatten element order
+_ORDER_PRESERVING = {"Activation", "Dropout", "Masking", "GaussianNoise",
+                     "GaussianDropout", "LeakyReLU", "ELU",
+                     "ThresholdedReLU", "SoftMax"}
+# order-preserving but carrying PER-FEATURE parameters: a Flatten behind
+# one of these would need the same h,w,c→c,h,w permutation applied to its
+# weights — unimplemented, must be refused loudly, never converted wrong
+_ORDER_PRESERVING_WITH_PARAMS = {"BatchNormalization", "PReLU", "SReLU"}
+
+
+def _flatten_shape_before(records, dense_record):
+    """If ``dense_record``'s input is (possibly through order-preserving
+    layers) the output of a Flatten, return that Flatten's input shape
+    (OUR channels-first shape) — the tf→th row permutation needs it.
+    Raises NotImplementedError when a per-feature-parameter layer sits
+    between a (3-D) Flatten and the Dense: its weights would need the same
+    permutation, which is unimplemented — silent mis-conversion is the one
+    unacceptable outcome."""
+
+    def walk(next_fn, start):
+        blocker = None
+        r = next_fn(start)
+        while r is not None:
+            if r.class_name == "Flatten":
+                if blocker is not None and r.input_shape is not None \
+                        and len(r.input_shape) == 3:
+                    raise NotImplementedError(
+                        f"keras converter: tf-ordered Flatten→"
+                        f"{blocker}→Dense — the {blocker} layer's "
+                        "per-feature weights would need the flatten-order "
+                        "permutation too; re-export channels-first")
+                return None if blocker else r.input_shape
+            if r.class_name in _ORDER_PRESERVING_WITH_PARAMS:
+                blocker = blocker or r.class_name
+            elif r.class_name not in _ORDER_PRESERVING:
+                return None  # feature order re-mixed by a weighted op
+            r = next_fn(r)
+        return None
+
+    if dense_record.parent_names is not None:  # functional graph
+        by_name = {r.name: r for r in records}
+
+        def parent(r):
+            names = r.parent_names or []
+            return by_name.get(names[0]) if len(names) == 1 else None
+        return walk(parent, dense_record)
+    try:  # sequential: walk backwards
+        i = records.index(dense_record)
+    except ValueError:
+        return None
+    seq = records[:i][::-1] + [None]
+
+    def prev(r):
+        if r is dense_record:
+            return seq[0] if seq else None
+        j = seq.index(r)
+        return seq[j + 1]
+    return walk(prev, dense_record)
+
+
 def load_weights(model, weights: Dict[str, List[np.ndarray]],
                  by_name=False, strict=True) -> None:
     """Apply a {layer_name: [arrays]} weight dict to a converted model.
@@ -887,9 +992,19 @@ def load_weights(model, weights: Dict[str, List[np.ndarray]],
         else:
             pairs = list(zip(expecting, (w for _, w in named)))
 
+    tf_ordered = getattr(model, "_tf_ordered", False)
     for record, ws in pairs:
-        for target_cls, p_up, s_up in _convert(record,
-                                               [np.asarray(w) for w in ws]):
+        ws = [np.asarray(w) for w in ws]
+        if tf_ordered and record.class_name == "Dense":
+            fshape = _flatten_shape_before(records, record)
+            if fshape is not None and len(fshape) == 3:
+                # keras flattened (h, w, c); this model flattens (c, h, w):
+                # permute the Dense kernel's input rows accordingly
+                C, H, W = fshape
+                perm = np.arange(C * H * W).reshape(H, W, C) \
+                         .transpose(2, 0, 1).ravel()
+                ws[0] = ws[0][perm]
+        for target_cls, p_up, s_up in _convert(record, ws):
             built = record.module
             rel, _ = _find(built, target_cls)
             base = path_of[id(built)]

@@ -1,0 +1,354 @@
+"""Fused BN-apply + ReLU + matmul (+ batch-stats epilogue) Pallas kernel.
+
+The ResNet bottleneck's 1x1 convolutions ARE matmuls over M = B*H*W pixels.
+XLA runs the chain
+
+    z_prev --(read)--> bn_stats --(read)--> normalize+relu --(write)-->
+    x_hat --(read)--> conv1x1 --(write)--> z --(read)--> bn_stats ...
+
+with ~5 HBM passes of the big stage-1 activations per conv (the round-2
+profile: stage-1 elementwise BN/residual chains at the HBM roofline,
+README "Performance"). This kernel folds the elementwise work into the
+matmul's VMEM pipeline:
+
+  * prologue: ``x_hat = relu(x * a + b)`` applied to the streamed input
+    tile, where ``a = gamma/sqrt(var+eps)`` and ``b = beta - mean*a`` are
+    the previous BN's per-channel affine (computed outside, in jnp, so BN
+    statistics stay differentiable through plain autodiff);
+  * matmul on the MXU (f32 accumulation);
+  * epilogue: per-output-channel ``sum`` and ``sum of squares`` of ``z``
+    accumulated in VMEM scratch — the NEXT BN's batch statistics — written
+    once, so the stats pass never re-reads ``z`` from HBM.
+
+Forward and backward are Pallas kernels under ``jax.custom_vjp``; the
+backward recomputes ``x_hat`` from the saved ``x`` tile-by-tile (flash-
+attention-style rematerialisation) and fuses the ``dgamma/dbeta``-feeding
+reductions (``da``, ``db``) and the stats-gradient injection
+``dz_eff = dz + ds1 + 2*z*ds2`` into the two gradient matmuls.
+
+Reference analog: the entire ``nn/mkldnn/`` fused-layer backend exists to
+do exactly this on CPUs (e.g. mkldnn post-ops on SpatialConvolution);
+here it is one kernel family on the TPU MXU. Used by
+``models/resnet.py``'s ``fused="pallas"`` NHWC bottleneck variant.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _mm(a, b, ta=False):
+    ca = 0 if ta else 1
+    return jax.lax.dot_general(a, b, (((ca,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# forward: z = relu(x*a + b) @ w ; s1/s2 = per-channel sums of z
+# ---------------------------------------------------------------------------
+
+
+def _row_mask(i, block_m, m_total, width):
+    """(block_m, width) mask of rows whose GLOBAL index is < m_total —
+    zero-pads' contributions must not leak into stats/gradient sums (the
+    prologue bias makes padded rows nonzero)."""
+    rows = i * block_m + jax.lax.broadcasted_iota(jnp.int32,
+                                                  (block_m, width), 0)
+    return rows < m_total
+
+
+def _fwd_kernel(x_ref, w_ref, a_ref, b_ref, z_ref, s1_ref, s2_ref,
+                acc1, acc2, *, nm, prologue, relu, stats, m_total, block_m):
+    j = pl.program_id(0)   # N tile (parallel)
+    i = pl.program_id(1)   # M tile (sequential innermost — stats accumulate)
+
+    if stats:
+        @pl.when(i == 0)
+        def _init():
+            acc1[:] = jnp.zeros_like(acc1)
+            acc2[:] = jnp.zeros_like(acc2)
+
+    x = x_ref[...].astype(jnp.float32)
+    if prologue:
+        x = x * a_ref[...].astype(jnp.float32) + b_ref[...].astype(
+            jnp.float32)
+    if relu:
+        x = jnp.maximum(x, 0.0)
+    z = _mm(x, w_ref[...].astype(jnp.float32))      # (bm, bn) f32
+    z_ref[...] = z.astype(z_ref.dtype)
+
+    if stats:
+        zm = jnp.where(_row_mask(i, block_m, m_total, z.shape[1]), z, 0.0)
+        acc1[:] += jnp.sum(zm, axis=0, keepdims=True)
+        acc2[:] += jnp.sum(zm * zm, axis=0, keepdims=True)
+
+        @pl.when(i == nm - 1)
+        def _finish():
+            s1_ref[...] = acc1[:]
+            s2_ref[...] = acc2[:]
+
+
+def _fwd(x, w, a, b, relu, stats, block_m, block_n, interpret):
+    M, K = x.shape
+    N = w.shape[1]
+    prologue = a is not None
+    xp = _pad_to(_pad_to(x, 0, block_m), 1, 128)
+    wp = _pad_to(_pad_to(w, 0, 128), 1, block_n)
+    Kp = xp.shape[1]
+    ap = (_pad_to(a.reshape(1, K), 1, 128) if prologue
+          else jnp.zeros((1, Kp), x.dtype))
+    bp = (_pad_to(b.reshape(1, K), 1, 128) if prologue
+          else jnp.zeros((1, Kp), x.dtype))
+    nm = xp.shape[0] // block_m
+    nn = wp.shape[1] // block_n
+
+    kernel = functools.partial(_fwd_kernel, nm=nm, prologue=prologue,
+                               relu=relu, stats=stats, m_total=M,
+                               block_m=block_m)
+    z, s1, s2 = pl.pallas_call(
+        kernel,
+        grid=(nn, nm),
+        in_specs=[
+            pl.BlockSpec((block_m, Kp), lambda j, i: (i, 0)),
+            pl.BlockSpec((Kp, block_n), lambda j, i: (0, j)),
+            pl.BlockSpec((1, Kp), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda j, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_m, block_n), lambda j, i: (i, j)),
+            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
+            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], wp.shape[1]), x.dtype),
+            jax.ShapeDtypeStruct((1, wp.shape[1]), jnp.float32),
+            jax.ShapeDtypeStruct((1, wp.shape[1]), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, block_n), jnp.float32),
+                        pltpu.VMEM((1, block_n), jnp.float32)],
+        interpret=interpret,
+    )(xp, wp, ap, bp)
+    return z[:M, :N], s1[0, :N], s2[0, :N]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dx_kernel(x_ref, w_ref, a_ref, b_ref, dz_ref, z_ref, ds1_ref,
+                   ds2_ref, dx_ref, da_ref, db_ref, acc_da, acc_db,
+                   *, nm, prologue, relu, stats, m_total, block_m):
+    j = pl.program_id(0)   # K tile? no — dx is (M, K): K whole, M tiles.
+    i = pl.program_id(1)   # M tile (sequential — da/db accumulate)
+
+    if prologue:
+        @pl.when(i == 0)
+        def _init():
+            acc_da[:] = jnp.zeros_like(acc_da)
+            acc_db[:] = jnp.zeros_like(acc_db)
+
+    dz = dz_ref[...].astype(jnp.float32)
+    if stats:
+        z = z_ref[...].astype(jnp.float32)
+        dz = dz + ds1_ref[...].astype(jnp.float32) \
+            + 2.0 * z * ds2_ref[...].astype(jnp.float32)
+        dz = jnp.where(_row_mask(i, block_m, m_total, dz.shape[1]), dz, 0.0)
+    dxh = _mm(dz, w_ref[...].astype(jnp.float32).T)   # (bm, K)
+    x = x_ref[...].astype(jnp.float32)
+    if prologue:
+        xn = x * a_ref[...].astype(jnp.float32) + b_ref[...].astype(
+            jnp.float32)
+    else:
+        xn = x
+    dxn = jnp.where(xn > 0.0, dxh, 0.0) if relu else dxh
+    if prologue:
+        dx = dxn * a_ref[...].astype(jnp.float32)
+        acc_da[:] += jnp.sum(dxn * x, axis=0, keepdims=True)
+        acc_db[:] += jnp.sum(dxn, axis=0, keepdims=True)
+
+        @pl.when(i == nm - 1)
+        def _finish():
+            da_ref[...] = acc_da[:]
+            db_ref[...] = acc_db[:]
+    else:
+        dx = dxn
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _bwd_dw_kernel(x_ref, a_ref, b_ref, dz_ref, z_ref, ds1_ref, ds2_ref,
+                   dw_ref, acc, *, nm, prologue, relu, stats, m_total,
+                   block_m):
+    j = pl.program_id(0)   # N tile (parallel)
+    i = pl.program_id(1)   # M tile (sequential — dw accumulates)
+
+    @pl.when(i == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    x = x_ref[...].astype(jnp.float32)
+    if prologue:
+        x = x * a_ref[...].astype(jnp.float32) + b_ref[...].astype(
+            jnp.float32)
+    if relu:
+        x = jnp.maximum(x, 0.0)
+    dz = dz_ref[...].astype(jnp.float32)
+    if stats:
+        z = z_ref[...].astype(jnp.float32)
+        dz = dz + ds1_ref[...].astype(jnp.float32) \
+            + 2.0 * z * ds2_ref[...].astype(jnp.float32)
+        dz = jnp.where(_row_mask(i, block_m, m_total, dz.shape[1]), dz, 0.0)
+    acc[:] += _mm(x, dz, ta=True)                    # (K, bn)
+
+    @pl.when(i == nm - 1)
+    def _finish():
+        dw_ref[...] = acc[:].astype(dw_ref.dtype)
+
+
+def _bwd(relu, stats, block_m, block_n, interpret, res, grads):
+    x, w, a, b, z = res
+    dz, ds1, ds2 = grads
+    M, K = x.shape
+    N = w.shape[1]
+    prologue = a is not None
+
+    xp = _pad_to(_pad_to(x, 0, block_m), 1, 128)
+    wp = _pad_to(_pad_to(w, 0, 128), 1, block_n)
+    Kp, Np = xp.shape[1], wp.shape[1]
+    Mp = xp.shape[0]
+    zero_col = jnp.zeros((1, Np), jnp.float32)
+    zp = (_pad_to(_pad_to(z, 0, block_m), 1, block_n) if stats
+          else jnp.zeros((Mp, Np), x.dtype))
+    dzp = _pad_to(_pad_to(dz.astype(jnp.float32), 0, block_m), 1, block_n)
+    ds1p = (_pad_to(ds1.reshape(1, N).astype(jnp.float32), 1, block_n)
+            if stats else zero_col)
+    ds2p = (_pad_to(ds2.reshape(1, N).astype(jnp.float32), 1, block_n)
+            if stats else zero_col)
+    ap = (_pad_to(a.reshape(1, K), 1, 128) if prologue
+          else jnp.zeros((1, Kp), x.dtype))
+    bp = (_pad_to(b.reshape(1, K), 1, 128) if prologue
+          else jnp.zeros((1, Kp), x.dtype))
+    nm = Mp // block_m
+    nn = Np // block_n
+
+    # dx (+ da/db) kernel: one pass over M tiles, full K and N resident
+    dx_kernel = functools.partial(_bwd_dx_kernel, nm=nm, prologue=prologue,
+                                  relu=relu, stats=stats, m_total=M,
+                                  block_m=block_m)
+    dx, da, db = pl.pallas_call(
+        dx_kernel,
+        grid=(1, nm),
+        in_specs=[
+            pl.BlockSpec((block_m, Kp), lambda j, i: (i, 0)),
+            pl.BlockSpec((Kp, Np), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda j, i: (0, 0)),
+            pl.BlockSpec((block_m, Np), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_m, Np), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, Np), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, Np), lambda j, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_m, Kp), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, Kp), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda j, i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Mp, Kp), x.dtype),
+            jax.ShapeDtypeStruct((1, Kp), jnp.float32),
+            jax.ShapeDtypeStruct((1, Kp), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, Kp), jnp.float32),
+                        pltpu.VMEM((1, Kp), jnp.float32)],
+        interpret=interpret,
+    )(xp, wp, ap, bp, dzp, zp, ds1p, ds2p)
+
+    dw_kernel = functools.partial(_bwd_dw_kernel, nm=nm, prologue=prologue,
+                                  relu=relu, stats=stats, m_total=M,
+                                  block_m=block_m)
+    dw = pl.pallas_call(
+        dw_kernel,
+        grid=(nn, nm),
+        in_specs=[
+            pl.BlockSpec((block_m, Kp), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, Kp), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda j, i: (0, 0)),
+            pl.BlockSpec((block_m, block_n), lambda j, i: (i, j)),
+            pl.BlockSpec((block_m, block_n), lambda j, i: (i, j)),
+            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
+            pl.BlockSpec((1, block_n), lambda j, i: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((Kp, block_n), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((Kp, Np), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((Kp, block_n), jnp.float32)],
+        interpret=interpret,
+    )(xp, ap, bp, dzp, zp, ds1p, ds2p)
+
+    dx = dx[:M, :K]
+    dw = dw[:K, :N].astype(w.dtype)
+    if prologue:
+        da_out = da[0, :K].astype(a.dtype)
+        db_out = db[0, :K].astype(b.dtype)
+    else:
+        da_out = db_out = None
+    return dx, dw, da_out, db_out
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _fused(x, w, a, b, relu, stats, block_m, block_n, interpret):
+    return _fwd(x, w, a, b, relu, stats, block_m, block_n, interpret)
+
+
+def _fused_fwd(x, w, a, b, relu, stats, block_m, block_n, interpret):
+    z, s1, s2 = _fwd(x, w, a, b, relu, stats, block_m, block_n, interpret)
+    return (z, s1, s2), (x, w, a, b, z if stats else None)
+
+
+def _fused_bwd(relu, stats, block_m, block_n, interpret, res, grads):
+    dx, dw, da, db = _bwd(relu, stats, block_m, block_n, interpret, res,
+                          grads)
+    return dx, dw, da, db
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_bn_relu_matmul(x, w, scale=None, bias=None, *, relu=None,
+                         stats=True, block_m=512, block_n=256,
+                         interpret=False):
+    """``z = act(x * scale + bias) @ w`` with fused per-channel output
+    statistics.
+
+    x: (M, K); w: (K, N); scale/bias: (K,) per-channel affine (the previous
+    BatchNorm folded to ``a = gamma*rsqrt(var+eps)``, ``b = beta - mean*a``)
+    or None for a plain input. ``relu`` defaults to True when a prologue is
+    given. Returns ``(z, s1, s2)`` with ``s1 = sum_m z`` and
+    ``s2 = sum_m z^2`` (f32) when ``stats`` else ``(z, None-like zeros)``.
+    Differentiable (custom_vjp, Pallas backward); gradients flow through
+    scale/bias so BN statistics chains stay exact.
+    """
+    if relu is None:
+        relu = scale is not None
+    M = x.shape[0]
+    bm = min(block_m, max(128, ((M + 127) // 128) * 128))
+    return _fused(x, w, scale, bias, bool(relu), bool(stats), int(bm),
+                  int(block_n), bool(interpret))

@@ -127,6 +127,184 @@ def bottleneck(nin, nmid, stride=1, expansion=4,
         CAddTable(), ReLU())
 
 
+from ..nn.module import Module as _Module
+import jax
+from jax import lax as _lax
+
+
+class FusedBottleneck(_Module):
+    """NHWC bottleneck with the 1x1 convs running through the fused
+    Pallas BN+ReLU+matmul+stats kernel (kernels/fused_matmul.py).
+
+    The math is identical to :func:`bottleneck`; what changes is HBM
+    traffic: BN2's normalize+ReLU rides the third conv's prologue, and
+    every 1x1 conv's output statistics (the next BN's batch mean/var) are
+    accumulated in the matmul epilogue instead of a separate full pass
+    over the activation. The 3x3 conv stays on ``lax.conv`` (XLA's conv
+    is already MXU-tiled; its BN stats are plain jnp reductions).
+    Dispatch follows the flash policy (``parallel.flash.flash_mode``):
+    Pallas on TPU-class backends, interpreter under
+    ``BIGDL_TPU_FLASH=interpret``, plain-jnp fallback elsewhere — the
+    fallback computes the same values, so tests compare the two paths.
+
+    Param/state layout is this module's own (w1/w2/w3 HWIO + bn{1,2,3}
+    and optional proj_w/proj_bn) — the fused variant is a benchmark/
+    deployment choice, not a checkpoint-compatible swap (reference
+    analog: nn/mkldnn's fused layers are separate classes too).
+    """
+
+    def __init__(self, nin, nmid, stride=1, expansion=4,
+                 zero_init_residual=False, eps=1e-5, momentum=0.1,
+                 name=None):
+        super().__init__(name=name)
+        self.nin, self.nmid, self.stride = nin, nmid, stride
+        self.nout = nmid * expansion
+        self.zero_init = zero_init_residual
+        self.eps, self.momentum = eps, momentum
+        self.project = (nin != self.nout or stride != 1)
+
+    def _init_params(self, rng):
+        import jax
+        ks = jax.random.split(rng, 4)
+        msra = MsraFiller(False)
+
+        def conv_w(key, kh, kw, cin, cout):
+            # stored HWIO; init draws in OIHW so std matches the unfused
+            # SpatialConvolution blocks (fan-in = cin*kh*kw)
+            return msra(key, (cout, cin, kh, kw),
+                        fan_in=cin * kh * kw).transpose(2, 3, 1, 0)
+
+        def bn(n, zero=False):
+            return {"weight": jnp.zeros((n,)) if zero else jnp.ones((n,)),
+                    "bias": jnp.zeros((n,))}
+
+        p = {"w1": conv_w(ks[0], 1, 1, self.nin, self.nmid),
+             "w2": conv_w(ks[1], 3, 3, self.nmid, self.nmid),
+             "w3": conv_w(ks[2], 1, 1, self.nmid, self.nout),
+             "bn1": bn(self.nmid), "bn2": bn(self.nmid),
+             "bn3": bn(self.nout, self.zero_init)}
+        if self.project:
+            p["proj_w"] = conv_w(ks[3], 1, 1, self.nin, self.nout)
+            p["proj_bn"] = bn(self.nout)
+        return p
+
+    def _init_state(self):
+        def rs(n):
+            return {"running_mean": jnp.zeros((n,)),
+                    "running_var": jnp.ones((n,))}
+        s = {"bn1": rs(self.nmid), "bn2": rs(self.nmid),
+             "bn3": rs(self.nout)}
+        if self.project:
+            s["proj_bn"] = rs(self.nout)
+        return s
+
+    @staticmethod
+    def _mode():
+        from ..parallel.flash import flash_mode
+        return flash_mode()
+
+    def _mm(self, x2d, w, scale, bias, relu, stats):
+        """Dispatch one fused matmul; the jnp fallback is the same math."""
+        mode = self._mode()
+        if mode in ("pallas", "interpret"):
+            from ..kernels.fused_matmul import fused_bn_relu_matmul
+            return fused_bn_relu_matmul(
+                x2d, w, scale, bias, relu=relu, stats=stats,
+                interpret=(mode == "interpret"))
+        xh = x2d if scale is None else x2d * scale + bias
+        if relu:
+            xh = jnp.maximum(xh, 0.0)
+        z = xh @ w
+        zf = z.astype(jnp.float32)
+        if stats:
+            return z, jnp.sum(zf, 0), jnp.sum(zf * zf, 0)
+        return z, None, None
+
+    def _bn_affine(self, params, state, key, s1, s2, m, training):
+        """Batch (or running) stats → the per-channel (a, b) affine; also
+        the updated running stats."""
+        g = params[key]["weight"].astype(jnp.float32)
+        beta = params[key]["bias"].astype(jnp.float32)
+        if training:
+            mean = s1 / m
+            var = jnp.maximum(s2 / m - mean * mean, 0.0)
+            n = m
+            unbiased = var * n / max(n - 1, 1)
+            new = {"running_mean": (1 - self.momentum)
+                   * state[key]["running_mean"] + self.momentum * mean,
+                   "running_var": (1 - self.momentum)
+                   * state[key]["running_var"] + self.momentum * unbiased}
+        else:
+            mean = state[key]["running_mean"].astype(jnp.float32)
+            var = state[key]["running_var"].astype(jnp.float32)
+            new = state[key]
+        inv = jax.lax.rsqrt(var + self.eps)
+        a = g * inv
+        b = beta - mean * a
+        return a, b, new
+
+    def _apply(self, params, state, x, training, rng):
+        B, H, W, _ = x.shape
+        dt = x.dtype
+        new_state = {}
+
+        def cast(v):
+            return v.astype(dt)
+
+        # conv1 (1x1): plain input, fused output stats for BN1
+        x2d = x.reshape(-1, self.nin)
+        w1 = cast(params["w1"].reshape(self.nin, self.nmid))
+        z1, s11, s12 = self._mm(x2d, w1, None, None, relu=False,
+                                stats=training)
+        a1, b1, new_state["bn1"] = self._bn_affine(
+            params, state, "bn1", s11, s12, x2d.shape[0], training)
+        # BN1+ReLU materialises once (the 3x3 conv needs a spatial tensor)
+        xh1 = jnp.maximum(z1 * cast(a1) + cast(b1), 0) \
+                 .reshape(B, H, W, self.nmid)
+
+        # conv2 (3x3, stride here — v1.5 placement); stats via jnp
+        z2 = _lax.conv_general_dilated(
+            xh1, cast(params["w2"]), window_strides=(self.stride,) * 2,
+            padding=((1, 1), (1, 1)),  # explicit: matches _conv(pad=1),
+            # not SAME (stride-2 SAME pads (0,1) — different taps)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        H2, W2 = z2.shape[1], z2.shape[2]
+        m2 = B * H2 * W2
+        if training:
+            z2f = z2.astype(jnp.float32)
+            s21 = jnp.sum(z2f, axis=(0, 1, 2))
+            s22 = jnp.sum(z2f * z2f, axis=(0, 1, 2))
+        else:
+            s21 = s22 = None
+        a2, b2, new_state["bn2"] = self._bn_affine(
+            params, state, "bn2", s21, s22, m2, training)
+
+        # conv3 (1x1): BN2+ReLU fused into the prologue, stats for BN3
+        w3 = cast(params["w3"].reshape(self.nmid, self.nout))
+        z3, s31, s32 = self._mm(z2.reshape(-1, self.nmid), w3, cast(a2),
+                                cast(b2), relu=True, stats=training)
+        a3, b3, new_state["bn3"] = self._bn_affine(
+            params, state, "bn3", s31, s32, m2, training)
+
+        # shortcut
+        if self.project:
+            if self.stride != 1:
+                xs = x[:, ::self.stride, ::self.stride, :]
+            else:
+                xs = x
+            wp = cast(params["proj_w"].reshape(self.nin, self.nout))
+            zp, sp1, sp2 = self._mm(xs.reshape(-1, self.nin), wp, None,
+                                    None, relu=False, stats=training)
+            ap, bp, new_state["proj_bn"] = self._bn_affine(
+                params, state, "proj_bn", sp1, sp2, m2, training)
+            short = zp * cast(ap) + cast(bp)
+        else:
+            short = x.reshape(-1, self.nout)
+
+        # BN3 + residual add + ReLU: one fused XLA elementwise pass
+        out = jnp.maximum(z3 * cast(a3) + cast(b3) + short, 0)
+        return out.reshape(B, H2, W2, self.nout), new_state
+
 _IMAGENET_CFG = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
 
 
@@ -134,14 +312,16 @@ def ResNet(class_num: int = 1000, depth: int = 50,
            shortcut_type: str = ShortcutType.B, data_set: str = "ImageNet",
            zero_init_residual: bool = True, with_log_softmax: bool = False,
            format: str = "NCHW", stem: str = "conv7",
-           pool_grad: str = "exact"):
+           pool_grad: str = "exact", fused: str = "none"):
     """Factory with the reference's signature
     (models/resnet/ResNet.scala apply(classNum, opt)). ``format='NHWC'``
     builds the channels-last variant (identical params; activations NHWC —
     the layout XLA:TPU tiles convs fastest in; see bench.py).
     ``stem='s2d'`` (NHWC only) computes the same stem via a space-to-depth
     reparameterization (SpaceToDepthStem) — identical math and params,
-    faster MXU packing."""
+    faster MXU packing. ``fused='pallas'`` (NHWC only) swaps bottlenecks
+    for :class:`FusedBottleneck` (Pallas BN+ReLU+matmul+stats kernels on
+    the 1x1 convs — same math, fewer HBM passes)."""
     if data_set.lower() == "cifar10":
         return ResNetCifar(class_num, depth, shortcut_type)
     fmt = format
@@ -156,13 +336,24 @@ def ResNet(class_num: int = 1000, depth: int = 50,
     model.add(ReLU())
     model.add(SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=fmt,
                                 grad_mode=pool_grad))
+    if fused == "pallas":
+        assert fmt == "NHWC", "fused bottlenecks are the NHWC/TPU path"
+        if shortcut_type != ShortcutType.B:
+            raise NotImplementedError(
+                f"fused='pallas' implements shortcut type B only "
+                f"(requested {shortcut_type!r}) — the fused model must "
+                "stay architecture-identical to its unfused A/B partner")
     nin = 64
     for stage, n_blocks in enumerate(blocks):
         nmid = 64 * (2 ** stage)
         for b in range(n_blocks):
             stride = 2 if (stage > 0 and b == 0) else 1
-            model.add(bottleneck(nin, nmid, stride, 4, shortcut_type,
-                                 zero_init_residual, fmt))
+            if fused == "pallas":
+                model.add(FusedBottleneck(nin, nmid, stride, 4,
+                                          zero_init_residual))
+            else:
+                model.add(bottleneck(nin, nmid, stride, 4, shortcut_type,
+                                     zero_init_residual, fmt))
             nin = nmid * 4
     model.add(SpatialAveragePooling(7, 7, 1, 1, global_pooling=True,
                                     format=fmt))

@@ -279,11 +279,19 @@ def test_full_model_hdf5_with_config(tmp_path):
     assert np.allclose(out, x @ w + b, atol=1e-5)
 
 
-def test_tf_ordering_rejected():
+def test_tf_ordering_builds_channels_first():
+    """A keras-1.2 'tf'-ordered conv definition converts: the model is
+    built channels-first with the (H, W, C) input shape transposed (round-3
+    transposed-weight pipeline; exactness vs real keras is covered by
+    test_tf_ordered_conv_stack_matches_real_keras)."""
     spec = [_layer("Convolution2D", "c", nb_filter=2, nb_row=3, nb_col=3,
-                   dim_ordering="tf", batch_input_shape=[None, 8, 8, 3])]
-    with pytest.raises(NotImplementedError):
-        model_from_json(_seq_json(spec))
+                   dim_ordering="tf", border_mode="same",
+                   batch_input_shape=[None, 8, 8, 3])]
+    model = model_from_json(_seq_json(spec))
+    assert model._tf_ordered
+    out = model._module().evaluate().forward(
+        np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+    assert np.asarray(out).shape == (2, 2, 8, 8)
 
 
 def test_unsupported_layer_class_rejected():
@@ -465,10 +473,13 @@ def test_modern_keras_edge_configs():
         np.random.randn(2, 12, 3).astype(np.float32))
     assert out.shape == (2, 3, 3)
 
+    # channels_last pooling converts via the transposed pipeline: the
+    # model is built channels-first, so feed NCHW
     m2 = keras.Sequential([keras.layers.Input((6, 6, 3)),
                            keras.layers.MaxPooling2D()])
-    with pytest.raises(NotImplementedError):
-        model_from_json(m2.to_json())  # channels_last must be loud
+    out2 = model_from_json(m2.to_json())._module().evaluate().forward(
+        np.random.randn(2, 3, 6, 6).astype(np.float32))
+    assert out2.shape == (2, 3, 3, 3)
 
     m3 = keras.Sequential([
         keras.layers.Input((3, 8, 8)),
@@ -483,3 +494,129 @@ def test_modern_keras_edge_configs():
     y = model_from_json(m4.to_json())._module().evaluate().forward(
         -np.ones((1, 4), np.float32))
     np.testing.assert_allclose(np.asarray(y), -0.01, rtol=1e-5)
+
+
+def _keras12_h5(path, keras_model, h5py):
+    """Write a keras-1.2-layout weights HDF5 from a live tf.keras model
+    (layer_names/weight_names attrs — the format load_weights_hdf5 reads;
+    modern tf.keras save_weights uses a different container)."""
+    with h5py.File(path, "w") as f:
+        names = []
+        for layer in keras_model.layers:
+            ws = layer.get_weights()
+            if not ws:
+                continue
+            names.append(layer.name.encode())
+            g = f.create_group(layer.name)
+            wnames = [f"{layer.name}_p{i}".encode() for i in range(len(ws))]
+            g.attrs["weight_names"] = wnames
+            for wn, w in zip(wnames, ws):
+                g.create_dataset(wn.decode(), data=w)
+        f.attrs["layer_names"] = names
+
+
+def test_tf_ordered_conv_stack_matches_real_keras(tmp_path):
+    """VERDICT r2 #6: a channels_last ('tf'-ordered) conv stack — JSON +
+    HDF5 weights from REAL tf.keras — converts through the transposed-weight
+    pipeline and matches tf.keras outputs (incl. the Flatten→Dense row
+    permutation)."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    h5py = pytest.importorskip("h5py")
+    keras = tf.keras
+    from bigdl_tpu.keras.converter import model_from_json, load_weights_hdf5
+
+    rng = np.random.RandomState(0)
+    m = keras.Sequential([
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.Conv2D(5, 3, activation="relu", padding="same"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Conv2D(4, 3),
+        keras.layers.Flatten(),
+        keras.layers.Dense(6, activation="tanh"),
+        keras.layers.Dense(3),
+    ])
+    x = rng.randn(4, 8, 8, 3).astype(np.float32)
+    ref = m.predict(x, verbose=0)
+
+    ours = model_from_json(m.to_json())
+    path = str(tmp_path / "w.h5")
+    _keras12_h5(path, m, h5py)
+    load_weights_hdf5(ours, path)
+    out = np.asarray(ours._module().evaluate().forward(
+        x.transpose(0, 3, 1, 2)))  # converted model consumes NCHW
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_tf_ordered_functional_with_bn_matches_real_keras(tmp_path):
+    """Functional channels_last graph with BatchNormalization(axis=-1):
+    BN stats stay per-channel across the layout change."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    h5py = pytest.importorskip("h5py")
+    keras = tf.keras
+    from bigdl_tpu.keras.converter import model_from_json, load_weights_hdf5
+
+    rng = np.random.RandomState(1)
+    inp = keras.layers.Input((6, 6, 2))
+    h = keras.layers.Conv2D(4, 3, padding="same")(inp)
+    h = keras.layers.BatchNormalization(axis=-1)(h)
+    h = keras.layers.Activation("relu")(h)
+    h = keras.layers.Flatten()(h)
+    out_l = keras.layers.Dense(2)(h)
+    m = keras.Model(inp, out_l)
+    # non-trivial BN stats
+    bn = m.layers[2]
+    bn.set_weights([rng.rand(4).astype(np.float32) + 0.5,
+                    rng.randn(4).astype(np.float32),
+                    rng.randn(4).astype(np.float32),
+                    rng.rand(4).astype(np.float32) + 0.3])
+    x = rng.randn(3, 6, 6, 2).astype(np.float32)
+    ref = m.predict(x, verbose=0)
+
+    ours = model_from_json(m.to_json())
+    path = str(tmp_path / "w.h5")
+    _keras12_h5(path, m, h5py)
+    load_weights_hdf5(ours, path)
+    out = np.asarray(ours._module().evaluate().forward(
+        x.transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_tf_ordered_conv3d_input_transposed():
+    """Rank-4 tf-ordered input shapes (D, H, W, C) transpose to
+    (C, D, H, W) — a channels_last Conv3D must not treat D as channels."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    keras = tf.keras
+    m = keras.Sequential([
+        keras.layers.Input((5, 6, 6, 2)),
+        keras.layers.Conv3D(4, 3, padding="same"),
+    ])
+    ours = model_from_json(m.to_json())
+    x = np.random.RandomState(0).randn(1, 2, 5, 6, 6).astype(np.float32)
+    out = np.asarray(ours._module().evaluate().forward(x))
+    assert out.shape == (1, 4, 5, 6, 6), out.shape
+
+
+def test_tf_ordered_flatten_bn_dense_rejected(tmp_path):
+    """A per-feature-parameter layer (BatchNormalization) between Flatten
+    and Dense in a tf-ordered model is refused loudly at weight-load time —
+    never silently mis-permuted."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    h5py = pytest.importorskip("h5py")
+    keras = tf.keras
+    from bigdl_tpu.keras.converter import load_weights_hdf5
+    m = keras.Sequential([
+        keras.layers.Input((6, 6, 2)),
+        keras.layers.Conv2D(3, 3, padding="same"),
+        keras.layers.Flatten(),
+        keras.layers.BatchNormalization(),
+        keras.layers.Dense(2),
+    ])
+    ours = model_from_json(m.to_json())
+    path = str(tmp_path / "w.h5")
+    _keras12_h5(path, m, h5py)
+    with pytest.raises(NotImplementedError, match="per-feature"):
+        load_weights_hdf5(ours, path)

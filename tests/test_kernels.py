@@ -184,3 +184,96 @@ def test_lm_loss_chunked_matches_criterion():
     assert np.allclose(float(l_ref), float(l_ch), rtol=1e-5)
     for a, b in zip(g_ref, g_ch):
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused BN+ReLU+matmul (+stats) kernel and the FusedBottleneck built on it
+# ---------------------------------------------------------------------------
+
+def test_fused_matmul_forward_and_grads():
+    from bigdl_tpu.kernels.fused_matmul import fused_bn_relu_matmul
+    rng = np.random.RandomState(0)
+    M, K, N = 160, 48, 72  # deliberately unpadded sizes
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+    w = jnp.asarray(rng.randn(K, N).astype(np.float32) * 0.1)
+    a = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(K).astype(np.float32))
+
+    def ref(x, w, a, b):
+        xh = jnp.maximum(x * a + b, 0.0)
+        z = xh @ w
+        return z, jnp.sum(z, 0), jnp.sum(z * z, 0)
+
+    z, s1, s2 = fused_bn_relu_matmul(x, w, a, b, interpret=True)
+    zr, s1r, s2r = ref(x, w, a, b)
+    assert np.allclose(z, zr, atol=1e-4)
+    assert np.allclose(s1, s1r, atol=1e-3)
+    assert np.allclose(s2, s2r, atol=1e-2)
+
+    def mk_loss(fwd):
+        def loss(x, w, a, b):
+            z, s1, s2 = fwd(x, w, a, b)
+            mean = s1 / z.shape[0]
+            var = s2 / z.shape[0] - mean ** 2
+            zh = (z - mean) * jax.lax.rsqrt(var + 1e-5)
+            return jnp.sum(jnp.tanh(zh * 0.3))
+        return loss
+
+    gf = jax.grad(mk_loss(lambda *aa: fused_bn_relu_matmul(
+        *aa, interpret=True)), argnums=(0, 1, 2, 3))(x, w, a, b)
+    gr = jax.grad(mk_loss(ref), argnums=(0, 1, 2, 3))(x, w, a, b)
+    for name, f, r in zip("xwab", gf, gr):
+        rel = float(jnp.abs(f - r).max()) / (float(jnp.abs(r).max()) + 1e-9)
+        assert rel < 2e-4, (name, rel)
+
+
+def test_fused_bottleneck_matches_reference_block(monkeypatch):
+    """FusedBottleneck == the Sequential bottleneck with identical weights
+    (fwd train+eval, running stats), and the interpret-mode Pallas path ==
+    the jnp fallback in values and grads."""
+    from bigdl_tpu.models.resnet import FusedBottleneck, bottleneck
+    rng = np.random.RandomState(0)
+    B, H, W, C = 2, 8, 8, 16
+    x = jnp.asarray(rng.randn(B, H, W, C).astype(np.float32))
+    monkeypatch.setenv("BIGDL_TPU_FLASH", "off")  # jnp fallback path
+
+    for stride, nmid in ((1, 8), (2, 8)):
+        fb = FusedBottleneck(C, nmid, stride)
+        params, state = fb.init(jax.random.PRNGKey(0))
+        ref = bottleneck(C, nmid, stride, 4, "B", False, "NHWC")
+        rp, rs = ref.init(jax.random.PRNGKey(1))
+        main_p, sc_p = rp["0"]["0"], rp["0"]["1"]
+
+        def oihw(hwio):
+            return jnp.asarray(np.transpose(hwio, (3, 2, 0, 1)))
+        main_p["0"]["weight"] = oihw(params["w1"].reshape(1, 1, C, nmid))
+        main_p["3"]["weight"] = oihw(np.asarray(params["w2"]))
+        main_p["6"]["weight"] = oihw(params["w3"].reshape(1, 1, nmid,
+                                                          4 * nmid))
+        sc_p["0"]["weight"] = oihw(params["proj_w"].reshape(1, 1, C,
+                                                            4 * nmid))
+        for training in (True, False):
+            out_f, st_f = fb.apply(params, state, x, training=training)
+            out_r, st_r = ref.apply(rp, rs, x, training=training)
+            assert np.allclose(np.asarray(out_f), np.asarray(out_r),
+                               atol=2e-4)
+            if training:
+                assert np.allclose(
+                    np.asarray(st_f["bn1"]["running_mean"]),
+                    np.asarray(st_r["0"]["0"]["1"]["running_mean"]),
+                    atol=1e-4)
+
+    fb = FusedBottleneck(C, 8, 1)
+    params, state = fb.init(jax.random.PRNGKey(0))
+
+    def loss(p):
+        out, _ = fb.apply(p, state, x, training=True)
+        return jnp.sum(out * out) * 0.01
+
+    l_jnp, g_jnp = jax.value_and_grad(loss)(params)
+    monkeypatch.setenv("BIGDL_TPU_FLASH", "interpret")  # real kernel
+    l_krn, g_krn = jax.value_and_grad(loss)(params)
+    assert abs(float(l_jnp) - float(l_krn)) < 1e-3
+    for va, vb in zip(jax.tree_util.tree_leaves(g_jnp),
+                      jax.tree_util.tree_leaves(g_krn)):
+        assert np.allclose(np.asarray(va), np.asarray(vb), atol=1e-3)

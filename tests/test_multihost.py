@@ -152,8 +152,13 @@ pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 import jax
+# heartbeat_timeout_seconds: keep the coordination service's OWN failure
+# escalation (error-poll -> fatal process termination) out of the test
+# window — detection must come from Heartbeat.beat's watchdog, and the
+# service's async fatal would otherwise race it under heavy CI load
 jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n,
-                           process_id=pid)
+                           process_id=pid,
+                           heartbeat_timeout_seconds=600)
 from bigdl_tpu.parallel.failure import Heartbeat, HeartbeatLost
 
 hb = Heartbeat()
