@@ -91,7 +91,10 @@ def _build_resnet_step(batch, size):
     # BN+ReLU+matmul+stats blocks (models/resnet.py FusedBottleneck) —
     # the on-chip A/B lever for the conv-stack MFU push.
     fused = "pallas" if os.environ.get("BENCH_FUSED") == "1" else "none"
-    model = ResNet(class_num=1000, depth=50, format="NHWC", fused=fused)
+    # BENCH_POOL_GRAD=fast enables the scatter-free maxpool backward
+    # (nn/pool.py) — the second pending on-chip A/B lever
+    model = ResNet(class_num=1000, depth=50, format="NHWC", fused=fused,
+                   pool_grad=os.environ.get("BENCH_POOL_GRAD", "exact"))
     params, mstate = model.init(jax.random.PRNGKey(0))
     crit = CrossEntropyCriterion()
     optim = SGD(learningrate=0.1, momentum=0.9)

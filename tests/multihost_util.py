@@ -1,0 +1,98 @@
+"""Shared pieces of the multi-controller tests (driver script text +
+port helper) — imported by test_multihost*.py, which are separate
+files so pytest-xdist loadfile sharding overlaps them."""
+import socket
+
+
+_DRIVER = r"""
+import os, sys
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+dp = 8 // n  # devices per process: 8-device global mesh regardless of n
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={dp}"
+import jax
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n,
+                           process_id=pid)
+import numpy as np
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental import multihost_utils
+
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == dp
+
+# 1) coordinator-level allgather (heartbeat path)
+seen = multihost_utils.process_allgather(jnp.asarray([float(pid)]))
+assert sorted(np.asarray(seen).reshape(-1).tolist()) == [float(i) for i in
+                                                         range(n)], seen
+
+# 2) cross-process psum over the global mesh
+mesh = Mesh(np.array(jax.devices()), ("data",))
+sharding = NamedSharding(mesh, P("data"))
+local = np.full((dp,), float(pid + 1), np.float32)  # dp per process
+garr = jax.make_array_from_process_local_data(sharding, local)
+out = jax.jit(shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                        in_specs=P("data"), out_specs=P()),
+              out_shardings=NamedSharding(mesh, P()))(garr)
+# psum of per-device values: dp devices carry (pid+1) for each pid
+expect = float(sum((i + 1) * dp for i in range(n)))
+total = float(np.asarray(jax.device_get(
+    out.addressable_shards[0].data)).reshape(-1)[0])
+assert total == expect, (total, expect)
+
+# 3) hybrid DCN x ICI mesh in a real 2-process topology
+from bigdl_tpu.parallel.mesh import make_hybrid_mesh
+hmesh = make_hybrid_mesh(ici_shape=(1, dp), dcn_shape=(n, 1),
+                         axes=("data", "model"))
+assert hmesh.devices.shape == (n, dp)
+# the ICI (model) axis must stay inside one process
+for row in hmesh.devices:
+    assert len({d.process_index for d in row}) == 1, hmesh.devices
+
+# 4) full DistriOptimizer training across processes: each process feeds its
+# LOCAL data split (the reference's per-partition reads); gradients psum
+# over the global 'data' axis spanning both processes
+from bigdl_tpu import nn
+from bigdl_tpu.models import LeNet5
+from bigdl_tpu.optim import DistriOptimizer, SGD, MaxIteration
+from bigdl_tpu.dataset import DataSet, mnist
+
+dmesh = Mesh(np.array(jax.devices()), ("data",))
+imgs, labels = mnist.load(n_synthetic=64)
+# per-process split: each controller feeds a DIFFERENT slice of the data
+per = 64 // n
+imgs = imgs[pid * per:(pid + 1) * per]
+labels = labels[pid * per:(pid + 1) * per]
+ds = DataSet.array(mnist.to_samples(imgs, labels))
+opt = DistriOptimizer(LeNet5(10), ds, nn.ClassNLLCriterion(),
+                      SGD(learningrate=0.01), MaxIteration(2),
+                      batch_size=8, mesh=dmesh)
+opt.optimize()
+loss = float(opt.optim_method.state["loss"])
+assert np.isfinite(loss), loss
+# every process must agree on the replicated loss/params
+agreed = multihost_utils.process_allgather(jnp.asarray([loss]))
+assert np.allclose(np.asarray(agreed).reshape(-1), loss), agreed
+
+# 5) ZeRO-1 sharded-optimizer variant over the same 2-process mesh
+ds2 = DataSet.array(mnist.to_samples(imgs, labels))
+opt2 = DistriOptimizer(LeNet5(10), ds2, nn.ClassNLLCriterion(),
+                       SGD(learningrate=0.01), MaxIteration(2),
+                       batch_size=8, mesh=dmesh,
+                       parameter_mode="zero1", compress="bf16")
+opt2.optimize()
+assert np.isfinite(float(opt2.optim_method.state["loss"]))
+
+print(f"MULTIHOST_OK_{pid}")
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+

@@ -31,36 +31,10 @@ def test_resnet18_like_cifar_forward():
     assert out.shape == (2, 10)
 
 
-def test_resnet50_forward_tiny():
-    m = models.ResNet(class_num=100, depth=50)
-    x = np.random.randn(1, 3, 64, 64).astype(np.float32)  # small spatial
-    m.evaluate()
-    out = m.forward(x)
-    assert out.shape == (1, 100)
-    # ~25.5M params for class_num=1000; with 100 classes slightly fewer
-    n = _count_params(m)
-    assert 23_000_000 < n < 26_000_000, n
-
-
 def test_resnet_param_count_matches_torch_resnet50():
     m = models.ResNet(class_num=1000, depth=50)
     n = _count_params(m)
     assert n == 25_557_032, n  # torchvision resnet50 param count
-
-
-def test_vgg_cifar_forward():
-    m = models.VggForCifar10(10)
-    m.evaluate()
-    x = np.random.randn(2, 3, 32, 32).astype(np.float32)
-    assert m.forward(x).shape == (2, 10)
-
-
-def test_inception_v1_forward():
-    m = models.Inception_v1(1000)
-    m.evaluate()
-    x = np.random.randn(1, 3, 224, 224).astype(np.float32)
-    out = m.forward(x)
-    assert out.shape == (1, 1000)
 
 
 def test_ptb_model_forward():

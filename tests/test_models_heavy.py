@@ -1,0 +1,35 @@
+"""Compile-heavy model forwards (ResNet-50, VGG, Inception) — split
+from test_models.py so pytest-xdist loadfile sharding overlaps them
+with the rest (each is tens of seconds of XLA compile on CPU)."""
+import numpy as np
+
+from bigdl_tpu import models
+from test_models import _count_params
+
+
+def test_resnet50_forward_tiny():
+    m = models.ResNet(class_num=100, depth=50)
+    x = np.random.randn(1, 3, 64, 64).astype(np.float32)  # small spatial
+    m.evaluate()
+    out = m.forward(x)
+    assert out.shape == (1, 100)
+    # ~25.5M params for class_num=1000; with 100 classes slightly fewer
+    n = _count_params(m)
+    assert 23_000_000 < n < 26_000_000, n
+
+
+def test_vgg_cifar_forward():
+    m = models.VggForCifar10(10)
+    m.evaluate()
+    x = np.random.randn(2, 3, 32, 32).astype(np.float32)
+    assert m.forward(x).shape == (2, 10)
+
+
+def test_inception_v1_forward():
+    m = models.Inception_v1(1000)
+    m.evaluate()
+    x = np.random.randn(1, 3, 224, 224).astype(np.float32)
+    out = m.forward(x)
+    assert out.shape == (1, 1000)
+
+

@@ -1,0 +1,8 @@
+"""4-process variant of the multi-controller test — its own file so
+pytest-xdist loadfile sharding runs it in parallel with the 2-process one
+(the suite's wall time is the slowest FILE)."""
+
+
+def test_four_process_distributed():
+    from test_multihost import test_multi_process_distributed
+    test_multi_process_distributed(4)

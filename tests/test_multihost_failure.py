@@ -1,0 +1,81 @@
+"""Heartbeat failure-injection test — own file for loadfile sharding
+(see tests/test_multihost.py for the 2-process rendezvous basics)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from multihost_util import _free_port
+
+
+_FAILURE_DRIVER = r"""
+import os, sys, time
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+# heartbeat_timeout_seconds: keep the coordination service's OWN failure
+# escalation (error-poll -> fatal process termination) out of the test
+# window — detection must come from Heartbeat.beat's watchdog, and the
+# service's async fatal would otherwise race it under heavy CI load
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n,
+                           process_id=pid,
+                           heartbeat_timeout_seconds=600)
+from bigdl_tpu.parallel.failure import Heartbeat, HeartbeatLost
+
+hb = Heartbeat()
+for i in range(100):
+    if pid == n - 1 and i == 2:
+        # simulated host death: no shutdown handshake, no exit notice —
+        # the peers' next heartbeat exchange must detect it
+        os._exit(0)
+    try:
+        stale = hb.beat(timeout_s=20.0)
+    except HeartbeatLost as e:
+        # detection -> clean halt (the real loop would checkpoint here).
+        # os._exit, not sys.exit: atexit would run jax.distributed.shutdown,
+        # whose shutdown barrier can never complete with a dead peer — the
+        # distributed channel is already lost, leave without the handshake
+        print(f"DETECTED_{pid}: {e}", flush=True)
+        os._exit(0)
+    time.sleep(0.2)
+raise SystemExit(f"process {pid} never detected the dead peer")
+"""
+
+
+def test_heartbeat_detects_killed_process():
+    """Failure injection (VERDICT r2 #8): one of 4 processes dies without
+    ceremony mid-run; every survivor's next Heartbeat.beat(timeout_s=...)
+    raises HeartbeatLost and the process halts cleanly (rc 0) instead of
+    stalling in the collective forever. Reference analog: Spark task-failure
+    detection feeding DistriOptimizer's retry (optim/DistriOptimizer.scala)."""
+    try:
+        port = _free_port()
+    except OSError:
+        pytest.skip("no localhost sockets in this sandbox")
+    n = 4
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _FAILURE_DRIVER, str(pid), str(n), str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(n)]
+    outs = []
+    for pid, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p2 in procs:
+                p2.kill()
+            raise
+        outs.append((pid, proc.returncode, out, err))
+    for pid, rc, out, err in outs:
+        assert rc == 0, f"process {pid} failed:\n{err[-3000:]}"
+        if pid < n - 1:  # survivors must have DETECTED the death
+            assert f"DETECTED_{pid}" in out, \
+                f"process {pid} did not detect the dead peer:\n{out}\n{err[-1500:]}"
