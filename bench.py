@@ -456,7 +456,8 @@ def _orchestrate(which: str):
     (a previous real measurement, flagged ``cached``), then CPU fallback."""
     attempts = [
         (os.environ.copy(), 800.0, "tpu attempt 1"),
-        (os.environ.copy(), 420.0, "tpu attempt 2"),
+        (os.environ.copy(), 600.0, "tpu attempt 2"),
+        (os.environ.copy(), 420.0, "tpu attempt 3"),
     ]
     errors = []
     if _TUNNEL_STATE["probed"] and not _TUNNEL_STATE["alive"]:
@@ -476,7 +477,7 @@ def _orchestrate(which: str):
             break  # a second TPU attempt would degrade identically
         errors.append(f"{label}: {err}")
         if i + 1 < len(attempts):
-            # the attempt failed on its own 800s budget: one probe child
+            # the attempt failed on its own timeout budget: one probe child
             # decides whether a retry can possibly succeed (healthy runs
             # never pay for the probe)
             if not _tunnel_alive():

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..nn.attention import (LayerNormalization, TransformerBlock,
-                            causal_mask, embed_ids)
+                            embed_ids)
 from ..nn.moe import MixtureOfExperts
 from ..nn.module import Module
 from ..utils.table import Table
@@ -29,7 +29,7 @@ class MoETransformerLM(Module):
                  num_heads: int = 4, filter_size: int = 1024,
                  num_layers: int = 4, n_experts: int = 4,
                  moe_every: int = 2, capacity_factor: float = 1.25,
-                 max_len: int = 2048, name=None):
+                 max_len: int = 2048, use_flash: bool = True, name=None):
         super().__init__(name=name)
         self.vocab_size, self.hidden_size = vocab_size, hidden_size
         self.max_len = max_len
@@ -39,10 +39,12 @@ class MoETransformerLM(Module):
             if i in self.moe_idx:
                 self.blocks.append(_MoEBlock(hidden_size, num_heads,
                                              filter_size, n_experts,
-                                             capacity_factor))
+                                             capacity_factor,
+                                             use_flash=use_flash))
             else:
                 self.blocks.append(TransformerBlock(hidden_size, num_heads,
-                                                    filter_size))
+                                                    filter_size, causal=True,
+                                                    use_flash=use_flash))
         self.ln_f = LayerNormalization(hidden_size)
 
     def _init_params(self, rng):
@@ -60,7 +62,9 @@ class MoETransformerLM(Module):
     def _apply(self, params, state, x, training, rng):
         ids = x
         h = embed_ids(params["embed"], ids, self.hidden_size)
-        mask = causal_mask(ids.shape[1])
+        # causal masking lives inside the blocks (flash-friendly — no
+        # materialised (T, T) mask, mirroring Transformer's LM mode)
+        mask = None
         aux = jnp.zeros((), h.dtype)
         for i, blk in enumerate(self.blocks):
             r = jax.random.fold_in(rng, i) if rng is not None else None
@@ -82,8 +86,10 @@ class _MoEBlock(TransformerBlock):
     the two block types cannot drift."""
 
     def __init__(self, hidden_size: int, num_heads: int, filter_size: int,
-                 n_experts: int, capacity_factor: float, name=None):
-        super().__init__(hidden_size, num_heads, filter_size, name=name)
+                 n_experts: int, capacity_factor: float,
+                 use_flash: bool = True, name=None):
+        super().__init__(hidden_size, num_heads, filter_size, causal=True,
+                         use_flash=use_flash, name=name)
         self.ffn = MixtureOfExperts(hidden_size, n_experts,
                                     ffn_hidden=filter_size,
                                     capacity_factor=capacity_factor)

@@ -1,0 +1,100 @@
+"""SSD-style detection through the ImageFrame pipeline.
+
+The reference's detection examples pipe images through
+``ImageFrame.read → transform(...) → MTImageFeatureToBatch → model``
+(transform/vision/image/ImageFrame.scala + MTImageFeatureToBatch.scala);
+this example runs the same call stack end-to-end: a folder of real JPEGs
+(written with the native libjpeg encoder), vision transforms, the frame
+batcher with bbox carriage, a tiny conv backbone with PriorBox heads, and
+``DetectionOutputSSD`` post-processing (decode + per-class NMS).
+
+Self-asserting (exits nonzero on failure) like every example here.
+Run: JAX_PLATFORMS=cpu PYTHONPATH=. python examples/ssd_image_frame.py
+"""
+import os
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.transform import ImageFrame, MTImageFeatureToBatch, vision
+from bigdl_tpu.utils.table import Table
+
+SIZE = 64
+
+
+def make_jpeg_folder(root, n=6):
+    from bigdl_tpu.native import encode_jpeg, jpeg_available
+    if not jpeg_available():
+        raise SystemExit(0)  # no libjpeg in this environment — skip cleanly
+    rng = np.random.RandomState(0)
+    os.makedirs(root, exist_ok=True)
+    for i in range(n):
+        img = (rng.rand(72, 80, 3) * 255).astype(np.uint8)
+        img[20:40, 30:60] = (255, 0, 0)  # a red "object"
+        with open(os.path.join(root, f"{i}.jpg"), "wb") as f:
+            f.write(encode_jpeg(img))
+
+
+def main():
+    # fresh per-run dir: a fixed shared path could hold stale files from
+    # edited runs and break the exact-count assert below
+    root = tempfile.mkdtemp(prefix="ssd_frame_demo_")
+    make_jpeg_folder(root)
+
+    # 1) frame pipeline: read -> transform -> batches
+    frame = ImageFrame.read(root)
+    t = vision.Resize(SIZE, SIZE) | \
+        vision.ChannelNormalize(127.0, 127.0, 127.0, 128.0, 128.0, 128.0)
+    frame = frame.transform(t)
+    assert len(frame) == 6
+    batches = list(MTImageFeatureToBatch(SIZE, SIZE, batch_size=3,
+                                         with_bbox=True)(frame))
+    assert [b.input.shape for b in batches] == [(3, 3, SIZE, SIZE)] * 2
+
+    # 2) a tiny SSD-ish head: conv backbone -> loc + conf maps + priors
+    n_classes, feat = 3, SIZE // 8
+    backbone = nn.Sequential(
+        nn.SpatialConvolution(3, 16, 3, 3, 2, 2, 1, 1), nn.ReLU(),
+        nn.SpatialConvolution(16, 16, 3, 3, 2, 2, 1, 1), nn.ReLU(),
+        nn.SpatialConvolution(16, 16, 3, 3, 2, 2, 1, 1), nn.ReLU())
+    prior = nn.PriorBox(min_sizes=[16.0], max_sizes=[32.0],
+                        aspect_ratios=[2.0], is_flip=True, is_clip=True,
+                        img_size=SIZE, step=8.0,
+                        variances=(0.1, 0.1, 0.2, 0.2))
+    n_anchor = prior.num_priors
+    loc_head = nn.SpatialConvolution(16, n_anchor * 4, 3, 3, 1, 1, 1, 1)
+    conf_head = nn.SpatialConvolution(16, n_anchor * n_classes, 3, 3, 1, 1,
+                                      1, 1)
+    out_head = nn.DetectionOutputSSD(n_classes=n_classes, keep_topk=10,
+                                     conf_thresh=0.01).evaluate()
+
+    x = jnp.asarray(batches[0].input)
+    fmap = backbone.forward(x)
+    assert fmap.shape == (3, 16, feat, feat)
+    priors = prior.forward(fmap)                       # (1, 2, nPriors*4)
+    loc = loc_head.forward(fmap).transpose(0, 2, 3, 1).reshape(3, -1)
+    conf = conf_head.forward(fmap).transpose(0, 2, 3, 1).reshape(3, -1)
+    n_priors = priors.shape[2] // 4
+    assert loc.shape[1] == n_priors * 4
+
+    # 3) SSD post-processing: decode + NMS -> [label, score, box] rows
+    dets = np.asarray(out_head.forward(Table(loc, conf, priors)))
+    assert dets.shape == (3, 1 + 10 * 6)
+    counts = dets[:, 0].astype(int)
+    assert (counts >= 0).all() and (counts <= 10).all()
+    for b in range(3):
+        rows = dets[b, 1:1 + counts[b] * 6].reshape(-1, 6)
+        if len(rows):
+            labels, scores = rows[:, 0], rows[:, 1]
+            assert ((labels >= 1) & (labels < n_classes)).all()
+            assert ((scores > 0) & (scores <= 1.0001)).all()
+    print(f"ssd_image_frame OK: {counts.sum()} detections over "
+          f"{len(counts)} images (untrained net — counts are arbitrary, "
+          f"the pipeline shape/range contracts are what is asserted)")
+
+
+if __name__ == "__main__":
+    main()
