@@ -40,16 +40,23 @@ def lm_loss_chunked(h, embed, targets, chunk: int = 128,
     """Tied-projection softmax cross-entropy over hidden states without
     materialising the full (B, T, vocab) logits.
 
-    Equivalent to ``TimeDistributedMaskCriterion(CrossEntropyCriterion(),
-    padding_value)(h @ embed.T, targets)`` — 1-based integer targets,
-    ``padding_value`` entries excluded, mean over valid positions — but
-    computed as a ``lax.scan`` over sequence chunks whose body is wrapped in
+    Computed as a ``lax.scan`` over sequence chunks whose body is wrapped in
     ``jax.checkpoint``: forward AND backward only ever hold one
     (B, chunk, vocab) logits block (f32), turning the loss head's HBM
     high-water mark from O(T·vocab) into O(chunk·vocab).
 
+    Token-id convention: targets are RAW token ids — 0-based rows of the
+    tied embedding, so logits column ``j`` means "next token is ``j``" and
+    ``argmax(logits)`` round-trips through ``Transformer.generate``
+    directly. (This deliberately differs from the torch-parity
+    ``ClassNLLCriterion`` family's 1-based CLASS labels: a 1-based head
+    over a tied embedding would train every logit column to mean
+    "token j+1" and make greedy decoding off by one — caught by
+    ``examples/lm_generate.py``.) ``padding_value`` entries (default 0 —
+    reserve id 0 for padding) are excluded; mean over valid positions.
+
     h: (B, T, H) hidden states; embed: (vocab, H) tied embedding;
-    targets: (B, T) 1-based ids (``padding_value`` = ignore).
+    targets: (B, T) token ids (``padding_value`` = ignore).
     """
     B, T, H = h.shape
     if T % chunk != 0:
@@ -66,7 +73,7 @@ def lm_loss_chunked(h, embed, targets, chunk: int = 128,
     def chunk_loss(hx, emb, yx):
         logits = (hx @ emb.T).astype(jnp.float32)              # (B,c,V)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        idx = jnp.clip(yx - 1, 0, logits.shape[-1] - 1)
+        idx = jnp.clip(yx, 0, logits.shape[-1] - 1)  # raw token ids
         gold = jnp.take_along_axis(logits, idx[..., None],
                                    axis=-1)[..., 0]
         valid = (yx != padding_value).astype(jnp.float32)

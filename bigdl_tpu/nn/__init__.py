@@ -77,7 +77,8 @@ from .criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                         CosineEmbeddingCriterion, HingeEmbeddingCriterion,
                         L1HingeEmbeddingCriterion, MarginRankingCriterion,
                         SoftmaxWithCriterion, TimeDistributedCriterion,
-                        TimeDistributedMaskCriterion, ParallelCriterion,
+                        TimeDistributedMaskCriterion, LMCriterion,
+                        ParallelCriterion,
                         MultiCriterion, L1Cost, DiceCoefficientCriterion,
                         MeanAbsolutePercentageCriterion,
                         MeanSquaredLogarithmicCriterion, PoissonCriterion,

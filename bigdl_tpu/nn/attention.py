@@ -98,6 +98,30 @@ class Attention(Module):
         b, t, _ = x.shape
         return x.reshape(b, t, self.num_heads, -1).transpose(0, 2, 1, 3)
 
+    def qkv(self, params, qx, kx=None):
+        """Projected (B, nH, T, D) query/key/value heads."""
+        kx = qx if kx is None else kx
+        return (self._split(qx @ params["wq"]),
+                self._split(kx @ params["wk"]),
+                self._split(kx @ params["wv"]))
+
+    def _merge(self, o, params):
+        b, h, t, d = o.shape
+        return o.transpose(0, 2, 1, 3).reshape(b, t, h * d) @ params["wo"]
+
+    def decode(self, params, x_t, k_cache, v_cache, pos):
+        """One autoregressive step: project the current token, write its
+        K/V into the cache at ``pos`` (traced scalar), attend over
+        positions <= pos. x_t: (B, 1, H); caches: (B, nH, Tmax, D).
+        Returns (out (B, 1, H), k_cache, v_cache)."""
+        q, k_t, v_t = self.qkv(params, x_t)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k_t.astype(k_cache.dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v_t.astype(v_cache.dtype), (0, 0, pos, 0))
+        o = _decode_attention(q, k_cache, v_cache, pos)
+        return self._merge(o, params), k_cache, v_cache
+
     def _apply(self, params, state, x, training, rng):
         if isinstance(x, Table):
             qx = x[1]
@@ -105,9 +129,7 @@ class Attention(Module):
             mask = x[3] if len(x) >= 3 else None
         else:
             qx, kx, mask = x, x, None
-        q = self._split(qx @ params["wq"])
-        k = self._split(kx @ params["wk"])
-        v = self._split(kx @ params["wv"])
+        q, k, v = self.qkv(params, qx, kx)
         if self.seq_axis is not None:
             if mask is not None:
                 raise ValueError(
@@ -128,9 +150,23 @@ class Attention(Module):
                 mask = causal_mask(q.shape[2])
             o = dot_product_attention(q, k, v, mask,
                                       self.attention_dropout, rng, training)
-        b, h, t, d = o.shape
-        o = o.transpose(0, 2, 1, 3).reshape(b, t, h * d)
-        return o @ params["wo"]
+        return self._merge(o, params)
+
+
+def _decode_attention(q, cache_k, cache_v, pos):
+    """Single-position attention over a KV cache.
+
+    q: (B, H, 1, D); cache_k/v: (B, H, Tmax, D) with positions > pos
+    holding garbage — masked by position, so the cache never needs
+    zeroing. Returns (B, H, 1, D). O(Tmax) per step; the einsum is tiny
+    (one query row), so no flash kernel is needed on the decode path."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k) / math.sqrt(d)
+    t = cache_k.shape[2]
+    keep = jnp.arange(t)[None, None, None, :] <= pos
+    logits = jnp.where(keep, logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, cache_v)
 
 
 class FeedForwardNetwork(Module):
@@ -230,6 +266,35 @@ class TransformerBlock(Module):
         f, _ = self.ffn.apply(params["ffn"], {}, n, training, r2)
         return h + f
 
+    def _ffn_sublayer(self, params, h):
+        n, _ = self.ln2.apply(params["ln2"], {}, h, False, None)
+        f, _ = self.ffn.apply(params["ffn"], {}, n, False, None)
+        return h + f
+
+    def prefill(self, params, h):
+        """Causal forward over a full prompt that also RETURNS the
+        projected K/V heads (for the decode cache). (h, (k, v)).
+        Honors the block's ``use_flash`` choice exactly like ``_apply``
+        (a model configured off the Pallas path must prefill through the
+        same attention implementation it trained with)."""
+        n, _ = self.ln1.apply(params["ln1"], {}, h, False, None)
+        q, k, v = self.attn.qkv(params["attn"], n)
+        if self.attn.use_flash:
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = dot_product_attention(q, k, v, causal_mask(q.shape[2]))
+        h = h + self.attn._merge(o, params["attn"])
+        return self._ffn_sublayer(params, h), (k, v)
+
+    def decode_step(self, params, h_t, kv, pos):
+        """One cached autoregressive step. h_t: (B, 1, H);
+        kv: (k_cache, v_cache); pos: traced scalar position."""
+        n, _ = self.ln1.apply(params["ln1"], {}, h_t, False, None)
+        a, k_cache, v_cache = self.attn.decode(params["attn"], n, kv[0],
+                                               kv[1], pos)
+        h_t = h_t + a
+        return self._ffn_sublayer(params, h_t), (k_cache, v_cache)
+
 
 class Transformer(Module):
     """Transformer (nn/Transformer.scala). ``mode='lm'`` (decoder-only causal
@@ -325,3 +390,94 @@ class Transformer(Module):
         # LM mode: causal masking lives inside the blocks (flash path)
         h = self.hidden_states(params, x, training, rng)
         return h @ params["embed"].T  # tied output projection
+
+    # ---- autoregressive inference (KV cache; TPU-first, the reference's
+    # Transformer is training-only) --------------------------------------
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        """Per-block (k, v) caches shaped (B, nH, max_len, D). Positions
+        beyond the current one hold garbage — decode masks by position."""
+        nh = self.blocks[0].attn.num_heads
+        d = self.hidden_size // nh
+        return [(jnp.zeros((batch, nh, max_len, d), dtype),) * 2
+                for _ in self.blocks]
+
+    def prefill(self, params, ids, max_len: int):
+        """Run the prompt once, returning (last-position logits, caches).
+        ids: (B, Tp) with Tp <= max_len."""
+        assert self.mode == "lm"
+        B, Tp = ids.shape
+        h = self._embed(params, ids)
+        caches = self.init_cache(B, max_len, h.dtype)
+        for i, blk in enumerate(self.blocks):
+            h, (k, v) = blk.prefill(params[f"block{i}"], h)
+            caches[i] = (jax.lax.dynamic_update_slice(
+                caches[i][0], k.astype(caches[i][0].dtype), (0, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(
+                caches[i][1], v.astype(caches[i][1].dtype), (0, 0, 0, 0)))
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
+        return h[:, -1] @ params["embed"].T, caches
+
+    def decode_one(self, params, tokens, pos, caches):
+        """One cached step. tokens: (B,) int ids at position ``pos``
+        (traced scalar). Returns (logits (B, V), caches)."""
+        emb = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+        pe = position_encoding(self.max_len, self.hidden_size,
+                               emb.dtype)
+        h = (emb * math.sqrt(self.hidden_size)
+             + jax.lax.dynamic_slice_in_dim(pe, pos, 1, 0))[:, None, :]
+        new_caches = []
+        for i, blk in enumerate(self.blocks):
+            h, kv = blk.decode_step(params[f"block{i}"], h, caches[i], pos)
+            new_caches.append(kv)
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
+        return h[:, 0] @ params["embed"].T, new_caches
+
+    def generate(self, params, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, rng=None, top_k: int = 0):
+        """Autoregressive generation with a KV cache: prefill the prompt,
+        then ``lax.scan`` one fused decode step per token (greedy when
+        ``temperature`` == 0, else temperature/top-k sampling). Returns
+        (B, Tp + max_new_tokens) ids. Jit-compatible end to end.
+
+        Token-id convention: logits column ``j`` is taken as token ``j``
+        (the tied embedding's own indexing) — train with
+        ``models.lm_loss_chunked`` (0-based head). A model trained with
+        the torch-parity 1-BASED criteria (``CrossEntropyCriterion`` et
+        al. treat target ``t`` as column ``t-1``) would decode off by one
+        here."""
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        B, Tp = prompt_ids.shape
+        if max_new_tokens <= 0:
+            return prompt_ids
+        total = Tp + max_new_tokens
+        assert total <= self.max_len, (total, self.max_len)
+        logits, caches = self.prefill(params, prompt_ids, total)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        def pick(logits, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            l = logits / temperature
+            if top_k > 0:
+                k_eff = min(top_k, l.shape[-1])
+                kth = jnp.sort(l, axis=-1)[:, -k_eff][:, None]
+                l = jnp.where(l < kth, -1e30, l)
+            return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
+
+        key0, rng = jax.random.split(rng)
+        first = pick(logits, key0)
+
+        def body(carry, step_key):
+            caches, tok, pos = carry
+            logits, caches = self.decode_one(params, tok, pos, caches)
+            nxt = pick(logits, step_key)
+            return (caches, nxt, pos + 1), tok
+
+        keys = jax.random.split(rng, max(max_new_tokens - 1, 1))
+        (_, last, _), toks = jax.lax.scan(
+            body, (caches, first, jnp.int32(Tp)), keys[:max_new_tokens - 1])
+        out = jnp.concatenate(
+            [prompt_ids, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+        return out

@@ -387,6 +387,32 @@ class TimeDistributedCriterion(Criterion):
         return total / steps if self.size_average else total
 
 
+class LMCriterion(Criterion):
+    """Masked softmax-CE over RAW (0-based) token ids — the language-model
+    head convention (TPU-first addition; the reference's criteria are all
+    1-based torch classes). Logits column ``j`` means "token ``j``", the
+    tied embedding's own indexing, so models trained with this criterion
+    decode directly through ``Transformer.generate``. ``padding_value``
+    targets (default 0 — reserve id 0 for padding) are excluded; mean over
+    valid positions. Accepts (B, T, V) logits with (B, T) targets or the
+    flattened 2-D forms. Same math as ``models.lm_loss_chunked`` (which
+    additionally chunks the vocab projection for HBM)."""
+
+    def __init__(self, padding_value: int = 0):
+        super().__init__(True)
+        self.padding_value = padding_value
+
+    def _forward(self, input, target):
+        logits = input.reshape((-1, input.shape[-1])).astype(jnp.float32)
+        t = jnp.asarray(target).astype(jnp.int32).reshape((-1,))
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        idx = jnp.clip(t, 0, logits.shape[-1] - 1)
+        gold = jnp.take_along_axis(logits, idx[:, None], axis=-1)[:, 0]
+        valid = (t != self.padding_value).astype(jnp.float32)
+        return jnp.sum((lse - gold) * valid) / jnp.maximum(
+            jnp.sum(valid), 1.0)
+
+
 class TimeDistributedMaskCriterion(Criterion):
     """Masked per-timestep criterion (nn/TimeDistributedMaskCriterion.scala).
     padding entries (target == padding_value) are excluded."""

@@ -1,0 +1,77 @@
+"""Train a tiny character LM and generate text with the KV cache.
+
+Beyond the reference (its ``nn/Transformer.scala`` is training-only):
+``Transformer.generate`` runs a prefill pass then one ``lax.scan``-fused
+decode step per token over per-block K/V caches — the standard TPU
+autoregressive-inference shape. This example memorises a short corpus and
+checks greedy generation reproduces it.
+
+Run: JAX_PLATFORMS=cpu PYTHONPATH=. python examples/lm_generate.py
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.models import TransformerLM, lm_loss_chunked
+from bigdl_tpu.optim import Adam
+
+TEXT = "the quick brown fox jumps over the lazy dog. " * 4
+chars = sorted(set(TEXT))
+stoi = {c: i + 1 for i, c in enumerate(chars)}  # 0 = pad
+itos = {i: c for c, i in stoi.items()}
+V = len(chars) + 1
+
+
+def main():
+    seq = np.array([stoi[c] for c in TEXT], np.int32)
+    T = 64
+    # stride = the 45-char sentence period: every window is the same
+    # periodic text at the same positions, so the continuation the
+    # assertion checks is unambiguously memorisable
+    starts = np.arange(0, len(seq) - T - 1, 45)
+    x = np.stack([seq[s:s + T] for s in starts])
+    y = np.stack([seq[s + 1:s + T + 1] for s in starts])
+
+    model = TransformerLM(vocab_size=V, hidden_size=64, num_heads=4,
+                          filter_size=128, num_layers=2, max_len=128)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    optim = Adam(learningrate=3e-3)
+    opt_state = optim.init_state(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            h = model.hidden_states(p, x, training=True,
+                                    rng=jax.random.PRNGKey(1))
+            return lm_loss_chunked(h, p["embed"], y, chunk=32)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = optim.update(grads, params, opt_state,
+                                         jnp.float32(3e-3))
+        return loss, params, opt_state
+
+    xb, yb = jnp.asarray(x), jnp.asarray(y)
+    first = None
+    for i in range(400):
+        loss, params, opt_state = step(params, opt_state, xb, yb)
+        if first is None:
+            first = float(loss)
+    final = float(loss)
+    print(f"loss {first:.3f} -> {final:.3f}")
+    assert final < 0.35, final  # memorised
+
+    # prompt with a full sentence of context, and keep prompt+generation
+    # inside the 64 trained positions (absolute PE rows beyond the
+    # training window length are untrained)
+    prompt_txt = TEXT[:45]
+    prompt = jnp.asarray([[stoi[c] for c in prompt_txt]], jnp.int32)
+    out = model.generate(params, prompt, max_new_tokens=16)
+    text = "".join(itos.get(int(t), "?") for t in np.asarray(out)[0])
+    print("generated:", repr(text[45:]))
+    assert text.startswith(prompt_txt)
+    # greedy continuation reproduces the memorised corpus
+    assert text[45:61] == TEXT[45:61], (text[45:61], TEXT[45:61])
+    print("lm_generate OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,8 +37,11 @@ def main():
 
     model = TransformerLM(vocab_size=args.vocab, hidden_size=128,
                           num_heads=4, filter_size=256, num_layers=2)
-    crit = nn.TimeDistributedMaskCriterion(nn.CrossEntropyCriterion(),
-                                           padding_value=0)
+    # LMCriterion: the 0-based token-id head (logits column j == token j,
+    # the tied embedding's indexing) — models trained with it decode
+    # directly via Transformer.generate (the 1-based torch-parity criteria
+    # would train a permuted head)
+    crit = nn.LMCriterion(padding_value=0)
     opt = DistriOptimizer(model, ds, crit, Adam(learningrate=3e-4),
                           max_iteration(args.iters),
                           batch_size=8 * mesh.shape["data"], mesh=mesh,

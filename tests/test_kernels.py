@@ -156,9 +156,12 @@ def test_lm_remat_matches_plain():
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
-def test_lm_loss_chunked_matches_criterion():
-    """lm_loss_chunked == TimeDistributedMaskCriterion(CE) over full logits,
-    values AND gradients (through a scan-of-checkpoint body)."""
+def test_lm_loss_chunked_matches_full_logits():
+    """lm_loss_chunked == full-logits softmax-CE with RAW (0-based) token
+    ids, values AND gradients (through a scan-of-checkpoint body). The
+    0-based head is what makes argmax(logits) round-trip through
+    generate(); the torch-parity criteria stay 1-based — the identity is
+    chunked(y) == TimeDistributedMaskCriterion(CE)(logits, y+1)."""
     import jax
     from bigdl_tpu.models import lm_loss_chunked
     from bigdl_tpu.nn import (CrossEntropyCriterion,
@@ -167,14 +170,16 @@ def test_lm_loss_chunked_matches_criterion():
     B, T, H, V = 2, 64, 16, 53
     h = jnp.asarray(rng.randn(B, T, H).astype(np.float32))
     emb = jnp.asarray(0.1 * rng.randn(V, H).astype(np.float32))
-    y = rng.randint(1, V, size=(B, T)).astype(np.int32)
+    y = rng.randint(1, V - 1, size=(B, T)).astype(np.int32)
     y[0, :5] = 0  # padding positions excluded
     y = jnp.asarray(y)
-    crit = TimeDistributedMaskCriterion(CrossEntropyCriterion(),
-                                        padding_value=0)
 
     def ref(h, emb):
-        return crit._forward(h @ emb.T, y)
+        logits = (h @ emb.T).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, y[..., None], axis=-1)[..., 0]
+        valid = (y != 0).astype(jnp.float32)
+        return jnp.sum((lse - gold) * valid) / jnp.sum(valid)
 
     def chunked(h, emb):
         return lm_loss_chunked(h, emb, y, chunk=16)
@@ -184,6 +189,13 @@ def test_lm_loss_chunked_matches_criterion():
     assert np.allclose(float(l_ref), float(l_ch), rtol=1e-5)
     for a, b in zip(g_ref, g_ch):
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # identity to the 1-based criterion: shift targets up by one (pad
+    # positions shift to 1 — give the shifted criterion padding_value=1)
+    crit = TimeDistributedMaskCriterion(CrossEntropyCriterion(),
+                                        padding_value=1)
+    l_crit = crit._forward(h @ emb.T, y + 1)
+    assert np.allclose(float(l_crit), float(l_ch), rtol=1e-5)
 
 
 # ---------------------------------------------------------------------------

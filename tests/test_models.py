@@ -149,3 +149,68 @@ def test_moe_transformer_lm_trains():
             first = float(l)
             assert float(gmax) > 0, "no gradient reached expert weights"
     assert float(l) < first, (first, float(l))
+
+
+def test_transformer_lm_generate_matches_naive():
+    """KV-cache generate() == the naive re-forward-everything loop
+    (greedy), and the sampled path stays in-vocab and jit-compiles."""
+    import jax.numpy as jnp
+    from bigdl_tpu.models import TransformerLM
+    model = TransformerLM(vocab_size=61, hidden_size=32, num_heads=2,
+                          filter_size=64, num_layers=2, max_len=32)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(np.random.RandomState(0).randint(
+        1, 61, size=(2, 5)), jnp.int32)
+
+    out = model.generate(params, prompt, max_new_tokens=6)
+    assert out.shape == (2, 11)
+    assert np.array_equal(np.asarray(out[:, :5]), np.asarray(prompt))
+
+    # naive: re-run the full forward each step, argmax the last position
+    ids = prompt
+    for _ in range(6):
+        logits, _ = model.apply(params, {}, ids, training=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+    assert np.array_equal(np.asarray(out), np.asarray(ids)), \
+        (np.asarray(out), np.asarray(ids))
+
+    # sampling path, jitted end to end
+    sampled = jax.jit(lambda p, x: model.generate(
+        p, x, max_new_tokens=4, temperature=0.8, top_k=5,
+        rng=jax.random.PRNGKey(1)))(params, prompt)
+    assert sampled.shape == (2, 9)
+    s = np.asarray(sampled[:, 5:])
+    assert ((s >= 0) & (s < 61)).all()
+
+
+def test_lm_criterion_matches_chunked_head():
+    """nn.LMCriterion == models.lm_loss_chunked (the 0-based LM head) in
+    value and gradient; generate edge cases (max_new_tokens=0, top_k >
+    vocab) behave."""
+    import jax.numpy as jnp
+    from bigdl_tpu.models import TransformerLM, lm_loss_chunked
+    from bigdl_tpu.nn import LMCriterion
+    rng = np.random.RandomState(3)
+    B, T, H, V = 2, 16, 8, 23
+    h = jnp.asarray(rng.randn(B, T, H).astype(np.float32))
+    emb = jnp.asarray(0.2 * rng.randn(V, H).astype(np.float32))
+    y = rng.randint(1, V, size=(B, T)).astype(np.int32)
+    y[1, :3] = 0
+    y = jnp.asarray(y)
+    crit = LMCriterion(padding_value=0)
+    l1, g1 = jax.value_and_grad(lambda h: crit._forward(h @ emb.T, y))(h)
+    l2, g2 = jax.value_and_grad(
+        lambda h: lm_loss_chunked(h, emb, y, chunk=8))(h)
+    assert np.allclose(float(l1), float(l2), rtol=1e-6)
+    assert np.allclose(np.asarray(g1), np.asarray(g2), atol=1e-6)
+
+    model = TransformerLM(vocab_size=V, hidden_size=16, num_heads=2,
+                          filter_size=32, num_layers=1, max_len=32)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(rng.randint(1, V, (1, 4)), jnp.int32)
+    out0 = model.generate(params, prompt, max_new_tokens=0)
+    assert out0.shape == (1, 4)  # contract: Tp + 0
+    outk = model.generate(params, prompt, max_new_tokens=3,
+                          temperature=1.0, top_k=1000)  # > vocab: clipped
+    assert outk.shape == (1, 7)
