@@ -15,8 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..nn.attention import (LayerNormalization, TransformerBlock,
-                            embed_ids)
+from ..nn.attention import (LayerNormalization, Transformer,
+                            TransformerBlock, embed_ids)
 from ..nn.moe import MixtureOfExperts
 from ..nn.module import Module
 from ..utils.table import Table
@@ -33,6 +33,7 @@ class MoETransformerLM(Module):
         super().__init__(name=name)
         self.vocab_size, self.hidden_size = vocab_size, hidden_size
         self.max_len = max_len
+        self.mode = "lm"  # the Transformer inference machinery's guard
         self.blocks = []
         self.moe_idx = set(range(moe_every - 1, num_layers, moe_every))
         for i in range(num_layers):
@@ -59,6 +60,9 @@ class MoETransformerLM(Module):
     def _init_state(self):
         return {"aux_loss": jnp.zeros(())}
 
+    def _embed(self, params, ids):
+        return embed_ids(params["embed"], ids, self.hidden_size)
+
     def _apply(self, params, state, x, training, rng):
         ids = x
         h = embed_ids(params["embed"], ids, self.hidden_size)
@@ -78,6 +82,18 @@ class MoETransformerLM(Module):
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, training, None)
         logits = h @ params["embed"].T  # tied output projection
         return logits, {"aux_loss": aux}
+
+    # ---- autoregressive inference: the shared Transformer machinery,
+    # bound as-is (blocks inherit prefill/decode_step; MoE routing is
+    # token-level, so cached decode routes each new token normally).
+    # Caveat: expert capacity is computed per forward — a full-sequence
+    # forward can DROP tokens at tight capacity_factor where one-token
+    # decode steps never do, so cached and naive decoding can differ
+    # exactly when the full forward would have dropped a token ----
+    init_cache = Transformer.init_cache
+    prefill = Transformer.prefill
+    decode_one = Transformer.decode_one
+    generate = Transformer.generate
 
 
 class _MoEBlock(TransformerBlock):

@@ -204,10 +204,13 @@ def position_encoding(length, hidden_size, dtype=jnp.float32):
 
 def embed_ids(embed, ids, hidden_size):
     """Token embedding + sqrt(d) scale + sinusoidal positions (the LM
-    input head shared by Transformer and MoETransformerLM)."""
+    input head shared by Transformer and MoETransformerLM). The PE is cast
+    to the embedding dtype — an f32 PE added to bf16 embeddings would
+    silently promote EVERY downstream activation (and the KV caches) to
+    f32, doubling HBM traffic in what looks like a bf16 model."""
     h = jnp.take(embed, ids.astype(jnp.int32), axis=0)
     h = h * math.sqrt(hidden_size)
-    return h + position_encoding(ids.shape[1], hidden_size)
+    return h + position_encoding(ids.shape[1], hidden_size, h.dtype)
 
 
 class TransformerBlock(Module):
@@ -462,7 +465,8 @@ class Transformer(Module):
             l = logits / temperature
             if top_k > 0:
                 k_eff = min(top_k, l.shape[-1])
-                kth = jnp.sort(l, axis=-1)[:, -k_eff][:, None]
+                # lax.top_k: O(V) threshold, not a full per-step sort
+                kth = jax.lax.top_k(l, k_eff)[0][:, -1:]
                 l = jnp.where(l < kth, -1e30, l)
             return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
 
