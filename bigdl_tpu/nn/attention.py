@@ -289,13 +289,31 @@ class TransformerBlock(Module):
         h = h + self.attn._merge(o, params["attn"])
         return self._ffn_sublayer(params, h), (k, v)
 
-    def decode_step(self, params, h_t, kv, pos):
+    def cross_kv(self, params, enc):
+        """Precompute the cross-attention K/V heads from the encoder
+        output (constant across decode steps); the query projection is
+        per-step, so only K/V are built here."""
+        assert self.with_cross
+        p = params["cross"]
+        return (self.cross._split(enc @ p["wk"]),
+                self.cross._split(enc @ p["wv"]))
+
+    def decode_step(self, params, h_t, kv, pos, cross_kv=None,
+                    cross_mask=None):
         """One cached autoregressive step. h_t: (B, 1, H);
-        kv: (k_cache, v_cache); pos: traced scalar position."""
+        kv: (k_cache, v_cache); pos: traced scalar position. For
+        translation-mode blocks pass the precomputed ``cross_kv`` and the
+        additive source-padding ``cross_mask``."""
         n, _ = self.ln1.apply(params["ln1"], {}, h_t, False, None)
         a, k_cache, v_cache = self.attn.decode(params["attn"], n, kv[0],
                                                kv[1], pos)
         h_t = h_t + a
+        if self.with_cross and cross_kv is not None:
+            n, _ = self.ln3.apply(params["ln3"], {}, h_t, False, None)
+            q = self.cross._split(n @ params["cross"]["wq"])
+            o = dot_product_attention(q, cross_kv[0], cross_kv[1],
+                                      cross_mask)
+            h_t = h_t + self.cross._merge(o, params["cross"])
         return self._ffn_sublayer(params, h_t), (k_cache, v_cache)
 
 
@@ -421,9 +439,12 @@ class Transformer(Module):
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
         return h[:, -1] @ params["embed"].T, caches
 
-    def decode_one(self, params, tokens, pos, caches):
+    def decode_one(self, params, tokens, pos, caches, cross=None,
+                   cross_mask=None):
         """One cached step. tokens: (B,) int ids at position ``pos``
-        (traced scalar). Returns (logits (B, V), caches)."""
+        (traced scalar). Returns (logits (B, V), caches). Translation-mode
+        callers pass per-block precomputed ``cross`` K/V and the source
+        padding ``cross_mask``."""
         emb = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
         pe = position_encoding(self.max_len, self.hidden_size,
                                emb.dtype)
@@ -431,7 +452,9 @@ class Transformer(Module):
              + jax.lax.dynamic_slice_in_dim(pe, pos, 1, 0))[:, None, :]
         new_caches = []
         for i, blk in enumerate(self.blocks):
-            h, kv = blk.decode_step(params[f"block{i}"], h, caches[i], pos)
+            h, kv = blk.decode_step(
+                params[f"block{i}"], h, caches[i], pos,
+                cross[i] if cross is not None else None, cross_mask)
             new_caches.append(kv)
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
         return h[:, 0] @ params["embed"].T, new_caches
@@ -485,3 +508,39 @@ class Transformer(Module):
         out = jnp.concatenate(
             [prompt_ids, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
         return out
+
+    def translate(self, params, src_ids, max_new_tokens: int,
+                  bos_id: int = 1, eos_id=None):
+        """Greedy encoder-decoder decoding (mode='translation'): encode
+        the source once, precompute each block's cross-attention K/V, then
+        one cached decode step per target token starting from ``bos_id``.
+        Tokens after the first ``eos_id`` (when given) are replaced by 0.
+        Returns (B, max_new_tokens) target ids (without the BOS)."""
+        assert self.mode == "translation"
+        src_ids = jnp.asarray(src_ids, jnp.int32)
+        B = src_ids.shape[0]
+        assert max_new_tokens + 1 <= self.max_len
+        src_mask = padding_mask((src_ids != 0), src_ids.shape[1])
+        enc = self._embed(params, src_ids)
+        enc = self._stack(self.enc_blocks, "enc_block", params, enc,
+                          src_mask, False, None)
+        cross = [blk.cross_kv(params[f"block{i}"], enc)
+                 for i, blk in enumerate(self.blocks)]
+        caches = self.init_cache(B, max_new_tokens + 1, enc.dtype)
+
+        def body(carry, _):
+            caches, tok, pos, done = carry
+            logits, caches = self.decode_one(params, tok, pos, caches,
+                                             cross, src_mask)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            emit = jnp.where(done, 0, nxt)
+            if eos_id is not None:
+                done = jnp.logical_or(done, nxt == eos_id)
+            return (caches, nxt, pos + 1, done), emit
+
+        bos = jnp.full((B,), bos_id, jnp.int32)
+        done0 = jnp.zeros((B,), bool)
+        (_, _, _, _), toks = jax.lax.scan(
+            body, (caches, bos, jnp.int32(0), done0), None,
+            length=max_new_tokens)
+        return jnp.moveaxis(toks, 0, 1)

@@ -250,3 +250,45 @@ def test_moe_lm_generate_matches_naive():
         nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
     assert np.array_equal(np.asarray(out), np.asarray(ids))
+
+
+def test_transformer_translate_matches_naive():
+    """translate() (cached encoder-decoder greedy decode) == the naive
+    re-forward loop through mode='translation' apply."""
+    import jax.numpy as jnp
+    from bigdl_tpu.nn import Transformer
+    from bigdl_tpu.utils.table import Table
+    model = Transformer(vocab_size=31, hidden_size=16, num_heads=2,
+                        filter_size=32, num_hidden_layers=2,
+                        mode="translation", max_len=32)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    src = jnp.asarray(np.random.RandomState(0).randint(1, 31, (2, 7)),
+                      jnp.int32)
+    src = src.at[1, 5:].set(0)  # padded source
+    out = model.translate(params, src, max_new_tokens=6, bos_id=1)
+    assert out.shape == (2, 6)
+
+    tgt = jnp.full((2, 1), 1, jnp.int32)  # BOS
+    for _ in range(6):
+        logits, _ = model.apply(params, {}, Table(src, tgt), training=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        tgt = jnp.concatenate([tgt, nxt[:, None]], axis=1)
+    assert np.array_equal(np.asarray(out), np.asarray(tgt[:, 1:]))
+
+
+def test_transformer_translate_eos_masking():
+    """Tokens after the first eos are emitted as 0 (padding)."""
+    import jax.numpy as jnp
+    from bigdl_tpu.nn import Transformer
+    model = Transformer(vocab_size=13, hidden_size=8, num_heads=2,
+                        filter_size=16, num_hidden_layers=1,
+                        mode="translation", max_len=16)
+    params, _ = model.init(jax.random.PRNGKey(1))
+    src = jnp.asarray(np.random.RandomState(1).randint(1, 13, (1, 5)),
+                      jnp.int32)
+    out_free = np.asarray(model.translate(params, src, 8, bos_id=1))
+    # force every token to be "eos": all emissions after the first must be 0
+    eos = int(out_free[0, 0])
+    out = np.asarray(model.translate(params, src, 8, bos_id=1, eos_id=eos))
+    assert out[0, 0] == eos
+    assert (out[0, 1:] == 0).all(), out
