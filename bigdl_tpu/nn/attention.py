@@ -509,6 +509,15 @@ class Transformer(Module):
             [prompt_ids, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
         return out
 
+    def _encode_src(self, params, src_ids):
+        """Shared source-side setup for translate/translate_beam:
+        padding mask + encoder stack."""
+        src_mask = padding_mask((src_ids != 0), src_ids.shape[1])
+        enc = self._embed(params, src_ids)
+        enc = self._stack(self.enc_blocks, "enc_block", params, enc,
+                          src_mask, False, None)
+        return enc, src_mask
+
     def translate(self, params, src_ids, max_new_tokens: int,
                   bos_id: int = 1, eos_id=None):
         """Greedy encoder-decoder decoding (mode='translation'): encode
@@ -520,10 +529,7 @@ class Transformer(Module):
         src_ids = jnp.asarray(src_ids, jnp.int32)
         B = src_ids.shape[0]
         assert max_new_tokens + 1 <= self.max_len
-        src_mask = padding_mask((src_ids != 0), src_ids.shape[1])
-        enc = self._embed(params, src_ids)
-        enc = self._stack(self.enc_blocks, "enc_block", params, enc,
-                          src_mask, False, None)
+        enc, src_mask = self._encode_src(params, src_ids)
         cross = [blk.cross_kv(params[f"block{i}"], enc)
                  for i, blk in enumerate(self.blocks)]
         caches = self.init_cache(B, max_new_tokens + 1, enc.dtype)
@@ -544,3 +550,102 @@ class Transformer(Module):
             body, (caches, bos, jnp.int32(0), done0), None,
             length=max_new_tokens)
         return jnp.moveaxis(toks, 0, 1)
+
+    def translate_beam(self, params, src_ids, max_new_tokens: int,
+                       beam_size: int = 4, bos_id: int = 1, eos_id=None,
+                       length_penalty: float = 0.0):
+        """Beam-search decoding for mode='translation' (beyond the
+        reference, whose Transformer has no inference path at all).
+
+        Standard fixed-width beam search under ``lax.scan``: beams ride a
+        flattened (B*beam) batch through the SAME cached decode step as
+        greedy; finished beams (emitted ``eos_id``) are frozen with their
+        score. Score = sum log-prob / (len ** length_penalty). Returns
+        (B, max_new_tokens) ids of the best beam (BOS excluded, positions
+        after eos zeroed). ``beam_size=1`` reproduces :meth:`translate`.
+        """
+        assert self.mode == "translation"
+        src_ids = jnp.asarray(src_ids, jnp.int32)
+        B, Ts = src_ids.shape
+        K = beam_size
+        V = self.vocab_size
+        assert max_new_tokens + 1 <= self.max_len
+
+        enc, src_mask = self._encode_src(params, src_ids)
+        # project cross K/V ONCE on the un-repeated encoder output, then
+        # expand to the (B*K) beam layout
+        rep = lambda x: jnp.repeat(x, K, axis=0)
+        mask_k = rep(src_mask)
+        cross = [tuple(rep(t) for t in
+                       blk.cross_kv(params[f"block{i}"], enc))
+                 for i, blk in enumerate(self.blocks)]
+        caches = self.init_cache(B * K, max_new_tokens + 1, enc.dtype)
+
+        neg = jnp.float32(-1e30)
+        # beam 0 starts live, the rest dead so the first expansion draws
+        # K distinct continuations of BOS rather than K copies
+        scores0 = jnp.tile(jnp.concatenate(
+            [jnp.zeros((1,)), jnp.full((K - 1,), neg)]), (B,))
+
+        def gather_beams(tree, idx):
+            """idx: (B, K) beam indices into the previous (B*K) layout."""
+            flat = (jnp.arange(B)[:, None] * K + idx).reshape(-1)
+            return jax.tree_util.tree_map(lambda x: x[flat], tree)
+
+        def body(carry, _):
+            caches, tok, pos, scores, done = carry
+            logits, new_caches = self.decode_one(params, tok, pos, caches,
+                                                 cross, mask_k)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            # candidates are (V + 1)-wide: the extra column is the frozen
+            # beam's single "stay" continuation (score unchanged) — vocab
+            # column 0 remains selectable by live beams, preserving exact
+            # greedy parity at beam_size=1 and eos_id=0 detection
+            live = jnp.where(done[:, None], neg, logp) + scores[:, None]
+            stay = jnp.where(done, scores, neg)[:, None]
+            cand = jnp.concatenate([live, stay], axis=1)  # (B*K, V+1)
+            cand = cand.reshape(B, K * (V + 1))
+            top, flat_idx = jax.lax.top_k(cand, K)   # (B, K)
+            beam_idx = flat_idx // (V + 1)
+            col = (flat_idx % (V + 1)).astype(jnp.int32)
+            caches = gather_beams(new_caches, beam_idx)
+            done = gather_beams(done, beam_idx)
+            col_flat = col.reshape(-1)
+            emitted = jnp.where(col_flat == V, 0, col_flat)  # stay → pad
+            if eos_id is not None:
+                emit_eos = jnp.logical_and(col_flat != V,
+                                           emitted == eos_id)
+                done = jnp.logical_or(done, emit_eos)
+            return (caches, emitted, pos + 1, top.reshape(-1), done), \
+                (emitted, beam_idx)
+
+        bos = jnp.full((B * K,), bos_id, jnp.int32)
+        done0 = jnp.zeros((B * K,), bool)
+        (_, _, _, scores, done), (toks, parents) = jax.lax.scan(
+            body, (caches, bos, jnp.int32(0), scores0, done0), None,
+            length=max_new_tokens)
+        # backtrack: beams were physically gathered every step, so the
+        # token at step t for final beam j is found by following parents
+        toks = toks.reshape(max_new_tokens, B, K)
+        parents = parents.reshape(max_new_tokens, B, K)
+
+        # backtrack ALL K final beams (slots are physically re-gathered
+        # every step, so per-slot columns of `toks` mix hypotheses — both
+        # the length penalty and the output must follow parent pointers)
+        def walk(beams, inputs):
+            tk, pr = inputs
+            tok_t = jnp.take_along_axis(tk, beams, axis=1)   # (B, K)
+            beams = jnp.take_along_axis(pr, beams, axis=1)
+            return beams, tok_t
+
+        init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+        _, rev = jax.lax.scan(walk, init, (toks[::-1], parents[::-1]))
+        paths = rev[::-1]                                     # (T, B, K)
+
+        lens = jnp.sum(paths != 0, axis=0).astype(jnp.float32)  # (B, K)
+        norm = jnp.maximum(lens, 1.0) ** length_penalty
+        final = scores.reshape(B, K) / norm
+        best = jnp.argmax(final, axis=1)                        # (B,)
+        out = jnp.take_along_axis(
+            paths, best[None, :, None], axis=2)[:, :, 0]        # (T, B)
+        return jnp.moveaxis(out, 0, 1)

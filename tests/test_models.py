@@ -292,3 +292,40 @@ def test_transformer_translate_eos_masking():
     out = np.asarray(model.translate(params, src, 8, bos_id=1, eos_id=eos))
     assert out[0, 0] == eos
     assert (out[0, 1:] == 0).all(), out
+
+
+def test_transformer_translate_beam():
+    """beam_size=1 beam search == greedy translate; wider beams return
+    in-vocab sequences with a no-worse model score than greedy."""
+    import jax.numpy as jnp
+    from bigdl_tpu.nn import Transformer
+    model = Transformer(vocab_size=29, hidden_size=16, num_heads=2,
+                        filter_size=32, num_hidden_layers=2,
+                        mode="translation", max_len=32)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    src = jnp.asarray(np.random.RandomState(0).randint(1, 29, (3, 6)),
+                      jnp.int32)
+    greedy = model.translate(params, src, max_new_tokens=5, bos_id=1)
+    beam1 = model.translate_beam(params, src, max_new_tokens=5,
+                                 beam_size=1, bos_id=1)
+    assert np.array_equal(np.asarray(greedy), np.asarray(beam1))
+
+    beam4 = model.translate_beam(params, src, max_new_tokens=5,
+                                 beam_size=4, bos_id=1)
+    assert beam4.shape == (3, 5)
+    b = np.asarray(beam4)
+    assert ((b >= 0) & (b < 29)).all()
+
+    def seq_logprob(tgt):
+        from bigdl_tpu.utils.table import Table
+        full = jnp.concatenate([jnp.full((3, 1), 1, jnp.int32), tgt], 1)
+        logits, _ = model.apply(params, {}, Table(src, full[:, :-1]),
+                                training=False)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        gold = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
+                                   -1)[..., 0]
+        return np.asarray(jnp.sum(gold, axis=1))
+
+    sg = seq_logprob(jnp.asarray(greedy))
+    sb = seq_logprob(beam4)
+    assert (sb >= sg - 1e-4).all(), (sb, sg)  # beam never worse than greedy
