@@ -204,12 +204,17 @@ class FusedBottleneck(_Module):
         return flash_mode()
 
     def _mm(self, x2d, w, scale, bias, relu, stats):
-        """Dispatch one fused matmul; the jnp fallback is the same math."""
+        """Dispatch one fused matmul; the jnp fallback is the same math.
+        BIGDL_TPU_FUSED_BLOCK_M/_N override the kernel tile sizes (read at
+        trace time — the on-chip sweep's tuning knobs)."""
         mode = self._mode()
         if mode in ("pallas", "interpret"):
+            import os
             from ..kernels.fused_matmul import fused_bn_relu_matmul
             return fused_bn_relu_matmul(
                 x2d, w, scale, bias, relu=relu, stats=stats,
+                block_m=int(os.environ.get("BIGDL_TPU_FUSED_BLOCK_M", 512)),
+                block_n=int(os.environ.get("BIGDL_TPU_FUSED_BLOCK_N", 256)),
                 interpret=(mode == "interpret"))
         xh = x2d if scale is None else x2d * scale + bias
         if relu:
