@@ -3,3 +3,5 @@ from .quantize import (quantize, QuantizedLinear, QuantizedSpatialConvolution,
 from .calibration import (calibrate, fold_batchnorm, quantizable_paths,
                           Observer, MinMaxObserver, MovingAverageObserver,
                           PercentileObserver)
+from .lm import (QuantizedWeight, quantize_lm_params,
+                 quantize_weight_int8, lm_quantized_bytes)

@@ -1,0 +1,112 @@
+"""Weight-only int8 quantization for the transformer LM inference path.
+
+Autoregressive decode is WEIGHT-bandwidth-bound: every generated token
+re-reads all block weights from HBM while activations are a single token
+row. Storing block matmul weights as int8 with per-output-channel scales
+halves (vs bf16) or quarters (vs f32) that traffic; the dequantize —
+``(x @ q_int8.astype(x.dtype)) * scale`` — fuses into the matmul under
+XLA, so the int8 tensor is what travels.
+
+Mechanism: :class:`QuantizedWeight` is a registered pytree whose
+``__rmatmul__`` performs the fused dequant-matmul. Because every matmul
+site in the transformer stack is spelled ``x @ params[...]``, quantized
+params drop into the UNCHANGED forward/prefill/decode code — no model
+edits, no parallel implementation to keep in sync. The tied embedding
+stays un-quantized (it is consumed by ``jnp.take`` and transposed for
+the output projection).
+
+Beyond the reference: its int8 path (``bigdl.utils.Quantization``,
+nn/quantized/) covers Linear/Conv inference; BigDL 0.x has no
+transformer decode to quantize. PTQ for Linear/Conv lives in
+``quantization/quantize.py``; this module is the LM-specific weight-only
+variant.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedWeight:
+    """Per-output-channel symmetric int8 weight: ``w ≈ q * s``.
+
+    Supports the one operation the transformer stack needs
+    (``x @ w`` via ``__rmatmul__``); anything else should fail loudly
+    rather than silently densify.
+    """
+
+    def __init__(self, q, s):
+        self.q = q            # (K, N) int8
+        self.s = s            # (N,) f32 scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # the EFFECTIVE dtype seen by consumers
+        return self.s.dtype
+
+    def __rmatmul__(self, x):
+        # dequant fused into the matmul epilogue by XLA: int8 is what
+        # travels from HBM
+        return (x @ self.q.astype(x.dtype)) * self.s.astype(x.dtype)
+
+    def dequantize(self):
+        return self.q.astype(self.s.dtype) * self.s
+
+    def __repr__(self):
+        return f"QuantizedWeight{tuple(self.q.shape)}"
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedWeight,
+    lambda w: ((w.q, w.s), None),
+    lambda _, ch: QuantizedWeight(*ch))
+
+
+_DEFAULT_KEYS = frozenset({"wq", "wk", "wv", "wo", "w1", "w2"})
+
+
+def quantize_weight_int8(w):
+    """(K, N) weight → :class:`QuantizedWeight` with per-OUT-channel
+    scales. One quantization implementation exists in this package —
+    quantize.py's ``quantize_weight`` (axis = the KEPT out-channel axis,
+    which for a (K, N) matmul weight is 1); this wraps it in the pytree
+    carrier."""
+    from .quantize import quantize_weight
+    q, s = quantize_weight(jnp.asarray(w), axis=1)
+    return QuantizedWeight(q, s.reshape(-1))
+
+
+def quantize_lm_params(params, keys=_DEFAULT_KEYS):
+    """Replace the 2-D block matmul weights named in ``keys`` with
+    :class:`QuantizedWeight`. Everything else (embedding, layernorms,
+    biases) keeps its dtype. The result drops into ``model.apply`` /
+    ``generate`` / ``translate`` unchanged — but do NOT run it through
+    dtype-cast tree_maps (they would cast the int8 payload)."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: (quantize_weight_int8(v)
+                        if k in keys and hasattr(v, "ndim") and v.ndim == 2
+                        else walk(v))
+                    for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return node
+
+    return walk(params)
+
+
+def lm_quantized_bytes(params) -> dict:
+    """Weight-byte accounting: {'quantized': n, 'dense': n} — the HBM
+    traffic story the decode path cares about."""
+    qb = db = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedWeight)):
+        if isinstance(leaf, QuantizedWeight):
+            qb += leaf.q.size + leaf.s.size * 4
+        elif hasattr(leaf, "nbytes"):
+            db += leaf.nbytes
+    return {"quantized": int(qb), "dense": int(db)}

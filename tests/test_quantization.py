@@ -278,3 +278,40 @@ def test_quantized_resnet50_accuracy_drop():
     assert agree >= 0.5, agree
     rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-8)
     assert rel < 0.25, rel
+
+
+def test_weight_only_int8_lm_generate():
+    """quantize_lm_params drops into the UNCHANGED forward/generate code:
+    logits stay close to float, greedy generation runs jitted, and the
+    quantized weight bytes are ~4x smaller than the f32 originals."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.quantization import quantize_lm_params, lm_quantized_bytes
+
+    model = TransformerLM(vocab_size=43, hidden_size=32, num_heads=2,
+                          filter_size=64, num_layers=2, max_len=32)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    qparams = quantize_lm_params(params)
+
+    ids = jnp.asarray(np.random.RandomState(0).randint(1, 43, (2, 10)),
+                      jnp.int32)
+    ref, _ = model.apply(params, {}, ids, training=False)
+    out, _ = model.apply(qparams, {}, ids, training=False)
+    rel = float(jnp.abs(out - ref).max() /
+                (jnp.abs(ref).max() + 1e-9))
+    assert rel < 0.08, rel  # int8 weight rounding error bound
+
+    gen = jax.jit(lambda p, x: model.generate(p, x, max_new_tokens=4))
+    toks = gen(qparams, ids[:, :4])
+    assert toks.shape == (2, 8)
+    assert ((np.asarray(toks) >= 0) & (np.asarray(toks) < 43)).all()
+
+    # the quantized payload is ~4x smaller than the SAME mats in f32
+    b = lm_quantized_bytes(qparams)
+    orig = sum(v.nbytes
+               for blk in range(2)
+               for k, v in params[f"block{blk}"]["attn"].items()) \
+        + sum(params[f"block{blk}"]["ffn"][k].nbytes
+              for blk in range(2) for k in ("w1", "w2"))
+    assert b["quantized"] < 0.3 * orig, (b, orig)
