@@ -106,7 +106,7 @@ def lm_quantized_bytes(params) -> dict:
     for leaf in jax.tree_util.tree_leaves(
             params, is_leaf=lambda x: isinstance(x, QuantizedWeight)):
         if isinstance(leaf, QuantizedWeight):
-            qb += leaf.q.size + leaf.s.size * 4
+            qb += leaf.q.nbytes + leaf.s.nbytes
         elif hasattr(leaf, "nbytes"):
             db += leaf.nbytes
     return {"quantized": int(qb), "dense": int(db)}
