@@ -460,11 +460,14 @@ class Transformer(Module):
         return h[:, 0] @ params["embed"].T, new_caches
 
     def generate(self, params, prompt_ids, max_new_tokens: int,
-                 temperature: float = 0.0, rng=None, top_k: int = 0):
+                 temperature: float = 0.0, rng=None, top_k: int = 0,
+                 eos_id=None):
         """Autoregressive generation with a KV cache: prefill the prompt,
         then ``lax.scan`` one fused decode step per token (greedy when
         ``temperature`` == 0, else temperature/top-k sampling). Returns
-        (B, Tp + max_new_tokens) ids. Jit-compatible end to end.
+        (B, Tp + max_new_tokens) ids; with ``eos_id``, positions after a
+        row's first EOS are emitted as 0 (fixed shape — the scan still
+        runs max_new_tokens steps). Jit-compatible end to end.
 
         Token-id convention: logits column ``j`` is taken as token ``j``
         (the tied embedding's own indexing) — train with
@@ -495,16 +498,24 @@ class Transformer(Module):
 
         key0, rng = jax.random.split(rng)
         first = pick(logits, key0)
+        done0 = (first == eos_id) if eos_id is not None \
+            else jnp.zeros((B,), bool)
 
         def body(carry, step_key):
-            caches, tok, pos = carry
+            caches, tok, pos, done = carry
             logits, caches = self.decode_one(params, tok, pos, caches)
             nxt = pick(logits, step_key)
-            return (caches, nxt, pos + 1), tok
+            if eos_id is not None:
+                nxt = jnp.where(done, 0, nxt)
+                new_done = jnp.logical_or(done, nxt == eos_id)
+            else:
+                new_done = done
+            return (caches, nxt, pos + 1, new_done), tok
 
         keys = jax.random.split(rng, max(max_new_tokens - 1, 1))
-        (_, last, _), toks = jax.lax.scan(
-            body, (caches, first, jnp.int32(Tp)), keys[:max_new_tokens - 1])
+        (_, last, _, _), toks = jax.lax.scan(
+            body, (caches, first, jnp.int32(Tp), done0),
+            keys[:max_new_tokens - 1])
         out = jnp.concatenate(
             [prompt_ids, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
         return out
