@@ -352,3 +352,34 @@ def test_lm_generate_eos_masking():
     out = np.asarray(model.generate(params, prompt, 8, eos_id=eos))
     assert out[0, pos] == eos and (out[0, pos + 1:] == 0).all(), out[0]
     assert np.array_equal(out[1], free[1])
+
+
+def test_translate_beam_score_monotone_in_width():
+    """The best final model score is non-decreasing in beam width (a
+    classic beam-search implementation property)."""
+    import jax.numpy as jnp
+    from bigdl_tpu.nn import Transformer
+    from bigdl_tpu.utils.table import Table
+    model = Transformer(vocab_size=17, hidden_size=12, num_heads=2,
+                        filter_size=24, num_hidden_layers=1,
+                        mode="translation", max_len=16)
+    params, _ = model.init(jax.random.PRNGKey(2))
+    src = jnp.asarray(np.random.RandomState(3).randint(1, 17, (2, 5)),
+                      jnp.int32)
+
+    def score(tgt):
+        full = jnp.concatenate([jnp.full((2, 1), 1, jnp.int32), tgt], 1)
+        logits, _ = model.apply(params, {}, Table(src, full[:, :-1]),
+                                training=False)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        gold = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
+                                   -1)[..., 0]
+        return np.asarray(jnp.sum(gold, axis=1))
+
+    prev = None
+    for k in (1, 2, 4, 8):
+        s = score(model.translate_beam(params, src, 4, beam_size=k,
+                                       bos_id=1))
+        if prev is not None:
+            assert (s >= prev - 1e-4).all(), (k, s, prev)
+        prev = s
