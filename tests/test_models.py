@@ -149,237 +149,3 @@ def test_moe_transformer_lm_trains():
             first = float(l)
             assert float(gmax) > 0, "no gradient reached expert weights"
     assert float(l) < first, (first, float(l))
-
-
-def test_transformer_lm_generate_matches_naive():
-    """KV-cache generate() == the naive re-forward-everything loop
-    (greedy), and the sampled path stays in-vocab and jit-compiles."""
-    import jax.numpy as jnp
-    from bigdl_tpu.models import TransformerLM
-    model = TransformerLM(vocab_size=61, hidden_size=32, num_heads=2,
-                          filter_size=64, num_layers=2, max_len=32)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    prompt = jnp.asarray(np.random.RandomState(0).randint(
-        1, 61, size=(2, 5)), jnp.int32)
-
-    out = model.generate(params, prompt, max_new_tokens=6)
-    assert out.shape == (2, 11)
-    assert np.array_equal(np.asarray(out[:, :5]), np.asarray(prompt))
-
-    # naive: re-run the full forward each step, argmax the last position
-    ids = prompt
-    for _ in range(6):
-        logits, _ = model.apply(params, {}, ids, training=False)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
-    assert np.array_equal(np.asarray(out), np.asarray(ids)), \
-        (np.asarray(out), np.asarray(ids))
-
-    # sampling path, jitted end to end
-    sampled = jax.jit(lambda p, x: model.generate(
-        p, x, max_new_tokens=4, temperature=0.8, top_k=5,
-        rng=jax.random.PRNGKey(1)))(params, prompt)
-    assert sampled.shape == (2, 9)
-    s = np.asarray(sampled[:, 5:])
-    assert ((s >= 0) & (s < 61)).all()
-
-
-def test_lm_criterion_matches_chunked_head():
-    """nn.LMCriterion == models.lm_loss_chunked (the 0-based LM head) in
-    value and gradient; generate edge cases (max_new_tokens=0, top_k >
-    vocab) behave."""
-    import jax.numpy as jnp
-    from bigdl_tpu.models import TransformerLM, lm_loss_chunked
-    from bigdl_tpu.nn import LMCriterion
-    rng = np.random.RandomState(3)
-    B, T, H, V = 2, 16, 8, 23
-    h = jnp.asarray(rng.randn(B, T, H).astype(np.float32))
-    emb = jnp.asarray(0.2 * rng.randn(V, H).astype(np.float32))
-    y = rng.randint(1, V, size=(B, T)).astype(np.int32)
-    y[1, :3] = 0
-    y = jnp.asarray(y)
-    crit = LMCriterion(padding_value=0)
-    l1, g1 = jax.value_and_grad(lambda h: crit._forward(h @ emb.T, y))(h)
-    l2, g2 = jax.value_and_grad(
-        lambda h: lm_loss_chunked(h, emb, y, chunk=8))(h)
-    assert np.allclose(float(l1), float(l2), rtol=1e-6)
-    assert np.allclose(np.asarray(g1), np.asarray(g2), atol=1e-6)
-
-    model = TransformerLM(vocab_size=V, hidden_size=16, num_heads=2,
-                          filter_size=32, num_layers=1, max_len=32)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    prompt = jnp.asarray(rng.randint(1, V, (1, 4)), jnp.int32)
-    out0 = model.generate(params, prompt, max_new_tokens=0)
-    assert out0.shape == (1, 4)  # contract: Tp + 0
-    outk = model.generate(params, prompt, max_new_tokens=3,
-                          temperature=1.0, top_k=1000)  # > vocab: clipped
-    assert outk.shape == (1, 7)
-
-
-def test_generate_prefill_kernel_path(monkeypatch):
-    """generate() with the Pallas prefill (interpret mode) == einsum."""
-    import jax.numpy as jnp
-    from bigdl_tpu.models import TransformerLM
-    model = TransformerLM(vocab_size=37, hidden_size=16, num_heads=2,
-                          filter_size=32, num_layers=2, max_len=32)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    prompt = jnp.asarray(np.random.RandomState(1).randint(1, 37, (2, 6)),
-                         jnp.int32)
-    monkeypatch.setenv("BIGDL_TPU_FLASH", "off")
-    out_e = model.generate(params, prompt, max_new_tokens=5)
-    monkeypatch.setenv("BIGDL_TPU_FLASH", "interpret")
-    out_k = model.generate(params, prompt, max_new_tokens=5)
-    assert np.array_equal(np.asarray(out_e), np.asarray(out_k))
-
-
-def test_moe_lm_generate_matches_naive():
-    """MoE LM cached generate() == the naive re-forward loop (greedy):
-    token-level routing behaves identically under cached decode."""
-    import jax.numpy as jnp
-    from bigdl_tpu.models import MoETransformerLM
-    model = MoETransformerLM(vocab_size=41, hidden_size=32, num_heads=2,
-                             filter_size=64, num_layers=2, n_experts=2,
-                             max_len=32)
-    params, state = model.init(jax.random.PRNGKey(0))
-    prompt = jnp.asarray(np.random.RandomState(2).randint(1, 41, (2, 5)),
-                         jnp.int32)
-    out = model.generate(params, prompt, max_new_tokens=5)
-    ids = prompt
-    for _ in range(5):
-        logits, _ = model.apply(params, state, ids, training=False)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
-    assert np.array_equal(np.asarray(out), np.asarray(ids))
-
-
-def test_transformer_translate_matches_naive():
-    """translate() (cached encoder-decoder greedy decode) == the naive
-    re-forward loop through mode='translation' apply."""
-    import jax.numpy as jnp
-    from bigdl_tpu.nn import Transformer
-    from bigdl_tpu.utils.table import Table
-    model = Transformer(vocab_size=31, hidden_size=16, num_heads=2,
-                        filter_size=32, num_hidden_layers=2,
-                        mode="translation", max_len=32)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    src = jnp.asarray(np.random.RandomState(0).randint(1, 31, (2, 7)),
-                      jnp.int32)
-    src = src.at[1, 5:].set(0)  # padded source
-    out = model.translate(params, src, max_new_tokens=6, bos_id=1)
-    assert out.shape == (2, 6)
-
-    tgt = jnp.full((2, 1), 1, jnp.int32)  # BOS
-    for _ in range(6):
-        logits, _ = model.apply(params, {}, Table(src, tgt), training=False)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        tgt = jnp.concatenate([tgt, nxt[:, None]], axis=1)
-    assert np.array_equal(np.asarray(out), np.asarray(tgt[:, 1:]))
-
-
-def test_transformer_translate_eos_masking():
-    """Tokens after the first eos are emitted as 0 (padding)."""
-    import jax.numpy as jnp
-    from bigdl_tpu.nn import Transformer
-    model = Transformer(vocab_size=13, hidden_size=8, num_heads=2,
-                        filter_size=16, num_hidden_layers=1,
-                        mode="translation", max_len=16)
-    params, _ = model.init(jax.random.PRNGKey(1))
-    src = jnp.asarray(np.random.RandomState(1).randint(1, 13, (1, 5)),
-                      jnp.int32)
-    out_free = np.asarray(model.translate(params, src, 8, bos_id=1))
-    # force every token to be "eos": all emissions after the first must be 0
-    eos = int(out_free[0, 0])
-    out = np.asarray(model.translate(params, src, 8, bos_id=1, eos_id=eos))
-    assert out[0, 0] == eos
-    assert (out[0, 1:] == 0).all(), out
-
-
-def test_transformer_translate_beam():
-    """beam_size=1 beam search == greedy translate; wider beams return
-    in-vocab sequences with a no-worse model score than greedy."""
-    import jax.numpy as jnp
-    from bigdl_tpu.nn import Transformer
-    model = Transformer(vocab_size=29, hidden_size=16, num_heads=2,
-                        filter_size=32, num_hidden_layers=2,
-                        mode="translation", max_len=32)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    src = jnp.asarray(np.random.RandomState(0).randint(1, 29, (3, 6)),
-                      jnp.int32)
-    greedy = model.translate(params, src, max_new_tokens=5, bos_id=1)
-    beam1 = model.translate_beam(params, src, max_new_tokens=5,
-                                 beam_size=1, bos_id=1)
-    assert np.array_equal(np.asarray(greedy), np.asarray(beam1))
-
-    beam4 = model.translate_beam(params, src, max_new_tokens=5,
-                                 beam_size=4, bos_id=1)
-    assert beam4.shape == (3, 5)
-    b = np.asarray(beam4)
-    assert ((b >= 0) & (b < 29)).all()
-
-    def seq_logprob(tgt):
-        from bigdl_tpu.utils.table import Table
-        full = jnp.concatenate([jnp.full((3, 1), 1, jnp.int32), tgt], 1)
-        logits, _ = model.apply(params, {}, Table(src, full[:, :-1]),
-                                training=False)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        gold = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
-                                   -1)[..., 0]
-        return np.asarray(jnp.sum(gold, axis=1))
-
-    sg = seq_logprob(jnp.asarray(greedy))
-    sb = seq_logprob(beam4)
-    assert (sb >= sg - 1e-4).all(), (sb, sg)  # beam never worse than greedy
-
-
-def test_lm_generate_eos_masking():
-    """generate(eos_id=...): after a row emits eos, later positions are 0;
-    rows that never emit eos are unaffected (vs the eos-free output)."""
-    import jax.numpy as jnp
-    from bigdl_tpu.models import TransformerLM
-    model = TransformerLM(vocab_size=19, hidden_size=16, num_heads=2,
-                          filter_size=32, num_layers=1, max_len=24)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    prompt = jnp.asarray(np.random.RandomState(0).randint(1, 19, (2, 4)),
-                         jnp.int32)
-    free = np.asarray(model.generate(params, prompt, 8))
-    # deterministically pick an eos emitted by row 0 but never by row 1,
-    # so both the masking and the untouched-row checks are guaranteed
-    # non-vacuous (greedy output is fixed for this seed)
-    cands = [t for t in free[0, 4:] if t not in free[1, 4:]]
-    assert cands, (free[0], free[1])
-    eos = int(cands[0])
-    pos = int(np.where(free[0, 4:] == eos)[0][0]) + 4
-    out = np.asarray(model.generate(params, prompt, 8, eos_id=eos))
-    assert out[0, pos] == eos and (out[0, pos + 1:] == 0).all(), out[0]
-    assert np.array_equal(out[1], free[1])
-
-
-def test_translate_beam_score_monotone_in_width():
-    """The best final model score is non-decreasing in beam width (a
-    classic beam-search implementation property)."""
-    import jax.numpy as jnp
-    from bigdl_tpu.nn import Transformer
-    from bigdl_tpu.utils.table import Table
-    model = Transformer(vocab_size=17, hidden_size=12, num_heads=2,
-                        filter_size=24, num_hidden_layers=1,
-                        mode="translation", max_len=16)
-    params, _ = model.init(jax.random.PRNGKey(2))
-    src = jnp.asarray(np.random.RandomState(3).randint(1, 17, (2, 5)),
-                      jnp.int32)
-
-    def score(tgt):
-        full = jnp.concatenate([jnp.full((2, 1), 1, jnp.int32), tgt], 1)
-        logits, _ = model.apply(params, {}, Table(src, full[:, :-1]),
-                                training=False)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        gold = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
-                                   -1)[..., 0]
-        return np.asarray(jnp.sum(gold, axis=1))
-
-    prev = None
-    for k in (1, 2, 4, 8):
-        s = score(model.translate_beam(params, src, 4, beam_size=k,
-                                       bos_id=1))
-        if prev is not None:
-            assert (s >= prev - 1e-4).all(), (k, s, prev)
-        prev = s
