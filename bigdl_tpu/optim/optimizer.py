@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import os
 import pickle
+import queue
+import threading
 import time
 from typing import List, Optional
 
@@ -46,6 +48,73 @@ from ..utils import engine
 from ..utils.table import Table
 
 _tmap = jax.tree_util.tree_map
+
+
+def _atomic_pickle(path, payload):
+    """tmp + fsync + rename: a crash mid-write (including OS crash/power
+    loss — hence the fsync before the rename) must never tear the
+    checkpoint the nan_policy='resume' path depends on."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class _AsyncCheckpointWriter:
+    """One daemon writer thread; submissions are written IN ORDER (so the
+    'latest checkpoint' on disk is always the latest submitted), each via
+    the atomic tmp+rename. ``flush`` drains the queue and re-raises the
+    first writer error (a silently failing checkpointer is worse than a
+    crashed one). The reference writes checkpoints synchronously on the
+    Spark driver (Optimizer.setCheckpoint → File.save); on TPU the step
+    loop should not stall on host file IO."""
+
+    def __init__(self, max_pending: int = 2):
+        # bounded: a slow disk backpressures the training loop instead of
+        # accumulating one full host model copy per checkpoint interval
+        self._q: queue.Queue = queue.Queue(maxsize=max_pending)
+        self._err = None
+        self._thread = None
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                path, payload = item
+                try:
+                    _atomic_pickle(path, payload)
+                except Exception as e:  # noqa: BLE001 — surfaced in flush
+                    if self._err is None:
+                        self._err = e
+            finally:
+                self._q.task_done()
+
+    def submit(self, path, payload):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        self._q.put((path, payload))
+
+    def flush(self):
+        if self._thread is not None:
+            self._q.join()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError(
+                f"async checkpoint write failed: {err}") from err
+
+    def close(self):
+        """Flush, then stop the writer thread (optimize() calls this so
+        no daemon thread outlives the run)."""
+        self.flush()
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join(timeout=30)
+            self._thread = None
 
 
 class Metrics:
@@ -126,6 +195,8 @@ class BaseOptimizer:
         self.checkpoint_trigger = None
         self.checkpoint_path = None
         self.checkpoint_overwrite = True
+        self.checkpoint_async = False
+        self._ckpt_writer = _AsyncCheckpointWriter()
         self.train_summary = None
         self.val_summary = None
         self.clip_const = None
@@ -145,10 +216,16 @@ class BaseOptimizer:
         self.validation_batch = batch_size or self.batch_size
         return self
 
-    def set_checkpoint(self, trigger, path, overwrite=True):
+    def set_checkpoint(self, trigger, path, overwrite=True,
+                       async_write=False):
+        """``async_write=True`` moves serialization + file IO onto a
+        background writer thread (ordered, atomic) so the training loop
+        only pays the device→host fetch; ``wait_for_checkpoints()`` (also
+        called at the end of ``optimize``) flushes and surfaces errors."""
         self.checkpoint_trigger = trigger
         self.checkpoint_path = path
         self.checkpoint_overwrite = overwrite
+        self.checkpoint_async = async_write
         os.makedirs(path, exist_ok=True)
         return self
 
@@ -273,6 +350,8 @@ class BaseOptimizer:
         tag = "" if self.checkpoint_overwrite else \
             f"_e{state['epoch']}_i{state['neval']}"
         path = os.path.join(self.checkpoint_path, f"checkpoint{tag}.bigdl")
+        # the device→host fetch is the only synchronous part; serialization
+        # and file IO can ride the writer thread (async_write)
         payload = {
             "params": _tmap(np.asarray, self._params_for_checkpoint(params)),
             "opt_state": self._to_host(opt_state),
@@ -280,8 +359,18 @@ class BaseOptimizer:
             "optim_host_state": dict(self.optim_method.state),
             "epoch": state["epoch"], "neval": state["neval"],
         }
-        with open(path, "wb") as f:
-            pickle.dump(payload, f)
+        if self.checkpoint_async:
+            self._ckpt_writer.submit(path, payload)
+        else:
+            _atomic_pickle(path, payload)
+
+    def wait_for_checkpoints(self):
+        """Block until every async checkpoint write has landed (re-raising
+        a writer failure). No-op for synchronous checkpoints."""
+        self._ckpt_writer.flush()
+
+    def _close_checkpoints(self):
+        self._ckpt_writer.close()
 
     def load_checkpoint(self, path):
         """Resume training state from a snapshot (parity:
@@ -366,6 +455,7 @@ class BaseOptimizer:
                             f"(nan_policy='{self.nan_policy}') — data or "
                             "hyperparameters are unrecoverably bad")
                     if self.nan_policy == "resume":
+                        self.wait_for_checkpoints()  # in-flight writes
                         snap = self._latest_checkpoint()
                         if snap is None:
                             raise FloatingPointError(
@@ -426,6 +516,7 @@ class BaseOptimizer:
         self.model.params, self.model.state = \
             self._collect(params, mstate, opt_state)
         self.model.grad_params = _tmap(jnp.zeros_like, self.model.params)
+        self._close_checkpoints()  # land async writes, stop the writer
         return self.model
 
     def _fire_mid_epoch(self, state, params, opt_state, mstate):

@@ -456,3 +456,39 @@ def test_accuracy_sequence_labels_and_onehot():
     r2 = Top1Accuracy()(out2, oh)
     expect2 = int(np.sum(np.argmax(out2, -1) + 1 == t.reshape(-1)))
     assert r2.correct == expect2 and r2.count == 30
+
+
+def test_async_checkpoint_write_and_resume(tmp_path):
+    """set_checkpoint(async_write=True): writes land on the background
+    thread (ordered, atomic tmp+rename), optimize() flushes them, resume
+    works, and writer failures surface instead of vanishing."""
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.optim import LocalOptimizer, SGD, MaxEpoch, \
+        several_iteration
+    from bigdl_tpu.dataset import DataSet, mnist
+    from bigdl_tpu import nn
+
+    imgs, labels = mnist.load(n_synthetic=32)
+    ds = DataSet.array(mnist.to_samples(imgs, labels))
+    opt = LocalOptimizer(LeNet5(10), ds, nn.ClassNLLCriterion(),
+                         SGD(learningrate=0.01), MaxEpoch(2), batch_size=8)
+    opt.set_checkpoint(several_iteration(2), str(tmp_path),
+                       async_write=True)
+    opt.optimize()
+    snap = tmp_path / "checkpoint.bigdl"
+    assert snap.exists()
+    assert not (tmp_path / "checkpoint.bigdl.tmp").exists()  # atomic
+
+    # resume restores counters/params
+    opt2 = LocalOptimizer(LeNet5(10), ds, nn.ClassNLLCriterion(),
+                          SGD(learningrate=0.01), MaxEpoch(3), batch_size=8)
+    opt2.load_checkpoint(str(snap))
+    opt2.optimize()
+    assert np.isfinite(float(opt2.optim_method.state["loss"]))
+
+    # a failing writer surfaces at flush
+    from bigdl_tpu.optim.optimizer import _AsyncCheckpointWriter
+    w = _AsyncCheckpointWriter()
+    w.submit(str(tmp_path / "no" / "such" / "dir" / "x.bigdl"), {"a": 1})
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        w.flush()
