@@ -505,6 +505,18 @@ def main():
     if "--child" in sys.argv:
         child_main(sys.argv[sys.argv.index("--child") + 1])
         return
+    if "--smoke" in sys.argv:
+        # fast CPU plumbing check (no tunnel ladder, no cache): run the
+        # headline child directly with the axon registration stripped
+        lines, err = _run_child("headline", _cpu_env(), 600.0)
+        if not lines:
+            print(json.dumps({"metric": "bench_failed", "value": 0,
+                              "unit": "error", "vs_baseline": 0,
+                              "error": str(err)[-300:]}), flush=True)
+            raise SystemExit(1)
+        for line in lines:
+            print(json.dumps(line), flush=True)
+        return
     for line in _orchestrate("headline"):
         print(json.dumps(line), flush=True)
     if "--all" in sys.argv:
