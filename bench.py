@@ -507,15 +507,24 @@ def main():
         return
     if "--smoke" in sys.argv:
         # fast CPU plumbing check (no tunnel ladder, no cache): run the
-        # headline child directly with the axon registration stripped
-        lines, err = _run_child("headline", _cpu_env(), 600.0)
-        if not lines:
-            print(json.dumps({"metric": "bench_failed", "value": 0,
-                              "unit": "error", "vs_baseline": 0,
-                              "error": str(err)[-300:]}), flush=True)
+        # headline child — and with --all every secondary config too —
+        # directly with the axon registration stripped
+        configs = ["headline"]
+        if "--all" in sys.argv:
+            from bench_extra import CONFIGS
+            configs += [f"secondary:{k}" for k in CONFIGS]
+        failed = False
+        for which in configs:
+            lines, err = _run_child(which, _cpu_env(), 600.0)
+            if not lines:
+                lines = [{"metric": f"bench_failed_{which}", "value": 0,
+                          "unit": "error", "vs_baseline": 0,
+                          "error": str(err)[-300:]}]
+                failed = True
+            for line in lines:
+                print(json.dumps(line), flush=True)
+        if failed:
             raise SystemExit(1)
-        for line in lines:
-            print(json.dumps(line), flush=True)
         return
     for line in _orchestrate("headline"):
         print(json.dumps(line), flush=True)
