@@ -1,0 +1,132 @@
+"""Analytic roofline model for ResNet-50 sync-SGD training on TPU v5e.
+
+VERDICT r2 #1's fallback arm: if the chip can't reach SURVEY §6's >=50%
+MFU on the conv stack, prove *why* with numbers. This walks the exact
+ResNet-50 v1.5 layer shapes `bench.py` trains (NHWC, batch 256, 224x224,
+bf16 activations/weights) and computes, per conv:
+
+  * training FLOPs (fwd + dgrad + wgrad matmul-equivalents = 3x fwd);
+  * minimum HBM traffic (activations in/out, weights, and the elementwise
+    BN/ReLU/residual chains that read/write whole activation tensors);
+  * an MXU packing ceiling from tile quantization: XLA lowers conv to
+    matmuls of [N*H'*W', k*k*Cin] x [k*k*Cin, Cout]; the v5e MXU consumes
+    128x128 tiles (8x128 lanes x 16 sublanes bf16), so contraction or
+    output dims that are not multiples of 128 waste the remainder tile.
+
+Per-layer attainable time = max(compute time / packing, memory time), the
+classic roofline. The summary prints an *upper bound* on end-to-end MFU —
+real XLA adds non-overlapped epilogues, DMA stalls and optimizer time on
+top, so measured MFU must sit below this bound.
+
+Reference anchor: the reference frames ResNet-50 training throughput as
+its headline too (models/resnet/TrainImageNet.scala:1); its MKL-DNN
+fusion work (nn/mkldnn/SpatialConvolution.scala:1) is the same
+"elementwise chains are the bottleneck" fight on Xeon.
+
+Run: python tools/roofline_resnet.py [--batch 256] [--no-fused]
+"""
+from __future__ import annotations
+
+import argparse
+
+PEAK_FLOPS = 197e12      # v5e bf16 peak (public spec)
+HBM_BW = 819e9           # v5e HBM bandwidth, bytes/s (public spec)
+BYTES = 2                # bf16
+
+
+def _ceil(a, b):
+    return -(-a // b)
+
+
+def conv_layers():
+    """(name, H_out, W_out, k, Cin, Cout, stride, has_residual_add)
+    for ResNet-50 v1.5 at 224x224 input (stride-2 on the 3x3)."""
+    layers = [("stem7x7", 112, 112, 7, 3, 64, 2, False)]
+    cfg = [(3, 64, 256, 56), (4, 128, 512, 28),
+           (6, 256, 1024, 14), (3, 512, 2048, 7)]
+    nin = 64
+    for si, (blocks, nmid, nout, hw) in enumerate(cfg):
+        for b in range(blocks):
+            s = 2 if (si > 0 and b == 0) else 1
+            hw_in = hw * s
+            layers.append((f"s{si}b{b}_1x1a", hw_in, hw_in, 1, nin, nmid,
+                           1, False))
+            layers.append((f"s{si}b{b}_3x3", hw, hw, 3, nmid, nmid, s,
+                           False))
+            layers.append((f"s{si}b{b}_1x1b", hw, hw, 1, nmid, nout, 1,
+                           True))
+            if b == 0:
+                layers.append((f"s{si}b{b}_proj", hw, hw, 1, nin, nout, s,
+                               False))
+            nin = nout
+    return layers
+
+
+def analyze(batch=256, fused=True, verbose=True):
+    """Roofline each conv (+ its BN/ReLU/residual epilogue); return
+    (total_flops, lower-bound step time, mfu upper bound).
+
+    ``fused=True`` models a perfectly-fused epilogue (BN/ReLU/residual
+    applied while the conv output streams, batch stats accumulated
+    on-chip — what kernels/fused_matmul.py implements for the 1x1s);
+    ``fused=False`` charges separate HBM passes for normalize+ReLU,
+    stats reduction, and residual add (the un-fused XLA graph's floor)."""
+    rows = []
+    tot_flops = tot_t = 0.0
+    for (name, h, w, k, cin, cout, stride, res) in conv_layers():
+        n_pix = batch * h * w
+        contraction = k * k * cin
+        fwd_flops = 2.0 * n_pix * contraction * cout
+        flops = 3.0 * fwd_flops  # fwd + dgrad + wgrad
+
+        # packing: tile quantization on both matmul dims
+        pack = (contraction / (_ceil(contraction, 128) * 128)) * \
+               (cout / (_ceil(cout, 128) * 128))
+        # spatial dim is huge (n_pix >= 12k) -> its quantization is ~1
+
+        in_bytes = batch * (h * stride) * (w * stride) * cin * BYTES
+        out_bytes = n_pix * cout * BYTES
+        w_bytes = contraction * cout * BYTES
+        # training streams each activation ~3x (fwd, dgrad, wgrad reads)
+        mem = 3.0 * (in_bytes + out_bytes) + 2.0 * w_bytes
+        if res:
+            # the shortcut tensor must come from HBM even in the perfect-
+            # fusion limit (it exceeds VMEM): one read fwd, one bwd
+            mem += 2.0 * out_bytes
+        if not fused:
+            # separate BN stats pass (read), then a normalize+ReLU pass
+            # (read+write) = 3 passes over the output; the residual add
+            # (read both + write) is 3 more — fwd and bwd both walk
+            # these chains
+            epilogue = out_bytes * 3 + (out_bytes * 3 if res else 0)
+            mem += 2.0 * epilogue
+
+        t_comp = flops / (PEAK_FLOPS * pack)
+        t_mem = mem / HBM_BW
+        t = max(t_comp, t_mem)
+        rows.append((name, flops / 1e9, pack, t_comp * 1e3, t_mem * 1e3,
+                     "mem" if t_mem > t_comp else "mxu"))
+        tot_flops += flops
+        tot_t += t
+
+    mfu_bound = tot_flops / tot_t / PEAK_FLOPS
+    if verbose:
+        print(f"{'layer':<14}{'GFLOPs':>9}{'pack':>7}{'t_mxu ms':>10}"
+              f"{'t_hbm ms':>10}  bound")
+        for r in rows:
+            print(f"{r[0]:<14}{r[1]:>9.1f}{r[2]:>7.2f}{r[3]:>10.2f}"
+                  f"{r[4]:>10.2f}  {r[5]}")
+        print(f"\nbatch {batch}, fused_epilogue={fused}")
+        print(f"total train GFLOPs/step: {tot_flops/1e9:.0f}")
+        print(f"roofline step-time lower bound: {tot_t*1e3:.1f} ms "
+              f"-> {batch/tot_t:.0f} img/s")
+        print(f"end-to-end MFU upper bound: {mfu_bound:.1%}")
+    return tot_flops, tot_t, mfu_bound
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--no-fused", action="store_true")
+    a = ap.parse_args()
+    analyze(a.batch, fused=not a.no_fused)
