@@ -1,0 +1,91 @@
+"""Analytic roofline for the TransformerLM bench config on TPU v5e.
+
+Companion to ``tools/roofline_resnet.py`` for the flagship LM
+(`bench_extra.bench_transformer_lm`: B16, T1024, H1024, 16 heads,
+F4096, V32000, L12, remat over blocks, flash attention, chunked CE
+head). Answers: what MFU can this config reach, and what eats the gap?
+
+Accounting matches `bench_extra._lm_model_flops` (model FLOPs only; the
+MFU numerator excludes recompute) — but the *time* denominator here
+charges everything the chip actually executes:
+
+  * matmul time at MXU peak with tile-quantization packing (all dims
+    are multiples of 128 at these shapes except the T^2 causal tail);
+  * the remat recompute tax: remat-over-blocks re-runs each block's
+    forward during backward, so executed block FLOPs ~ 4/3 x model;
+  * HBM traffic: weights (bf16 read fwd + dgrad + wgrad write) + f32
+    master params/momentum for the SGD update + block boundary
+    activations (B*T*H per layer, stored and re-read) + flash
+    attention's Q/K/V/O streams + the CE head's logits chunks.
+
+Run: python tools/roofline_lm.py [--batch 16]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path[:0] = [_here, os.path.dirname(_here)]
+from roofline_resnet import PEAK_FLOPS, HBM_BW  # noqa: E402 (one source)
+from bench_extra import _lm_model_flops  # noqa: E402
+
+BF16 = 2
+F32 = 4
+
+
+def analyze(B=16, T=1024, H=1024, F=4096, V=32000, L=12, verbose=True):
+    tok = B * T
+    # --- model FLOPs (the MFU numerator) — imported from the bench so
+    # the bound and the measured number share one accounting
+    model_flops = _lm_model_flops(B, T, H, F, L, V)
+    # block/head split re-derived for the remat tax below
+    per_layer = (4 * 2 * tok * H * H          # qkvo projections
+                 + 2 * 2 * tok * T * H * 0.5  # causal scores + AV
+                 + 2 * 2 * tok * H * F)       # ffn
+    fwd = L * per_layer + 2 * tok * H * V     # + tied vocab head
+    assert abs(model_flops - 3.0 * fwd) < 1e6, "split out of sync with bench"
+
+    # --- executed FLOPs (the time numerator): remat re-runs each
+    # block's forward once during backward -> blocks cost 4x fwd, the
+    # (un-remat'd) head costs the plain 3x
+    executed = 4.0 * L * per_layer + 3.0 * 2 * tok * H * V
+    t_mxu = executed / PEAK_FLOPS  # packing ~1: all dims % 128 == 0
+
+    # --- HBM traffic ---
+    params = L * (4 * H * H + 2 * H * F) + V * H  # tied embedding
+    w_traffic = params * (3 * BF16 + 5 * F32)
+    # bf16: fwd read + dgrad read + recompute read; f32: grad write +
+    # master param read/write + momentum read/write = 5 f32 passes
+    act_boundary = L * tok * H * BF16 * 2         # stored + re-read
+    flash_streams = L * tok * H * BF16 * 8        # q,k,v,o fwd + bwd
+    head = 2 * tok * V * BF16                     # logits chunks fwd+bwd
+    mem = w_traffic + act_boundary + flash_streams + head
+    t_hbm = mem / HBM_BW
+
+    # matmuls and HBM overlap poorly when both are near-saturated; the
+    # bound below takes max() per the classic roofline (optimistic)
+    t = max(t_mxu, t_hbm)
+    mfu_bound = model_flops / t / PEAK_FLOPS
+    if verbose:
+        print(f"B{B} T{T} H{H} F{F} V{V} L{L} (remat over blocks)")
+        print(f"model TFLOPs/step:     {model_flops/1e12:8.2f}")
+        print(f"executed TFLOPs/step:  {executed/1e12:8.2f} "
+              f"(remat tax {executed/model_flops:.2f}x)")
+        print(f"t_mxu {t_mxu*1e3:6.1f} ms   t_hbm {t_hbm*1e3:6.1f} ms "
+              f"({'mxu' if t_mxu > t_hbm else 'hbm'}-bound)")
+        print(f"step-time lower bound: {t*1e3:.1f} ms "
+              f"-> {tok/t/1e3:.0f}k tokens/s")
+        print(f"MFU upper bound:       {mfu_bound:.1%}")
+        print(f"(the remat tax alone caps MFU at "
+              f"{model_flops/executed:.1%} of MXU peak — the price of "
+              f"fitting B16/T1024 in 16 GB without activation offload)")
+    return model_flops, t, mfu_bound
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    a = ap.parse_args()
+    analyze(B=a.batch)
