@@ -87,10 +87,22 @@ def _build_resnet_step(batch, size):
     engine.set_seed(0)
     # NHWC: TPU-native conv layout (channels-last); f32 master params,
     # bf16 compute inside the step (MXU path), f32 SGD update.
-    # BENCH_FUSED=1 swaps bottlenecks for the Pallas fused
-    # BN+ReLU+matmul+stats blocks (models/resnet.py FusedBottleneck) —
-    # the on-chip A/B lever for the conv-stack MFU push.
-    fused = "pallas" if os.environ.get("BENCH_FUSED") == "1" else "none"
+    # BENCH_FUSED selects the bottleneck variant (models/resnet.py):
+    #   xla (default) — layout-preserving 1x1-conv-as-dot restructure with
+    #     affine prologue + one-pass stats epilogue, fused by XLA; the
+    #     round-3 on-chip A/B measured it +4.2% over plain lax.conv
+    #     (2441 vs 2342 img/s). The flattened-reshape form of the same
+    #     math was 1.75x SLOWER — layout preservation is the whole win.
+    #   1 — the hand-written Pallas fused kernel arm (kernels/fused_matmul)
+    #   0 — plain unfused bottlenecks (the pre-round-3 baseline)
+    _fused_env = os.environ.get("BENCH_FUSED", "xla")
+    try:
+        fused = {"1": "pallas", "pallas": "pallas", "xla": "xla",
+                 "0": "none", "none": "none"}[_fused_env]
+    except KeyError:
+        # an unknown value must not silently benchmark the wrong arm
+        raise SystemExit(f"BENCH_FUSED={_fused_env!r}: expected "
+                         "xla | pallas/1 | none/0")
     # BENCH_POOL_GRAD=fast enables the scatter-free maxpool backward
     # (nn/pool.py) — the second pending on-chip A/B lever
     model = ResNet(class_num=1000, depth=50, format="NHWC", fused=fused,
@@ -251,20 +263,26 @@ def bench_resnet50_realdata():
     jpeg_size = 256 if on_tpu else 96
 
     paths, labels = _ensure_jpeg_folder(n_images, jpeg_size)
+    # each worker holds one fully-built batch (~154 MB at B256/224²) while
+    # blocked on the bounded queue, so the default is capped: memory is
+    # workers × batch_bytes beyond the queue itself
+    n_workers = int(os.environ.get("BENCH_JPEG_WORKERS",
+                                   min(16, max(8, os.cpu_count() or 1))))
     pf = JpegFolderPrefetcher(
         paths, labels, size, size, mean=(124.0, 117.0, 104.0),
-        std=(59.0, 57.0, 57.0), batch_size=batch,
-        n_workers=int(os.environ.get("BENCH_JPEG_WORKERS", 8)),
+        std=(59.0, 57.0, 57.0), batch_size=batch, n_workers=n_workers,
         queue_capacity=4)
 
     step, carry, lr, flops_per_step = _build_resnet_step(batch, size)
 
     def batches():
-        """Endless stream of device-resident (x, y); prefetcher epochs are
-        restarted transparently. NCHW→NHWC happens ON DEVICE (a cheap
-        layout op) so the host path is decode → bf16 cast → async put."""
+        """Endless stream of device-resident (x, y). loop_epochs keeps the
+        decode workers running across epoch boundaries (a cold restart
+        refills the whole queue: 7-11 s stall on a 1-core host). NCHW→NHWC
+        happens ON DEVICE (a cheap layout op) so the host path is
+        decode → bf16 cast → async put."""
         while True:
-            for mb in pf.data(train=True):
+            for mb in pf.data(train=True, loop_epochs=1000):
                 xh = np.asarray(mb.input, np.float32)  # (B, C, H, W)
                 x = jnp.transpose(jnp.asarray(xh, jnp.bfloat16),
                                   (0, 2, 3, 1))
@@ -304,6 +322,12 @@ def bench_resnet50_realdata():
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
         "mfu": round(flops_per_step * steps / dt / peak, 4),
         "input_wait_frac": round(wait[0] / dt, 4),
+        # input_wait_frac ≈ 1 means decode-bound: single-core libjpeg
+        # decode+resize runs ~230 img/s/core, so feeding the chip's
+        # synthetic rate needs ~ (synthetic/230) host cores. host_cpus
+        # makes that legible in the recorded line.
+        "host_cpus": os.cpu_count(),
+        "jpeg_workers": n_workers,
         "backend": backend,
         "device": jax.devices()[0].device_kind,
     }

@@ -82,13 +82,17 @@ def _fwd_kernel(x_ref, w_ref, a_ref, b_ref, z_ref, s1_ref, s2_ref,
             acc1[:] = jnp.zeros_like(acc1)
             acc2[:] = jnp.zeros_like(acc2)
 
-    x = x_ref[...].astype(jnp.float32)
+    # The MXU contraction stays in the input dtype (bf16 on the bench path;
+    # f32 matmuls run at a fraction of bf16 MXU throughput — the round-3
+    # on-chip A/B measured the all-f32 variant at 2.2x slower than XLA).
+    # Only the affine prologue and the stats accumulate in f32.
+    x = x_ref[...]
     if prologue:
-        x = x * a_ref[...].astype(jnp.float32) + b_ref[...].astype(
-            jnp.float32)
+        x = (x.astype(jnp.float32) * a_ref[...].astype(jnp.float32)
+             + b_ref[...].astype(jnp.float32)).astype(x_ref.dtype)
     if relu:
-        x = jnp.maximum(x, 0.0)
-    z = _mm(x, w_ref[...].astype(jnp.float32))      # (bm, bn) f32
+        x = jnp.maximum(x, 0)
+    z = _mm(x, w_ref[...])                          # (bm, bn) f32 accum
     z_ref[...] = z.astype(z_ref.dtype)
 
     if stats:
@@ -162,13 +166,14 @@ def _bwd_dx_kernel(x_ref, w_ref, a_ref, b_ref, dz_ref, z_ref, ds1_ref,
             acc_da[:] = jnp.zeros_like(acc_da)
             acc_db[:] = jnp.zeros_like(acc_db)
 
-    dz = dz_ref[...].astype(jnp.float32)
+    dz = dz_ref[...]
     if stats:
         z = z_ref[...].astype(jnp.float32)
-        dz = dz + ds1_ref[...].astype(jnp.float32) \
-            + 2.0 * z * ds2_ref[...].astype(jnp.float32)
+        dz = (dz.astype(jnp.float32) + ds1_ref[...].astype(jnp.float32)
+              + 2.0 * z * ds2_ref[...].astype(jnp.float32))
         dz = jnp.where(_row_mask(i, block_m, m_total, dz.shape[1]), dz, 0.0)
-    dxh = _mm(dz, w_ref[...].astype(jnp.float32).T)   # (bm, K)
+        dz = dz.astype(dz_ref.dtype)
+    dxh = _mm(dz, w_ref[...].T)                       # (bm, K) f32 accum
     x = x_ref[...].astype(jnp.float32)
     if prologue:
         xn = x * a_ref[...].astype(jnp.float32) + b_ref[...].astype(
@@ -200,19 +205,20 @@ def _bwd_dw_kernel(x_ref, a_ref, b_ref, dz_ref, z_ref, ds1_ref, ds2_ref,
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    x = x_ref[...].astype(jnp.float32)
+    x = x_ref[...]
     if prologue:
-        x = x * a_ref[...].astype(jnp.float32) + b_ref[...].astype(
-            jnp.float32)
+        x = (x.astype(jnp.float32) * a_ref[...].astype(jnp.float32)
+             + b_ref[...].astype(jnp.float32)).astype(x_ref.dtype)
     if relu:
-        x = jnp.maximum(x, 0.0)
-    dz = dz_ref[...].astype(jnp.float32)
+        x = jnp.maximum(x, 0)
+    dz = dz_ref[...]
     if stats:
         z = z_ref[...].astype(jnp.float32)
-        dz = dz + ds1_ref[...].astype(jnp.float32) \
-            + 2.0 * z * ds2_ref[...].astype(jnp.float32)
+        dz = (dz.astype(jnp.float32) + ds1_ref[...].astype(jnp.float32)
+              + 2.0 * z * ds2_ref[...].astype(jnp.float32))
         dz = jnp.where(_row_mask(i, block_m, m_total, dz.shape[1]), dz, 0.0)
-    acc[:] += _mm(x, dz, ta=True)                    # (K, bn)
+        dz = dz.astype(dz_ref.dtype)
+    acc[:] += _mm(x, dz, ta=True)                    # (K, bn) f32 accum
 
     @pl.when(i == nm - 1)
     def _finish():
@@ -233,7 +239,9 @@ def _bwd(relu, stats, block_m, block_n, interpret, res, grads):
     zero_col = jnp.zeros((1, Np), jnp.float32)
     zp = (_pad_to(_pad_to(z, 0, block_m), 1, block_n) if stats
           else jnp.zeros((Mp, Np), x.dtype))
-    dzp = _pad_to(_pad_to(dz.astype(jnp.float32), 0, block_m), 1, block_n)
+    # dz rides HBM in the compute dtype (bf16 on the bench path); the
+    # stats-gradient injection upcasts tile-locally inside the kernels.
+    dzp = _pad_to(_pad_to(dz.astype(x.dtype), 0, block_m), 1, block_n)
     ds1p = (_pad_to(ds1.reshape(1, N).astype(jnp.float32), 1, block_n)
             if stats else zero_col)
     ds2p = (_pad_to(ds2.reshape(1, N).astype(jnp.float32), 1, block_n)
@@ -333,7 +341,7 @@ _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
 def fused_bn_relu_matmul(x, w, scale=None, bias=None, *, relu=None,
-                         stats=True, block_m=512, block_n=256,
+                         stats=True, block_m=512, block_n=512,
                          interpret=False):
     """``z = act(x * scale + bias) @ w`` with fused per-channel output
     statistics.
@@ -348,7 +356,35 @@ def fused_bn_relu_matmul(x, w, scale=None, bias=None, *, relu=None,
     """
     if relu is None:
         relu = scale is not None
-    M = x.shape[0]
+    M, K = x.shape
+    N = w.shape[1]
+    eb = x.dtype.itemsize          # compute-dtype element bytes
     bm = min(block_m, max(128, ((M + 127) // 128) * 128))
+    bn = min(block_n, max(128, ((N + 127) // 128) * 128))
+    # Fit every pallas_call inside the TPU's 16 MB scoped-VMEM limit.
+    # The dgrad kernel is the tight one: it keeps the whole (Kp, Np)
+    # weight resident plus double-buffered block_m-tall x/dz/z/dx blocks,
+    # so at wide layers (e.g. ResNet stage-3 proj: K=1024, N=2048,
+    # M=12544) a fixed block_m=512 overflows and the on-chip compile
+    # fails. Model the footprints (x2 for Pallas double-buffering of
+    # grid-varying blocks) and shrink block_m until all three fit.
+    Kp = -(-K // 128) * 128
+
+    def _vmem(bm_):
+        Np = -(-N // bn) * bn
+        fwd = 2 * bm_ * (Kp + bn) * eb + 2 * Kp * bn * eb
+        # dz/z charged at f32 width: the stats-gradient injection upcasts
+        # them tile-locally inside the kernel, and those temporaries live
+        # in the same scoped VMEM as the blocks
+        dx = 2 * bm_ * (2 * Kp * eb + 2 * Np * 4) + Kp * Np * eb
+        # dw: blocks + its (Kp, bn) f32 accumulator scratch + f32 output
+        dw = 2 * bm_ * (Kp * eb + 2 * bn * 4) + 3 * Kp * bn * 4
+        return max(fwd, dx, dw)
+
+    budget = 13 * 1024 * 1024
+    while bm > 128 and _vmem(bm) > budget:
+        bm = max(128, ((bm // 2 + 127) // 128) * 128)
+    while bn > 128 and _vmem(bm) > budget:
+        bn = max(128, ((bn // 2 + 127) // 128) * 128)
     return _fused(x, w, scale, bias, bool(relu), bool(stats), int(bm),
-                  int(block_n), bool(interpret))
+                  int(bn), bool(interpret))

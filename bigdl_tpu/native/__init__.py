@@ -160,16 +160,40 @@ class NativePrefetcher:
     def batches_per_epoch(self):
         return self.n // self.batch_size
 
-    def data(self, train: bool = True):
+    def data(self, train: bool = True, loop_epochs: int = 1):
+        """Yield MiniBatches for ``loop_epochs`` epochs (freshly permuted
+        each) as ONE worker run: with loop_epochs > 1 the decode threads
+        never join/respawn between epochs, so there is no queue-refill
+        stall at epoch boundaries (measured 7-11 s per boundary on a
+        1-core host — the round-3 realdata-bench diagnosis)."""
         from ..dataset.minibatch import MiniBatch
         if self._epoch_open:
             self.lib.pf_end_epoch(self.handle)
-        order = (self._rng.permutation(self.n) if train
-                 else np.arange(self.n)).astype(np.int32)
-        order = np.ascontiguousarray(order)
+        loop_epochs = max(1, loop_epochs)
+        if self.n * loop_epochs > 1 << 26:
+            # the looped order is materialised host-side (int32 per sample
+            # per epoch); cap it rather than silently eating GBs or
+            # overflowing pf_start_epoch's int length at 2^31
+            raise ValueError(
+                f"loop_epochs={loop_epochs} over {self.n} samples needs a "
+                f"{self.n * loop_epochs * 4 / 1e6:.0f} MB index array; "
+                "keep n*loop_epochs <= 64M and restart data() instead")
+        # looped mode drops each epoch's partial batch (drop-remainder):
+        # the C++ workers chunk the whole order by batch_size, so without
+        # the trim a batch could span the epoch boundary and contain the
+        # same sample twice from two independent permutations
+        per = (self.n if loop_epochs == 1
+               else self.n - self.n % self.batch_size)
+        if train:
+            order = np.concatenate([self._rng.permutation(self.n)[:per]
+                                    for _ in range(loop_epochs)])
+        else:
+            order = np.tile(np.arange(self.n)[:per], loop_epochs)
+        order = np.ascontiguousarray(order.astype(np.int32))
         self.lib.pf_start_epoch(
             self.handle, order.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
-            self.n, self.batch_size, self.n_workers, self.queue_capacity)
+            len(order), self.batch_size, self.n_workers,
+            self.queue_capacity)
         self._epoch_open = True
         per = self.c * self.h * self.w
         while True:

@@ -44,8 +44,12 @@ void jpeg_err_exit(j_common_ptr cinfo) {
 }
 
 // Decode to 8-bit RGB (or grayscale) rows. Returns channels or -1.
+// min_w/min_h > 0 enable libjpeg's fractional-DCT downscale: the smallest
+// scale 1/8..8/8 whose output still covers (min_w, min_h) is decoded
+// directly — on large sources (real ImageNet JPEGs average ~500 px) this
+// skips most of the IDCT work the bilinear resize would discard anyway.
 int jpeg_decode_raw(const uint8_t* buf, long len, std::vector<uint8_t>& out,
-                    int* w, int* h) {
+                    int* w, int* h, int min_w = 0, int min_h = 0) {
   jpeg_decompress_struct cinfo;
   JpegErr err;
   cinfo.err = jpeg_std_error(&err.mgr);
@@ -62,6 +66,19 @@ int jpeg_decode_raw(const uint8_t* buf, long len, std::vector<uint8_t>& out,
     return -1;
   }
   cinfo.out_color_space = cinfo.num_components >= 3 ? JCS_RGB : JCS_GRAYSCALE;
+  if (min_w > 0 && min_h > 0) {
+    // training-pipeline path only (the prefetcher passes its resize
+    // target): approximate-but-~25%-faster IDCT. The exact-decode public
+    // APIs (decode_jpeg / eval loaders) keep the default JDCT_ISLOW.
+    cinfo.dct_method = JDCT_IFAST;
+    cinfo.scale_denom = 8;
+    for (unsigned s = 1; s <= 8; ++s) {
+      cinfo.scale_num = s;
+      if (long(cinfo.image_width) * s / 8 >= min_w &&
+          long(cinfo.image_height) * s / 8 >= min_h)
+        break;
+    }
+  }
   jpeg_start_decompress(&cinfo);
   *w = int(cinfo.output_width);
   *h = int(cinfo.output_height);
@@ -185,6 +202,8 @@ struct Prefetcher {
   }
 
   void worker_loop() {
+    std::vector<uint8_t> raw, pix;  // reused across images: no per-image
+                                    // multi-MB malloc churn
     for (;;) {
       if (stop.load()) break;
       size_t start = cursor.fetch_add(batch);
@@ -199,10 +218,10 @@ struct Prefetcher {
         float* dst = b.x.data() + (i - start) * per_image();
         if (jpeg_mode) {
 #ifdef BIGDL_TPU_JPEG
-          std::vector<uint8_t> raw, pix;
           int sw = 0, sh = 0, sc = -1;
           if (read_file(files[idx], raw))
-            sc = jpeg_decode_raw(raw.data(), long(raw.size()), pix, &sw, &sh);
+            sc = jpeg_decode_raw(raw.data(), long(raw.size()), pix, &sw, &sh,
+                                 width, height);
           if (sc > 0) {
             resize_norm_chw(pix.data(), sw, sh, sc, width, height,
                             mean.empty() ? nullptr : mean.data(),

@@ -37,6 +37,28 @@ def test_prefetcher_shuffled_epoch_covers_all():
     assert sorted(seen) == list(range(1, 41))
 
 
+def test_prefetcher_looped_epochs_cover_all_without_restart():
+    """loop_epochs=k yields k full (independently permuted) epochs from ONE
+    worker run — the no-queue-refill-stall path the realdata bench uses."""
+    imgs = np.arange(40, dtype=np.uint8).reshape(40, 1, 1, 1)
+    labels = np.arange(1, 41, dtype=np.int64)
+    pf = native.NativePrefetcher(imgs, labels, [0.0], [1.0], batch_size=8)
+    seen = []
+    for b in pf.data(train=True, loop_epochs=3):
+        seen.extend(np.asarray(b.get_target()).astype(int).tolist())
+    assert len(seen) == 120
+    # every epoch's worth of labels appears exactly 3 times overall
+    assert sorted(seen) == sorted(list(range(1, 41)) * 3)
+    # non-divisible n: each epoch drops its partial batch so no minibatch
+    # spans an epoch boundary (which could repeat a sample within a batch)
+    pf2 = native.NativePrefetcher(imgs, labels, [0.0], [1.0], batch_size=16)
+    batches = [np.asarray(b.get_target()).astype(int)
+               for b in pf2.data(train=True, loop_epochs=2)]
+    assert [len(b) for b in batches] == [16, 16, 16, 16]  # 2 * (40 // 16)
+    for b in batches:
+        assert len(set(b.tolist())) == len(b), "duplicate sample in batch"
+
+
 def test_prefetcher_trains_lenet():
     from bigdl_tpu import nn
     from bigdl_tpu.models import LeNet5
