@@ -73,6 +73,25 @@ def _init_backend_with_retry():
     raise last
 
 
+def resnet_bench_variant():
+    """Resolve the (fused, pool_grad) ResNet variant from the BENCH_* env —
+    the ONE parser shared by the bench and tools/profile_resnet.py so the
+    profiler always captures the variant the bench actually runs. Unknown
+    values raise: they must not silently benchmark the wrong arm."""
+    fused_env = os.environ.get("BENCH_FUSED", "xla")
+    try:
+        fused = {"1": "pallas", "pallas": "pallas", "xla": "xla",
+                 "0": "none", "none": "none"}[fused_env]
+    except KeyError:
+        raise SystemExit(f"BENCH_FUSED={fused_env!r}: expected "
+                         "xla | pallas/1 | none/0")
+    pool_grad = os.environ.get("BENCH_POOL_GRAD", "exact")
+    if pool_grad not in ("exact", "fast"):
+        raise SystemExit(f"BENCH_POOL_GRAD={pool_grad!r}: expected "
+                         "exact | fast")
+    return fused, pool_grad
+
+
 def _build_resnet_step(batch, size):
     """Compile the ResNet-50 train step (fwd + CE loss + bwd + momentum
     SGD, donated buffers). Returns (step, carry, lr, flops_per_step) —
@@ -95,18 +114,11 @@ def _build_resnet_step(batch, size):
     #     math was 1.75x SLOWER — layout preservation is the whole win.
     #   1 — the hand-written Pallas fused kernel arm (kernels/fused_matmul)
     #   0 — plain unfused bottlenecks (the pre-round-3 baseline)
-    _fused_env = os.environ.get("BENCH_FUSED", "xla")
-    try:
-        fused = {"1": "pallas", "pallas": "pallas", "xla": "xla",
-                 "0": "none", "none": "none"}[_fused_env]
-    except KeyError:
-        # an unknown value must not silently benchmark the wrong arm
-        raise SystemExit(f"BENCH_FUSED={_fused_env!r}: expected "
-                         "xla | pallas/1 | none/0")
+    fused, pool_grad = resnet_bench_variant()
     # BENCH_POOL_GRAD=fast enables the scatter-free maxpool backward
-    # (nn/pool.py) — the second pending on-chip A/B lever
+    # (nn/pool.py; measured -15% on v5e, kept as an option)
     model = ResNet(class_num=1000, depth=50, format="NHWC", fused=fused,
-                   pool_grad=os.environ.get("BENCH_POOL_GRAD", "exact"))
+                   pool_grad=pool_grad)
     params, mstate = model.init(jax.random.PRNGKey(0))
     crit = CrossEntropyCriterion()
     optim = SGD(learningrate=0.1, momentum=0.9)

@@ -214,9 +214,17 @@ def bench_transformer_lm(on_tpu):
     H, F, V = (1024, 4096, 32000)
     L = _sized(on_tpu, 12, 2)
     steps, warmup = _sized(on_tpu, 15, 2), _sized(on_tpu, 3, 1)
+    # BENCH_LM_REMAT=0 disables per-block rematerialisation: the analytic
+    # roofline (tools/roofline_lm.py) charges remat a 1.28x executed-FLOPs
+    # tax, and with the chunked CE head the un-rematerialised B16/T1024/12L
+    # activations may fit 16 GB — the on-chip A/B decides.
+    _remat_env = os.environ.get("BENCH_LM_REMAT", "1")
+    if _remat_env not in ("0", "1"):
+        # an unknown value must not silently benchmark the wrong arm
+        raise SystemExit(f"BENCH_LM_REMAT={_remat_env!r}: expected 1 | 0")
     model = TransformerLM(vocab_size=V, hidden_size=H, num_heads=16,
                           filter_size=F, num_layers=L, max_len=seqlen,
-                          remat=True)
+                          remat=_remat_env == "1")
     optim = SGD(learningrate=0.01, momentum=0.9)
 
     rng = np.random.RandomState(0)

@@ -77,9 +77,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        s = _mm(q, k, tb=True) * scale             # (bq, bk)
+        # MXU contractions stay in the INPUT dtype (bf16 on the model
+        # path) with f32 accumulation from preferred_element_type — f32
+        # operands run the MXU at a fraction of bf16 throughput (the
+        # round-3 fused-matmul A/B measured the all-f32 form 2.2x slower).
+        # f32 is reserved for the softmax statistics math.
+        s = _mm(q_ref[0, 0], k_ref[0, 0], tb=True) * scale   # (bq, bk) f32
 
         col = k_off + jax.lax.broadcasted_iota(jnp.int32,
                                                (block_q, block_k), 1)
@@ -95,7 +98,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         alpha = jnp.exp(m_prev - m_cur)
         p = jnp.exp(s - m_cur)                     # (bq, bk)
         l_ref[:] = alpha * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + _mm(p, v_ref[0, 0].astype(jnp.float32))
+        acc_ref[:] = acc_ref[:] * alpha + _mm(p.astype(v_ref.dtype),
+                                              v_ref[0, 0])
         m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
 
     @pl.when(j == nk - 1)
@@ -180,14 +184,14 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16-operand MXU contractions with f32 accumulation (see the
+        # forward kernel's dtype note); p/ds are computed in f32 and cast
+        # back to the wire dtype only as matmul operands
         lse = lse_ref[0, 0][:, :1]                 # (bq, 1)
         delta = delta_ref[0, 0][:, :1]             # (bq, 1)
+        dt = q_ref.dtype
 
-        s = _mm(q, k, tb=True) * scale             # (bq, bk)
+        s = _mm(q_ref[0, 0], k_ref[0, 0], tb=True) * scale   # (bq, bk)
         col = k_off + jax.lax.broadcasted_iota(jnp.int32,
                                                (block_q, block_k), 1)
         mask = col < kv_len
@@ -195,12 +199,12 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             row = q_off + jax.lax.broadcasted_iota(jnp.int32,
                                                    (block_q, block_k), 0)
             mask = jnp.logical_and(mask, col <= row)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # (bq, bk) f32
 
-        dv_acc[:] += _mm(p, do, ta=True)            # (bk, d)
-        dp = _mm(do, v, tb=True)                    # (bq, bk)
+        dv_acc[:] += _mm(p.astype(dt), do_ref[0, 0], ta=True)  # (bk, d)
+        dp = _mm(do_ref[0, 0], v_ref[0, 0], tb=True)           # (bq, bk)
         ds = p * (dp - delta) * scale
-        dk_acc[:] += _mm(ds, q, ta=True)            # (bk, d)
+        dk_acc[:] += _mm(ds.astype(dt), q_ref[0, 0], ta=True)  # (bk, d)
 
     @pl.when(i == nq - 1)
     def _finish():
@@ -224,14 +228,10 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
 
-        s = _mm(q, k, tb=True) * scale
+        s = _mm(q_ref[0, 0], k_ref[0, 0], tb=True) * scale
         col = k_off + jax.lax.broadcasted_iota(jnp.int32,
                                                (block_q, block_k), 1)
         mask = col < kv_len
@@ -240,9 +240,9 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                                    (block_q, block_k), 0)
             mask = jnp.logical_and(mask, col <= row)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dp = _mm(do, v, tb=True)
+        dp = _mm(do_ref[0, 0], v_ref[0, 0], tb=True)
         ds = p * (dp - delta) * scale
-        dq_acc[:] += _mm(ds, k)                     # (bq, d)
+        dq_acc[:] += _mm(ds.astype(k_ref.dtype), k_ref[0, 0])  # (bq, d)
 
     @pl.when(j == nk - 1)
     def _finish():

@@ -71,7 +71,11 @@ def lm_loss_chunked(h, embed, targets, chunk: int = 128,
         1, 0)                                                  # (n,B,c)
 
     def chunk_loss(hx, emb, yx):
-        logits = (hx @ emb.T).astype(jnp.float32)              # (B,c,V)
+        # bf16 operands, f32 ACCUMULATION — `(hx @ emb.T).astype(f32)`
+        # would round the logits to bf16 first and only then upcast
+        logits = jax.lax.dot_general(
+            hx, emb, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)                # (B,c,V)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         idx = jnp.clip(yx, 0, logits.shape[-1] - 1)  # raw token ids
         gold = jnp.take_along_axis(logits, idx[..., None],

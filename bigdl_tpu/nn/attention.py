@@ -99,7 +99,20 @@ class Attention(Module):
         return x.reshape(b, t, self.num_heads, -1).transpose(0, 2, 1, 3)
 
     def qkv(self, params, qx, kx=None):
-        """Projected (B, nH, T, D) query/key/value heads."""
+        """Projected (B, nH, T, D) query/key/value heads.
+
+        Self-attention projects through ONE (H, 3H) matmul — one read of
+        the activations and a single well-packed MXU contraction instead
+        of three H×H dots. Params stay separate wq/wk/wv (checkpoint
+        layout unchanged); the concat is a trace-time weight reshuffle."""
+        ws = (params["wq"], params["wk"], params["wv"])
+        if (kx is None or kx is qx) and all(
+                isinstance(w, jnp.ndarray) for w in ws):
+            # int8 QuantizedWeight wrappers (quantization/lm.py) keep the
+            # three-dot path: they dequantize per-matmul and can't concat
+            w3 = jnp.concatenate(ws, axis=1)
+            q, k, v = jnp.split(qx @ w3, 3, axis=-1)
+            return self._split(q), self._split(k), self._split(v)
         kx = qx if kx is None else kx
         return (self._split(qx @ params["wq"]),
                 self._split(kx @ params["wk"]),

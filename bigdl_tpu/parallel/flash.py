@@ -59,6 +59,13 @@ def flash_mode() -> str:
     return "pallas" if backend in ("tpu", "axon") else "einsum"
 
 
+def _flash_blocks():
+    """Kernel tile-size overrides for on-chip sweeps (trace-time env, like
+    BIGDL_TPU_FUSED_BLOCK_*): BIGDL_TPU_FLASH_BLOCK_Q / _K."""
+    return {"block_q": int(os.environ.get("BIGDL_TPU_FLASH_BLOCK_Q", 512)),
+            "block_k": int(os.environ.get("BIGDL_TPU_FLASH_BLOCK_K", 512))}
+
+
 def flash_attention(q, k, v, causal: bool = False):
     """q, k, v: (B, H, T, D)."""
     mode = flash_mode()
@@ -66,7 +73,8 @@ def flash_attention(q, k, v, causal: bool = False):
         return _einsum_fallback(q, k, v, causal)  # explicit: no warning
     if mode == "interpret":
         from ..kernels.flash_attention import flash_attention_fused
-        return flash_attention_fused(q, k, v, causal=causal, interpret=True)
+        return flash_attention_fused(q, k, v, causal=causal, interpret=True,
+                                     **_flash_blocks())
 
     try:
         backend = jax.default_backend()
@@ -77,7 +85,8 @@ def flash_attention(q, k, v, causal: bool = False):
             # import inside the branch: a jax build without pallas must not
             # break the einsum path for non-TPU callers
             from ..kernels.flash_attention import flash_attention_fused
-            return flash_attention_fused(q, k, v, causal=causal)
+            return flash_attention_fused(q, k, v, causal=causal,
+                                         **_flash_blocks())
         except Exception as e:
             _warn_once(("kernel", backend),
                        "Pallas flash-attention kernel failed on backend %r "

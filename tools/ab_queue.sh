@@ -1,0 +1,41 @@
+#!/bin/bash
+# On-chip A/B experiment queue: run each variant as a bench child and log
+# one line per result. Run when the tunnel is up (the watcher chains it
+# after the main sweep). Each experiment has its own timeout so one hang
+# cannot eat the queue.
+cd /root/repo
+LOG=/root/repo/docs/AB_QUEUE_LOG.md
+run() {
+  local label="$1"; shift
+  local cfg="$1"; shift
+  echo "### $label ($(date -u +%H:%M:%SZ))" >> "$LOG"
+  local out rc
+  out=$(env "$@" timeout 900 python bench.py --child "$cfg" 2>/tmp/ab_err.log)
+  rc=$?
+  local line
+  line=$(printf '%s\n' "$out" | grep '"metric"' | tail -1)
+  if [ $rc -ne 0 ] || [ -z "$line" ]; then
+    echo "FAILED rc=$rc ($(tail -c 200 /tmp/ab_err.log | tr '\n' ' '))" >> "$LOG"
+  else
+    echo "$line" >> "$LOG"
+  fi
+}
+echo "## A/B queue run $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$LOG"
+# 1. LM without remat: is the 1.28x remat FLOPs tax avoidable at B16/T1024?
+run "lm remat=0" secondary:transformer BENCH_LM_REMAT=0
+# 2. LM bigger batch under remat (more MXU work per layer-scan step)
+run "lm B32 remat=1" secondary:transformer BENCH_LM_BATCH=32
+# 3. ResNet fused=xla at batch 512 (batch-512 was -5% on the UNFUSED path)
+run "resnet fused=xla B512" headline BENCH_BATCH=512 BENCH_STEPS=10
+# 4. realdata with the loop_epochs + fast-IDCT prefetcher fixes
+run "realdata post-fix" secondary:realdata
+# 5. flash kernel tile sweep at the LM bench shapes
+run "lm flash q256 k512" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=256 BIGDL_TPU_FLASH_BLOCK_K=512
+run "lm flash q512 k1024" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=512 BIGDL_TPU_FLASH_BLOCK_K=1024
+# 6. remat OFF + batch 32 (if remat=0 fits, bigger batch may too)
+run "lm remat=0 B32" secondary:transformer BENCH_LM_REMAT=0 BENCH_LM_BATCH=32
+# 7. where does the fused=xla resnet step spend time now?
+echo "### profile fused=xla ($(date -u +%H:%M:%SZ))" >> "$LOG"
+timeout 900 python tools/profile_resnet.py > /tmp/profile_fused.out 2>&1 \
+  && tail -30 /tmp/profile_fused.out >> "$LOG" \
+  || echo "profile FAILED rc=$?" >> "$LOG"

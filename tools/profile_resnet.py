@@ -11,6 +11,8 @@ import glob
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def capture(batch: int = 256, logdir: str = "/tmp/bigdl_prof"):
     import jax
@@ -22,7 +24,11 @@ def capture(batch: int = 256, logdir: str = "/tmp/bigdl_prof"):
     from bigdl_tpu.utils import engine
 
     engine.set_seed(0)
-    model = ResNet(class_num=1000, depth=50, format="NHWC")
+    # profile the exact variant the bench runs (shared BENCH_* parser)
+    from bench import resnet_bench_variant
+    fused, pool_grad = resnet_bench_variant()
+    model = ResNet(class_num=1000, depth=50, format="NHWC", fused=fused,
+                   pool_grad=pool_grad)
     params, mstate = model.init(jax.random.PRNGKey(0))
     crit = CrossEntropyCriterion()
     optim = SGD(learningrate=0.1, momentum=0.9)

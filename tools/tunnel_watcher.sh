@@ -1,14 +1,16 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every ~7 min; when it comes back, run the full
-# live bench sweep (refreshing .bench_tpu_cache.json) and log the outcome.
+# live bench sweep (refreshing .bench_tpu_cache.json), then the A/B
+# experiment queue, and log both.
 LOG=/root/repo/docs/R3_ONCHIP_STATUS.md
 cd /root/repo
 for i in $(seq 1 200); do
   if timeout 90 python -c "import jax; ds=jax.devices(); assert any(d.platform in ('tpu','axon') for d in ds)" 2>/dev/null; then
     echo "watcher: tunnel UP $(date -u +%H:%M:%SZ) — running sweep" >> "$LOG"
     timeout 3500 python bench.py --all > /tmp/watcher_sweep.out 2>&1
-    echo "watcher: sweep done $(date -u +%H:%M:%SZ) rc=$?" >> "$LOG"
-    grep -c '"backend": "tpu"' /tmp/watcher_sweep.out >> "$LOG"
+    echo "watcher: sweep done $(date -u +%H:%M:%SZ) rc=$? ($(grep -c '"backend": "tpu"' /tmp/watcher_sweep.out) tpu lines)" >> "$LOG"
+    /root/repo/tools/ab_queue.sh
+    echo "watcher: ab queue done $(date -u +%H:%M:%SZ)" >> "$LOG"
     exit 0
   fi
   echo "watcher probe $i down $(date -u +%H:%M:%SZ)" >> /tmp/watcher_probe.log
