@@ -361,30 +361,336 @@ def fused_bn_relu_matmul(x, w, scale=None, bias=None, *, relu=None,
     eb = x.dtype.itemsize          # compute-dtype element bytes
     bm = min(block_m, max(128, ((M + 127) // 128) * 128))
     bn = min(block_n, max(128, ((N + 127) // 128) * 128))
-    # Fit every pallas_call inside the TPU's 16 MB scoped-VMEM limit.
-    # The dgrad kernel is the tight one: it keeps the whole (Kp, Np)
-    # weight resident plus double-buffered block_m-tall x/dz/z/dx blocks,
-    # so at wide layers (e.g. ResNet stage-3 proj: K=1024, N=2048,
-    # M=12544) a fixed block_m=512 overflows and the on-chip compile
-    # fails. Model the footprints (x2 for Pallas double-buffering of
-    # grid-varying blocks) and shrink block_m until all three fit.
+    # Fit every pallas_call inside the TPU's 16 MB scoped-VMEM limit:
+    # at wide layers (e.g. ResNet stage-3 proj: K=1024, N=2048, M=12544)
+    # a fixed block_m=512 overflows and the on-chip compile fails.
+    # Shrink block_m (then block_n) until the shared footprint model fits.
     Kp = -(-K // 128) * 128
-
-    def _vmem(bm_):
-        Np = -(-N // bn) * bn
-        fwd = 2 * bm_ * (Kp + bn) * eb + 2 * Kp * bn * eb
-        # dz/z charged at f32 width: the stats-gradient injection upcasts
-        # them tile-locally inside the kernel, and those temporaries live
-        # in the same scoped VMEM as the blocks
-        dx = 2 * bm_ * (2 * Kp * eb + 2 * Np * 4) + Kp * Np * eb
-        # dw: blocks + its (Kp, bn) f32 accumulator scratch + f32 output
-        dw = 2 * bm_ * (Kp * eb + 2 * bn * 4) + 3 * Kp * bn * 4
-        return max(fwd, dx, dw)
-
-    budget = 13 * 1024 * 1024
-    while bm > 128 and _vmem(bm) > budget:
+    budget = _VMEM_BUDGET
+    while bm > 128 and _vmem_need(bm, Kp, -(-N // bn) * bn, bn, eb) > budget:
         bm = max(128, ((bm // 2 + 127) // 128) * 128)
-    while bn > 128 and _vmem(bm) > budget:
+    while bn > 128 and _vmem_need(bm, Kp, -(-N // bn) * bn, bn, eb) > budget:
         bn = max(128, ((bn // 2 + 127) // 128) * 128)
     return _fused(x, w, scale, bias, bool(relu), bool(stats), int(bm),
                   int(bn), bool(interpret))
+
+
+_VMEM_BUDGET = 13 * 1024 * 1024    # conservative vs the 16 MB scoped limit
+
+
+def _vmem_need(rows, Kp, Np, bn, eb):
+    """Worst-case scoped-VMEM footprint across the three pallas_calls for
+    a (rows, Kp) x (Kp, Np) fused matmul with N tiled by ``bn`` — the ONE
+    model shared by the flattened and NHWC block-size fitters (x2 for
+    Pallas double-buffering of grid-varying blocks; dz/z charged at f32
+    width because the stats-gradient injection upcasts them tile-locally;
+    dw charged for its (Kp, bn) f32 accumulator scratch and output)."""
+    fwd = 2 * rows * (Kp + bn) * eb + 2 * Kp * bn * eb
+    dx = 2 * rows * (2 * Kp * eb + 2 * Np * 4) + Kp * Np * eb
+    dw = 2 * rows * (Kp * eb + 2 * bn * 4) + 3 * Kp * bn * 4
+    return max(fwd, dx, dw)
+
+
+# ---------------------------------------------------------------------------
+# layout-preserving NHWC variant
+# ---------------------------------------------------------------------------
+# The flattened (B*H*W, K) form above pays a relayout copy of every
+# activation on entry/exit of the pallas_call: the round-3 on-chip A/B
+# measured that copy at ~1.7x of the whole step (and the identical pure-XLA
+# 2-D-matmul control arm lost by the same factor, while the 4-D
+# dot_general form WON by 4.2%). These kernels therefore keep the HBM
+# arrays in their native (B, H, W, C) tiling — blocks are (bb, bh, W, K)
+# and the flatten to matmul rows happens in-register, where the leading-
+# dims collapse is layout-free. ResNet shapes divide cleanly (B, H, N all
+# powers-of-two-ish), so there is no padding and none of the row masks the
+# flattened kernels need; callers with non-dividing shapes use the
+# flattened fallback.
+
+
+def _fwd4_kernel(x_ref, w_ref, a_ref, b_ref, z_ref, s1_ref, s2_ref,
+                 acc1, acc2, *, nb, nh, prologue, relu, stats):
+    ib = pl.program_id(1)
+    ih = pl.program_id(2)   # innermost sequential
+
+    if stats:
+        @pl.when(jnp.logical_and(ib == 0, ih == 0))
+        def _init():
+            acc1[:] = jnp.zeros_like(acc1)
+            acc2[:] = jnp.zeros_like(acc2)
+
+    xb = x_ref[...]
+    bb, bh, W, K = xb.shape
+    x = xb.reshape(bb * bh * W, K)
+    if prologue:
+        x = (x.astype(jnp.float32) * a_ref[...].astype(jnp.float32)
+             + b_ref[...].astype(jnp.float32)).astype(x_ref.dtype)
+    if relu:
+        x = jnp.maximum(x, 0)
+    z = _mm(x, w_ref[...])                       # (rows, bn) f32 accum
+    z_ref[...] = z.reshape(bb, bh, W, -1).astype(z_ref.dtype)
+
+    if stats:
+        acc1[:] += jnp.sum(z, axis=0, keepdims=True)
+        acc2[:] += jnp.sum(z * z, axis=0, keepdims=True)
+
+        @pl.when(jnp.logical_and(ib == nb - 1, ih == nh - 1))
+        def _finish():
+            s1_ref[...] = acc1[:]
+            s2_ref[...] = acc2[:]
+
+
+def _bwd4_dx_kernel(x_ref, w_ref, a_ref, b_ref, dz_ref, z_ref, ds1_ref,
+                    ds2_ref, dx_ref, da_ref, db_ref, acc_da, acc_db,
+                    *, nb, nh, prologue, relu, stats):
+    ib = pl.program_id(1)
+    ih = pl.program_id(2)
+
+    if prologue:
+        @pl.when(jnp.logical_and(ib == 0, ih == 0))
+        def _init():
+            acc_da[:] = jnp.zeros_like(acc_da)
+            acc_db[:] = jnp.zeros_like(acc_db)
+
+    bb, bh, W, K = x_ref.shape
+    N = dz_ref.shape[-1]
+    dz = dz_ref[...].reshape(bb * bh * W, N)
+    if stats:
+        z = z_ref[...].reshape(bb * bh * W, N).astype(jnp.float32)
+        dz = (dz.astype(jnp.float32) + ds1_ref[...].astype(jnp.float32)
+              + 2.0 * z * ds2_ref[...].astype(jnp.float32))
+        dz = dz.astype(dz_ref.dtype)
+    dxh = _mm(dz, w_ref[...].T)                  # (rows, K) f32 accum
+    x = x_ref[...].reshape(bb * bh * W, K).astype(jnp.float32)
+    if prologue:
+        xn = x * a_ref[...].astype(jnp.float32) + b_ref[...].astype(
+            jnp.float32)
+    else:
+        xn = x
+    dxn = jnp.where(xn > 0.0, dxh, 0.0) if relu else dxh
+    if prologue:
+        dx = dxn * a_ref[...].astype(jnp.float32)
+        acc_da[:] += jnp.sum(dxn * x, axis=0, keepdims=True)
+        acc_db[:] += jnp.sum(dxn, axis=0, keepdims=True)
+
+        @pl.when(jnp.logical_and(ib == nb - 1, ih == nh - 1))
+        def _finish():
+            da_ref[...] = acc_da[:]
+            db_ref[...] = acc_db[:]
+    else:
+        dx = dxn
+    dx_ref[...] = dx.reshape(bb, bh, W, K).astype(dx_ref.dtype)
+
+
+def _bwd4_dw_kernel(x_ref, a_ref, b_ref, dz_ref, z_ref, ds1_ref, ds2_ref,
+                    dw_ref, acc, *, nb, nh, prologue, relu, stats):
+    ib = pl.program_id(1)
+    ih = pl.program_id(2)
+
+    @pl.when(jnp.logical_and(ib == 0, ih == 0))
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    bb, bh, W, K = x_ref.shape
+    bn = dz_ref.shape[-1]
+    x = x_ref[...].reshape(bb * bh * W, K)
+    if prologue:
+        x = (x.astype(jnp.float32) * a_ref[...].astype(jnp.float32)
+             + b_ref[...].astype(jnp.float32)).astype(x_ref.dtype)
+    if relu:
+        x = jnp.maximum(x, 0)
+    dz = dz_ref[...].reshape(bb * bh * W, bn)
+    if stats:
+        z = z_ref[...].reshape(bb * bh * W, bn).astype(jnp.float32)
+        dz = (dz.astype(jnp.float32) + ds1_ref[...].astype(jnp.float32)
+              + 2.0 * z * ds2_ref[...].astype(jnp.float32))
+        dz = dz.astype(dz_ref.dtype)
+    acc[:] += _mm(x, dz, ta=True)                # (K, bn) f32 accum
+
+    @pl.when(jnp.logical_and(ib == nb - 1, ih == nh - 1))
+    def _finish():
+        dw_ref[...] = acc[:].astype(dw_ref.dtype)
+
+
+def _fwd4(x, w, a, b, relu, stats, block_b, block_h, block_n, interpret):
+    B, H, W, K = x.shape
+    N = w.shape[1]
+    prologue = a is not None
+    nb, nh, nn = B // block_b, H // block_h, N // block_n
+    a2 = (a.reshape(1, K) if prologue else jnp.zeros((1, K), x.dtype))
+    b2 = (b.reshape(1, K) if prologue else jnp.zeros((1, K), x.dtype))
+
+    kernel = functools.partial(_fwd4_kernel, nb=nb, nh=nh,
+                               prologue=prologue, relu=relu, stats=stats)
+    z, s1, s2 = pl.pallas_call(
+        kernel,
+        grid=(nn, nb, nh),
+        in_specs=[
+            pl.BlockSpec((block_b, block_h, W, K),
+                         lambda j, ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((K, block_n), lambda j, ib, ih: (0, j)),
+            pl.BlockSpec((1, K), lambda j, ib, ih: (0, 0)),
+            pl.BlockSpec((1, K), lambda j, ib, ih: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_b, block_h, W, block_n),
+                         lambda j, ib, ih: (ib, ih, 0, j)),
+            pl.BlockSpec((1, block_n), lambda j, ib, ih: (0, j)),
+            pl.BlockSpec((1, block_n), lambda j, ib, ih: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, W, N), x.dtype),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, block_n), jnp.float32),
+                        pltpu.VMEM((1, block_n), jnp.float32)],
+        interpret=interpret,
+    )(x, w, a2, b2)
+    return z, s1[0], s2[0]
+
+
+def _bwd4(relu, stats, block_b, block_h, block_n, interpret, res, grads):
+    x, w, a, b, z = res
+    dz, ds1, ds2 = grads
+    B, H, W, K = x.shape
+    N = w.shape[1]
+    prologue = a is not None
+    nb, nh, nn = B // block_b, H // block_h, N // block_n
+    dz = dz.astype(x.dtype)
+    zz = z if stats else jnp.zeros((B, H, W, N), x.dtype)
+    ds1r = (ds1.reshape(1, N).astype(jnp.float32) if stats
+            else jnp.zeros((1, N), jnp.float32))
+    ds2r = (ds2.reshape(1, N).astype(jnp.float32) if stats
+            else jnp.zeros((1, N), jnp.float32))
+    a2 = (a.reshape(1, K) if prologue else jnp.zeros((1, K), x.dtype))
+    b2 = (b.reshape(1, K) if prologue else jnp.zeros((1, K), x.dtype))
+
+    dx_kernel = functools.partial(_bwd4_dx_kernel, nb=nb, nh=nh,
+                                  prologue=prologue, relu=relu, stats=stats)
+    dx, da, db = pl.pallas_call(
+        dx_kernel,
+        grid=(1, nb, nh),
+        in_specs=[
+            pl.BlockSpec((block_b, block_h, W, K),
+                         lambda j, ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((K, N), lambda j, ib, ih: (0, 0)),
+            pl.BlockSpec((1, K), lambda j, ib, ih: (0, 0)),
+            pl.BlockSpec((1, K), lambda j, ib, ih: (0, 0)),
+            pl.BlockSpec((block_b, block_h, W, N),
+                         lambda j, ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((block_b, block_h, W, N),
+                         lambda j, ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((1, N), lambda j, ib, ih: (0, 0)),
+            pl.BlockSpec((1, N), lambda j, ib, ih: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_b, block_h, W, K),
+                         lambda j, ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((1, K), lambda j, ib, ih: (0, 0)),
+            pl.BlockSpec((1, K), lambda j, ib, ih: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, W, K), x.dtype),
+            jax.ShapeDtypeStruct((1, K), jnp.float32),
+            jax.ShapeDtypeStruct((1, K), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, K), jnp.float32),
+                        pltpu.VMEM((1, K), jnp.float32)],
+        interpret=interpret,
+    )(x, w, a2, b2, dz, zz, ds1r, ds2r)
+
+    dw_kernel = functools.partial(_bwd4_dw_kernel, nb=nb, nh=nh,
+                                  prologue=prologue, relu=relu, stats=stats)
+    dw = pl.pallas_call(
+        dw_kernel,
+        grid=(nn, nb, nh),
+        in_specs=[
+            pl.BlockSpec((block_b, block_h, W, K),
+                         lambda j, ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((1, K), lambda j, ib, ih: (0, 0)),
+            pl.BlockSpec((1, K), lambda j, ib, ih: (0, 0)),
+            pl.BlockSpec((block_b, block_h, W, block_n),
+                         lambda j, ib, ih: (ib, ih, 0, j)),
+            pl.BlockSpec((block_b, block_h, W, block_n),
+                         lambda j, ib, ih: (ib, ih, 0, j)),
+            pl.BlockSpec((1, block_n), lambda j, ib, ih: (0, j)),
+            pl.BlockSpec((1, block_n), lambda j, ib, ih: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((K, block_n), lambda j, ib, ih: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((K, N), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((K, block_n), jnp.float32)],
+        interpret=interpret,
+    )(x, a2, b2, dz, zz, ds1r, ds2r)
+
+    dw = dw.astype(w.dtype)
+    if prologue:
+        da_out = da[0].astype(a.dtype)
+        db_out = db[0].astype(b.dtype)
+    else:
+        da_out = db_out = None
+    return dx, dw, da_out, db_out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _fused4(x, w, a, b, relu, stats, block_b, block_h, block_n, interpret):
+    return _fwd4(x, w, a, b, relu, stats, block_b, block_h, block_n,
+                 interpret)
+
+
+def _fused4_fwd(x, w, a, b, relu, stats, block_b, block_h, block_n,
+                interpret):
+    z, s1, s2 = _fwd4(x, w, a, b, relu, stats, block_b, block_h, block_n,
+                      interpret)
+    return (z, s1, s2), (x, w, a, b, z if stats else None)
+
+
+def _fused4_bwd(relu, stats, block_b, block_h, block_n, interpret, res,
+                grads):
+    return _bwd4(relu, stats, block_b, block_h, block_n, interpret, res,
+                 grads)
+
+
+_fused4.defvjp(_fused4_fwd, _fused4_bwd)
+
+
+def _divisors_desc(n, cap):
+    return [d for d in range(min(n, cap), 0, -1) if n % d == 0]
+
+
+def fused_bn_relu_matmul_nhwc(x, w, scale=None, bias=None, *, relu=None,
+                              stats=True, block_n=512, interpret=False):
+    """Layout-preserving NHWC form of :func:`fused_bn_relu_matmul`.
+
+    x: (B, H, W, K) stays in its native tiling end-to-end — the 1x1-conv
+    contraction happens over the last axis with the flatten done
+    in-register. Returns ``(z (B,H,W,N), s1, s2)``. Returns None (caller
+    falls back) when shapes don't tile cleanly: N % block_n (after
+    capping) or no (block_b, block_h) fits the VMEM budget.
+    """
+    if relu is None:
+        relu = scale is not None
+    B, H, W, K = x.shape
+    N = w.shape[1]
+    eb = x.dtype.itemsize
+    bn = min(block_n, N)
+    if N % bn:
+        return None
+
+    def _fits(rows):
+        return _vmem_need(rows, K, N, bn, eb) <= _VMEM_BUDGET
+
+    pick = None
+    for bb in _divisors_desc(B, 64):
+        if _fits(bb * H * W):
+            pick = (bb, H)
+            break
+    if pick is None:
+        for bh in _divisors_desc(H, H)[1:]:          # split H next
+            if _fits(1 * bh * W):
+                pick = (1, bh)
+                break
+    if pick is None:
+        return None
+    bb, bh = pick
+    return _fused4(x, w, scale, bias, bool(relu), bool(stats), int(bb),
+                   int(bh), int(bn), bool(interpret))

@@ -217,18 +217,32 @@ class FusedBottleneck(_Module):
         flattened form at 1.75x slower than lax.conv (the reshape forces
         relayout copies of every stage-1 activation); layout-preserving
         contraction is the fix, for the hand kernel and the XLA arm both.
-        BIGDL_TPU_FUSED_BLOCK_M/_N override the Pallas tile sizes (read
-        at trace time — the on-chip sweep's tuning knobs)."""
+        Trace-time env knobs for on-chip sweeps: BIGDL_TPU_FUSED_BLOCK_N
+        tiles N on both Pallas arms; BIGDL_TPU_FUSED_LAYOUT=flat forces
+        the flattened (BHW, C) kernel (whose extra BIGDL_TPU_FUSED_BLOCK_M
+        knob tiles rows) — the measured-slower arm kept reproducible."""
         mode = self._mode() if self.kernel != "xla" else "xla"
         if mode in ("pallas", "interpret"):
             import os
-            from ..kernels.fused_matmul import fused_bn_relu_matmul
+            from ..kernels.fused_matmul import (fused_bn_relu_matmul,
+                                                fused_bn_relu_matmul_nhwc)
+            interp = (mode == "interpret")
+            bn = int(os.environ.get("BIGDL_TPU_FUSED_BLOCK_N", 512))
+            layout = os.environ.get("BIGDL_TPU_FUSED_LAYOUT", "nhwc")
+            if x.ndim == 4 and layout != "flat":
+                # layout-preserving kernel: (B,H,W,K) blocks straight from
+                # HBM, flatten in-register — the flattened form's relayout
+                # copies measured ~1.7x of the whole step on-chip
+                out = fused_bn_relu_matmul_nhwc(
+                    x, w, scale, bias, relu=relu, stats=stats, block_n=bn,
+                    interpret=interp)
+                if out is not None:
+                    return out
             z, s1, s2 = fused_bn_relu_matmul(
                 x.reshape(-1, x.shape[-1]), w, scale, bias, relu=relu,
                 stats=stats,
                 block_m=int(os.environ.get("BIGDL_TPU_FUSED_BLOCK_M", 512)),
-                block_n=int(os.environ.get("BIGDL_TPU_FUSED_BLOCK_N", 512)),
-                interpret=(mode == "interpret"))
+                block_n=bn, interpret=interp)
             return z.reshape(x.shape[:-1] + (w.shape[1],)), s1, s2
         xh = x if scale is None else x * scale + bias
         if relu:

@@ -239,6 +239,68 @@ def test_fused_matmul_forward_and_grads():
         assert rel < 2e-4, (name, rel)
 
 
+def test_fused_matmul_nhwc_forward_and_grads():
+    """Layout-preserving (B,H,W,K) kernel == last-axis dot_general math —
+    values, stats, and grads through the same BN-normalize loss as the
+    flattened kernel's test."""
+    from bigdl_tpu.kernels.fused_matmul import fused_bn_relu_matmul_nhwc
+    rng = np.random.RandomState(0)
+    B, H, W, K, N = 4, 6, 8, 16, 32
+    x = jnp.asarray(rng.randn(B, H, W, K).astype(np.float32))
+    w = jnp.asarray(rng.randn(K, N).astype(np.float32) * 0.1)
+    a = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(K).astype(np.float32))
+
+    def ref(x, w, a, b):
+        xh = jnp.maximum(x * a + b, 0.0)
+        z = jax.lax.dot_general(xh, w, (((3,), (0,)), ((), ())))
+        return z, jnp.sum(z, (0, 1, 2)), jnp.sum(z * z, (0, 1, 2))
+
+    kern = lambda *aa: fused_bn_relu_matmul_nhwc(*aa, interpret=True)
+    z, s1, s2 = kern(x, w, a, b)
+    zr, s1r, s2r = ref(x, w, a, b)
+    assert z.shape == (B, H, W, N)
+    assert np.allclose(z, zr, atol=1e-4)
+    assert np.allclose(s1, s1r, atol=1e-3)
+    assert np.allclose(s2, s2r, atol=1e-2)
+
+    def mk_loss(fwd):
+        def loss(x, w, a, b):
+            z, s1, s2 = fwd(x, w, a, b)
+            m = B * H * W
+            mean = s1 / m
+            var = s2 / m - mean ** 2
+            zh = (z - mean) * jax.lax.rsqrt(var + 1e-5)
+            return jnp.sum(jnp.tanh(zh * 0.3))
+        return loss
+
+    gf = jax.grad(mk_loss(kern), argnums=(0, 1, 2, 3))(x, w, a, b)
+    gr = jax.grad(mk_loss(ref), argnums=(0, 1, 2, 3))(x, w, a, b)
+    for name, f, r in zip("xwab", gf, gr):
+        rel = float(jnp.abs(f - r).max()) / (float(jnp.abs(r).max()) + 1e-9)
+        assert rel < 2e-4, (name, rel)
+    # non-dividing N falls back (caller handles None)
+    wbad = jnp.asarray(rng.randn(K, 24).astype(np.float32))
+    assert fused_bn_relu_matmul_nhwc(x, wbad, block_n=16,
+                                     interpret=True) is None
+
+    # genuinely multi-tile grid (nb=2, nh=2, nn=2): covers the cross-tile
+    # accumulator init/finish guards (ib==0&&ih==0 / last-tile writes)
+    # that the auto-fitted single-tile call above never exercises
+    from bigdl_tpu.kernels.fused_matmul import _fused4
+    zm, s1m, s2m = _fused4(x, w, a, b, True, True, B // 2, H // 2, N // 2,
+                           True)
+    assert np.allclose(zm, zr, atol=1e-4)
+    assert np.allclose(s1m, s1r, atol=1e-3)
+    assert np.allclose(s2m, s2r, atol=1e-2)
+    gm = jax.grad(mk_loss(lambda *aa: _fused4(
+        *aa, True, True, B // 2, H // 2, N // 2, True)),
+        argnums=(0, 1, 2, 3))(x, w, a, b)
+    for name, f, r in zip("xwab", gm, gr):
+        rel = float(jnp.abs(f - r).max()) / (float(jnp.abs(r).max()) + 1e-9)
+        assert rel < 2e-4, ("multi-tile", name, rel)
+
+
 def test_fused_bottleneck_matches_reference_block(monkeypatch):
     """FusedBottleneck == the Sequential bottleneck with identical weights
     (fwd train+eval, running stats), and the interpret-mode Pallas path ==

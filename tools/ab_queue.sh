@@ -34,7 +34,9 @@ run "lm flash q256 k512" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=256 BIGDL
 run "lm flash q512 k1024" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=512 BIGDL_TPU_FLASH_BLOCK_K=1024
 # 6. remat OFF + batch 32 (if remat=0 fits, bigger batch may too)
 run "lm remat=0 B32" secondary:transformer BENCH_LM_REMAT=0 BENCH_LM_BATCH=32
-# 7. where does the fused=xla resnet step spend time now?
+# 7. layout-preserving Pallas bottleneck vs the winning fused=xla arm
+run "resnet fused=pallas(nhwc)" headline BENCH_FUSED=pallas
+# 8. where does the fused=xla resnet step spend time now?
 echo "### profile fused=xla ($(date -u +%H:%M:%SZ))" >> "$LOG"
 timeout 900 python tools/profile_resnet.py > /tmp/profile_fused.out 2>&1 \
   && tail -30 /tmp/profile_fused.out >> "$LOG" \
