@@ -89,7 +89,10 @@ def resnet_bench_variant():
     if pool_grad not in ("exact", "fast"):
         raise SystemExit(f"BENCH_POOL_GRAD={pool_grad!r}: expected "
                          "exact | fast")
-    return fused, pool_grad
+    stem = os.environ.get("BENCH_STEM", "conv7")
+    if stem not in ("conv7", "s2d"):
+        raise SystemExit(f"BENCH_STEM={stem!r}: expected conv7 | s2d")
+    return fused, pool_grad, stem
 
 
 def _build_resnet_step(batch, size):
@@ -114,11 +117,11 @@ def _build_resnet_step(batch, size):
     #     math was 1.75x SLOWER — layout preservation is the whole win.
     #   1 — the hand-written Pallas fused kernel arm (kernels/fused_matmul)
     #   0 — plain unfused bottlenecks (the pre-round-3 baseline)
-    fused, pool_grad = resnet_bench_variant()
+    fused, pool_grad, stem = resnet_bench_variant()
     # BENCH_POOL_GRAD=fast enables the scatter-free maxpool backward
     # (nn/pool.py; measured -15% on v5e, kept as an option)
     model = ResNet(class_num=1000, depth=50, format="NHWC", fused=fused,
-                   pool_grad=pool_grad)
+                   pool_grad=pool_grad, stem=stem)
     params, mstate = model.init(jax.random.PRNGKey(0))
     crit = CrossEntropyCriterion()
     optim = SGD(learningrate=0.1, momentum=0.9)
@@ -280,24 +283,24 @@ def bench_resnet50_realdata():
     # workers × batch_bytes beyond the queue itself
     n_workers = int(os.environ.get("BENCH_JPEG_WORKERS",
                                    min(16, max(8, os.cpu_count() or 1))))
+    # bf16_nhwc: decode workers emit accelerator-ready batches — no host
+    # f32→bf16 cast (measured 0.24 s/batch), no device-side transpose,
+    # half the host→device bytes
     pf = JpegFolderPrefetcher(
         paths, labels, size, size, mean=(124.0, 117.0, 104.0),
         std=(59.0, 57.0, 57.0), batch_size=batch, n_workers=n_workers,
-        queue_capacity=4)
+        queue_capacity=4, out="bf16_nhwc")
 
     step, carry, lr, flops_per_step = _build_resnet_step(batch, size)
 
     def batches():
         """Endless stream of device-resident (x, y). loop_epochs keeps the
         decode workers running across epoch boundaries (a cold restart
-        refills the whole queue: 7-11 s stall on a 1-core host). NCHW→NHWC
-        happens ON DEVICE (a cheap layout op) so the host path is
-        decode → bf16 cast → async put."""
+        refills the whole queue: 7-11 s stall on a 1-core host); batches
+        arrive bf16 NHWC so the host path is decode → async device_put."""
         while True:
             for mb in pf.data(train=True, loop_epochs=1000):
-                xh = np.asarray(mb.input, np.float32)  # (B, C, H, W)
-                x = jnp.transpose(jnp.asarray(xh, jnp.bfloat16),
-                                  (0, 2, 3, 1))
+                x = jnp.asarray(np.asarray(mb.input))  # (B, H, W, 3) bf16
                 y = jnp.asarray(np.asarray(mb.target), jnp.int32)
                 yield x, y
 

@@ -64,9 +64,10 @@ def load_library():
         lib.pf_start_epoch.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int]
-        lib.pf_next.argtypes = [ctypes.c_void_p,
-                                ctypes.POINTER(ctypes.c_float),
+        lib.pf_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                 ctypes.POINTER(ctypes.c_float)]
+        lib.pf_set_format.restype = ctypes.c_int
+        lib.pf_set_format.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.pf_end_epoch.argtypes = [ctypes.c_void_p]
         lib.pf_destroy.argtypes = [ctypes.c_void_p]
         lib.pf_decode_failures.restype = ctypes.c_int64
@@ -118,6 +119,8 @@ class NativePrefetcher:
     Usable as a dataset for the optimizers: ``data(train)`` yields MiniBatch
     with inputs shaped (B, C, H, W) and 1-based float labels.
     """
+
+    _out_format = 0  # 0 = f32 CHW; 1 = bf16 NHWC (JpegFolderPrefetcher)
 
     def __init__(self, images: np.ndarray, labels: np.ndarray,
                  mean, std, batch_size: int = 32, n_workers: int = 4,
@@ -195,13 +198,19 @@ class NativePrefetcher:
             len(order), self.batch_size, self.n_workers,
             self.queue_capacity)
         self._epoch_open = True
-        per = self.c * self.h * self.w
+        bf16_nhwc = self._out_format == 1
+        if bf16_nhwc:
+            import ml_dtypes
+            x_shape, x_dtype = ((self.batch_size, self.h, self.w, 3),
+                                ml_dtypes.bfloat16)
+        else:
+            x_shape, x_dtype = ((self.batch_size, self.c, self.h, self.w),
+                                np.float32)
         while True:
-            x = np.empty((self.batch_size, self.c, self.h, self.w),
-                         np.float32)
+            x = np.empty(x_shape, x_dtype)
             y = np.empty((self.batch_size,), np.float32)
             got = self.lib.pf_next(
-                self.handle, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self.handle, ctypes.c_void_p(x.ctypes.data),
                 y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
             if got == 0:
                 self._epoch_open = False
@@ -320,10 +329,17 @@ class JpegFolderPrefetcher(NativePrefetcher):
 
     def __init__(self, paths, labels, height: int, width: int, mean, std,
                  batch_size: int = 32, n_workers: int = 4,
-                 queue_capacity: int = 4, seed: int = 1):
+                 queue_capacity: int = 4, seed: int = 1,
+                 out: str = "f32_chw"):
+        """``out="bf16_nhwc"`` makes the decode workers emit
+        accelerator-ready batches: normalized bf16 in NHWC, so the host
+        path is decode → device_put with no f32→bf16 cast, no transpose,
+        and half the host→device bytes."""
         self.lib = load_library()
         if self.lib is None or not self.lib.jd_available():
             raise RuntimeError("native JPEG decode unavailable")
+        if out not in ("f32_chw", "bf16_nhwc"):
+            raise ValueError(f"out={out!r}: expected f32_chw | bf16_nhwc")
         n = len(paths)
         labels = np.ascontiguousarray(labels, np.int64)
         mean = np.ascontiguousarray(np.broadcast_to(
@@ -344,6 +360,9 @@ class JpegFolderPrefetcher(NativePrefetcher):
         self.queue_capacity = queue_capacity
         self._rng = np.random.RandomState(seed)
         self._epoch_open = False
+        self._out_format = 1 if out == "bf16_nhwc" else 0
+        if self.lib.pf_set_format(self.handle, self._out_format) != 0:
+            raise RuntimeError(f"pf_set_format({out}) rejected")
 
 
 def read_tfrecords_native(path: str, verify_crc: bool = True):

@@ -98,11 +98,14 @@ int jpeg_decode_raw(const uint8_t* buf, long len, std::vector<uint8_t>& out,
   return c;
 }
 
-// Bilinear-resize HWC u8 → normalized CHW float (always 3 output channels;
-// grayscale is broadcast). Half-pixel-center sampling (align_corners=false).
-void resize_norm_chw(const uint8_t* src, int sw, int sh, int sc, int tw,
-                     int th, const float* mean, const float* stdv,
-                     float* out) {
+// Bilinear resample + normalize, one copy of the half-pixel-center
+// sampling math (align_corners=false); Store(x, y, c, value) decides the
+// output layout/dtype so the f32-CHW and bf16-NHWC pipelines can never
+// drift apart.
+template <typename Store>
+void resize_norm_generic(const uint8_t* src, int sw, int sh, int sc, int tw,
+                         int th, const float* mean, const float* stdv,
+                         Store store) {
   const float sx = float(sw) / tw, sy = float(sh) / th;
   for (int y = 0; y < th; ++y) {
     float fy = (y + 0.5f) * sy - 0.5f;
@@ -122,11 +125,41 @@ void resize_norm_chw(const uint8_t* src, int sw, int sh, int sc, int tw,
         float v11 = src[(size_t(y1) * sw + x1) * sc + cs];
         float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
                   v10 * wy * (1 - wx) + v11 * wy * wx;
-        out[(size_t(c) * th + y) * tw + x] =
-            (v - (mean ? mean[c] : 0.f)) / (stdv ? stdv[c] : 1.f);
+        store(x, y, c,
+              (v - (mean ? mean[c] : 0.f)) / (stdv ? stdv[c] : 1.f));
       }
     }
   }
+}
+
+// f32 CHW (grayscale broadcast to 3 channels, like the generic core)
+void resize_norm_chw(const uint8_t* src, int sw, int sh, int sc, int tw,
+                     int th, const float* mean, const float* stdv,
+                     float* out) {
+  resize_norm_generic(src, sw, sh, sc, tw, th, mean, stdv,
+                      [out, tw, th](int x, int y, int c, float v) {
+                        out[(size_t(c) * th + y) * tw + x] = v;
+                      });
+}
+
+// round-to-nearest-even f32 -> bf16 bits
+static inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  bits += 0x7FFFu + ((bits >> 16) & 1u);
+  return uint16_t(bits >> 16);
+}
+
+// bf16 NHWC: the accelerator-ready layout — what the chip consumes is
+// exactly what leaves the host (no f32→bf16 cast or CHW→NHWC transpose
+// downstream, half the host→device bytes of the f32 path).
+void resize_norm_nhwc_bf16(const uint8_t* src, int sw, int sh, int sc,
+                           int tw, int th, const float* mean,
+                           const float* stdv, uint16_t* out) {
+  resize_norm_generic(src, sw, sh, sc, tw, th, mean, stdv,
+                      [out, tw](int x, int y, int c, float v) {
+                        out[(size_t(y) * tw + x) * 3 + c] = f32_to_bf16(v);
+                      });
 }
 
 bool read_file(const std::string& path, std::vector<uint8_t>& buf) {
@@ -147,7 +180,8 @@ bool read_file(const std::string& path, std::vector<uint8_t>& buf) {
 #endif  // BIGDL_TPU_JPEG
 
 struct Batch {
-  std::vector<float> x;
+  std::vector<float> x;      // f32 CHW format (default)
+  std::vector<uint16_t> xh;  // bf16 NHWC format (out_format == 1)
   std::vector<float> y;
   int n = 0;
 };
@@ -180,6 +214,10 @@ struct Prefetcher {
 
   int per_image() const { return channels * height * width; }
 
+  // 0 = f32 CHW (default); 1 = bf16 NHWC (JPEG pipeline only — the
+  // accelerator-ready layout, set via pf_set_format before start_epoch)
+  int out_format = 0;
+
   void decode_one(const uint8_t* rec, float* out) const {
     const int hw = height * width;
     for (int c = 0; c < channels; ++c) {
@@ -211,27 +249,44 @@ struct Prefetcher {
       size_t end = std::min(start + size_t(batch), order.size());
       Batch b;
       b.n = int(end - start);
-      b.x.resize(size_t(b.n) * per_image());
+      const bool bf16_nhwc = out_format == 1;
+      if (bf16_nhwc)
+        b.xh.resize(size_t(b.n) * per_image());
+      else
+        b.x.resize(size_t(b.n) * per_image());
       b.y.resize(b.n);
       for (size_t i = start; i < end; ++i) {
         int idx = order[i];
-        float* dst = b.x.data() + (i - start) * per_image();
+        size_t off = (i - start) * size_t(per_image());
+        float* dst = bf16_nhwc ? nullptr : b.x.data() + off;
+        uint16_t* dst16 = bf16_nhwc ? b.xh.data() + off : nullptr;
         if (jpeg_mode) {
 #ifdef BIGDL_TPU_JPEG
           int sw = 0, sh = 0, sc = -1;
           if (read_file(files[idx], raw))
             sc = jpeg_decode_raw(raw.data(), long(raw.size()), pix, &sw, &sh,
                                  width, height);
-          if (sc > 0) {
+          if (sc > 0 && bf16_nhwc) {
+            resize_norm_nhwc_bf16(pix.data(), sw, sh, sc, width, height,
+                                  mean.empty() ? nullptr : mean.data(),
+                                  std_.empty() ? nullptr : std_.data(),
+                                  dst16);
+          } else if (sc > 0) {
             resize_norm_chw(pix.data(), sw, sh, sc, width, height,
                             mean.empty() ? nullptr : mean.data(),
                             std_.empty() ? nullptr : std_.data(), dst);
           } else {
             decode_failures.fetch_add(1);
-            std::memset(dst, 0, sizeof(float) * per_image());
+            if (bf16_nhwc)
+              std::memset(dst16, 0, sizeof(uint16_t) * per_image());
+            else
+              std::memset(dst, 0, sizeof(float) * per_image());
           }
 #else
-          std::memset(dst, 0, sizeof(float) * per_image());
+          if (bf16_nhwc)
+            std::memset(dst16, 0, sizeof(uint16_t) * per_image());
+          else
+            std::memset(dst, 0, sizeof(float) * per_image());
 #endif
         } else {
           decode_one(images.data() + size_t(idx) * record_bytes, dst);
@@ -364,8 +419,22 @@ void pf_start_epoch(void* h, const int* order, int n, int batch,
     p->workers.emplace_back([p] { p->worker(); });
 }
 
-// returns batch size, 0 at epoch end. out_x sized batch*per_image floats.
-int pf_next(void* h, float* out_x, float* out_y) {
+// Select the output format BEFORE pf_start_epoch: 0 = f32 CHW (default),
+// 1 = bf16 NHWC (JPEG pipeline only). Returns 0 on success, -1 if the
+// format is unsupported for this prefetcher.
+int pf_set_format(void* h, int fmt) {
+  auto* p = static_cast<Prefetcher*>(h);
+  if (fmt == 1 && !p->jpeg_mode) return -1;
+  if (fmt != 0 && fmt != 1) return -1;
+  if (p->active_workers.load() != 0) return -1;  // mid-epoch switch would
+      // make pf_next copy from the wrong Batch member for queued batches
+  p->out_format = fmt;
+  return 0;
+}
+
+// returns batch size, 0 at epoch end. out_x sized batch*per_image
+// elements of the selected format (f32 or bf16-bits).
+int pf_next(void* h, void* out_x, float* out_y) {
   auto* p = static_cast<Prefetcher*>(h);
   Batch b;
   {
@@ -378,7 +447,10 @@ int pf_next(void* h, float* out_x, float* out_y) {
     p->ready.pop();
   }
   p->cv_push.notify_one();
-  std::memcpy(out_x, b.x.data(), b.x.size() * sizeof(float));
+  if (p->out_format == 1)
+    std::memcpy(out_x, b.xh.data(), b.xh.size() * sizeof(uint16_t));
+  else
+    std::memcpy(out_x, b.x.data(), b.x.size() * sizeof(float));
   std::memcpy(out_y, b.y.data(), b.y.size() * sizeof(float));
   return b.n;
 }

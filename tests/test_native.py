@@ -154,6 +154,40 @@ def test_native_jpeg_folder_prefetcher(tmp_path):
     assert ys2 == sorted(float(l) for l in labels)
 
 
+def test_native_jpeg_prefetcher_bf16_nhwc_output(tmp_path):
+    """out="bf16_nhwc" emits accelerator-ready batches: same pixels as the
+    f32 CHW path within bf16 rounding, transposed to NHWC, dtype bf16.
+    n_workers=1 so both instances deliver batches in cursor order."""
+    import ml_dtypes
+    if not native.jpeg_available():
+        import pytest
+        pytest.skip("libjpeg not available")
+    paths, labels = [], []
+    for i in range(8):
+        p, _ = _make_jpeg(tmp_path, w=48, h=48, name=f"bf{i}.jpg")
+        paths.append(p)
+        labels.append(i % 4 + 1)
+    kw = dict(mean=(124.0, 117.0, 104.0), std=(59.0, 57.0, 57.0),
+              batch_size=4, n_workers=1, queue_capacity=2)
+    pf32 = native.JpegFolderPrefetcher(paths, labels, 32, 32, **kw)
+    pf16 = native.JpegFolderPrefetcher(paths, labels, 32, 32,
+                                       out="bf16_nhwc", **kw)
+    b32 = next(pf32.data(train=False))
+    b16 = next(pf16.data(train=False))
+    x16 = np.asarray(b16.get_input())
+    assert x16.dtype == ml_dtypes.bfloat16
+    assert x16.shape == (4, 32, 32, 3)
+    x32 = np.transpose(np.asarray(b32.get_input()), (0, 2, 3, 1))
+    assert np.max(np.abs(x32 - x16.astype(np.float32))) < 0.02
+    assert np.allclose(np.asarray(b32.get_target()),
+                       np.asarray(b16.get_target()))
+    # non-JPEG prefetchers reject the format rather than crash
+    imgs = np.zeros((8, 1, 8, 8), np.uint8)
+    pf = native.NativePrefetcher(imgs, np.arange(1, 9, dtype=np.int64),
+                                 [0.0], [1.0], batch_size=4)
+    assert pf.lib.pf_set_format(pf.handle, 1) != 0
+
+
 def test_native_jpeg_prefetcher_counts_bad_files(tmp_path):
     from bigdl_tpu import native
     if not native.jpeg_available():

@@ -36,7 +36,9 @@ run "lm flash q512 k1024" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=512 BIGD
 run "lm remat=0 B32" secondary:transformer BENCH_LM_REMAT=0 BENCH_LM_BATCH=32
 # 7. layout-preserving Pallas bottleneck vs the winning fused=xla arm
 run "resnet fused=pallas(nhwc)" headline BENCH_FUSED=pallas
-# 8. where does the fused=xla resnet step spend time now?
+# 8. space-to-depth stem on top of the fused=xla win (was neutral unfused)
+run "resnet fused=xla s2d" headline BENCH_STEM=s2d
+# 9. where does the fused=xla resnet step spend time now?
 echo "### profile fused=xla ($(date -u +%H:%M:%SZ))" >> "$LOG"
 timeout 900 python tools/profile_resnet.py > /tmp/profile_fused.out 2>&1 \
   && tail -30 /tmp/profile_fused.out >> "$LOG" \

@@ -26,9 +26,9 @@ def capture(batch: int = 256, logdir: str = "/tmp/bigdl_prof"):
     engine.set_seed(0)
     # profile the exact variant the bench runs (shared BENCH_* parser)
     from bench import resnet_bench_variant
-    fused, pool_grad = resnet_bench_variant()
+    fused, pool_grad, stem = resnet_bench_variant()
     model = ResNet(class_num=1000, depth=50, format="NHWC", fused=fused,
-                   pool_grad=pool_grad)
+                   pool_grad=pool_grad, stem=stem)
     params, mstate = model.init(jax.random.PRNGKey(0))
     crit = CrossEntropyCriterion()
     optim = SGD(learningrate=0.1, momentum=0.9)
