@@ -68,6 +68,9 @@ def load_library():
                                 ctypes.POINTER(ctypes.c_float)]
         lib.pf_set_format.restype = ctypes.c_int
         lib.pf_set_format.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pf_set_augment.restype = ctypes.c_int
+        lib.pf_set_augment.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                       ctypes.c_longlong]
         lib.pf_end_epoch.argtypes = [ctypes.c_void_p]
         lib.pf_destroy.argtypes = [ctypes.c_void_p]
         lib.pf_decode_failures.restype = ctypes.c_int64
@@ -330,11 +333,18 @@ class JpegFolderPrefetcher(NativePrefetcher):
     def __init__(self, paths, labels, height: int, width: int, mean, std,
                  batch_size: int = 32, n_workers: int = 4,
                  queue_capacity: int = 4, seed: int = 1,
-                 out: str = "f32_chw"):
+                 out: str = "f32_chw", augment: bool = False):
         """``out="bf16_nhwc"`` makes the decode workers emit
         accelerator-ready batches: normalized bf16 in NHWC, so the host
         path is decode → device_put with no f32→bf16 cast, no transpose,
-        and half the host→device bytes."""
+        and half the host→device bytes.
+
+        ``augment=True`` runs Inception-style RandomResizedCrop (area
+        U(0.08, 1), aspect exp(U(±log 4/3)), center-square fallback) +
+        p=0.5 horizontal flip ON the decode workers — the reference's
+        ImageNet train transform at native speed, deterministic per
+        (seed, epoch position). Build a separate augment=False instance
+        for evaluation."""
         self.lib = load_library()
         if self.lib is None or not self.lib.jd_available():
             raise RuntimeError("native JPEG decode unavailable")
@@ -363,6 +373,9 @@ class JpegFolderPrefetcher(NativePrefetcher):
         self._out_format = 1 if out == "bf16_nhwc" else 0
         if self.lib.pf_set_format(self.handle, self._out_format) != 0:
             raise RuntimeError(f"pf_set_format({out}) rejected")
+        if self.lib.pf_set_augment(self.handle, 1 if augment else 0,
+                                   seed) != 0:
+            raise RuntimeError("pf_set_augment rejected")
 
 
 def read_tfrecords_native(path: str, verify_crc: bool = True):

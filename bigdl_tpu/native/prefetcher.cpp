@@ -21,6 +21,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <cmath>
 #include <mutex>
 #include <queue>
 #include <string>
@@ -98,25 +99,33 @@ int jpeg_decode_raw(const uint8_t* buf, long len, std::vector<uint8_t>& out,
   return c;
 }
 
-// Bilinear resample + normalize, one copy of the half-pixel-center
+// Bilinear resample + normalize from a source REGION (rx, ry, rw, rh)
+// with optional horizontal flip — one copy of the half-pixel-center
 // sampling math (align_corners=false); Store(x, y, c, value) decides the
 // output layout/dtype so the f32-CHW and bf16-NHWC pipelines can never
-// drift apart.
+// drift apart. Sample coordinates are clamped to the full image, so any
+// region within bounds is safe.
 template <typename Store>
-void resize_norm_generic(const uint8_t* src, int sw, int sh, int sc, int tw,
-                         int th, const float* mean, const float* stdv,
-                         Store store) {
-  const float sx = float(sw) / tw, sy = float(sh) / th;
+void resize_norm_region(const uint8_t* src, int sw, int sh, int sc,
+                        float rx, float ry, float rw, float rh, bool flip,
+                        int tw, int th, const float* mean,
+                        const float* stdv, Store store) {
+  const float sx = rw / tw, sy = rh / th;
   for (int y = 0; y < th; ++y) {
-    float fy = (y + 0.5f) * sy - 0.5f;
-    int y0 = fy < 0 ? 0 : int(fy);
+    float fy = ry + (y + 0.5f) * sy - 0.5f;
+    if (fy < 0) fy = 0;
+    if (fy > sh - 1) fy = float(sh - 1);
+    int y0 = int(fy);
     int y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
-    float wy = fy < 0 ? 0.f : fy - y0;
+    float wy = fy - y0;
     for (int x = 0; x < tw; ++x) {
-      float fx = (x + 0.5f) * sx - 0.5f;
-      int x0 = fx < 0 ? 0 : int(fx);
+      int xe = flip ? tw - 1 - x : x;
+      float fx = rx + (xe + 0.5f) * sx - 0.5f;
+      if (fx < 0) fx = 0;
+      if (fx > sw - 1) fx = float(sw - 1);
+      int x0 = int(fx);
       int x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
-      float wx = fx < 0 ? 0.f : fx - x0;
+      float wx = fx - x0;
       for (int c = 0; c < 3; ++c) {
         int cs = sc == 1 ? 0 : c;
         float v00 = src[(size_t(y0) * sw + x0) * sc + cs];
@@ -132,14 +141,67 @@ void resize_norm_generic(const uint8_t* src, int sw, int sh, int sc, int tw,
   }
 }
 
+template <typename Store>
+void resize_norm_generic(const uint8_t* src, int sw, int sh, int sc, int tw,
+                         int th, const float* mean, const float* stdv,
+                         Store store) {
+  resize_norm_region(src, sw, sh, sc, 0.f, 0.f, float(sw), float(sh),
+                     false, tw, th, mean, stdv, store);
+}
+
+// splitmix64: per-image deterministic RNG stream for augmentation
+static inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+static inline float rnd01(uint64_t* s) {
+  return float((splitmix64(s) >> 40) * (1.0 / 16777216.0));
+}
+
+// Inception-style RandomResizedCrop: sample area fraction U(0.08, 1) and
+// aspect ratio exp(U(log 3/4, log 4/3)), 10 attempts, then central
+// max-square fallback (the reference ImageNet train transform's
+// semantics, run on the decode workers at native speed).
+void sample_crop(uint64_t* rng, int sw, int sh, float* rx, float* ry,
+                 float* rw, float* rh) {
+  for (int attempt = 0; attempt < 10; ++attempt) {
+    float area = float(sw) * sh * (0.08f + 0.92f * rnd01(rng));
+    float logr = (rnd01(rng) * 2.f - 1.f) * 0.28768207f;  // log(4/3)
+    float ratio = std::exp(logr);
+    float cw = std::sqrt(area * ratio);
+    float ch = std::sqrt(area / ratio);
+    if (cw <= sw && ch <= sh) {
+      *rx = rnd01(rng) * (sw - cw);
+      *ry = rnd01(rng) * (sh - ch);
+      *rw = cw;
+      *rh = ch;
+      return;
+    }
+  }
+  float side = float(sw < sh ? sw : sh);
+  *rx = (sw - side) * 0.5f;
+  *ry = (sh - side) * 0.5f;
+  *rw = side;
+  *rh = side;
+}
+
+// The two output layouts, ONE copy of each indexing scheme — used by the
+// public decode helpers and the prefetcher workers alike.
+inline auto chw_store(float* out, int tw, int th) {
+  return [out, tw, th](int x, int y, int c, float v) {
+    out[(size_t(c) * th + y) * tw + x] = v;
+  };
+}
+
 // f32 CHW (grayscale broadcast to 3 channels, like the generic core)
 void resize_norm_chw(const uint8_t* src, int sw, int sh, int sc, int tw,
                      int th, const float* mean, const float* stdv,
                      float* out) {
   resize_norm_generic(src, sw, sh, sc, tw, th, mean, stdv,
-                      [out, tw, th](int x, int y, int c, float v) {
-                        out[(size_t(c) * th + y) * tw + x] = v;
-                      });
+                      chw_store(out, tw, th));
 }
 
 // round-to-nearest-even f32 -> bf16 bits
@@ -150,17 +212,13 @@ static inline uint16_t f32_to_bf16(float f) {
   return uint16_t(bits >> 16);
 }
 
-// bf16 NHWC: the accelerator-ready layout — what the chip consumes is
-// exactly what leaves the host (no f32→bf16 cast or CHW→NHWC transpose
-// downstream, half the host→device bytes of the f32 path).
-void resize_norm_nhwc_bf16(const uint8_t* src, int sw, int sh, int sc,
-                           int tw, int th, const float* mean,
-                           const float* stdv, uint16_t* out) {
-  resize_norm_generic(src, sw, sh, sc, tw, th, mean, stdv,
-                      [out, tw](int x, int y, int c, float v) {
-                        out[(size_t(y) * tw + x) * 3 + c] = f32_to_bf16(v);
-                      });
+// bf16 NHWC: the accelerator-ready layout (see pf_set_format)
+inline auto nhwc_bf16_store(uint16_t* out, int tw) {
+  return [out, tw](int x, int y, int c, float v) {
+    out[(size_t(y) * tw + x) * 3 + c] = f32_to_bf16(v);
+  };
 }
+
 
 bool read_file(const std::string& path, std::vector<uint8_t>& buf) {
   FILE* f = fopen(path.c_str(), "rb");
@@ -217,6 +275,10 @@ struct Prefetcher {
   // 0 = f32 CHW (default); 1 = bf16 NHWC (JPEG pipeline only — the
   // accelerator-ready layout, set via pf_set_format before start_epoch)
   int out_format = 0;
+  // RandomResizedCrop + hflip on the decode workers (JPEG pipeline only,
+  // pf_set_augment before start_epoch); deterministic per (seed, index)
+  int augment = 0;
+  uint64_t aug_seed = 1;
 
   void decode_one(const uint8_t* rec, float* out) const {
     const int hw = height * width;
@@ -262,19 +324,41 @@ struct Prefetcher {
         uint16_t* dst16 = bf16_nhwc ? b.xh.data() + off : nullptr;
         if (jpeg_mode) {
 #ifdef BIGDL_TPU_JPEG
+          // Under augmentation the fractional-DCT floor rises by
+          // 1/sqrt(min_area) = 1/sqrt(0.08) ≈ 3.54x so even the
+          // smallest crop still covers >= target resolution in SOURCE
+          // pixels — otherwise small crops would train on upsampled
+          // pre-scaled pixels, quietly diverging from the reference
+          // transform's full-resolution crops.
+          const int dec_w = augment ? int(width * 3.54f) + 1 : width;
+          const int dec_h = augment ? int(height * 3.54f) + 1 : height;
           int sw = 0, sh = 0, sc = -1;
           if (read_file(files[idx], raw))
             sc = jpeg_decode_raw(raw.data(), long(raw.size()), pix, &sw, &sh,
-                                 width, height);
-          if (sc > 0 && bf16_nhwc) {
-            resize_norm_nhwc_bf16(pix.data(), sw, sh, sc, width, height,
-                                  mean.empty() ? nullptr : mean.data(),
-                                  std_.empty() ? nullptr : std_.data(),
-                                  dst16);
-          } else if (sc > 0) {
-            resize_norm_chw(pix.data(), sw, sh, sc, width, height,
-                            mean.empty() ? nullptr : mean.data(),
-                            std_.empty() ? nullptr : std_.data(), dst);
+                                 dec_w, dec_h);
+          if (sc > 0) {
+            float rx = 0.f, ry = 0.f, rw = float(sw), rh = float(sh);
+            bool flip = false;
+            if (augment) {
+              // hash (seed, epoch position) into the stream state: a raw
+              // gamma-multiple offset would make every image's draws a
+              // lagged copy of its neighbors' (splitmix64 advances by the
+              // same gamma per draw)
+              uint64_t ix = uint64_t(i + 1);
+              uint64_t rs = aug_seed ^ splitmix64(&ix);
+              sample_crop(&rs, sw, sh, &rx, &ry, &rw, &rh);
+              flip = rnd01(&rs) < 0.5f;
+            }
+            const float* mp = mean.empty() ? nullptr : mean.data();
+            const float* sp = std_.empty() ? nullptr : std_.data();
+            if (bf16_nhwc)
+              resize_norm_region(pix.data(), sw, sh, sc, rx, ry, rw, rh,
+                                 flip, width, height, mp, sp,
+                                 nhwc_bf16_store(dst16, width));
+            else
+              resize_norm_region(pix.data(), sw, sh, sc, rx, ry, rw, rh,
+                                 flip, width, height, mp, sp,
+                                 chw_store(dst, width, height));
           } else {
             decode_failures.fetch_add(1);
             if (bf16_nhwc)
@@ -429,6 +513,17 @@ int pf_set_format(void* h, int fmt) {
   if (p->active_workers.load() != 0) return -1;  // mid-epoch switch would
       // make pf_next copy from the wrong Batch member for queued batches
   p->out_format = fmt;
+  return 0;
+}
+
+// Enable/disable worker-side RandomResizedCrop + horizontal flip (JPEG
+// pipeline only, not mid-epoch). Returns 0 on success.
+int pf_set_augment(void* h, int enabled, long long seed) {
+  auto* p = static_cast<Prefetcher*>(h);
+  if (enabled && !p->jpeg_mode) return -1;
+  if (p->active_workers.load() != 0) return -1;
+  p->augment = enabled ? 1 : 0;
+  p->aug_seed = uint64_t(seed);
   return 0;
 }
 

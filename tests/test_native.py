@@ -188,6 +188,45 @@ def test_native_jpeg_prefetcher_bf16_nhwc_output(tmp_path):
     assert pf.lib.pf_set_format(pf.handle, 1) != 0
 
 
+def test_native_jpeg_prefetcher_augmentation(tmp_path):
+    """Worker-side RandomResizedCrop + hflip: deterministic per seed,
+    different across seeds, different from the un-augmented decode, and
+    statistically centered (mean within the un-augmented image's range)."""
+    if not native.jpeg_available():
+        import pytest
+        pytest.skip("libjpeg not available")
+    paths, labels = [], []
+    for i in range(8):
+        p, _ = _make_jpeg(tmp_path, w=64, h=48, name=f"aug{i}.jpg")
+        paths.append(p)
+        labels.append(i % 4 + 1)
+    kw = dict(mean=(124.0, 117.0, 104.0), std=(59.0, 57.0, 57.0),
+              batch_size=8, n_workers=1, queue_capacity=2)
+    plain = np.asarray(next(native.JpegFolderPrefetcher(
+        paths, labels, 32, 32, **kw).data(train=False)).get_input())
+    a1 = np.asarray(next(native.JpegFolderPrefetcher(
+        paths, labels, 32, 32, augment=True, seed=7,
+        **kw).data(train=False)).get_input())
+    a1b = np.asarray(next(native.JpegFolderPrefetcher(
+        paths, labels, 32, 32, augment=True, seed=7,
+        **kw).data(train=False)).get_input())
+    a2 = np.asarray(next(native.JpegFolderPrefetcher(
+        paths, labels, 32, 32, augment=True, seed=8,
+        **kw).data(train=False)).get_input())
+    assert np.array_equal(a1, a1b)          # same seed → same crops
+    assert not np.array_equal(a1, a2)       # different seed → different
+    assert not np.array_equal(a1, plain)    # augmented ≠ plain decode
+    assert np.isfinite(a1).all()
+    # crops sample real pixels: values stay within the plain image's
+    # normalized range (bilinear cannot extrapolate)
+    assert a1.min() >= plain.min() - 0.1 and a1.max() <= plain.max() + 0.1
+    # non-JPEG prefetchers reject augmentation rather than crash
+    imgs = np.zeros((8, 1, 8, 8), np.uint8)
+    pf = native.NativePrefetcher(imgs, np.arange(1, 9, dtype=np.int64),
+                                 [0.0], [1.0], batch_size=4)
+    assert pf.lib.pf_set_augment(pf.handle, 1, 3) != 0
+
+
 def test_native_jpeg_prefetcher_counts_bad_files(tmp_path):
     from bigdl_tpu import native
     if not native.jpeg_available():
