@@ -24,6 +24,7 @@ exact ``updateGradInput``/``accGradParameters`` pair for every layer.
 """
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Any, Dict, Optional, Tuple
 
@@ -361,7 +362,6 @@ class Module:
 
     # -- serialization (parity: Module.save / Module.loadModule) --------
     def save(self, path, overwrite=True):
-        import os
         if not overwrite and os.path.exists(path):
             raise IOError(f"{path} exists and overwrite=False")
         self.ensure_initialized()
@@ -393,6 +393,35 @@ class Module:
         m.state = jax.tree_util.tree_map(jnp.asarray, payload["state"])
         m.grad_params = jax.tree_util.tree_map(jnp.zeros_like, m.params)
         return m
+
+    def save_orbax(self, path, overwrite=True):
+        """Write params+state as an Orbax checkpoint directory — the JAX
+        ecosystem's interchange format (sharding-aware, async-capable,
+        readable by any orbax consumer). Complements the self-contained
+        pickle ``save`` (which also captures the module topology; orbax
+        stores arrays only, so ``load_orbax`` needs a constructed module).
+        ``overwrite`` matches :meth:`save`'s default (periodic checkpoint
+        loops re-save to the same path)."""
+        import orbax.checkpoint as ocp
+        self.ensure_initialized()
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(str(path)),
+                   {"params": _to_numpy_tree(self.params),
+                    "state": _to_numpy_tree(self.state)},
+                   force=overwrite)
+        return self
+
+    def load_orbax(self, path):
+        """Restore params+state saved by :meth:`save_orbax` into THIS
+        module (shapes/structure must match its architecture)."""
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        payload = ckptr.restore(os.path.abspath(str(path)))
+        self.params = jax.tree_util.tree_map(jnp.asarray, payload["params"])
+        self.state = jax.tree_util.tree_map(jnp.asarray, payload["state"])
+        self.grad_params = jax.tree_util.tree_map(jnp.zeros_like,
+                                                  self.params)
+        return self
 
     def save_weights(self, path):
         self.ensure_initialized()
