@@ -189,6 +189,22 @@ def bench_inception_int8(on_tpu):
             "vs_baseline": round(v / _BASE["inception_v1_int8"], 3)}
 
 
+def _timed_lm_steps(step, carry, args, steps, warmup):
+    """Shared LM-bench harness: warmup, one full sync, timed chained
+    steps, final sync + NaN guard. ``step(*carry, *args) -> (loss,
+    *carry)`` must be an AOT-compiled executable with donated carry."""
+    for _ in range(warmup):
+        loss, *carry = step(*carry, *args)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, *carry = step(*carry, *args)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    assert final == final, "NaN loss in LM bench"
+    return dt
+
+
 def _lm_model_flops(B, T, H, F, L, V, causal=True):
     """Analytic model FLOPs for one LM training step (fwd + 2x bwd).
 
@@ -266,17 +282,8 @@ def bench_transformer_lm(on_tpu):
     lr = jnp.float32(0.01)
     step = jax.jit(train_step, donate_argnums=(0, 1)) \
               .lower(params, opt_state, x, y, lr).compile()
-
-    carry = [params, opt_state]
-    for _ in range(warmup):
-        loss, *carry = step(*carry, x, y, lr)
-    float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, *carry = step(*carry, x, y, lr)
-    final = float(loss)
-    dt = time.perf_counter() - t0
-    assert final == final, "NaN loss in transformer bench"
+    dt = _timed_lm_steps(step, [params, opt_state], (x, y, lr), steps,
+                         warmup)
     v = batch * seqlen * steps / dt
     # vs_baseline is null: the reference has no transformer config, and a
     # ratio against the LSTM anchor would be a meaningless cross-model number
@@ -287,6 +294,68 @@ def bench_transformer_lm(on_tpu):
         peak = _peak_flops(jax.devices()[0].device_kind)
         flops_per_step = _lm_model_flops(batch, seqlen, H, F, L, V)
         r["mfu"] = round(flops_per_step * steps / dt / peak, 4)
+    return r
+
+
+def bench_moe_lm(on_tpu):
+    """Switch-MoE Transformer LM train step (bf16 compute, f32 masters):
+    the sparse-FFN showcase. MFU counts ACTIVATED expert FLOPs only
+    (top-1 routing runs one expert per token — the sparse win is
+    parameters, not per-token compute), plus router/aux overhead omitted
+    (conservative numerator, same convention as _lm_model_flops)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models import MoETransformerLM
+    from bigdl_tpu.optim import SGD
+
+    batch = _sized(on_tpu, 8, 2)
+    seqlen = _sized(on_tpu, 1024, 32)
+    H, F, V = (1024, 4096, 32000)
+    L = _sized(on_tpu, 12, 2)
+    E = 8
+    steps, warmup = _sized(on_tpu, 10, 2), _sized(on_tpu, 3, 1)
+    model = MoETransformerLM(vocab_size=V, hidden_size=H, num_heads=16,
+                             filter_size=F, num_layers=L, n_experts=E,
+                             moe_every=2, max_len=seqlen)
+    optim = SGD(learningrate=0.01, momentum=0.9)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, V, size=(batch, seqlen + 1)).astype(np.int32)
+    x = jnp.asarray(ids[:, :-1])
+    y = jnp.asarray(ids[:, 1:])
+
+    params, _ = model.init(jax.random.PRNGKey(0))
+    opt_state = optim.init_state(params)
+
+    def train_step(params, opt_state, x, y, lr):
+        def loss_fn(p):
+            p16 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if a.dtype == jnp.float32 else a, p)
+            from bigdl_tpu.models import lm_loss_chunked
+            h, aux = model.hidden_states(p16, x, training=True,
+                                         rng=jax.random.PRNGKey(0))
+            return (lm_loss_chunked(h, p16["embed"], y, chunk=128)
+                    + 0.01 * aux.astype(jnp.float32))
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt = optim.update(grads, params, opt_state, lr)
+        return loss, new_params, new_opt
+
+    lr = jnp.float32(0.01)
+    step = jax.jit(train_step, donate_argnums=(0, 1)) \
+              .lower(params, opt_state, x, y, lr).compile()
+    dt = _timed_lm_steps(step, [params, opt_state], (x, y, lr), steps,
+                         warmup)
+    v = batch * seqlen * steps / dt
+    r = {"metric": "moe_lm_train_tokens_per_sec", "value": round(v, 1),
+         "unit": "tokens/sec", "vs_baseline": None, "n_experts": E}
+    if on_tpu:
+        from bench import _peak_flops
+        peak = _peak_flops(jax.devices()[0].device_kind)
+        flops = _lm_model_flops(batch, seqlen, H, F, L, V)  # top-1: dense-
+        # equivalent activated FLOPs per token (one expert == one FFN)
+        r["mfu"] = round(flops * steps / dt / peak, 4)
     return r
 
 
@@ -305,6 +374,7 @@ CONFIGS = {
     "lstm": ("bench_lstm_ptb", "lstm_"),
     "inception_int8": ("bench_inception_int8", "inception_"),
     "transformer": ("bench_transformer_lm", "transformer_"),
+    "moe": ("bench_moe_lm", "moe_"),
     "realdata": ("bench_realdata", "realdata_"),
 }
 
@@ -328,7 +398,7 @@ def bench_secondary():
     on_tpu = backend in ("tpu", "axon")
     results = []
     for fn in (bench_lenet, bench_vgg, bench_lstm_ptb, bench_inception_int8,
-               bench_transformer_lm, bench_realdata):
+               bench_transformer_lm, bench_moe_lm, bench_realdata):
         try:
             r = fn(on_tpu)
         except Exception as e:  # one broken config must not hide the rest

@@ -63,8 +63,12 @@ class MoETransformerLM(Module):
     def _embed(self, params, ids):
         return embed_ids(params["embed"], ids, self.hidden_size)
 
-    def _apply(self, params, state, x, training, rng):
-        ids = x
+    def hidden_states(self, params, ids, training=False, rng=None):
+        """``(h, aux_loss)`` — final pre-projection hidden states plus the
+        summed router auxiliary loss. Mirrors ``Transformer.hidden_states``
+        so callers can fuse the tied projection with the loss
+        (``models.lm_loss_chunked``) instead of materialising the full
+        (B, T, vocab) logits tensor."""
         h = embed_ids(params["embed"], ids, self.hidden_size)
         # causal masking lives inside the blocks (flash-friendly — no
         # materialised (T, T) mask, mirroring Transformer's LM mode)
@@ -80,6 +84,10 @@ class MoETransformerLM(Module):
                 h = blk._apply(params[f"block{i}"], {}, Table(h, mask),
                                training, r)
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, training, None)
+        return h, aux
+
+    def _apply(self, params, state, x, training, rng):
+        h, aux = self.hidden_states(params, x, training, rng)
         logits = h @ params["embed"].T  # tied output projection
         return logits, {"aux_loss": aux}
 
