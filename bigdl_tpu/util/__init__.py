@@ -1,0 +1,8 @@
+"""``bigdl_tpu.util`` — pyspark-parity spelling of the util package.
+
+The reference's Python API lives under ``bigdl.util`` (singular); this
+package mirrors that module path so user scripts port with only the
+top-level package rename. The TPU-native utilities themselves live in
+``bigdl_tpu.utils`` (plural).
+"""
+from . import common  # noqa: F401
