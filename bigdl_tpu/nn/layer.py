@@ -1,0 +1,18 @@
+"""``bigdl_tpu.nn.layer`` — pyspark-parity module path.
+
+The reference's Python layers all live in ``bigdl.nn.layer`` (one huge
+module); here they are organised per-family under ``bigdl_tpu.nn`` and
+re-exported from the package root. This shim mirrors the reference
+module path so ``from bigdl.nn.layer import Linear, Sequential, Model``
+ports with only the top-level package rename (docs/MIGRATION.md).
+"""
+import inspect as _inspect
+
+import bigdl_tpu.nn as _nn
+
+__all__ = [n for n in dir(_nn)
+           if not n.startswith("_")
+           and not _inspect.ismodule(getattr(_nn, n))
+           and getattr(getattr(_nn, n), "__module__",
+                       "").startswith("bigdl_tpu")]
+globals().update({n: getattr(_nn, n) for n in __all__})

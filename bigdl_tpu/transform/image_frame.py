@@ -20,7 +20,7 @@ from typing import Iterable, List, Optional, Sequence
 
 import numpy as np
 
-from .vision import FeatureTransformer, ImageFeature, MatToTensor
+from ._vision_impl import FeatureTransformer, ImageFeature, MatToTensor
 from ..dataset.minibatch import MiniBatch
 
 
