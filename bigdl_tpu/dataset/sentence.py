@@ -1,0 +1,31 @@
+"""``bigdl_tpu.dataset.sentence`` — pyspark-parity helpers (reference
+``bigdl/dataset/sentence.py``). The reference tokenizes with NLTK
+(Punkt + word_tokenize); the rebuild keeps the same FUNCTION SURFACE on
+dependency-free regexes. Deltas from ``dataset/text.py``'s pipeline
+tokenizers: ``sentence_tokenizer`` preserves case and splits ALL
+punctuation (NLTK-word_tokenize-like), while ``text.SentenceTokenizer``
+lowercases for dictionary building — use the pipeline classes for
+training pipelines and these functions for ported scripts."""
+from __future__ import annotations
+
+import re
+
+__all__ = ["read_localfile", "sentences_split", "sentences_bipadding",
+           "sentence_tokenizer"]
+
+
+def read_localfile(fileName):
+    with open(fileName) as f:
+        return [line for line in f]
+
+
+def sentences_split(line):
+    return [s for s in re.split(r"(?<=[.!?])\s+", line.strip()) if s]
+
+
+def sentences_bipadding(sent):
+    return "SENTENCESTART " + sent + " SENTENCEEND"
+
+
+def sentence_tokenizer(sentences):
+    return re.findall(r"[\w']+|[^\w\s]", sentences)

@@ -1,0 +1,7 @@
+"""``bigdl_tpu.dlframes.dl_classifier`` — pyspark-parity module path
+(reference ``bigdl/dlframes/dl_classifier.py``); implementations live in
+``dlframes/dl_estimator.py``."""
+from .dl_estimator import (DLEstimator, DLModel, DLClassifier,  # noqa
+                           DLClassifierModel)
+
+__all__ = ["DLEstimator", "DLModel", "DLClassifier", "DLClassifierModel"]

@@ -1,0 +1,5 @@
+"""``bigdl_tpu.dlframes.dl_image_transformer`` — pyspark-parity module
+path (reference ``bigdl/dlframes/dl_image_transformer.py``)."""
+from .dl_image_reader import DLImageTransformer  # noqa
+
+__all__ = ["DLImageTransformer"]

@@ -1,13 +1,9 @@
 """``bigdl_tpu.nn.initialization_method`` — pyspark-parity module path
 (reference ``bigdl/nn/initialization_method.py``); implementations live
 in ``bigdl_tpu.nn.init``."""
-import inspect as _inspect
-
 from . import init as _init
 
-__all__ = [n for n in dir(_init)
-           if not n.startswith("_")
-           and not _inspect.ismodule(getattr(_init, n))
-           and getattr(getattr(_init, n), "__module__",
-                       "").startswith("bigdl_tpu")]
+from bigdl_tpu.util._parity import public_names as _public_names
+
+__all__ = _public_names(_init)
 globals().update({n: getattr(_init, n) for n in __all__})

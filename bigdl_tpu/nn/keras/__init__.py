@@ -1,0 +1,2 @@
+"""``bigdl_tpu.nn.keras`` — pyspark-parity package path (reference
+``bigdl/nn/keras/``); the Keras-style API lives in ``bigdl_tpu.keras``."""

@@ -1,0 +1,8 @@
+"""``bigdl_tpu.nn.keras.layer`` — pyspark-parity module path for the
+Keras-style layers (implementation: ``bigdl_tpu.keras.layers``)."""
+from ...keras import layers as _layers
+
+from bigdl_tpu.util._parity import public_names as _public_names
+
+__all__ = _public_names(_layers)
+globals().update({n: getattr(_layers, n) for n in __all__})

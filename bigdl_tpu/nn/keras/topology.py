@@ -1,0 +1,8 @@
+"""``bigdl_tpu.nn.keras.topology`` — pyspark-parity module path for the
+Keras-style Sequential/Model (implementation: ``bigdl_tpu.keras.topology``)."""
+from ...keras import topology as _topology
+
+from bigdl_tpu.util._parity import public_names as _public_names
+
+__all__ = _public_names(_topology)
+globals().update({n: getattr(_topology, n) for n in __all__})

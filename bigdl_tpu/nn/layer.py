@@ -6,13 +6,9 @@ re-exported from the package root. This shim mirrors the reference
 module path so ``from bigdl.nn.layer import Linear, Sequential, Model``
 ports with only the top-level package rename (docs/MIGRATION.md).
 """
-import inspect as _inspect
-
 import bigdl_tpu.nn as _nn
 
-__all__ = [n for n in dir(_nn)
-           if not n.startswith("_")
-           and not _inspect.ismodule(getattr(_nn, n))
-           and getattr(getattr(_nn, n), "__module__",
-                       "").startswith("bigdl_tpu")]
+from bigdl_tpu.util._parity import public_names as _public_names
+
+__all__ = _public_names(_nn)
 globals().update({n: getattr(_nn, n) for n in __all__})

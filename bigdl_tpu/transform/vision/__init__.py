@@ -1,13 +1,9 @@
 """``bigdl_tpu.transform.vision`` — pyspark-parity package path
 (reference ``bigdl/transform/vision/``); the implementation lives in
 ``transform/_vision_impl.py``."""
-import inspect as _inspect
-
 from .. import _vision_impl as _impl
 
-__all__ = [n for n in dir(_impl)
-           if not n.startswith("_")
-           and not _inspect.ismodule(getattr(_impl, n))
-           and getattr(getattr(_impl, n), "__module__",
-                       "").startswith("bigdl_tpu")]
+from bigdl_tpu.util._parity import public_names as _public_names
+
+__all__ = _public_names(_impl)
 globals().update({n: getattr(_impl, n) for n in __all__})

@@ -41,3 +41,43 @@ def test_transform_vision_image_path():
 
 def test_util_common_path():
     from bigdl_tpu.util.common import init_engine, JTensor, Sample  # noqa
+
+
+def test_dlframes_paths():
+    from bigdl_tpu.dlframes.dl_classifier import (DLEstimator, DLModel,
+                                                  DLClassifier,
+                                                  DLClassifierModel)  # noqa
+    from bigdl_tpu.dlframes.dl_image_transformer import DLImageTransformer
+    from bigdl_tpu.dlframes import DLClassifier as C2
+    assert DLClassifier is C2
+
+
+def test_dataset_sentence_and_base_paths(tmp_path):
+    from bigdl_tpu.dataset.sentence import (read_localfile, sentences_split,
+                                            sentences_bipadding,
+                                            sentence_tokenizer)
+    p = tmp_path / "t.txt"
+    p.write_text("One line.\nTwo.\n")
+    assert len(read_localfile(str(p))) == 2
+    assert sentences_split("A b. C d! E?") == ["A b.", "C d!", "E?"]
+    assert sentences_bipadding("x").startswith("SENTENCESTART ")
+    assert sentence_tokenizer("don't stop.") == ["don't", "stop", "."]
+
+    from bigdl_tpu.dataset.base import Progbar, maybe_download
+    Progbar(10, verbose=0).update(5)
+    f = tmp_path / "have.bin"
+    f.write_bytes(b"x")
+    assert maybe_download("have.bin", str(tmp_path), "http://x/") == str(f)
+    import pytest
+    with pytest.raises(FileNotFoundError, match="gated"):
+        maybe_download("missing.bin", str(tmp_path), "http://x/")
+
+
+def test_nn_keras_paths():
+    import numpy as np
+    from bigdl_tpu.nn.keras.layer import Dense
+    from bigdl_tpu.nn.keras.topology import Sequential
+    m = Sequential()
+    m.add(Dense(4, input_shape=(3,)))
+    out = m.predict(np.ones((2, 3), "float32"))
+    assert np.asarray(out).shape == (2, 4)
