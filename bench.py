@@ -106,6 +106,7 @@ def _build_resnet_step(batch, size):
     from bigdl_tpu.optim import SGD
     from bigdl_tpu.utils import engine
 
+    from bigdl_tpu.utils.amp import bf16_params
     engine.set_seed(0)
     # NHWC: TPU-native conv layout (channels-last); f32 master params,
     # bf16 compute inside the step (MXU path), f32 SGD update.
@@ -129,9 +130,7 @@ def _build_resnet_step(batch, size):
 
     def train_step(params, opt_state, mstate, x, y, lr):
         def loss_fn(p):
-            p16 = jax.tree_util.tree_map(
-                lambda a: a.astype(jnp.bfloat16)
-                if a.dtype == jnp.float32 else a, p)
+            p16 = bf16_params(p)
             out, new_state = model.apply(p16, mstate, x, training=True,
                                          rng=jax.random.PRNGKey(0))
             return crit._forward(out.astype(jnp.float32), y), new_state

@@ -45,6 +45,7 @@ def _train_bench(model, crit, x, y, optim, steps, warmup, bf16=True,
     represent integers above 256 exactly)."""
     import jax
     import jax.numpy as jnp
+    from bigdl_tpu.utils.amp import bf16_params
 
     params, mstate = model.init(jax.random.PRNGKey(0))
     opt_state = optim.init_state(params)
@@ -54,9 +55,7 @@ def _train_bench(model, crit, x, y, optim, steps, warmup, bf16=True,
     def train_step(params, opt_state, mstate, x, y, lr):
         def loss_fn(p):
             if bf16:
-                p = jax.tree_util.tree_map(
-                    lambda a: a.astype(jnp.bfloat16)
-                    if a.dtype == jnp.float32 else a, p)
+                p = bf16_params(p)
             out, new_state = model.apply(p, mstate, x, training=True,
                                          rng=jax.random.PRNGKey(0))
             return crit._forward(out.astype(jnp.float32), y), new_state
@@ -235,6 +234,7 @@ def bench_transformer_lm(on_tpu):
     over blocks, and a chunked fused projection+CE loss head
     (models.transformer_lm.lm_loss_chunked) — B16/T1024/12L now fits a
     16 GB v5e. MFU from analytic model FLOPs (see _lm_model_flops)."""
+    from bigdl_tpu.utils.amp import bf16_params
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -269,9 +269,7 @@ def bench_transformer_lm(on_tpu):
 
     def train_step(params, opt_state, x, y, lr):
         def loss_fn(p):
-            p16 = jax.tree_util.tree_map(
-                lambda a: a.astype(jnp.bfloat16)
-                if a.dtype == jnp.float32 else a, p)
+            p16 = bf16_params(p)
             h = model.hidden_states(p16, x, training=True,
                                     rng=jax.random.PRNGKey(0))
             return lm_loss_chunked(h, p16["embed"], y, chunk=128)
@@ -303,6 +301,7 @@ def bench_moe_lm(on_tpu):
     (top-1 routing runs one expert per token — the sparse win is
     parameters, not per-token compute), plus router/aux overhead omitted
     (conservative numerator, same convention as _lm_model_flops)."""
+    from bigdl_tpu.utils.amp import bf16_params
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -330,9 +329,7 @@ def bench_moe_lm(on_tpu):
 
     def train_step(params, opt_state, x, y, lr):
         def loss_fn(p):
-            p16 = jax.tree_util.tree_map(
-                lambda a: a.astype(jnp.bfloat16)
-                if a.dtype == jnp.float32 else a, p)
+            p16 = bf16_params(p)
             from bigdl_tpu.models import lm_loss_chunked
             h, aux = model.hidden_states(p16, x, training=True,
                                          rng=jax.random.PRNGKey(0))

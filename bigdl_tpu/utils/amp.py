@@ -1,0 +1,25 @@
+"""Mixed-precision helpers — the TPU training recipe in one place.
+
+The bf16 recipe every bench/example uses: keep f32 MASTER params (the
+optimizer update stays f32), cast to bf16 inside the jitted step so all
+MXU contractions run at bf16 throughput, compute losses in f32. These
+helpers are the one shared spelling of the cast (previously copy-pasted
+across the benches/tools).
+
+Reference analog: the mkldnn backend's f32↔bf16 reorder layers; here a
+pytree cast that XLA folds into the step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bf16_params"]
+
+
+def bf16_params(tree):
+    """Cast every f32 leaf to bf16 (non-f32 leaves — int8 quantized
+    weights, int tables, already-bf16 — pass through untouched)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if getattr(a, "dtype", None) == jnp.float32 else a, tree)

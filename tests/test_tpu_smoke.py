@@ -186,10 +186,9 @@ from bigdl_tpu.models import TransformerLM
 
 model = TransformerLM(vocab_size=32000, hidden_size=1024, num_heads=16,
                       filter_size=4096, num_layers=12, max_len=1152)
+from bigdl_tpu.utils.amp import bf16_params
 params, _ = model.init(jax.random.PRNGKey(0))
-params = jax.tree_util.tree_map(
-    lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
-    params)
+params = bf16_params(params)
 prompt = jnp.asarray(np.random.RandomState(0).randint(1, 32000, (8, 128)),
                      jnp.int32)
 gen = jax.jit(lambda p, x: model.generate(p, x, max_new_tokens=256))

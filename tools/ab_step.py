@@ -39,10 +39,10 @@ def build():
 
 
 def loss_and_grads(jax, jnp, model, crit, mstate, x, y):
+    from bigdl_tpu.utils.amp import bf16_params
+
     def f(p):
-        p16 = jax.tree_util.tree_map(
-            lambda a: a.astype(jnp.bfloat16)
-            if a.dtype == jnp.float32 else a, p)
+        p16 = bf16_params(p)
         out, new_state = model.apply(p16, mstate, x, training=True,
                                      rng=jax.random.PRNGKey(0))
         return crit._forward(out.astype(jnp.float32), y), new_state

@@ -22,6 +22,7 @@ def capture(batch: int = 256, logdir: str = "/tmp/bigdl_prof"):
     from bigdl_tpu.nn import CrossEntropyCriterion
     from bigdl_tpu.optim import SGD
     from bigdl_tpu.utils import engine
+    from bigdl_tpu.utils.amp import bf16_params
 
     engine.set_seed(0)
     # profile the exact variant the bench runs (shared BENCH_* parser)
@@ -40,9 +41,7 @@ def capture(batch: int = 256, logdir: str = "/tmp/bigdl_prof"):
 
     def train_step(params, opt_state, mstate, x, y, lr):
         def loss_fn(p):
-            p16 = jax.tree_util.tree_map(
-                lambda a: a.astype(jnp.bfloat16)
-                if a.dtype == jnp.float32 else a, p)
+            p16 = bf16_params(p)
             out, new_state = model.apply(p16, mstate, x, training=True,
                                          rng=jax.random.PRNGKey(0))
             return crit._forward(out.astype(jnp.float32), y), new_state
