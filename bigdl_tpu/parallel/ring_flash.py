@@ -58,7 +58,9 @@ def _block_attn_einsum(q, kb, vb, scale, causal_diag):
     return o, lse
 
 
-def _block_attn(q, kb, vb, scale, diag: bool, causal: bool, axis=None):
+def _block_attn(q, kb, vb, scale, diag: bool, causal: bool, axes=None):
+    if isinstance(axes, str):     # tolerate the old single-axis spelling
+        axes = (axes,)
     """(o, lse) for one K/V block. ``diag`` — block holds the same global
     positions as q (triangular mask applies)."""
     use_causal = causal and diag
@@ -68,7 +70,7 @@ def _block_attn(q, kb, vb, scale, diag: bool, causal: bool, axis=None):
             from ..kernels.flash_attention import _flash_fwd
             return _flash_fwd(q, kb, vb, use_causal, scale, 512, 512,
                               mode == "interpret",
-                              vma={axis} if axis else None)
+                              vma=set(axes) if axes else None)
         except Exception as e:  # pragma: no cover - depends on backend
             _warn_once("ring_fwd", "ring-flash forward kernel failed (%s); "
                        "falling back to einsum blocks", e)
@@ -92,7 +94,9 @@ def _block_bwd_einsum(q, kb, vb, lse, delta, do, scale, causal_diag):
 
 
 def _block_bwd(q, kb, vb, o, lse, delta, do, scale, diag: bool,
-               causal: bool, axis=None):
+               causal: bool, axes=None):
+    if isinstance(axes, str):     # tolerate the old single-axis spelling
+        axes = (axes,)
     """One block's (dq, dk, dv) contributions, f32, from GLOBAL (o, lse)
     and precomputed GLOBAL delta = rowsum(dO*O) (hoisted out of the ring
     scan — it is hop-invariant)."""
@@ -106,7 +110,7 @@ def _block_bwd(q, kb, vb, o, lse, delta, do, scale, diag: bool,
             return _flash_bwd(use_causal, scale, 512, 512,
                               mode == "interpret", (q, kb, vb, o, lse), do,
                               delta=delta, out_dtype=jnp.float32,
-                              vma={axis} if axis else None)
+                              vma=set(axes) if axes else None)
         except Exception as e:  # pragma: no cover - depends on backend
             _warn_once("ring_bwd", "ring-flash backward kernel failed "
                        "(%s); falling back to einsum blocks", e)
@@ -118,13 +122,27 @@ def _block_bwd(q, kb, vb, o, lse, delta, do, scale, diag: bool,
 # ---------------------------------------------------------------------------
 
 
-def _vary(x, axis):
-    """Mark a fresh constant as varying over ``axis`` (strict-VMA
+def _vary(x, axes):
+    """Mark a fresh constant as varying over ``axes`` (strict-VMA
     shard_map requires cond branches / scan carries to agree)."""
     try:
-        return lax.pcast(x, axis, to="varying")
+        return lax.pcast(x, axes, to="varying")
     except (AttributeError, TypeError):  # older jax spelling
-        return lax.pvary(x, axis)
+        return lax.pvary(x, axes)
+
+
+def _vma_axes(x, ring_axis):
+    """The FULL set of mesh axes ``x`` varies over inside this shard_map.
+    Under a composed mesh (e.g. dp x sp) the blocks vary over more than
+    the ring axis, and every fresh constant / kernel output must carry
+    the same set or strict-VMA cond/scan typing rejects the program."""
+    try:
+        vma = jax.typeof(x).vma
+        if vma:
+            return tuple(sorted(vma))
+    except Exception:
+        pass
+    return (ring_axis,) if ring_axis else ()
 
 
 def _merge(o, lse, o_i, lse_i):
@@ -145,6 +163,7 @@ def ring_flash_attention(q, k, v, axis: str = "seq",
 def _ring_fwd(q, k, v, axis, causal):
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
+    vaxes = _vma_axes(q, axis)
     scale = 1.0 / math.sqrt(q.shape[-1])
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -155,7 +174,7 @@ def _ring_fwd(q, k, v, axis, causal):
             b, h, tb, d = q.shape
             zeros = (jnp.zeros_like(q),
                      _vary(jnp.full((b, h, tb), NEG_INF, jnp.float32),
-                           axis))
+                           vaxes))
             # later blocks fully invisible: skip the compute entirely;
             # diagonal needs the triangular mask; earlier fully visible
             o_i, lse_i = lax.cond(
@@ -164,12 +183,12 @@ def _ring_fwd(q, k, v, axis, causal):
                 lambda: lax.cond(
                     src == idx,
                     lambda: _block_attn(q, k_blk, v_blk, scale, True,
-                                        True, axis),
+                                        True, vaxes),
                     lambda: _block_attn(q, k_blk, v_blk, scale, False,
-                                        True, axis)))
+                                        True, vaxes)))
         else:
             o_i, lse_i = _block_attn(q, k_blk, v_blk, scale, False, False,
-                                     axis)
+                                     vaxes)
         o, lse = _merge(o, lse, o_i, lse_i.astype(lse.dtype))
         k_next = lax.ppermute(k_blk, axis, perm)
         v_next = lax.ppermute(v_blk, axis, perm)
@@ -177,7 +196,7 @@ def _ring_fwd(q, k, v, axis, causal):
 
     b, h, tb, _ = q.shape
     o0 = jnp.zeros_like(q)
-    lse0 = _vary(jnp.full((b, h, tb), NEG_INF, jnp.float32), axis)
+    lse0 = _vary(jnp.full((b, h, tb), NEG_INF, jnp.float32), vaxes)
     (k_f, v_f, o, lse), _ = lax.scan(step, (k, v, o0, lse0),
                                      jnp.arange(n))
     return o, lse
@@ -197,6 +216,7 @@ def _ring_vjp_bwd(axis, causal, res, do):
     q, k, v, o, lse = res
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
+    vaxes = _vma_axes(q, axis)
     scale = 1.0 / math.sqrt(q.shape[-1])
     perm = [(i, (i + 1) % n) for i in range(n)]
     # hop-invariant: compute the global rowsum(dO*O) once, not per hop
@@ -215,12 +235,12 @@ def _ring_vjp_bwd(axis, causal, res, do):
                 lambda: lax.cond(
                     src == idx,
                     lambda: _block_bwd(q, k_blk, v_blk, o, lse, delta, do,
-                                       scale, True, True, axis),
+                                       scale, True, True, vaxes),
                     lambda: _block_bwd(q, k_blk, v_blk, o, lse, delta, do,
-                                       scale, False, True, axis)))
+                                       scale, False, True, vaxes)))
         else:
             dq_i, dk_i, dv_i = _block_bwd(q, k_blk, v_blk, o, lse, delta,
-                                          do, scale, False, False, axis)
+                                          do, scale, False, False, vaxes)
         dq = dq + dq_i
         dk_blk = dk_blk + dk_i
         dv_blk = dv_blk + dv_i
@@ -230,9 +250,9 @@ def _ring_vjp_bwd(axis, causal, res, do):
         dv_next = lax.ppermute(dv_blk, axis, perm)
         return (k_next, v_next, dk_next, dv_next, dq), None
 
-    init = (k, v, _vary(jnp.zeros(k.shape, jnp.float32), axis),
-            _vary(jnp.zeros(v.shape, jnp.float32), axis),
-            _vary(jnp.zeros(q.shape, jnp.float32), axis))
+    init = (k, v, _vary(jnp.zeros(k.shape, jnp.float32), vaxes),
+            _vary(jnp.zeros(v.shape, jnp.float32), vaxes),
+            _vary(jnp.zeros(q.shape, jnp.float32), vaxes))
     (k_f, v_f, dk, dv, dq), _ = lax.scan(step, init, jnp.arange(n))
     # after n hops every dK/dV block is back on its owner; cast once
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)

@@ -146,3 +146,78 @@ def test_attention_module_seq_parallel_matches_dense():
         out_specs=spec))(sp_attn.params, x)
     assert np.allclose(np.asarray(out), ref, atol=2e-4), \
         np.abs(np.asarray(out) - ref).max()
+
+
+def test_dp_sp_combined_training_step_matches_dense():
+    """dp x sp composed: a (2, 4) data-x-seq mesh trains one attention-LM
+    step with the batch sharded over 'data' AND the sequence ring-sharded
+    over 'seq'; the loss and parameter gradients must match the dense
+    single-device computation (the scaling-book recipe: shardings in,
+    psum'd grads out)."""
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    B, T, D, HEADS, V = 4, 32, 16, 2, 43
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, V, (B, T + 1)).astype(np.int32))
+    x, y = ids[:, :-1], ids[:, 1:]
+    k = jax.random.split(jax.random.PRNGKey(0), 6)
+    g = lambda kk, s: jax.random.normal(kk, s) * (1.0 / np.sqrt(s[0]))
+    params = {"emb": jax.random.normal(k[0], (V, D)) * 0.02,
+              "wq": g(k[1], (D, D)), "wk": g(k[2], (D, D)),
+              "wv": g(k[3], (D, D)), "wo": g(k[4], (D, D)),
+              "out": g(k[5], (D, V))}
+
+    def heads(z, b, t):
+        return z.reshape(b, t, HEADS, -1).transpose(0, 2, 1, 3)
+
+    def forward(p, xx, attn):
+        b, t = xx.shape
+        h = p["emb"][xx]
+        q, kk, vv = (heads(h @ p["wq"], b, t), heads(h @ p["wk"], b, t),
+                     heads(h @ p["wv"], b, t))
+        a = attn(q, kk, vv)
+        h = h + a.transpose(0, 2, 1, 3).reshape(b, t, D) @ p["wo"]
+        return h @ p["out"]
+
+    def ce(logits, yy):
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(logp, yy[..., None], -1).sum()
+
+    # dense oracle (single device, full batch/sequence)
+    def dense_loss(p):
+        logits = forward(p, x, lambda q, kk, vv: _dense_ref(q, kk, vv,
+                                                            True))
+        return ce(logits, y) / (B * T)
+    ref_loss, ref_grads = jax.value_and_grad(dense_loss)(params)
+
+    # sharded: batch over 'data', sequence over 'seq'
+    from bigdl_tpu.parallel.ring_flash import ring_flash_attention
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "seq"))
+
+    def local_loss(p, xx, yy):
+        logits = forward(
+            p, xx, lambda q, kk, vv: ring_flash_attention(
+                q, kk, vv, axis="seq", causal=True))
+        # local token-sum -> global mean over BOTH axes. The psum INSIDE
+        # the differentiated function means AD produces already-summed
+        # (mesh-invariant) gradients for the replicated params — an
+        # explicit post-grad psum would multiply them by the mesh size.
+        s = lax.psum(ce(logits, yy), ("data", "seq"))
+        return s / (B * T)
+
+    def sharded_step(p, xx, yy):
+        return jax.value_and_grad(local_loss)(p, xx, yy)
+
+    loss, grads = jax.jit(shard_map(
+        sharded_step, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), params),
+                  P("data", "seq"), P("data", "seq")),
+        out_specs=(P(), jax.tree_util.tree_map(lambda _: P(), params)),
+    ))(params, x, y)
+
+    assert np.allclose(float(loss), float(ref_loss), atol=1e-4), \
+        (float(loss), float(ref_loss))
+    for name in params:
+        d = float(jnp.max(jnp.abs(grads[name] - ref_grads[name])))
+        assert d < 2e-3, (name, d)
