@@ -61,7 +61,11 @@ def gpipe(stage_fn: Callable, axis: str = "pipe"):
         def tick(carry, t):
             recv, outs = carry
             mb = jnp.clip(t, 0, n_micro - 1)
-            inp = jnp.where(idx == 0, x_stack[mb], recv)
+            # _vary: x_stack is pipe-invariant (replicated over the stage
+            # axis) while recv is pipe-varying — under strict-VMA typing
+            # (and composed meshes, e.g. dp x pipe) where() operands must
+            # carry the same varying set
+            inp = jnp.where(idx == 0, _vary(x_stack[mb]), recv)
             out = stage_fn(params, inp)
             # the last stage finishes microbatch m at tick t = m + S - 1
             m = t - (n_stages - 1)

@@ -59,10 +59,10 @@ def _block_attn_einsum(q, kb, vb, scale, causal_diag):
 
 
 def _block_attn(q, kb, vb, scale, diag: bool, causal: bool, axes=None):
-    if isinstance(axes, str):     # tolerate the old single-axis spelling
-        axes = (axes,)
     """(o, lse) for one K/V block. ``diag`` — block holds the same global
-    positions as q (triangular mask applies)."""
+    positions as q (triangular mask applies). ``axes``: mesh axes the
+    blocks vary over (a bare string means one axis)."""
+    axes = _as_axes(axes)
     use_causal = causal and diag
     mode = _block_mode()
     if mode in ("pallas", "interpret"):
@@ -95,11 +95,10 @@ def _block_bwd_einsum(q, kb, vb, lse, delta, do, scale, causal_diag):
 
 def _block_bwd(q, kb, vb, o, lse, delta, do, scale, diag: bool,
                causal: bool, axes=None):
-    if isinstance(axes, str):     # tolerate the old single-axis spelling
-        axes = (axes,)
     """One block's (dq, dk, dv) contributions, f32, from GLOBAL (o, lse)
     and precomputed GLOBAL delta = rowsum(dO*O) (hoisted out of the ring
     scan — it is hop-invariant)."""
+    axes = _as_axes(axes)
     use_causal = causal and diag
     mode = _block_mode()
     if mode in ("pallas", "interpret"):
@@ -122,6 +121,11 @@ def _block_bwd(q, kb, vb, o, lse, delta, do, scale, diag: bool,
 # ---------------------------------------------------------------------------
 
 
+def _as_axes(axes):
+    """Normalize an axis spec: bare string -> 1-tuple; None/tuple pass."""
+    return (axes,) if isinstance(axes, str) else axes
+
+
 def _vary(x, axes):
     """Mark a fresh constant as varying over ``axes`` (strict-VMA
     shard_map requires cond branches / scan carries to agree)."""
@@ -140,7 +144,7 @@ def _vma_axes(x, ring_axis):
         vma = jax.typeof(x).vma
         if vma:
             return tuple(sorted(vma))
-    except Exception:
+    except (AttributeError, TypeError):  # older jax: no typeof/.vma
         pass
     return (ring_axis,) if ring_axis else ()
 
