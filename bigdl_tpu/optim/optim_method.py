@@ -260,6 +260,29 @@ class OptimMethod:
     def update(self, grads, params, opt_state, lr):
         raise NotImplementedError
 
+    def save(self, path, overwrite=True):
+        """Persist this optim method incl. hyper-params and step state
+        (parity: OptimMethod.save). Atomic tmp+rename write — a crash
+        mid-dump must not destroy the previous valid save."""
+        import os
+        if not overwrite and os.path.exists(path):
+            raise IOError(f"{path} exists and overwrite=False")
+        from .optimizer import _atomic_pickle
+        _atomic_pickle(path, self)
+        return self
+
+    @staticmethod
+    def load(path):
+        """Load an optim method saved by :meth:`save` (parity:
+        OptimMethod.load)."""
+        import pickle
+        with open(path, "rb") as f:
+            m = pickle.load(f)
+        if not isinstance(m, OptimMethod):
+            raise TypeError(f"{path} does not contain an OptimMethod "
+                            f"(got {type(m).__name__})")
+        return m
+
     def get_learning_rate(self):
         return self.current_lr()
 

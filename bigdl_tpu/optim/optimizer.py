@@ -209,6 +209,62 @@ class BaseOptimizer:
         self._step_fn = None
 
     # -- reference API surface ------------------------------------------
+    def set_model(self, model):
+        """Swap the model for optimizer reuse (pyspark Optimizer.set_model).
+        Training PROGRESS resets with it: the epoch/iteration counters and
+        any checkpoint-resume optimizer state belong to the old model —
+        without the reset a second ``optimize()`` would stop at the old
+        end-trigger after one step (or feed the old model's opt-state tree
+        into the new step)."""
+        self.model = model
+        self.optim_method.state = {"neval": 0, "epoch": 1}
+        self._resume_opt_state = None
+        return self
+
+    def set_criterion(self, criterion):
+        """Swap the criterion for optimizer reuse (pyspark
+        Optimizer.set_criterion). The step is rebuilt on the next
+        ``optimize()``."""
+        self.criterion = criterion
+        return self
+
+    def set_traindata(self, training_set, batch_size=None):
+        """Swap the training data for optimizer reuse (pyspark
+        Optimizer.set_traindata)."""
+        self.training_set = self._as_dataset(training_set)
+        if batch_size:
+            self.batch_size = batch_size
+        return self
+
+    def set_summary_trigger(self, name, trigger):
+        """Modify when a summary named tag is recorded (pyspark
+        Optimizer.set_summary_trigger)."""
+        target = None
+        if self.train_summary is not None:
+            target = self.train_summary
+        if self.val_summary is not None and name in ("ValidationLoss",
+                                                     "Validation"):
+            target = self.val_summary
+        if target is None:
+            raise ValueError("set a train/val summary before "
+                             "set_summary_trigger")
+        target.set_summary_trigger(name, trigger)
+        return self
+
+    def prepare_input(self):
+        """Materialise the dataset ahead of ``optimize`` (pyspark
+        Optimizer.prepare_input — there, forces the cached RDD; here the
+        dataset protocol is already local, so this just touches one
+        batch to surface IO errors early). Open-epoch datasets (the
+        native prefetchers spawn decode workers per data() call) are
+        skipped — pulling one batch would leave a whole epoch's worker
+        run open."""
+        if getattr(self.training_set, "_epoch_open", None) is not None:
+            return self
+        it = self.training_set.data(train=False)
+        next(iter(it), None)
+        return self
+
     def set_validation(self, trigger, dataset, methods, batch_size=None):
         self.validation_trigger = trigger
         self.validation_set = self._as_dataset(dataset)
@@ -482,13 +538,18 @@ class BaseOptimizer:
                 self.metrics.add("data_time", t1 - t0)
                 self.metrics.add("step_time", t2 - t1)
                 if self.train_summary is not None:
-                    self.train_summary.add_scalar("Loss", loss_val,
-                                                  state["neval"])
-                    self.train_summary.add_scalar("LearningRate", lr,
-                                                  state["neval"])
-                    self.train_summary.add_scalar(
-                        "Throughput",
-                        self.batch_size / max(t2 - t0, 1e-9), state["neval"])
+                    rec = self.train_summary.should_record
+                    if rec("Loss", state):
+                        self.train_summary.add_scalar("Loss", loss_val,
+                                                      state["neval"])
+                    if rec("LearningRate", state):
+                        self.train_summary.add_scalar("LearningRate", lr,
+                                                      state["neval"])
+                    if rec("Throughput", state):
+                        self.train_summary.add_scalar(
+                            "Throughput",
+                            self.batch_size / max(t2 - t0, 1e-9),
+                            state["neval"])
                 if self._fire_mid_epoch(state, params, opt_state, mstate):
                     pass
                 if self.end_trigger(state):
@@ -747,3 +808,14 @@ class Optimizer(BaseOptimizer):
         obj.__init__(model, training, criterion, optim_method, end_trigger,
                      batch_size)
         return obj
+
+    @staticmethod
+    def create(model, training_set, criterion, end_trigger=None,
+               batch_size=32, optim_method=None, cores=None,
+               bigdl_type="float"):
+        """pyspark ``Optimizer.create`` spelling (the ``cores``/
+        ``bigdl_type`` args are JVM-era and ignored; local-vs-distributed
+        is picked from the engine mesh like the constructor)."""
+        return Optimizer(model=model, training_set=training_set,
+                         criterion=criterion, optim_method=optim_method,
+                         end_trigger=end_trigger, batch_size=batch_size)

@@ -34,8 +34,15 @@ class Summary:
         return list(self._scalars.get(tag, []))
 
     def set_summary_trigger(self, name: str, trigger):
+        """Gate when the named tag is recorded (parity:
+        TrainSummary.setSummaryTrigger); consulted by the optimizers via
+        :meth:`should_record` — tags without a trigger record every step."""
         self._triggers[name] = trigger
         return self
+
+    def should_record(self, name: str, state) -> bool:
+        trig = self._triggers.get(name)
+        return True if trig is None else bool(trig(state))
 
     def close(self):
         self.writer.close()

@@ -73,6 +73,64 @@ def test_dataset_sentence_and_base_paths(tmp_path):
         maybe_download("missing.bin", str(tmp_path), "http://x/")
 
 
+def test_optimizer_reuse_and_persistence_surface(tmp_path):
+    """pyspark Optimizer conveniences: create factory, set_model/
+    set_criterion/set_traindata reuse, prepare_input, OptimMethod
+    save/load round-trip."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import SGD, Adam, Trigger
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.optim.optim_method import OptimMethod
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+
+    rng = np.random.RandomState(0)
+    samples = [Sample.from_ndarray(rng.randn(4).astype(np.float32),
+                                   float(rng.randint(1, 3)))
+               for _ in range(16)]
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    opt = Optimizer.create(model, DataSet.array(samples),
+                           nn.ClassNLLCriterion(), batch_size=16,
+                           end_trigger=Trigger.max_epoch(1))
+    opt.prepare_input()
+    opt.optimize()
+
+    # reuse: swap model/criterion/data and train again — progress counters
+    # must reset or the second optimize() stops at the old end-trigger
+    assert opt.optim_method.state["epoch"] > 1
+    m2 = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    opt.set_model(m2).set_criterion(nn.ClassNLLCriterion())
+    assert opt.optim_method.state == {"neval": 0, "epoch": 1}
+    opt.set_traindata(DataSet.array(samples), batch_size=8)
+    opt.optimize()
+    assert m2.params is not None
+    assert opt.optim_method.state["neval"] >= 2  # a FULL epoch retrained
+
+    # summary triggers actually gate recording
+    from bigdl_tpu.visualization import TrainSummary
+    from bigdl_tpu.optim import several_iteration
+    ts = TrainSummary(str(tmp_path), "app")
+    ts.set_summary_trigger("LearningRate", several_iteration(1000))
+    m3 = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    opt.set_model(m3)
+    opt.set_train_summary(ts)
+    opt.optimize()
+    assert len(ts.read_scalar("Loss")) >= 2          # ungated: every step
+    assert len(ts.read_scalar("LearningRate")) == 0  # gated off
+
+    # OptimMethod persistence keeps hyper-params and step state
+    a = Adam(learningrate=0.0123)
+    a.state["neval"] = 7
+    p = str(tmp_path / "adam.bin")
+    a.save(p)
+    b = OptimMethod.load(p)
+    assert isinstance(b, Adam)
+    assert b.learningrate == 0.0123 and b.state["neval"] == 7
+    import pytest
+    with pytest.raises(IOError):
+        a.save(p, overwrite=False)
+
+
 def test_nn_keras_paths():
     import numpy as np
     from bigdl_tpu.nn.keras.layer import Dense
