@@ -3,6 +3,10 @@ pyspark frontend parity with ``pyspark/bigdl/nn/layer.py`` and
 ``criterion.py`` — same class names, positional args, snake_case kwargs)."""
 
 from .module import Module, Container, Criterion, Node
+
+# pyspark spelling: every layer subclasses `Layer` there (the py4j base);
+# isinstance(x, Layer) in ported scripts must keep working
+Layer = Module
 from .init import (InitializationMethod, Zeros, Ones, ConstInit,
                    ConstInitMethod, RandomUniform,
                    RandomNormal, Xavier, MsraFiller, BilinearFiller)

@@ -139,3 +139,44 @@ def test_nn_keras_paths():
     m.add(Dense(4, input_shape=(3,)))
     out = m.predict(np.ones((2, 3), "float32"))
     assert np.asarray(out).shape == (2, 4)
+
+
+def test_util_tf_utils_path():
+    """bigdl.util.tf_utils parity: convert() builds a native module from
+    a real-TF GraphDef (cross-validated like the loaders)."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    from bigdl_tpu.util.tf_utils import convert, dump_model
+
+    tf1 = tf.compat.v1
+    g = tf1.Graph()
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, (None, 4), name="x")
+        w = tf1.constant(np.random.RandomState(0).randn(4, 3),
+                         tf.float32)
+        y = tf1.nn.relu(tf1.matmul(x, w), name="y")
+    m = convert(["x:0"], ["y:0"], graph_def=g.as_graph_def())
+    xin = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+    with tf1.Session(graph=g) as sess:
+        ref = sess.run(y, {x: xin})
+    out = np.asarray(m.evaluate().forward(xin))
+    assert np.allclose(out, ref, atol=1e-5)
+    with pytest.raises(NotImplementedError, match="MIGRATION"):
+        dump_model("/tmp/x")
+
+    # variables + a session: convert() freezes their live values; op
+    # objects (not just "name:0" strings) are accepted like the reference
+    g2 = tf1.Graph()
+    with g2.as_default():
+        x2 = tf1.placeholder(tf.float32, (None, 4), name="x2")
+        wv = tf1.get_variable(
+            "wv", initializer=np.random.RandomState(2).randn(4, 3)
+            .astype(np.float32))
+        y2 = tf1.identity(tf1.matmul(x2, wv), name="y2")
+        with tf1.Session(graph=g2) as sess:
+            sess.run(tf1.global_variables_initializer())
+            ref2 = sess.run(y2, {x2: xin})
+            m2 = convert([x2.op], [y2.op], graph_def=g2.as_graph_def(),
+                         sess=sess)
+    out2 = np.asarray(m2.evaluate().forward(xin))
+    assert np.allclose(out2, ref2, atol=1e-5)
