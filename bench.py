@@ -555,7 +555,12 @@ def main():
             configs += [f"secondary:{k}" for k in CONFIGS]
         failed = False
         for which in configs:
-            lines, err = _run_child(which, _cpu_env(), 600.0)
+            env = _cpu_env()
+            if which == "secondary:transformer":
+                # the auto policy's first arm is remat=0, so without the
+                # pin the remat=True path would lose its plumbing check
+                env.setdefault("BENCH_LM_REMAT", "1")
+            lines, err = _run_child(which, env, 600.0)
             if not lines:
                 lines = [{"metric": f"bench_failed_{which}", "value": 0,
                           "unit": "error", "vs_baseline": 0,

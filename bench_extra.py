@@ -246,17 +246,17 @@ def bench_transformer_lm(on_tpu):
     H, F, V = (1024, 4096, 32000)
     L = _sized(on_tpu, 12, 2)
     steps, warmup = _sized(on_tpu, 15, 2), _sized(on_tpu, 3, 1)
-    # BENCH_LM_REMAT=0 disables per-block rematerialisation: the analytic
-    # roofline (tools/roofline_lm.py) charges remat a 1.28x executed-FLOPs
-    # tax, and with the chunked CE head the un-rematerialised B16/T1024/12L
-    # activations may fit 16 GB — the on-chip A/B decides.
-    _remat_env = os.environ.get("BENCH_LM_REMAT", "1")
-    if _remat_env not in ("0", "1"):
+    # Remat policy: rematerialisation costs a 1.28x executed-FLOPs tax
+    # (tools/roofline_lm.py), but without it activations must fit HBM.
+    # BENCH_LM_REMAT=auto (default) tries remat=0 first and falls back to
+    # remat=1 on RESOURCE_EXHAUSTED, so the bench self-selects the faster
+    # arm that fits; =0/=1 pin an arm for A/Bs.
+    _remat_env = os.environ.get("BENCH_LM_REMAT", "auto")
+    if _remat_env not in ("0", "1", "auto"):
         # an unknown value must not silently benchmark the wrong arm
-        raise SystemExit(f"BENCH_LM_REMAT={_remat_env!r}: expected 1 | 0")
-    model = TransformerLM(vocab_size=V, hidden_size=H, num_heads=16,
-                          filter_size=F, num_layers=L, max_len=seqlen,
-                          remat=_remat_env == "1")
+        raise SystemExit(
+            f"BENCH_LM_REMAT={_remat_env!r}: expected auto | 1 | 0")
+    arms = {"0": [False], "1": [True], "auto": [False, True]}[_remat_env]
     optim = SGD(learningrate=0.01, momentum=0.9)
 
     rng = np.random.RandomState(0)
@@ -264,29 +264,50 @@ def bench_transformer_lm(on_tpu):
     x = jnp.asarray(ids[:, :-1])
     y = jnp.asarray(ids[:, 1:])
 
-    params, _ = model.init(jax.random.PRNGKey(0))
-    opt_state = optim.init_state(params)
+    last_oom = None
+    for remat in arms:
+        model = TransformerLM(vocab_size=V, hidden_size=H, num_heads=16,
+                              filter_size=F, num_layers=L, max_len=seqlen,
+                              remat=remat)
+        params, _ = model.init(jax.random.PRNGKey(0))
+        opt_state = optim.init_state(params)
 
-    def train_step(params, opt_state, x, y, lr):
-        def loss_fn(p):
-            p16 = bf16_params(p)
-            h = model.hidden_states(p16, x, training=True,
-                                    rng=jax.random.PRNGKey(0))
-            return lm_loss_chunked(h, p16["embed"], y, chunk=128)
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        new_params, new_opt = optim.update(grads, params, opt_state, lr)
-        return loss, new_params, new_opt
+        def train_step(params, opt_state, x, y, lr):
+            def loss_fn(p):
+                p16 = bf16_params(p)
+                h = model.hidden_states(p16, x, training=True,
+                                        rng=jax.random.PRNGKey(0))
+                return lm_loss_chunked(h, p16["embed"], y, chunk=128)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_opt = optim.update(grads, params, opt_state,
+                                               lr)
+            return loss, new_params, new_opt
 
-    lr = jnp.float32(0.01)
-    step = jax.jit(train_step, donate_argnums=(0, 1)) \
-              .lower(params, opt_state, x, y, lr).compile()
-    dt = _timed_lm_steps(step, [params, opt_state], (x, y, lr), steps,
-                         warmup)
+        lr = jnp.float32(0.01)
+        step = None
+        try:
+            step = jax.jit(train_step, donate_argnums=(0, 1)) \
+                      .lower(params, opt_state, x, y, lr).compile()
+            dt = _timed_lm_steps(step, [params, opt_state], (x, y, lr),
+                                 steps, warmup)
+            break
+        except Exception as e:  # HBM OOM surfaces as XlaRuntimeError
+            if remat is not arms[-1] and "RESOURCE_EXHAUSTED" in str(e):
+                last_oom = str(e)[:200]
+                # release the failed arm's params AND compiled executable
+                # before the fallback arm compiles
+                del params, opt_state, step, model
+                continue
+            if last_oom:
+                raise RuntimeError(
+                    f"remat={remat} failed after the remat=0 arm already "
+                    f"hit RESOURCE_EXHAUSTED ({last_oom})") from e
+            raise
     v = batch * seqlen * steps / dt
     # vs_baseline is null: the reference has no transformer config, and a
     # ratio against the LSTM anchor would be a meaningless cross-model number
     r = {"metric": "transformer_lm_train_tokens_per_sec", "value": round(v, 1),
-         "unit": "tokens/sec", "vs_baseline": None}
+         "unit": "tokens/sec", "vs_baseline": None, "remat": bool(remat)}
     if on_tpu:
         from bench import _peak_flops
         peak = _peak_flops(jax.devices()[0].device_kind)
