@@ -21,8 +21,9 @@ run() {
   fi
 }
 echo "## A/B queue run $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$LOG"
-# 1. LM without remat: is the 1.28x remat FLOPs tax avoidable at B16/T1024?
-run "lm remat=0" secondary:transformer BENCH_LM_REMAT=0
+# 1. LM remat arms: the --all sweep runs auto (remat=0 when it fits), so
+# pin remat=1 here to complete the A/B pair
+run "lm remat=1 (pinned)" secondary:transformer BENCH_LM_REMAT=1
 # 2. LM bigger batch under remat (more MXU work per layer-scan step)
 run "lm B32 remat=1" secondary:transformer BENCH_LM_BATCH=32
 # 3. ResNet fused=xla at batch 512 (batch-512 was -5% on the UNFUSED path)
