@@ -238,12 +238,15 @@ class BaseOptimizer:
 
     def set_summary_trigger(self, name, trigger):
         """Modify when a summary named tag is recorded (pyspark
-        Optimizer.set_summary_trigger)."""
+        Optimizer.set_summary_trigger). Train tags: "Loss",
+        "LearningRate", "Throughput". Validation: "Validation" gates all
+        validation scalars; a per-method tag (its repr) gates one."""
         target = None
         if self.train_summary is not None:
             target = self.train_summary
-        if self.val_summary is not None and name in ("ValidationLoss",
-                                                     "Validation"):
+        val_tags = {repr(m) for m in (self.validation_methods or ())}
+        if self.val_summary is not None and (
+                name.startswith("Validation") or name in val_tags):
             target = self.val_summary
         if target is None:
             raise ValueError("set a train/val summary before "
@@ -456,7 +459,12 @@ class BaseOptimizer:
             val, _ = res.result()
             scores[repr(method)] = val
             if self.val_summary is not None:
-                self.val_summary.add_scalar(repr(method), val, state["neval"])
+                # triggers gate recording: "Validation" gates every
+                # validation scalar, the per-method tag gates one
+                rec = self.val_summary.should_record
+                if rec("Validation", state) and rec(repr(method), state):
+                    self.val_summary.add_scalar(repr(method), val,
+                                                state["neval"])
         if scores:
             state["score"] = list(scores.values())[0]
         return scores

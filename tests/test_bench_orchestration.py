@@ -1,0 +1,112 @@
+"""bench.py orchestration logic (pure parent-side python — the cache
+ladder is the driver's evidence path, so its behaviors are pinned here:
+real-TPU lines get cached with timestamps, stale lines expire, per-config
+prefixes route correctly, env knobs validate loudly)."""
+import importlib
+import json
+import os
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+@pytest.fixture()
+def bench_mod(tmp_path, monkeypatch):
+    import bench
+    importlib.reload(bench)
+    # point the cache at a temp file so tests never touch the real one
+    monkeypatch.setattr(bench, "_TPU_CACHE", str(tmp_path / "cache.json"))
+    return bench
+
+
+def test_cache_roundtrip_and_merge(bench_mod):
+    b = bench_mod
+    b._cache_tpu_lines([{"metric": "resnet50_x", "value": 1.0,
+                         "backend": "tpu"},
+                        {"metric": "cpu_line", "value": 9, "backend": "cpu"}])
+    b._cache_tpu_lines([{"metric": "lenet_y", "value": 2.0,
+                         "backend": "axon"}])
+    cached = json.load(open(b._TPU_CACHE))
+    by = {l["metric"]: l for l in cached}
+    # only TPU-class lines are cached; both writes merged; stamped
+    assert set(by) == {"resnet50_x", "lenet_y"}
+    assert all("measured_at" in l for l in cached)
+    # updating a metric overwrites, not duplicates
+    b._cache_tpu_lines([{"metric": "resnet50_x", "value": 3.0,
+                         "backend": "tpu"}])
+    cached = json.load(open(b._TPU_CACHE))
+    assert len([l for l in cached if l["metric"] == "resnet50_x"]) == 1
+    assert [l for l in cached
+            if l["metric"] == "resnet50_x"][0]["value"] == 3.0
+
+
+def test_cached_lines_filter_by_config_and_age(bench_mod):
+    b = bench_mod
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    old = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                        time.gmtime(time.time() - 30 * 86400))
+    json.dump([
+        {"metric": "resnet50_train_images_per_sec_per_chip", "value": 1,
+         "backend": "tpu", "measured_at": now},
+        {"metric": "lenet_mnist_train_images_per_sec", "value": 2,
+         "backend": "tpu", "measured_at": now},
+        {"metric": "transformer_lm_train_tokens_per_sec", "value": 3,
+         "backend": "tpu", "measured_at": old},
+    ], open(b._TPU_CACHE, "w"))
+    # headline picks only resnet50_*
+    got = b._cached_tpu_lines("headline")
+    assert [l["metric"] for l in got] == \
+        ["resnet50_train_images_per_sec_per_chip"]
+    assert got[0]["cached"] is True
+    # per-config prefix routing
+    got = b._cached_tpu_lines("secondary:lenet")
+    assert [l["metric"] for l in got] == \
+        ["lenet_mnist_train_images_per_sec"]
+    # stale lines (>14 days) are dropped, not served
+    assert b._cached_tpu_lines("secondary:transformer") == []
+
+
+def test_corrupt_cache_resets_instead_of_blocking(bench_mod):
+    b = bench_mod
+    with open(b._TPU_CACHE, "w") as f:
+        f.write("{not json")
+    assert b._cached_tpu_lines("headline") == []
+    b._cache_tpu_lines([{"metric": "resnet50_z", "backend": "tpu"}])
+    assert json.load(open(b._TPU_CACHE))[0]["metric"] == "resnet50_z"
+
+
+def test_variant_parser_validates(bench_mod, monkeypatch):
+    b = bench_mod
+    monkeypatch.delenv("BENCH_FUSED", raising=False)
+    monkeypatch.delenv("BENCH_POOL_GRAD", raising=False)
+    monkeypatch.delenv("BENCH_STEM", raising=False)
+    assert b.resnet_bench_variant() == ("xla", "exact", "conv7")
+    monkeypatch.setenv("BENCH_FUSED", "1")
+    monkeypatch.setenv("BENCH_POOL_GRAD", "fast")
+    monkeypatch.setenv("BENCH_STEM", "s2d")
+    assert b.resnet_bench_variant() == ("pallas", "fast", "s2d")
+    monkeypatch.setenv("BENCH_FUSED", "typo")
+    with pytest.raises(SystemExit, match="BENCH_FUSED"):
+        b.resnet_bench_variant()
+
+
+def test_json_lines_parser_ignores_noise(bench_mod):
+    b = bench_mod
+    out = ("INFO: some log line\n"
+           '{"metric": "m1", "value": 1}\n'
+           "{broken json\n"
+           '{"no_metric_key": true}\n'
+           '{"metric": "m2", "value": 2}\n')
+    assert [l["metric"] for l in b._json_lines(out)] == ["m1", "m2"]
+
+
+def test_cpu_env_strips_axon(bench_mod, monkeypatch):
+    b = bench_mod
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "1.2.3.4")
+    env = b._cpu_env()
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert env["JAX_PLATFORMS"] == "cpu"

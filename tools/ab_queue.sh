@@ -25,7 +25,7 @@ echo "## A/B queue run $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$LOG"
 # pin remat=1 here to complete the A/B pair
 run "lm remat=1 (pinned)" secondary:transformer BENCH_LM_REMAT=1
 # 2. LM bigger batch under remat (more MXU work per layer-scan step)
-run "lm B32 remat=1" secondary:transformer BENCH_LM_BATCH=32
+run "lm B32 remat=1" secondary:transformer BENCH_LM_BATCH=32 BENCH_LM_REMAT=1
 # 3. ResNet fused=xla at batch 512 (batch-512 was -5% on the UNFUSED path)
 run "resnet fused=xla B512" headline BENCH_BATCH=512 BENCH_STEPS=10
 # 4. realdata with the loop_epochs + fast-IDCT prefetcher fixes
