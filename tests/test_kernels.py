@@ -338,14 +338,18 @@ def test_fused_matmul_nhwc_h_split_path(monkeypatch):
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(B, H, W, K).astype(np.float32))
     w = jnp.asarray(rng.randn(K, N).astype(np.float32) * 0.1)
-    # budget just above the bb=1, bh=2 footprint so the search lands there
+    # budget EXACTLY the (bb=1, bh=2) footprint — the fitter's _fits
+    # compares with <=, so the search lands there and nowhere larger
     need = fm._vmem_need(1 * 2 * W, K, N, min(512, N), 4)
     monkeypatch.setattr(fm, "_VMEM_BUDGET", need)
-    z, s1, s2 = fm.fused_bn_relu_matmul_nhwc(x, w, relu=False, stats=True,
-                                             interpret=True)
+    out = fm.fused_bn_relu_matmul_nhwc(x, w, relu=False, stats=True,
+                                       interpret=True)
+    assert out is not None     # None here = the fitter regressed
+    z, s1, s2 = out
     zr = jax.lax.dot_general(x, w, (((3,), (0,)), ((), ())))
     assert np.allclose(z, zr, atol=1e-4)
     assert np.allclose(s1, jnp.sum(zr, (0, 1, 2)), atol=1e-3)
+    assert np.allclose(s2, jnp.sum(zr * zr, (0, 1, 2)), atol=1e-2)
 
 
 def test_fused_bottleneck_matches_reference_block(monkeypatch):

@@ -227,6 +227,33 @@ def test_native_jpeg_prefetcher_augmentation(tmp_path):
     assert pf.lib.pf_set_augment(pf.handle, 1, 3) != 0
 
 
+def test_native_jpeg_augmentation_worker_count_invariant(tmp_path):
+    """Crops hash per (seed, epoch position), not per worker: the multiset
+    of augmented images is identical for 1 vs 3 decode workers (batch
+    ORDER may differ — completion order — but contents may not)."""
+    if not native.jpeg_available():
+        import pytest
+        pytest.skip("libjpeg not available")
+    paths, labels = [], []
+    for i in range(12):
+        p, _ = _make_jpeg(tmp_path, w=40, h=40, name=f"wi{i}.jpg")
+        paths.append(p)
+        labels.append(i % 3 + 1)
+
+    def collect(n_workers):
+        pf = native.JpegFolderPrefetcher(
+            paths, labels, 24, 24, mean=(124.0, 117.0, 104.0),
+            std=(59.0, 57.0, 57.0), batch_size=4, n_workers=n_workers,
+            queue_capacity=2, augment=True, seed=5)
+        out = []
+        for mb in pf.data(train=False):
+            for img in np.asarray(mb.get_input()):
+                out.append(img.tobytes())
+        return sorted(out)
+
+    assert collect(1) == collect(3)
+
+
 def test_native_jpeg_prefetcher_counts_bad_files(tmp_path):
     from bigdl_tpu import native
     if not native.jpeg_available():
