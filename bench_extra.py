@@ -377,6 +377,64 @@ def bench_moe_lm(on_tpu):
     return r
 
 
+def bench_lm_decode(on_tpu):
+    """Autoregressive decode throughput: KV-cache generation on the
+    flagship LM (B8, prompt 128, 256 new tokens), bf16 weights, with the
+    weight-only-int8 decode ratio alongside — decode is weight-bandwidth
+    bound, so int8 halves the HBM traffic per token. Prefill cost is
+    measured separately (1-token generate) and subtracted."""
+    from bigdl_tpu.utils.amp import bf16_params
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.quantization import quantize_lm_params
+
+    B = _sized(on_tpu, 8, 2)
+    prompt_len = _sized(on_tpu, 128, 8)
+    new_tokens = _sized(on_tpu, 256, 6)
+    H, F, V = ((1024, 4096, 32000) if on_tpu else (64, 256, 128))
+    L = _sized(on_tpu, 12, 2)
+    model = TransformerLM(vocab_size=V, hidden_size=H, num_heads=16
+                          if on_tpu else 2, filter_size=F, num_layers=L,
+                          max_len=prompt_len + new_tokens)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    params = bf16_params(params)
+    prompt = jnp.asarray(np.random.RandomState(0).randint(
+        1, V, (B, prompt_len)), jnp.int32)
+
+    def timed_decode(p):
+        gen = jax.jit(lambda pp, x: model.generate(
+            pp, x, max_new_tokens=new_tokens))
+        gen1 = jax.jit(lambda pp, x: model.generate(pp, x,
+                                                    max_new_tokens=1))
+        out = gen(p, prompt)
+        np.asarray(out[0, -1])            # compile + run once
+        o1 = gen1(p, prompt)
+        np.asarray(o1[0, -1])
+        t0 = time.perf_counter()
+        o1 = gen1(p, prompt)
+        np.asarray(o1[0, -1])
+        dt1 = time.perf_counter() - t0    # ~prefill + 1 token
+        t0 = time.perf_counter()
+        out = gen(p, prompt)
+        np.asarray(out[0, -1])
+        dt = time.perf_counter() - t0
+        denom = dt - dt1
+        if denom < 0.1 * dt:  # subtraction at the timer noise floor
+            # (smoke scales): report the unsubtracted rate instead of
+            # an arbitrarily inflated fiction
+            denom = dt
+        return B * (new_tokens - 1) / denom
+
+    bf16_tps = timed_decode(params)
+    int8_tps = timed_decode(quantize_lm_params(params))
+    return {"metric": "lm_decode_tokens_per_sec", "value": round(bf16_tps, 1),
+            "unit": "tokens/sec", "vs_baseline": None,
+            "int8_tokens_per_sec": round(int8_tps, 1),
+            "int8_speedup": round(int8_tps / max(bf16_tps, 1e-9), 3)}
+
+
 def bench_realdata(on_tpu):
     """ResNet-50 fed from real JPEG files via the C++ prefetcher — the
     implementation lives next to the synthetic headline in bench.py."""
@@ -393,6 +451,7 @@ CONFIGS = {
     "inception_int8": ("bench_inception_int8", "inception_"),
     "transformer": ("bench_transformer_lm", "transformer_"),
     "moe": ("bench_moe_lm", "moe_"),
+    "decode": ("bench_lm_decode", "lm_decode_"),
     "realdata": ("bench_realdata", "realdata_"),
 }
 
@@ -416,7 +475,8 @@ def bench_secondary():
     on_tpu = backend in ("tpu", "axon")
     results = []
     for fn in (bench_lenet, bench_vgg, bench_lstm_ptb, bench_inception_int8,
-               bench_transformer_lm, bench_moe_lm, bench_realdata):
+               bench_transformer_lm, bench_moe_lm, bench_lm_decode,
+               bench_realdata):
         try:
             r = fn(on_tpu)
         except Exception as e:  # one broken config must not hide the rest
