@@ -7,7 +7,7 @@ cd /root/repo
 for i in $(seq 1 200); do
   if timeout 90 python -c "import jax; ds=jax.devices(); assert any(d.platform in ('tpu','axon') for d in ds)" 2>/dev/null; then
     echo "watcher: tunnel UP $(date -u +%H:%M:%SZ) — running sweep" >> "$LOG"
-    timeout 3500 python bench.py --all > /tmp/watcher_sweep.out 2>&1
+    timeout 5400 python bench.py --all > /tmp/watcher_sweep.out 2>&1
     echo "watcher: sweep done $(date -u +%H:%M:%SZ) rc=$? ($(grep -c '"backend": "tpu"' /tmp/watcher_sweep.out) tpu lines)" >> "$LOG"
     /root/repo/tools/ab_queue.sh
     echo "watcher: ab queue done $(date -u +%H:%M:%SZ)" >> "$LOG"
