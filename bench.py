@@ -556,9 +556,9 @@ def main():
         failed = False
         for which in configs:
             env = _cpu_env()
-            if which == "secondary:transformer":
+            if which in ("secondary:transformer", "secondary:moe"):
                 # the auto policy's first arm is remat=0, so without the
-                # pin the remat=True path would lose its plumbing check
+                # pin the remat=True paths would lose their plumbing check
                 env.setdefault("BENCH_LM_REMAT", "1")
             lines, err = _run_child(which, env, 600.0)
             if not lines:

@@ -204,6 +204,32 @@ def _timed_lm_steps(step, carry, args, steps, warmup):
     return dt
 
 
+def _run_remat_arms(run_arm):
+    """Shared remat policy for the LM benches. ``run_arm(remat) -> dt``
+    builds, compiles and times one arm (its frame owns every buffer, so
+    an OOM unwinds cleanly). BENCH_LM_REMAT: auto (default) tries the
+    remat-free arm and falls back to remat=True on RESOURCE_EXHAUSTED;
+    0/1 pin an arm for A/Bs. Returns (dt, remat_used)."""
+    env = os.environ.get("BENCH_LM_REMAT", "auto")
+    if env not in ("0", "1", "auto"):
+        # an unknown value must not silently benchmark the wrong arm
+        raise SystemExit(f"BENCH_LM_REMAT={env!r}: expected auto | 1 | 0")
+    arms = {"0": [False], "1": [True], "auto": [False, True]}[env]
+    last_oom = None
+    for remat in arms:
+        try:
+            return run_arm(remat), remat
+        except Exception as e:  # HBM OOM surfaces as XlaRuntimeError
+            if remat is not arms[-1] and "RESOURCE_EXHAUSTED" in str(e):
+                last_oom = str(e)[:200]
+                continue
+            if last_oom:
+                raise RuntimeError(
+                    f"remat={remat} failed after the remat=False arm "
+                    f"already hit RESOURCE_EXHAUSTED ({last_oom})") from e
+            raise
+
+
 def _lm_model_flops(B, T, H, F, L, V, causal=True):
     """Analytic model FLOPs for one LM training step (fwd + 2x bwd).
 
@@ -246,17 +272,6 @@ def bench_transformer_lm(on_tpu):
     H, F, V = (1024, 4096, 32000)
     L = _sized(on_tpu, 12, 2)
     steps, warmup = _sized(on_tpu, 15, 2), _sized(on_tpu, 3, 1)
-    # Remat policy: rematerialisation costs a 1.28x executed-FLOPs tax
-    # (tools/roofline_lm.py), but without it activations must fit HBM.
-    # BENCH_LM_REMAT=auto (default) tries remat=0 first and falls back to
-    # remat=1 on RESOURCE_EXHAUSTED, so the bench self-selects the faster
-    # arm that fits; =0/=1 pin an arm for A/Bs.
-    _remat_env = os.environ.get("BENCH_LM_REMAT", "auto")
-    if _remat_env not in ("0", "1", "auto"):
-        # an unknown value must not silently benchmark the wrong arm
-        raise SystemExit(
-            f"BENCH_LM_REMAT={_remat_env!r}: expected auto | 1 | 0")
-    arms = {"0": [False], "1": [True], "auto": [False, True]}[_remat_env]
     optim = SGD(learningrate=0.01, momentum=0.9)
 
     rng = np.random.RandomState(0)
@@ -264,8 +279,7 @@ def bench_transformer_lm(on_tpu):
     x = jnp.asarray(ids[:, :-1])
     y = jnp.asarray(ids[:, 1:])
 
-    last_oom = None
-    for remat in arms:
+    def run_arm(remat):
         model = TransformerLM(vocab_size=V, hidden_size=H, num_heads=16,
                               filter_size=F, num_layers=L, max_len=seqlen,
                               remat=remat)
@@ -284,25 +298,12 @@ def bench_transformer_lm(on_tpu):
             return loss, new_params, new_opt
 
         lr = jnp.float32(0.01)
-        step = None
-        try:
-            step = jax.jit(train_step, donate_argnums=(0, 1)) \
-                      .lower(params, opt_state, x, y, lr).compile()
-            dt = _timed_lm_steps(step, [params, opt_state], (x, y, lr),
-                                 steps, warmup)
-            break
-        except Exception as e:  # HBM OOM surfaces as XlaRuntimeError
-            if remat is not arms[-1] and "RESOURCE_EXHAUSTED" in str(e):
-                last_oom = str(e)[:200]
-                # release the failed arm's params AND compiled executable
-                # before the fallback arm compiles
-                del params, opt_state, step, model
-                continue
-            if last_oom:
-                raise RuntimeError(
-                    f"remat={remat} failed after the remat=0 arm already "
-                    f"hit RESOURCE_EXHAUSTED ({last_oom})") from e
-            raise
+        step = jax.jit(train_step, donate_argnums=(0, 1)) \
+                  .lower(params, opt_state, x, y, lr).compile()
+        return _timed_lm_steps(step, [params, opt_state], (x, y, lr),
+                               steps, warmup)
+
+    dt, remat = _run_remat_arms(run_arm)
     v = batch * seqlen * steps / dt
     # vs_baseline is null: the reference has no transformer config, and a
     # ratio against the LSTM anchor would be a meaningless cross-model number
@@ -335,9 +336,6 @@ def bench_moe_lm(on_tpu):
     L = _sized(on_tpu, 12, 2)
     E = 8
     steps, warmup = _sized(on_tpu, 10, 2), _sized(on_tpu, 3, 1)
-    model = MoETransformerLM(vocab_size=V, hidden_size=H, num_heads=16,
-                             filter_size=F, num_layers=L, n_experts=E,
-                             moe_every=2, max_len=seqlen)
     optim = SGD(learningrate=0.01, momentum=0.9)
 
     rng = np.random.RandomState(0)
@@ -345,29 +343,37 @@ def bench_moe_lm(on_tpu):
     x = jnp.asarray(ids[:, :-1])
     y = jnp.asarray(ids[:, 1:])
 
-    params, _ = model.init(jax.random.PRNGKey(0))
-    opt_state = optim.init_state(params)
+    def run_arm(remat):
+        model = MoETransformerLM(vocab_size=V, hidden_size=H, num_heads=16,
+                                 filter_size=F, num_layers=L, n_experts=E,
+                                 moe_every=2, max_len=seqlen, remat=remat)
+        params, _ = model.init(jax.random.PRNGKey(0))
+        opt_state = optim.init_state(params)
 
-    def train_step(params, opt_state, x, y, lr):
-        def loss_fn(p):
-            p16 = bf16_params(p)
-            from bigdl_tpu.models import lm_loss_chunked
-            h, aux = model.hidden_states(p16, x, training=True,
-                                         rng=jax.random.PRNGKey(0))
-            return (lm_loss_chunked(h, p16["embed"], y, chunk=128)
-                    + 0.01 * aux.astype(jnp.float32))
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        new_params, new_opt = optim.update(grads, params, opt_state, lr)
-        return loss, new_params, new_opt
+        def train_step(params, opt_state, x, y, lr):
+            def loss_fn(p):
+                p16 = bf16_params(p)
+                from bigdl_tpu.models import lm_loss_chunked
+                h, aux = model.hidden_states(p16, x, training=True,
+                                             rng=jax.random.PRNGKey(0))
+                return (lm_loss_chunked(h, p16["embed"], y, chunk=128)
+                        + 0.01 * aux.astype(jnp.float32))
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_params, new_opt = optim.update(grads, params, opt_state,
+                                               lr)
+            return loss, new_params, new_opt
 
-    lr = jnp.float32(0.01)
-    step = jax.jit(train_step, donate_argnums=(0, 1)) \
-              .lower(params, opt_state, x, y, lr).compile()
-    dt = _timed_lm_steps(step, [params, opt_state], (x, y, lr), steps,
-                         warmup)
+        lr = jnp.float32(0.01)
+        step = jax.jit(train_step, donate_argnums=(0, 1)) \
+                  .lower(params, opt_state, x, y, lr).compile()
+        return _timed_lm_steps(step, [params, opt_state], (x, y, lr),
+                               steps, warmup)
+
+    dt, remat = _run_remat_arms(run_arm)
     v = batch * seqlen * steps / dt
     r = {"metric": "moe_lm_train_tokens_per_sec", "value": round(v, 1),
-         "unit": "tokens/sec", "vs_baseline": None, "n_experts": E}
+         "unit": "tokens/sec", "vs_baseline": None, "n_experts": E,
+         "remat": bool(remat)}
     if on_tpu:
         from bench import _peak_flops
         peak = _peak_flops(jax.devices()[0].device_kind)

@@ -29,10 +29,15 @@ class MoETransformerLM(Module):
                  num_heads: int = 4, filter_size: int = 1024,
                  num_layers: int = 4, n_experts: int = 4,
                  moe_every: int = 2, capacity_factor: float = 1.25,
-                 max_len: int = 2048, use_flash: bool = True, name=None):
+                 max_len: int = 2048, use_flash: bool = True,
+                 remat: bool = False, name=None):
         super().__init__(name=name)
         self.vocab_size, self.hidden_size = vocab_size, hidden_size
         self.max_len = max_len
+        # jax.checkpoint per block: the router's dispatch/combine one-hots
+        # are (T, E, capacity)-sized residuals — at bench scale ~GBs the
+        # backward would otherwise keep live (mirrors Transformer's remat)
+        self.remat = remat
         self.mode = "lm"  # the Transformer inference machinery's guard
         self.blocks = []
         self.moe_idx = set(range(moe_every - 1, num_layers, moe_every))
@@ -77,12 +82,18 @@ class MoETransformerLM(Module):
         for i, blk in enumerate(self.blocks):
             r = jax.random.fold_in(rng, i) if rng is not None else None
             if i in self.moe_idx:
-                h, a = blk.apply_with_aux(params[f"block{i}"], h, mask,
-                                          training, r)
+                def run(p, hh, blk=blk, r=r):
+                    return blk.apply_with_aux(p, hh, mask, training, r)
+                if self.remat:
+                    run = jax.checkpoint(run)
+                h, a = run(params[f"block{i}"], h)
                 aux = aux + a
             else:
-                h = blk._apply(params[f"block{i}"], {}, Table(h, mask),
-                               training, r)
+                def run(p, hh, blk=blk, r=r):
+                    return blk._apply(p, {}, Table(hh, mask), training, r)
+                if self.remat:
+                    run = jax.checkpoint(run)
+                h = run(params[f"block{i}"], h)
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, training, None)
         return h, aux
 

@@ -156,6 +156,37 @@ def test_lm_remat_matches_plain():
         assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+def test_moe_lm_remat_matches_plain():
+    """MoE LM remat=True changes memory, not values — fwd (incl. the
+    router aux loss) and grads identical through BOTH block types."""
+    import jax
+    from bigdl_tpu.models import MoETransformerLM
+    ids = jnp.asarray(np.random.RandomState(1).randint(
+        1, 67, size=(2, 16)).astype(np.int32))
+
+    def build(remat):
+        return MoETransformerLM(vocab_size=67, hidden_size=32, num_heads=2,
+                                filter_size=64, num_layers=2, n_experts=4,
+                                moe_every=2, capacity_factor=4.0,
+                                max_len=16, use_flash=False, remat=remat)
+
+    plain, remat = build(False), build(True)
+    params, _ = plain.init(jax.random.PRNGKey(0))
+
+    def loss(m):
+        def f(p):
+            h, aux = m.hidden_states(p, ids, training=False)
+            return jnp.sum(jnp.tanh(h * 0.01)) + 0.1 * aux
+        return f
+
+    l0, g0 = jax.value_and_grad(loss(plain))(params)
+    l1, g1 = jax.value_and_grad(loss(remat))(params)
+    assert np.allclose(float(l0), float(l1), atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 def test_lm_loss_chunked_matches_full_logits():
     """lm_loss_chunked == full-logits softmax-CE with RAW (0-based) token
     ids, values AND gradients (through a scan-of-checkpoint body). The
