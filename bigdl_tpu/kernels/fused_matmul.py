@@ -34,6 +34,7 @@ here it is one kernel family on the TPU MXU. Used by
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -371,6 +372,33 @@ def fused_bn_relu_matmul(x, w, scale=None, bias=None, *, relu=None,
         bm = max(128, ((bm // 2 + 127) // 128) * 128)
     while bn > 128 and _vmem_need(bm, Kp, -(-N // bn) * bn, bn, eb) > budget:
         bn = max(128, ((bn // 2 + 127) // 128) * 128)
+    if _vmem_need(bm, Kp, -(-N // bn) * bn, bn, eb) > budget:
+        # The dx kernel's footprint scales with the untiled (K, N) weight
+        # block plus full-Np gradient rows, so for very wide K/N both
+        # loops bottom out while still over budget. Proceeding would risk
+        # an on-chip scoped-VMEM compile failure; compute the same math
+        # unfused instead (XLA path, numerically identical, differentiable).
+        warnings.warn(
+            "fused_bn_relu_matmul: shape (M=%d, K=%d, N=%d) exceeds the "
+            "VMEM footprint model at the smallest block size; falling "
+            "back to the unfused XLA path" % (M, K, N))
+        # Mirror the kernel's dtype contract exactly: f32 affine prologue
+        # rounded back to the compute dtype, compute-dtype MXU contraction
+        # with f32 accumulation, stats from the f32 product, z returned in
+        # the compute dtype.
+        if scale is None:
+            h = x
+        else:
+            h = (x.astype(jnp.float32) * scale.astype(jnp.float32)
+                 + bias.astype(jnp.float32)).astype(x.dtype)
+        if relu:
+            h = jnp.maximum(h, 0)
+        zf = jnp.matmul(h, w, preferred_element_type=jnp.float32)
+        z = zf.astype(x.dtype)
+        if stats:
+            return z, jnp.sum(zf, 0), jnp.sum(zf * zf, 0)
+        n0 = jnp.zeros((N,), jnp.float32)
+        return z, n0, n0
     return _fused(x, w, scale, bias, bool(relu), bool(stats), int(bm),
                   int(bn), bool(interpret))
 

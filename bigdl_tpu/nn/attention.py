@@ -12,11 +12,22 @@ sequence parallelism lives in ``bigdl_tpu.parallel.ring_attention``.
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+def _fused_qkv_enabled():
+    """A/B toggle for the fused-QKV single-matmul path, read at trace time
+    (like the other BIGDL_TPU_* knobs). The concat of wq/wk/wv happens
+    inside the jitted step (weights are runtime inputs, XLA cannot
+    constant-fold it): one extra write+read of 3H^2 elements per layer per
+    step vs saving 2*B*T*H activation reads from the three-dot form — a net
+    win whenever B*T >> 3H (all bench shapes), and <1% of step time either
+    way at H<=1024. Set BIGDL_TPU_FUSED_QKV=0 to measure the three-dot arm."""
+    return os.environ.get("BIGDL_TPU_FUSED_QKV", "1") != "0"
 
 from .module import Module
 from .norm import LayerNormalization
@@ -106,7 +117,7 @@ class Attention(Module):
         of three H×H dots. Params stay separate wq/wk/wv (checkpoint
         layout unchanged); the concat is a trace-time weight reshuffle."""
         ws = (params["wq"], params["wk"], params["wv"])
-        if (kx is None or kx is qx) and all(
+        if (kx is None or kx is qx) and _fused_qkv_enabled() and all(
                 isinstance(w, jnp.ndarray) for w in ws):
             # int8 QuantizedWeight wrappers (quantization/lm.py) keep the
             # three-dot path: they dequantize per-matmul and can't concat

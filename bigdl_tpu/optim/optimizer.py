@@ -241,14 +241,19 @@ class BaseOptimizer:
         Optimizer.set_summary_trigger). Train tags: "Loss",
         "LearningRate", "Throughput". Validation: "Validation" gates all
         validation scalars; a per-method tag (its repr) gates one."""
-        target = None
-        if self.train_summary is not None:
-            target = self.train_summary
         val_tags = {repr(m) for m in (self.validation_methods or ())}
-        if self.val_summary is not None and (
-                name.startswith("Validation") or name in val_tags):
+        is_val_tag = name.startswith("Validation") or name in val_tags
+        if is_val_tag:
+            if self.val_summary is None:
+                raise ValueError(
+                    "set_summary_trigger(%r): validation tag but no "
+                    "validation summary is set — call set_val_summary "
+                    "first (the train loop only consults Loss/"
+                    "LearningRate/Throughput)" % (name,))
             target = self.val_summary
-        if target is None:
+        elif self.train_summary is not None:
+            target = self.train_summary
+        else:
             raise ValueError("set a train/val summary before "
                              "set_summary_trigger")
         target.set_summary_trigger(name, trigger)
@@ -264,8 +269,15 @@ class BaseOptimizer:
         run open."""
         if getattr(self.training_set, "_epoch_open", None) is not None:
             return self
-        it = self.training_set.data(train=False)
-        next(iter(it), None)
+        it = iter(self.training_set.data(train=False))
+        try:
+            next(it, None)
+        finally:
+            # generator-backed datasets may hold resources (open files,
+            # worker pools) in the abandoned iterator — release eagerly
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
         return self
 
     def set_validation(self, trigger, dataset, methods, batch_size=None):

@@ -361,6 +361,53 @@ def test_fused_matmul_nhwc_shape_matrix(B, H, W, K, N):
     assert np.allclose(s2, jnp.sum(zr * zr, (0, 1, 2)), atol=1e-2)
 
 
+def test_fused_matmul_vmem_overflow_fallback(monkeypatch):
+    """When even the smallest block size exceeds the VMEM footprint model,
+    fused_bn_relu_matmul warns and computes the same math unfused (XLA) —
+    values, stats, grads, dtype, and the stats=False tuple all match the
+    kernel contract."""
+    import warnings
+    import bigdl_tpu.kernels.fused_matmul as fm
+    rng = np.random.RandomState(3)
+    M, K, N = 32, 16, 24
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+    w = jnp.asarray(rng.randn(K, N).astype(np.float32) * 0.1)
+    a = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(K).astype(np.float32))
+
+    zk, s1k, s2k = fm.fused_bn_relu_matmul(x, w, a, b, interpret=True)
+
+    def grads(fwd):
+        def loss(x, w, a, b):
+            z, s1, s2 = fwd(x, w, a, b)
+            return (z * z).sum() + s1.sum() + (s2 * 0.1).sum()
+        return jax.grad(loss, argnums=(0, 1, 2, 3))(x, w, a, b)
+
+    gk = grads(lambda *t: fm.fused_bn_relu_matmul(*t, interpret=True))
+
+    monkeypatch.setattr(fm, "_VMEM_BUDGET", 1)  # force the overflow branch
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        zf, s1f, s2f = fm.fused_bn_relu_matmul(x, w, a, b)
+    assert any("falling" in str(r.message) for r in rec)
+    assert zf.dtype == x.dtype and s1f.dtype == jnp.float32
+    assert np.allclose(zf, zk, atol=1e-4)
+    assert np.allclose(s1f, s1k, atol=1e-3)
+    assert np.allclose(s2f, s2k, atol=1e-2)
+    gf = grads(fm.fused_bn_relu_matmul)
+    for gi, gj in zip(gk, gf):
+        assert np.allclose(gi, gj, atol=1e-3), np.abs(gi - gj).max()
+
+    # stats=False keeps the (z, zeros, zeros) tuple shape
+    z0, s10, s20 = fm.fused_bn_relu_matmul(x, w, a, b, stats=False)
+    assert s10.shape == (N,) and not s10.any() and not s20.any()
+
+    # bf16 compute dtype stays bf16 through the fallback (f32 scale/bias)
+    zb, s1b, _ = fm.fused_bn_relu_matmul(x.astype(jnp.bfloat16), w.astype(
+        jnp.bfloat16), a, b)
+    assert zb.dtype == jnp.bfloat16 and s1b.dtype == jnp.float32
+
+
 def test_fused_matmul_nhwc_h_split_path(monkeypatch):
     """When no whole-batch block fits the VMEM budget the fitter splits H
     — force that path with a tiny budget and check values still match."""
