@@ -25,21 +25,46 @@ Field numbers (bigdl.proto):
 Storage sharing matches the reference: the first occurrence of a storage id
 carries the data; later references carry only the id.
 
-Supported module set (both directions): Sequential, Linear,
-SpatialConvolution, SpatialMaxPooling, SpatialAveragePooling, ReLU, Tanh,
-Sigmoid, SoftMax, LogSoftMax, Dropout, BatchNormalization,
-SpatialBatchNormalization, Reshape, View, Identity, CAddTable, JoinTable.
+Two tiers (mirroring the reference's ModuleSerializer design —
+``utils/serializer/ModuleSerializer.scala:199`` registers ~40 custom
+serializers and falls back to a reflection-based default for every other
+layer):
+
+1. **Reference-compatible tier** (``_SAVE_TYPES``): the common layer set is
+   written with Scala class names and ctor-param attrs so checkpoints
+   cross-load with the actual reference.
+2. **Generic native tier** (everything else): ``moduleType`` is
+   ``bigdl_tpu::<python module path>.<ClassName>``; the module's
+   configuration is stored as typed ``cfg:`` attrs (primitives, arrays,
+   nested modules — the Python analog of the reference's reflected ctor
+   params), with a pickled-config fallback (``cfg_pickle`` custom attr) for
+   Python-only structures (Graph node topology, callables); the full
+   param/state pytree is stored as dtype-preserving ``param:<path>`` /
+   ``state:<path>`` tensor attrs. int8 / uint8 / bf16 / f16 tensors use
+   native datatype extension values (100-103) outside the reference enum
+   range, so quantized modules round-trip (the analog of the reference's
+   ``nn/quantized/QuantSerializer.scala``).
+
+Trust model: the generic tier's pickled-config fallback executes pickle on
+load, exactly like ``Module.load`` — load .bigdl files only from trusted
+sources.
+
+Plain containers in either tier store children as ``subModules`` (field 2),
+so a Sequential can mix reference-compatible and native-only layers.
 """
 from __future__ import annotations
 
+import pickle
 import struct
 from typing import Dict, List, Optional
 
+import ml_dtypes
 import numpy as np
 
 from .. import nn as N
+from ..nn.module import Container, Criterion, Module, Node
 from .wire import (field_bytes, field_string, field_varint, field_double,
-                   field_float, field_packed_float, iter_fields, read_varint,
+                   field_packed_double, field_packed_varint, iter_fields,
                    to_signed, unpack_packed)
 
 _SCALA_NN = "com.intel.analytics.bigdl.nn."
@@ -51,6 +76,10 @@ _DT_REGULARIZER, _DT_TENSOR, _DT_MODULE = 9, 10, 13
 _DT_ARRAY = 15
 
 # BigDLTensor/TensorStorage datatype: FLOAT=2 (same enum)
+
+# native datatype extension values (outside the reference enum range) —
+# only emitted by the generic tier, never on reference-compatible layers
+_NDT_INT8, _NDT_UINT8, _NDT_BF16, _NDT_F16 = 100, 101, 102, 103
 
 
 # ---------------------------------------------------------------------------
@@ -68,19 +97,55 @@ class _Ids:
         return v
 
 
+def _tensor_datatype(dtype) -> int:
+    dtype = np.dtype(dtype)
+    if dtype == np.int8:
+        return _NDT_INT8
+    if dtype == np.uint8:
+        return _NDT_UINT8
+    if dtype == ml_dtypes.bfloat16:
+        return _NDT_BF16
+    if dtype == np.float16:
+        return _NDT_F16
+    if dtype == np.int32 or dtype == np.int16:
+        return _DT_INT32
+    if dtype == np.int64:
+        return _DT_INT64
+    if dtype == np.bool_:
+        return _DT_BOOL
+    if dtype == np.float64:
+        return _DT_DOUBLE
+    return _DT_FLOAT
+
+
 def _enc_storage(data: np.ndarray, sid: int) -> bytes:
-    out = field_varint(1, _DT_FLOAT)
-    out += field_bytes(2, struct.pack(f"<{data.size}f",
-                                      *np.asarray(data, np.float32).ravel()))
+    dt = _tensor_datatype(data.dtype)
+    out = field_varint(1, dt)
+    flat = np.asarray(data).ravel()
+    if dt in (_NDT_INT8, _NDT_UINT8):
+        out += field_bytes(8, flat.tobytes())
+    elif dt == _DT_INT32:
+        out += field_packed_varint(6, [int(v) for v in flat])
+    elif dt == _DT_INT64:
+        out += field_packed_varint(7, [int(v) for v in flat])
+    elif dt == _DT_BOOL:
+        out += field_packed_varint(4, [int(v) for v in flat])
+    elif dt == _DT_DOUBLE:
+        out += field_packed_double(3, [float(v) for v in flat])
+    else:  # FLOAT / BF16 / F16 all travel as f32 floats (exact supersets)
+        out += field_bytes(2, struct.pack(
+            f"<{flat.size}f", *np.asarray(flat, np.float32)))
     out += field_varint(9, sid)
     return out
 
 
-def _enc_tensor(arr: np.ndarray, ids: _Ids) -> bytes:
-    arr = np.asarray(arr, np.float32)
+def _enc_tensor(arr: np.ndarray, ids: _Ids, keep_dtype: bool = False) -> bytes:
+    arr = np.asarray(arr)
+    if not keep_dtype:
+        arr = np.asarray(arr, np.float32)
     sizes = list(arr.shape)
     strides = [int(np.prod(sizes[i + 1:])) for i in range(len(sizes))]
-    out = field_varint(1, _DT_FLOAT)
+    out = field_varint(1, _tensor_datatype(arr.dtype))
     for s in sizes:
         out += field_varint(2, s)
     for s in strides:
@@ -88,6 +153,8 @@ def _enc_tensor(arr: np.ndarray, ids: _Ids) -> bytes:
     out += field_varint(4, 1)            # torch-style 1-based storage offset
     out += field_varint(5, len(sizes))
     out += field_varint(6, arr.size)
+    if arr.ndim == 0:
+        out += field_varint(7, 1)        # isScalar
     out += field_bytes(8, _enc_storage(arr, ids.take()))
     out += field_varint(9, ids.take())
     return out
@@ -122,7 +189,6 @@ def _attr_tensor(arr: np.ndarray, ids: "_Ids") -> bytes:
 
 
 def _attr_i32_array(vals) -> bytes:
-    from .wire import field_packed_varint
     body = field_varint(1, len(vals)) + field_varint(2, _DT_INT32)
     body += field_packed_varint(3, [int(v) for v in vals])  # packed i32
     return _attr(_DT_ARRAY, field_bytes(15, body))
@@ -130,6 +196,237 @@ def _attr_i32_array(vals) -> bytes:
 
 def _map_entry(key: str, attr_bytes: bytes) -> bytes:
     return field_bytes(8, field_string(1, key) + field_bytes(2, attr_bytes))
+
+
+# ---------------------------------------------------------------------------
+# generic native tier: typed AttrValue encoders for arbitrary configs
+# ---------------------------------------------------------------------------
+
+_NATIVE_PREFIX = "bigdl_tpu::"
+_DT_CUSTOM = 17       # native: AttrValue custom slot (field 17 bytes)
+
+# module attributes that are runtime state, not configuration
+_RUNTIME_ATTRS = frozenset({"params", "state", "grad_params", "output",
+                            "grad_input", "name", "train_mode"})
+
+
+class _Unrepresentable(Exception):
+    """Raised when a config value has no typed AttrValue form — the caller
+    falls back to the pickled-config custom attr."""
+
+
+def _attr_i64(v: int) -> bytes:
+    return _attr(_DT_INT64, field_varint(4, int(v)))
+
+
+def _attr_str(s: str) -> bytes:
+    return _attr(_DT_STRING, field_string(7, s))
+
+
+def _attr_double_array(vals) -> bytes:
+    body = field_varint(1, len(vals)) + field_varint(2, _DT_DOUBLE)
+    body += field_packed_double(6, [float(v) for v in vals])
+    return _attr(_DT_ARRAY, field_bytes(15, body))
+
+
+def _attr_str_array(vals) -> bytes:
+    body = field_varint(1, len(vals)) + field_varint(2, _DT_STRING)
+    for s in vals:
+        body += field_string(7, s)
+    return _attr(_DT_ARRAY, field_bytes(15, body))
+
+
+def _attr_module(mbytes: bytes) -> bytes:
+    return _attr(_DT_MODULE, field_bytes(13, mbytes))
+
+
+def _attr_module_array(mods) -> bytes:
+    body = field_varint(1, len(mods)) + field_varint(2, _DT_MODULE)
+    for mb in mods:
+        body += field_bytes(13, mb)
+    return _attr(_DT_ARRAY, field_bytes(15, body))
+
+
+def _attr_custom(blob: bytes) -> bytes:
+    return _attr(_DT_CUSTOM, field_bytes(17, blob))
+
+
+def _is_array(v) -> bool:
+    if isinstance(v, np.ndarray):
+        return True
+    try:
+        import jax
+        return isinstance(v, jax.Array)
+    except Exception:            # pragma: no cover - jax always present
+        return False
+
+
+def _enc_value(v, ids: _Ids) -> bytes:
+    """One config value → typed AttrValue bytes, or _Unrepresentable."""
+    if isinstance(v, Module):
+        return _attr_module(_enc_module(v, v.params, v.state or {}, ids))
+    if isinstance(v, (bool, np.bool_)):
+        return _attr_bool(bool(v))
+    if isinstance(v, (int, np.integer)):
+        iv = int(v)
+        return _attr_i32(iv) if -2**31 <= iv < 2**31 else _attr_i64(iv)
+    if isinstance(v, (float, np.floating)):
+        return _attr_double(float(v))
+    if isinstance(v, str):
+        return _attr_str(v)
+    if v is None:
+        return _attr(_DT_TENSOR)          # decodes back to None
+    if _is_array(v):
+        return _attr(_DT_TENSOR,
+                     field_bytes(10, _enc_tensor(np.asarray(v), ids,
+                                                 keep_dtype=True)))
+    if isinstance(v, (list, tuple)):
+        items = list(v)
+        if all(isinstance(x, (bool, np.bool_)) for x in items) and items:
+            raise _Unrepresentable("bool arrays have no typed form")
+        if all(isinstance(x, (int, np.integer)) for x in items):
+            return _attr_i32_array(items)  # covers the empty list too
+        if all(isinstance(x, (int, float, np.integer, np.floating))
+               for x in items):
+            return _attr_double_array(items)
+        if all(isinstance(x, str) for x in items):
+            return _attr_str_array(items)
+        if all(isinstance(x, Module) for x in items):
+            return _attr_module_array(
+                [_enc_module(x, x.params, x.state or {}, ids)
+                 for x in items])
+        raise _Unrepresentable(f"heterogeneous sequence {v!r}")
+    raise _Unrepresentable(f"{type(v).__name__} has no typed AttrValue form")
+
+
+def _iter_modules(obj, seen):
+    """All Module instances reachable from obj through dicts, sequences,
+    Module attributes, and Graph Nodes."""
+    if id(obj) in seen:
+        return
+    seen.add(id(obj))
+    if isinstance(obj, Module):
+        yield obj
+        yield from _iter_modules(obj.__dict__, seen)
+    elif isinstance(obj, Node):
+        if obj.module is not None:
+            yield from _iter_modules(obj.module, seen)
+        yield from _iter_modules(obj.prevs, seen)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            yield from _iter_modules(v, seen)
+    elif isinstance(obj, (list, tuple, set)):
+        for v in obj:
+            yield from _iter_modules(v, seen)
+
+
+def _pickle_config(m) -> bytes:
+    """Pickle a module with every reachable Module's runtime fields nulled
+    (the deep analog of Module._strip_runtime) — config only, no params."""
+    mods = list(_iter_modules(m, set()))
+    saved = [(x, x.params, x.state, x.grad_params, x.output, x.grad_input)
+             for x in mods]
+    try:
+        for x in mods:
+            x.params = x.state = x.grad_params = None
+            x.output = x.grad_input = None
+        return pickle.dumps(m)
+    finally:
+        for x, p, s, g, o, gi in saved:
+            x.params, x.state, x.grad_params = p, s, g
+            x.output, x.grad_input = o, gi
+
+
+def _flatten_tree(tree):
+    """(path, leaf) pairs for a nested dict/list/tuple pytree; empty
+    dicts/lists become ('<path>', _EMPTY_DICT/_EMPTY_LIST) markers so the
+    exact structure round-trips."""
+    pairs = []
+
+    def rec(t, path):
+        if isinstance(t, dict):
+            if not t:
+                pairs.append((path, _EMPTY_DICT))
+                return
+            for k in t:
+                ks = str(k)
+                if not isinstance(k, str) or "/" in ks or ks.startswith("["):
+                    raise _Unrepresentable(f"param key {k!r}")
+                rec(t[k], f"{path}/{ks}" if path else ks)
+        elif isinstance(t, tuple):
+            # tuples would come back as lists (different jax treedef) —
+            # route the whole tree to the pickle fallback instead
+            raise _Unrepresentable("tuple in param/state tree")
+        elif isinstance(t, list):
+            if not t:
+                pairs.append((path, _EMPTY_LIST))
+                return
+            for i, v in enumerate(t):
+                rec(v, f"{path}/[{i}]" if path else f"[{i}]")
+        else:
+            pairs.append((path, t))
+
+    rec(tree, "")
+    return pairs
+
+
+_EMPTY_DICT = object()
+_EMPTY_LIST = object()
+
+
+def _unflatten_pairs(pairs):
+    if len(pairs) == 1 and pairs[0][0] == "":
+        v = pairs[0][1]
+        return {} if v is _EMPTY_DICT else ([] if v is _EMPTY_LIST else v)
+    root: Dict = {}
+    for path, v in pairs:
+        segs = path.split("/")
+        cur = root
+        for s in segs[:-1]:
+            cur = cur.setdefault(s, {})
+        cur[segs[-1]] = v
+
+    def conv(d):
+        if d is _EMPTY_DICT:
+            return {}
+        if d is _EMPTY_LIST:
+            return []
+        if isinstance(d, dict):
+            if d and all(k.startswith("[") and k.endswith("]") for k in d):
+                return [conv(d[f"[{i}]"]) for i in range(len(d))]
+            return {k: conv(v) for k, v in d.items()}
+        return d
+
+    return conv(root)
+
+
+def _enc_tree_attrs(tree, tag: str, ids: _Ids, attrs: Dict[str, bytes]):
+    """Encode a param/state pytree as '<tag>:<path>' typed attrs; on any
+    unrepresentable leaf fall back to ONE '<tag>_pickle' custom attr."""
+    try:
+        for path, leaf in _flatten_tree(tree):
+            if leaf is _EMPTY_DICT:
+                attrs[f"{tag}E:{path}"] = _attr_bool(True)
+            elif leaf is _EMPTY_LIST:
+                attrs[f"{tag}L:{path}"] = _attr_bool(True)
+            elif _is_array(leaf):
+                attrs[f"{tag}:{path}"] = _attr(
+                    _DT_TENSOR,
+                    field_bytes(10, _enc_tensor(np.asarray(leaf), ids,
+                                                keep_dtype=True)))
+            elif isinstance(leaf, (bool, int, float, str, np.bool_,
+                                   np.integer, np.floating)) or leaf is None:
+                attrs[f"{tag}:{path}"] = _enc_value(leaf, ids)
+            else:
+                raise _Unrepresentable(type(leaf).__name__)
+    except _Unrepresentable:
+        for k in [k for k in attrs
+                  if k.startswith((f"{tag}:", f"{tag}E:", f"{tag}L:"))]:
+            del attrs[k]
+        import jax
+        np_tree = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if _is_array(x) else x, tree)
+        attrs[f"{tag}_pickle"] = _attr_custom(pickle.dumps(np_tree))
 
 
 def _module_attrs(m: N.Module, state, ids: "_Ids") -> Dict[str, bytes]:
@@ -164,9 +461,12 @@ def _module_attrs(m: N.Module, state, ids: "_Ids") -> Dict[str, bytes]:
                 "initGradBias": _attr_null_tensor(),
                 "withBias": _attr_bool(m.with_bias)}
     if t in ("SpatialMaxPooling",):
+        # ceilMode is toggled by .ceil()/.floor() post-ctor; the reference
+        # stores it the same way (SpatialMaxPooling.scala doSerializeModule)
         return {"kW": _attr_i32(m.kw), "kH": _attr_i32(m.kh),
                 "dW": _attr_i32(m.dw), "dH": _attr_i32(m.dh),
-                "padW": _attr_i32(m.pad_w), "padH": _attr_i32(m.pad_h)}
+                "padW": _attr_i32(m.pad_w), "padH": _attr_i32(m.pad_h),
+                "ceilMode": _attr_bool(m.ceil_mode)}
     if t in ("SpatialAveragePooling",):
         return {"kW": _attr_i32(m.kw), "kH": _attr_i32(m.kh),
                 "dW": _attr_i32(m.dw), "dH": _attr_i32(m.dh),
@@ -236,14 +536,19 @@ _SAVE_TYPES = ("Sequential", "Linear", "SpatialConvolution",
 
 def _enc_module(m: N.Module, params, state, ids: _Ids) -> bytes:
     t = type(m).__name__
-    if t not in _SAVE_TYPES:
-        raise NotImplementedError(
-            f"bigdl.proto serialization of {t} not supported "
-            f"(supported: {', '.join(_SAVE_TYPES)})")
+    if t in _SAVE_TYPES and type(m) is getattr(N, t, None):
+        return _enc_ref_compatible(m, params, state or {}, ids)
+    return _enc_generic(m, params, state, ids)
+
+
+def _enc_ref_compatible(m: N.Module, params, state, ids: _Ids) -> bytes:
+    """Reference wire form: Scala class name + ctor-param attrs."""
+    t = type(m).__name__
     out = field_string(1, m.name)
     if isinstance(m, N.Sequential):
         for i, child in enumerate(m.modules):
-            out += field_bytes(2, _enc_module(child, params[str(i)],
+            cp = None if params is None else params[str(i)]
+            out += field_bytes(2, _enc_module(child, cp,
                                               state.get(str(i), {}), ids))
     out += field_string(7, _SCALA_NN + t)
     for key, ab in _module_attrs(m, state, ids).items():
@@ -251,7 +556,7 @@ def _enc_module(m: N.Module, params, state, ids: _Ids) -> bytes:
     out += field_string(9, "0.4.0")
     out += field_varint(10, 1 if m.train_mode else 0)
     out += field_varint(12, ids.take())
-    tensors = [] if isinstance(m, N.Sequential) else \
+    tensors = [] if isinstance(m, N.Sequential) or params is None else \
         _collect_parameters(m, params)
     if tensors:
         out += field_varint(15, 1)  # hasParameters
@@ -260,12 +565,93 @@ def _enc_module(m: N.Module, params, state, ids: _Ids) -> bytes:
     return out
 
 
-def save_bigdl(model: N.Module, path: str) -> None:
-    """module.saveModule(path) parity — writes a reference-loadable
-    BigDLModule protobuf."""
-    model.ensure_initialized()
+def _enc_generic(m, params, state, ids: _Ids) -> bytes:
+    """Generic native tier: any Module (or Criterion) → proto bytes."""
+    cls = type(m)
+    out = field_string(1, getattr(m, "name", "") or "")
+    mtype = _NATIVE_PREFIX + cls.__module__ + "." + cls.__qualname__
+
+    # plain containers (child list is the only structure) use subModules;
+    # Graph subclasses carry Node topology, which only the pickled config
+    # can represent, so their children stay inside the parent's param tree
+    plain_container = isinstance(m, Container) and \
+        not isinstance(m, N.Graph)
+    attrs: Dict[str, bytes] = {}
+    if isinstance(m, N.Graph) or not cls.__module__.startswith("bigdl_tpu"):
+        # Graphs need Node topology; classes outside the package can't go
+        # through _resolve_native — both ride the pickled-config path
+        # (pickle stores the class by reference, so any importable user
+        # Module subclass round-trips, like the reference's reflection
+        # default does for user layers)
+        attrs["cfg_pickle"] = _attr_custom(_pickle_config(m))
+        plain_container = False
+    else:
+        skip = ("modules",) if plain_container else ()
+        try:
+            for k, v in m.__dict__.items():
+                if k in _RUNTIME_ATTRS or k in skip:
+                    continue
+                try:
+                    key = ("cfgt:" + k) if isinstance(v, tuple) \
+                        else ("cfg:" + k)
+                    attrs[key] = _enc_value(v, ids)
+                except _Unrepresentable:
+                    # no typed form for this one value (dicts, callables,
+                    # dtypes, ...) — pickle just the value, keep the rest
+                    # of the config typed and wire-inspectable
+                    attrs["cfgp:" + k] = _attr_custom(pickle.dumps(v))
+        except Exception:
+            # unpicklable value (lambda, ...) — last resort: whole config
+            attrs = {"cfg_pickle": _attr_custom(_pickle_config(m))}
+            plain_container = False
+
+    sub_bytes = []
+    handled = set()
+    if plain_container and "cfg_pickle" not in attrs:
+        for i, child in enumerate(m.modules):
+            cp = None if params is None else params.get(str(i))
+            cs = {} if not state else state.get(str(i), {})
+            sub_bytes.append(_enc_module(child, cp, cs, ids))
+            handled.add(str(i))
+    else:
+        plain_container = False
+
+    out += b"".join(field_bytes(2, sb) for sb in sub_bytes)
+    out += field_string(7, mtype)
+
+    if isinstance(m, Module) and params is not None:
+        own_params = {k: v for k, v in params.items()
+                      if k not in handled} if isinstance(params, dict) \
+            else params
+        _enc_tree_attrs(own_params, "param", ids, attrs)
+        own_state = {k: v for k, v in (state or {}).items()
+                     if k not in handled} if isinstance(state, dict) \
+            else state
+        _enc_tree_attrs(own_state if own_state is not None else {},
+                        "state", ids, attrs)
+    for key, ab in attrs.items():
+        out += _map_entry(key, ab)
+    out += field_string(9, "0.4.0")
+    out += field_varint(10, 1 if getattr(m, "train_mode", False) else 0)
+    out += field_varint(12, ids.take())
+    if isinstance(m, Module) and params is not None:
+        out += field_varint(15, 1)   # hasParameters: params tree present
+    return out
+
+
+def save_bigdl(model, path: str) -> None:
+    """module.saveModule(path) parity — writes a BigDLModule protobuf.
+
+    Reference-compatible layers cross-load with the actual reference;
+    every other module (incl. quantized, Graph, recurrent, criteria) uses
+    the generic native tier in the same container format."""
+    if isinstance(model, Module):
+        model.ensure_initialized()
+        data = _enc_module(model, model.params, model.state or {}, _Ids())
+    else:   # Criterion or other config-only object
+        data = _enc_generic(model, None, None, _Ids())
     with open(path, "wb") as f:
-        f.write(_enc_module(model, model.params, model.state or {}, _Ids()))
+        f.write(data)
 
 
 # ---------------------------------------------------------------------------
@@ -274,21 +660,46 @@ def save_bigdl(model: N.Module, path: str) -> None:
 
 
 def _dec_storage(buf: bytes, storages: Dict[int, np.ndarray]):
-    sid, data = -1, None
+    sid, data, dt, raw = -1, None, _DT_FLOAT, None
     for f, w, v in iter_fields(buf):
-        if f == 9 and w == 0:
+        if f == 1 and w == 0:
+            dt = v
+        elif f == 9 and w == 0:
             sid = to_signed(v, 32)
         elif f == 2:
             data = np.array(unpack_packed(v, "float"), np.float32) \
                 if w == 2 else np.array([struct.unpack("<f", v)[0]],
                                         np.float32)
         elif f == 3:
-            data = np.array(unpack_packed(v, "double"), np.float32) \
+            data = np.array(unpack_packed(v, "double"), np.float64) \
                 if w == 2 else np.array([struct.unpack("<d", v)[0]],
-                                        np.float32)
-        elif f == 6:
+                                        np.float64)
+        elif f == 4:
             vals = unpack_packed(v, "varint") if w == 2 else [v]
-            data = np.array([to_signed(x, 32) for x in vals], np.float32)
+            data = np.array([bool(x) for x in vals], np.bool_)
+        elif f == 6:
+            # negatives are wire-encoded as 64-bit two's-complement
+            # varints (proto int32 rule) — decode at 64 bits, then narrow
+            vals = unpack_packed(v, "varint") if w == 2 else [v]
+            data = np.array([to_signed(x) for x in vals],
+                            np.int64).astype(np.int32)
+        elif f == 7:
+            vals = unpack_packed(v, "varint") if w == 2 else [v]
+            data = np.array([to_signed(x) for x in vals], np.int64)
+        elif f == 8 and w == 2:
+            raw = v
+    if raw is not None and data is None:
+        data = np.frombuffer(
+            raw, np.uint8 if dt == _NDT_UINT8 else np.int8).copy()
+    if data is not None:
+        if dt == _NDT_BF16:
+            data = data.astype(ml_dtypes.bfloat16)
+        elif dt == _NDT_F16:
+            data = data.astype(np.float16)
+        elif dt == _DT_DOUBLE and data.dtype == np.float64:
+            # reference double checkpoints load as f32 (the jax side is
+            # f32; pre-r4 behavior preserved)
+            data = data.astype(np.float32)
     if data is not None and sid != -1:
         storages[sid] = data
     return sid, data
@@ -296,6 +707,7 @@ def _dec_storage(buf: bytes, storages: Dict[int, np.ndarray]):
 
 def _dec_tensor(buf: bytes, storages: Dict[int, np.ndarray]) -> np.ndarray:
     sizes, strides, offset, data, sid = [], [], 1, None, -1
+    is_scalar = False
     for f, w, v in iter_fields(buf):
         if f == 2:
             sizes += [to_signed(x, 32) for x in unpack_packed(v, "varint")] \
@@ -305,6 +717,8 @@ def _dec_tensor(buf: bytes, storages: Dict[int, np.ndarray]) -> np.ndarray:
                 if w == 2 else [to_signed(v, 32)]
         elif f == 4 and w == 0:
             offset = to_signed(v, 32)
+        elif f == 7 and w == 0:
+            is_scalar = bool(v)
         elif f == 8 and w == 2:
             sid, data = _dec_storage(v, storages)
     if data is None and sid in storages:
@@ -313,6 +727,8 @@ def _dec_tensor(buf: bytes, storages: Dict[int, np.ndarray]) -> np.ndarray:
         return np.zeros(sizes, np.float32)
     n = int(np.prod(sizes)) if sizes else data.size
     flat = data[offset - 1: offset - 1 + n]
+    if is_scalar and not sizes:
+        return flat.reshape(())
     return flat.reshape(sizes) if sizes else flat
 
 
@@ -335,10 +751,17 @@ def _dec_attr(buf: bytes, storages):
             val = bool(v)
         elif f == 10 and w == 2:
             val = _dec_tensor(v, storages)
+        elif f == 13 and w == 2:   # nested BigDLModule (generic tier cfg)
+            val = decode_bigdl_module(v, storages)
+        elif f == 17 and w == 2:   # custom bytes (native pickled payloads)
+            val = v
         elif f == 15 and w == 2:  # ArrayValue
-            arr = {"i32": [], "flt": [], "dbl": []}
+            arr = {"i32": [], "flt": [], "dbl": [], "str": [], "mod": []}
+            empty = False
             for f2, w2, v2 in iter_fields(v):
-                if f2 == 3:
+                if f2 == 1 and w2 == 0:
+                    empty = v2 == 0
+                elif f2 == 3 or f2 == 4:
                     arr["i32"] += [to_signed(x) for x in
                                    unpack_packed(v2, "varint")] \
                         if w2 == 2 else [to_signed(v2)]
@@ -348,7 +771,14 @@ def _dec_attr(buf: bytes, storages):
                 elif f2 == 6:
                     arr["dbl"] += unpack_packed(v2, "double") if w2 == 2 \
                         else [struct.unpack("<d", v2)[0]]
-            val = arr["i32"] or arr["flt"] or arr["dbl"]
+                elif f2 == 7 and w2 == 2:
+                    arr["str"].append(v2.decode("utf-8"))
+                elif f2 == 13 and w2 == 2:
+                    arr["mod"].append(decode_bigdl_module(v2, storages))
+            val = (arr["i32"] or arr["flt"] or arr["dbl"] or arr["str"]
+                   or arr["mod"])
+            if empty:
+                val = []
     return val
 
 
@@ -356,7 +786,8 @@ def decode_bigdl_module(buf: bytes, storages=None) -> Dict:
     """BigDLModule bytes → nested dict."""
     storages = {} if storages is None else storages
     mod = {"name": "", "moduleType": "", "subModules": [], "attr": {},
-           "parameters": [], "weight": None, "bias": None, "train": False}
+           "parameters": [], "weight": None, "bias": None, "train": False,
+           "hasParameters": False}
     for f, w, v in iter_fields(buf):
         if f == 1 and w == 2:
             mod["name"] = v.decode("utf-8")
@@ -379,6 +810,8 @@ def decode_bigdl_module(buf: bytes, storages=None) -> Dict:
                 mod["attr"][key] = _dec_attr(ab or b"", storages)
         elif f == 10 and w == 0:
             mod["train"] = bool(v)
+        elif f == 15 and w == 0:
+            mod["hasParameters"] = bool(v)
         elif f == 16 and w == 2:
             mod["parameters"].append(_dec_tensor(v, storages))
     return mod
@@ -401,7 +834,8 @@ def _build_module(mod: Dict) -> N.Module:
         m = N.Linear(int(a["inputSize"]), int(a["outputSize"]),
                      bool(a.get("withBias", True)))
     elif t in ("SpatialConvolution", "SpatialShareConvolution"):
-        m = N.SpatialConvolution(
+        cls = getattr(N, t)
+        m = cls(
             int(a["nInputPlane"]), int(a["nOutputPlane"]),
             int(a["kernelW"]), int(a["kernelH"]),
             int(a.get("strideW", 1)), int(a.get("strideH", 1)),
@@ -413,6 +847,8 @@ def _build_module(mod: Dict) -> N.Module:
                                 int(a.get("dW") or a["kW"]),
                                 int(a.get("dH") or a["kH"]),
                                 int(a.get("padW", 0)), int(a.get("padH", 0)))
+        if a.get("ceilMode"):
+            m.ceil()
     elif t == "SpatialAveragePooling":
         m = N.SpatialAveragePooling(
             int(a["kW"]), int(a["kH"]),
@@ -444,7 +880,9 @@ def _build_module(mod: Dict) -> N.Module:
                                         float(a.get("eps", 1e-5)),
                                         float(a.get("momentum", 0.1)),
                                         bool(a.get("affine", True)))
-    elif t in ("Reshape", "View"):
+    elif t == "View":
+        m = N.View(*[int(x) for x in a.get("sizes", a.get("size", []))])
+    elif t == "Reshape":
         size = [int(x) for x in a.get("size", a.get("sizes", []))]
         m = N.Reshape(size, batch_mode=a.get("batchMode"))
     elif t == "Identity":
@@ -497,20 +935,181 @@ def _load_params(m: N.Module, mod: Dict, params, state) -> None:
             tns.reshape(np.asarray(params[k]).shape))
 
 
-def load_bigdl(path_or_bytes) -> N.Module:
-    """ModuleLoader.loadFromFile parity — builds a bigdl_tpu module from a
-    reference-format BigDLModule protobuf."""
+def _resolve_native(mtype: str):
+    """'bigdl_tpu::<module>.<Class>' → the class object. Restricted to the
+    bigdl_tpu package (clean failure on foreign type names — NOT a security
+    boundary: the generic tier's pickled-config fallback means .bigdl files,
+    like ``Module.load`` pickles, must only be loaded from trusted
+    sources)."""
+    path = mtype[len(_NATIVE_PREFIX):]
+    if not path.startswith("bigdl_tpu."):
+        raise ValueError(f"refusing to resolve non-bigdl_tpu type {path!r}")
+    import importlib
+    parts = path.split(".")
+    pymod = None
+    for cut in range(len(parts) - 1, 0, -1):
+        try:
+            pymod = importlib.import_module(".".join(parts[:cut]))
+            break
+        except ImportError:
+            continue
+    if pymod is None:
+        raise ValueError(f"cannot import module for {path!r}")
+    obj = pymod
+    for nm in parts[cut:]:
+        obj = getattr(obj, nm)
+    return obj
+
+
+def _to_jnp_tree(tree):
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, tree)
+
+
+def _cfg_value(val):
+    """Decoded attr value → config value (module dicts become modules)."""
+    if isinstance(val, dict) and "moduleType" in val:
+        c, cp, cs = _assemble(val)
+        if cp is not None:
+            c.params = _to_jnp_tree(cp)
+            c.state = _to_jnp_tree(cs) if cs is not None else None
+        return c
+    if isinstance(val, list) and val and all(
+            isinstance(x, dict) and "moduleType" in x for x in val):
+        return [_cfg_value(x) for x in val]
+    return val
+
+
+def _assemble_generic(mod: Dict):
+    """Generic-tier BigDLModule dict → (object, params, state)."""
+    a = mod["attr"]
+    params: Optional[Dict] = None
+    state: Optional[Dict] = None
+
+    if "cfg_pickle" in a:
+        m = pickle.loads(a["cfg_pickle"])
+    else:
+        cls = _resolve_native(mod["moduleType"])
+        m = cls.__new__(cls)
+        if isinstance(m, Module):
+            m.params = m.state = m.grad_params = None
+            m.output = m.grad_input = None
+            m.train_mode = bool(mod["train"])
+            m._scale_w = m._scale_b = 1.0
+            m.name = mod["name"] or type(m).__name__
+        else:
+            m.output = m.grad_input = None
+        for key, val in a.items():
+            if key.startswith("cfgt:"):
+                v = _cfg_value(val)
+                setattr(m, key[5:], tuple(v) if isinstance(v, list) else v)
+            elif key.startswith("cfgp:"):
+                setattr(m, key[5:], pickle.loads(val))
+            elif key.startswith("cfg:"):
+                setattr(m, key[4:], _cfg_value(val))
+        if isinstance(m, Container):
+            m.modules = []
+
+    if isinstance(m, Module):
+        if mod["name"]:
+            m.name = mod["name"]
+        m.train_mode = bool(mod["train"])
+
+    # own params/state from typed attrs (or the pickled-tree fallback)
+    if "param_pickle" in a:
+        params = pickle.loads(a["param_pickle"])
+    elif mod["hasParameters"] or any(k.startswith(("param:", "paramE:",
+                                                   "paramL:"))
+                                     for k in a):
+        pairs = []
+        for key, val in a.items():
+            if key.startswith("param:"):
+                pairs.append((key[6:], val))
+            elif key.startswith("paramE:"):
+                pairs.append((key[7:], _EMPTY_DICT))
+            elif key.startswith("paramL:"):
+                pairs.append((key[7:], _EMPTY_LIST))
+        params = _unflatten_pairs(pairs) if pairs else {}
+    if "state_pickle" in a:
+        state = pickle.loads(a["state_pickle"])
+    else:
+        pairs = []
+        for key, val in a.items():
+            if key.startswith("state:"):
+                pairs.append((key[6:], val))
+            elif key.startswith("stateE:"):
+                pairs.append((key[7:], _EMPTY_DICT))
+            elif key.startswith("stateL:"):
+                pairs.append((key[7:], _EMPTY_LIST))
+        state = _unflatten_pairs(pairs) if pairs else (
+            {} if params is not None else None)
+
+    # children from subModules (plain containers)
+    if mod["subModules"] and "cfg_pickle" not in a:
+        params = {} if params is None else params
+        state = {} if state is None else state
+        for i, sub in enumerate(mod["subModules"]):
+            c, cp, cs = _assemble(sub)
+            if isinstance(c, Module):
+                c.params = c.state = c.grad_params = None
+            m.modules.append(c)
+            params[str(i)] = cp if cp is not None else {}
+            state[str(i)] = cs if cs is not None else {}
+    return m, params, state
+
+
+def _assemble(mod: Dict):
+    """BigDLModule dict (either tier) → (module, params_tree, state_tree)."""
+    mtype = mod["moduleType"]
+    if mtype.startswith(_NATIVE_PREFIX):
+        return _assemble_generic(mod)
+    t = mtype.rsplit(".", 1)[-1]
+    if t == "Sequential":
+        seq = N.Sequential()
+        if mod["name"]:
+            seq.set_name(mod["name"])
+        params: Dict = {}
+        state: Dict = {}
+        for i, sub in enumerate(mod["subModules"]):
+            c, cp, cs = _assemble(sub)
+            if isinstance(c, Module):
+                c.params = c.state = c.grad_params = None
+            seq.add(c)
+            params[str(i)] = cp if cp is not None else {}
+            state[str(i)] = cs if cs is not None else {}
+        return seq, params, state
+    # reference-compatible leaf
+    m = _build_module(mod)
+    m.ensure_initialized()
+    _load_params(m, mod, m.params, m.state if m.state is not None else {})
+    p, s = m.params, m.state if m.state is not None else {}
+    m.params = m.state = m.grad_params = None
+    return m, p, s
+
+
+def load_bigdl(path_or_bytes):
+    """ModuleLoader.loadFromFile parity — builds a bigdl_tpu module (or
+    criterion) from a BigDLModule protobuf, either tier."""
+    import jax
+    import jax.numpy as jnp
     if isinstance(path_or_bytes, (bytes, bytearray)):
         data = bytes(path_or_bytes)
     else:
         with open(path_or_bytes, "rb") as f:
             data = f.read()
     mod = decode_bigdl_module(data)
-    m = _build_module(mod)
-    m.ensure_initialized()
-    _load_params(m, mod, m.params, m.state or {})
-    if mod["train"]:
-        m.training()
-    else:
-        m.evaluate()
+    m, params, state = _assemble(mod)
+    if isinstance(m, Module):
+        if params is not None:
+            m.params = _to_jnp_tree(params)
+            m.grad_params = jax.tree_util.tree_map(
+                lambda x: jnp.zeros_like(x) if isinstance(
+                    x, jax.Array) else x, m.params)
+        m.state = _to_jnp_tree(state) if state is not None else None
+        if mod["train"]:
+            m.training()
+        else:
+            m.evaluate()
     return m

@@ -1,0 +1,242 @@
+"""Per-layer bigdl.proto round-trip sweep — every public Module class in
+``bigdl_tpu.nn`` must save→load through the protobuf serializer with its
+type, config, and param/state trees intact.
+
+Parity: the reference exercises exactly this with a reflection-default
+serializer plus a per-layer SerializerSpec sweep
+(``utils/serializer/ModuleSerializer.scala:199``); this is the bigdl_tpu
+equivalent. Classes with required ctor args get an instance factory below;
+zero-arg classes are auto-instantiated. The coverage assertion at the bottom
+guarantees no newly-added class silently escapes the sweep.
+"""
+import inspect
+
+import jax
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as N
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.loaders.bigdl_proto import save_bigdl, load_bigdl
+
+# abstract bases / machinery that users never instantiate directly
+EXEMPT = {
+    "Module", "Container", "Cell", "Layer", "TableOperation",
+}
+
+
+def _graph(cls):
+    inp = N.Input()
+    h = N.Linear(6, 5)(inp)
+    out = N.ReLU()(h)
+    return cls(inp, out)
+
+
+# instance factories for classes whose ctor has required args
+SPECS = {
+    "Add": lambda: N.Add(6),
+    "AddConstant": lambda: N.AddConstant(1.5),
+    "Attention": lambda: N.Attention(8, 2),
+    "BatchNormalization": lambda: N.BatchNormalization(6),
+    "BifurcateSplitTable": lambda: N.BifurcateSplitTable(1),
+    "Bilinear": lambda: N.Bilinear(4, 5, 3),
+    "BinaryTreeLSTM": lambda: N.BinaryTreeLSTM(6, 5),
+    "Bottle": lambda: N.Bottle(N.Linear(4, 3)),
+    "CAdd": lambda: N.CAdd((6,)),
+    "CMul": lambda: N.CMul((6,)),
+    "Clamp": lambda: N.Clamp(-1.0, 1.0),
+    "Concat": lambda: N.Concat(1, N.Linear(4, 3), N.Linear(4, 2)),
+    "ConvLSTMPeephole": lambda: N.ConvLSTMPeephole(3, 4),
+    "ConvLSTMPeephole3D": lambda: N.ConvLSTMPeephole3D(3, 4),
+    "Cosine": lambda: N.Cosine(4, 3),
+    "DynamicGraph": lambda: _graph(N.DynamicGraph),
+    "Euclidean": lambda: N.Euclidean(4, 3),
+    "ExpandSize": lambda: N.ExpandSize([2, 6]),
+    "FeedForwardNetwork": lambda: N.FeedForwardNetwork(8, 16),
+    "GRU": lambda: N.GRU(6, 5),
+    "GaussianDropout": lambda: N.GaussianDropout(0.3),
+    "GaussianNoise": lambda: N.GaussianNoise(0.2),
+    "Graph": lambda: _graph(N.Graph),
+    "Highway": lambda: N.Highway(6),
+    "Index": lambda: N.Index(1),
+    "InferReshape": lambda: N.InferReshape([-1, 3]),
+    "JoinTable": lambda: N.JoinTable(1),
+    "L1Penalty": lambda: N.L1Penalty(0.01),
+    "LSTM": lambda: N.LSTM(6, 5),
+    "LSTMPeephole": lambda: N.LSTMPeephole(6, 5),
+    "LayerNormalization": lambda: N.LayerNormalization(8),
+    "Linear": lambda: N.Linear(6, 4),
+    "LocallyConnected1D": lambda: N.LocallyConnected1D(8, 4, 3, 2),
+    "LocallyConnected2D": lambda: N.LocallyConnected2D(2, 8, 8, 3, 3, 3),
+    "LookupTable": lambda: N.LookupTable(10, 6),
+    "LookupTableSparse": lambda: N.LookupTableSparse(10, 6),
+    "MapTable": lambda: N.MapTable(N.Linear(4, 3)),
+    "Maxout": lambda: N.Maxout(6, 4, 2),
+    "MixtureOfExperts": lambda: N.MixtureOfExperts(8, 2),
+    "Model": lambda: _graph(N.Model),
+    "MulConstant": lambda: N.MulConstant(2.0),
+    "NormalizeScale": lambda: N.NormalizeScale(size=(1, 6, 1, 1)),
+    "Recurrent": lambda: N.Recurrent(N.LSTM(6, 5)),
+    "BiRecurrent": lambda: N.BiRecurrent().add(N.RnnCell(6, 5)),
+    "MultiRNNCell": lambda: N.MultiRNNCell([N.RnnCell(6, 6),
+                                            N.RnnCell(6, 6)]),
+    "Narrow": lambda: N.Narrow(1, 0, 2),
+    "NarrowTable": lambda: N.NarrowTable(1, 1),
+    "Pack": lambda: N.Pack(1),
+    "Padding": lambda: N.Padding(1, 2, 2),
+    "Power": lambda: N.Power(2.0),
+    "PriorBox": lambda: N.PriorBox([16.0], aspect_ratios=[2.0],
+                                   img_size=64, step=8.0),
+    "Proposal": lambda: N.Proposal(100, 10, [0.5, 1.0, 2.0], [8.0]),
+    "RNN": lambda: N.RNN(6, 5),
+    "RecurrentDecoder": lambda: N.RecurrentDecoder(4).add(N.RnnCell(5, 5)),
+    "View": lambda: N.View(2, 3),
+    "Replicate": lambda: N.Replicate(3),
+    "Reshape": lambda: N.Reshape([2, 3]),
+    "ResizeBilinear": lambda: N.ResizeBilinear(8, 8),
+    "RnnCell": lambda: N.RnnCell(6, 5),
+    "RoiAlign": lambda: N.RoiAlign(3, 3),
+    "RoiPooling": lambda: N.RoiPooling(3, 3),
+    "SReLU": lambda: N.SReLU((6,)),
+    "Scale": lambda: N.Scale((1, 6)),
+    "Select": lambda: N.Select(1, 0),
+    "SelectTable": lambda: N.SelectTable(1),
+    "SparseLinear": lambda: N.SparseLinear(6, 4),
+    "SpatialAveragePooling": lambda: N.SpatialAveragePooling(2, 2),
+    "SpatialBatchNormalization": lambda: N.SpatialBatchNormalization(3),
+    "SpatialConvolution": lambda: N.SpatialConvolution(3, 4, 3, 3),
+    "SpatialConvolutionMap": lambda: N.SpatialConvolutionMap(
+        np.array([[0, 0], [1, 1], [2, 2]], np.int32), 3, 3),
+    "SpatialDilatedConvolution": lambda: N.SpatialDilatedConvolution(
+        3, 4, 3, 3, dilation_w=2, dilation_h=2),
+    "SpatialFullConvolution": lambda: N.SpatialFullConvolution(3, 4, 3, 3),
+    "SpatialMaxPooling": lambda: N.SpatialMaxPooling(2, 2),
+    "SpatialSeparableConvolution": lambda: N.SpatialSeparableConvolution(
+        3, 6, 2, 3, 3),
+    "SpatialShareConvolution": lambda: N.SpatialShareConvolution(3, 4, 3, 3),
+    "SpatialZeroPadding": lambda: N.SpatialZeroPadding(1, 1, 1, 1),
+    "SplitTable": lambda: N.SplitTable(1),
+    "StaticGraph": lambda: _graph(N.StaticGraph),
+    "TemporalConvolution": lambda: N.TemporalConvolution(4, 6, 3),
+    "TemporalMaxPooling": lambda: N.TemporalMaxPooling(2),
+    "TimeDistributed": lambda: N.TimeDistributed(N.Linear(4, 3)),
+    "Transformer": lambda: N.Transformer(32, hidden_size=16, num_heads=2,
+                                         filter_size=32,
+                                         num_hidden_layers=1),
+    "TransformerBlock": lambda: N.TransformerBlock(8, 2, 16),
+    "Transpose": lambda: N.Transpose([(1, 2)]),
+    "TreeLSTM": lambda: N.TreeLSTM(6, 5),
+    "Unsqueeze": lambda: N.Unsqueeze(1),
+    "UpSampling1D": lambda: N.UpSampling1D(2),
+    "VolumetricAveragePooling": lambda: N.VolumetricAveragePooling(2, 2, 2),
+    "VolumetricBatchNormalization": lambda:
+        N.VolumetricBatchNormalization(3),
+    "VolumetricConvolution": lambda: N.VolumetricConvolution(3, 4, 2, 3, 3),
+    "VolumetricFullConvolution": lambda:
+        N.VolumetricFullConvolution(3, 4, 2, 3, 3),
+    "VolumetricMaxPooling": lambda: N.VolumetricMaxPooling(2, 2, 2),
+}
+
+
+def _public_module_classes():
+    out = []
+    for n in dir(N):
+        c = getattr(N, n)
+        if inspect.isclass(c) and issubclass(c, Module) and n not in EXEMPT:
+            out.append(n)
+    return out
+
+
+ALL_CLASSES = _public_module_classes()
+
+
+def _instance(name):
+    if name in SPECS:
+        return SPECS[name]()
+    return getattr(N, name)()
+
+
+def _tree_equal(t1, t2, name):
+    l1, s1 = jax.tree_util.tree_flatten(t1)
+    l2, s2 = jax.tree_util.tree_flatten(t2)
+    assert s1 == s2, f"{name}: tree structure changed\n{s1}\n{s2}"
+    for a, b in zip(l1, l2):
+        if hasattr(a, "dtype") or hasattr(b, "dtype"):
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.dtype == b.dtype, f"{name}: dtype {a.dtype}->{b.dtype}"
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=1e-6, err_msg=name)
+        else:
+            assert a == b, f"{name}: leaf {a!r} != {b!r}"
+
+
+@pytest.mark.parametrize("name", ALL_CLASSES)
+def test_roundtrip(name, tmp_path):
+    m = _instance(name)
+    m.ensure_initialized()
+    path = str(tmp_path / "m.bigdl")
+    save_bigdl(m, path)
+    m2 = load_bigdl(path)
+    assert type(m2) is type(m)
+    _tree_equal(m.params, m2.params, name)
+    _tree_equal(m.state, m2.state, name)
+
+
+class _DtypeBag(Module):
+    def _init_params(self, rng):
+        import ml_dtypes
+        import jax.numpy as jnp
+        return {
+            "i32": jnp.asarray(np.array([-5, 3, -(2**31)], np.int32)),
+            "i8": jnp.asarray(np.array([-128, 0, 127], np.int8)),
+            "u8": jnp.asarray(np.array([0, 255], np.uint8)),
+            "b": jnp.asarray(np.array([True, False])),
+            "f16": jnp.asarray(np.array([1.5, -2.25], np.float16)),
+            "bf16": jnp.asarray(np.array([0.5, -3.0], ml_dtypes.bfloat16)),
+            "scalar": jnp.float32(2.5),
+        }
+
+    def _apply(self, params, state, x, training, rng):
+        return x
+
+
+class _TupleTree(Module):
+    def _init_params(self, rng):
+        import jax.numpy as jnp
+        return {"pair": (jnp.zeros((2,)), jnp.ones((3,)))}
+
+    def _apply(self, params, state, x, training, rng):
+        return x
+
+
+def test_generic_tier_dtypes_roundtrip(tmp_path):
+    """Negative int32, bool, f16, bf16, int8 tensor leaves all survive the
+    generic tier with exact dtype and value (user-defined Module subclass,
+    exercising the out-of-package pickled-config path too)."""
+    m = _DtypeBag()
+    m.ensure_initialized()
+    path = str(tmp_path / "d.bigdl")
+    save_bigdl(m, path)
+    m2 = load_bigdl(path)
+    _tree_equal(m.params, m2.params, "_DtypeBag")
+    assert np.asarray(m2.params["scalar"]).shape == ()
+
+
+def test_tuple_in_param_tree_roundtrips_via_pickle(tmp_path):
+    """A tuple inside the param tree keeps its treedef (pickle fallback)."""
+    m = _TupleTree()
+    m.ensure_initialized()
+    path = str(tmp_path / "t.bigdl")
+    save_bigdl(m, path)
+    m2 = load_bigdl(path)
+    assert isinstance(m2.params["pair"], tuple)
+    _tree_equal(m.params, m2.params, "_TupleTree")
+
+
+def test_sweep_covers_every_public_class():
+    """A class added to bigdl_tpu.nn without a spec (when it needs one)
+    fails test_roundtrip via auto-instantiation — this guards the inverse:
+    specs for classes that no longer exist."""
+    missing = [n for n in SPECS if n not in ALL_CLASSES]
+    assert not missing, f"specs for non-existent classes: {missing}"
