@@ -257,6 +257,58 @@ def _convert_layer(layer: Dict, in_channels: Optional[int]):
     if typ == "Flatten":
         return N.InferReshape([0, -1], batch_mode=False).set_name(name), \
             in_channels
+    if typ == "Power":
+        # y = (shift + scale * x) ^ power  (caffe power_param semantics,
+        # reference utils/caffe/Converter.scala fromCaffePower)
+        p = layer.get("power_param", {})
+        m = N.Power(float(p.get("power", 1.0)), float(p.get("scale", 1.0)),
+                    float(p.get("shift", 0.0)))
+        return m.set_name(name), in_channels
+    if typ == "PReLU":
+        return N.PReLU(in_channels or 1).set_name(name), in_channels
+    if typ == "Threshold":
+        # caffe Threshold outputs the INDICATOR x > t (unlike torch
+        # Threshold, which passes x through) — BinaryThreshold matches
+        p = layer.get("threshold_param", {})
+        m = N.BinaryThreshold(float(p.get("threshold", 0.0)))
+        return m.set_name(name), in_channels
+    if typ == "Exp":
+        # y = base^(scale*x + shift); base=-1 means e
+        p = layer.get("exp_param", {})
+        base = float(p.get("base", -1.0))
+        scale = float(p.get("scale", 1.0))
+        shift = float(p.get("shift", 0.0))
+        ln_base = 1.0 if base <= 0 else float(np.log(base))
+        m = N.Sequential(N.MulConstant(scale * ln_base),
+                         N.AddConstant(shift * ln_base), N.Exp())
+        return m.set_name(name), in_channels
+    if typ == "Log":
+        # y = log_base(scale*x + shift)
+        p = layer.get("log_param", {})
+        base = float(p.get("base", -1.0))
+        scale = float(p.get("scale", 1.0))
+        shift = float(p.get("shift", 0.0))
+        m = N.Sequential(N.MulConstant(scale), N.AddConstant(shift),
+                         N.Log())
+        if base > 0:
+            m.add(N.MulConstant(1.0 / float(np.log(base))))
+        return m.set_name(name), in_channels
+    if typ == "AbsVal":
+        return N.Abs().set_name(name), in_channels
+    if typ == "ELU":
+        p = layer.get("elu_param", {})
+        return N.ELU(float(p.get("alpha", 1.0))).set_name(name), in_channels
+    if typ == "Deconvolution":
+        p = layer.get("convolution_param", {})
+        nout = int(p["num_output"])
+        kh, kw, sh, sw, ph, pw = _kernel_params(p)
+        group = int(p.get("group", 1))
+        bias = bool(p.get("bias_term", True))
+        m = N.SpatialFullConvolution(in_channels, nout, kw, kh, sw, sh,
+                                     pw, ph, n_group=group,
+                                     no_bias=not bias)
+        m.set_name(name)
+        return m, nout
     raise ValueError(f"unsupported caffe layer type {typ} ({name})")
 
 
@@ -298,6 +350,36 @@ def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None,
                     input_channels
             continue
         in_ch = channels.get(bottoms[0]) if bottoms else input_channels
+        if typ == "Slice":
+            # multi-output: one Narrow node per top blob (reference
+            # Converter.scala fromCaffeSlice; our DAG keys nodes by top,
+            # so each output gets its own slice node)
+            p = layer.get("slice_param", {})
+            axis = int(p.get("axis", 1))
+            points = [int(x) for x in _as_list(p.get("slice_point"))]
+            total = in_ch if axis == 1 else None
+            if not points:
+                if total is None or total % max(len(tops), 1):
+                    raise ValueError(
+                        f"Slice {layer.get('name')}: need slice_point or a "
+                        "channel count divisible by the top count")
+                step = total // len(tops)
+                points = [step * (i + 1) for i in range(len(tops) - 1)]
+            bounds = [0] + points + ([total] if total is not None else [])
+            if len(bounds) < len(tops) + 1:
+                raise ValueError(
+                    f"Slice {layer.get('name')}: slice_point count must be "
+                    "len(tops)-1")
+            src = nodes[bottoms[0]]
+            for i, t in enumerate(tops):
+                lo, hi = bounds[i], bounds[i + 1]
+                m = N.Narrow(axis + 1, lo + 1, hi - lo)  # 1-based incl. batch
+                m.set_name(f"{layer.get('name', 'slice')}_{i}")
+                modules_by_name[m.name] = m
+                nodes[t] = m(src)
+                channels[t] = (hi - lo) if axis == 1 else in_ch
+            last_top = tops[0] if tops else last_top
+            continue
         if typ == "Concat" or typ == 3:
             in_ch_total = sum(channels.get(b) or 0 for b in bottoms)
         m, out_ch = _convert_layer(layer, in_ch)
@@ -363,11 +445,17 @@ def _load_weights(graph, modules_by_name, blobs):
                 p[ikey] = sub
                 params[key] = p
             continue
-        if isinstance(m, N.SpatialConvolution):
+        if isinstance(m, (N.SpatialConvolution, N.SpatialFullConvolution)):
+            # caffe Deconvolution blobs are (in, out/g, kh, kw) — exactly
+            # our SpatialFullConvolution layout; Convolution blobs match
+            # SpatialConvolution's (out, in/g, kh, kw)
             w = bl[0].reshape(np.asarray(p["weight"]).shape)
             p["weight"] = jnp.asarray(w)
             if len(bl) > 1 and "bias" in p:
                 p["bias"] = jnp.asarray(bl[1].reshape(-1))
+        elif isinstance(m, N.PReLU):
+            p["weight"] = jnp.asarray(
+                bl[0].reshape(np.asarray(p["weight"]).shape))
         elif isinstance(m, N.Linear):
             p["weight"] = jnp.asarray(
                 bl[0].reshape(np.asarray(p["weight"]).shape))

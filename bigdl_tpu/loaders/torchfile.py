@@ -180,16 +180,83 @@ def _to_module(obj):
         m.params = p
         return m
 
-    if t in ("nn.Sequential",):
-        seq = N.Sequential()
+    def fill_container(cont):
         mods = g("modules", {})
         for i in sorted(k for k in mods if isinstance(k, int)):
-            seq.add(_to_module(mods[i]))
+            cont.add(_to_module(mods[i]))
         # stitch child params into container tree
-        seq.ensure_initialized()
-        seq.params = {str(i): m.params for i, m in enumerate(seq.modules)}
-        seq.state = {str(i): m.state for i, m in enumerate(seq.modules)}
-        return seq
+        cont.ensure_initialized()
+        cont.params = {str(i): c.params for i, c in enumerate(cont.modules)}
+        cont.state = {str(i): c.state for i, c in enumerate(cont.modules)}
+        return cont
+
+    if t in ("nn.Sequential",):
+        return fill_container(N.Sequential())
+    if t == "nn.Concat":
+        return fill_container(N.Concat(int(g("dimension", 2))))
+    if t == "nn.ConcatTable":
+        return fill_container(N.ConcatTable())
+    if t == "nn.ParallelTable":
+        return fill_container(N.ParallelTable())
+    if t == "nn.CAddTable":
+        return N.CAddTable()
+    if t == "nn.JoinTable":
+        return N.JoinTable(int(g("dimension", 2)),
+                           int(g("nInputDims", -1) or -1))
+    if t == "nn.LeakyReLU":
+        return N.LeakyReLU(float(g("negval", 0.01)))
+    if t == "nn.Threshold":
+        return N.Threshold(float(g("threshold", 1e-6)), float(g("val", 0.0)))
+    if t == "nn.SpatialCrossMapLRN":
+        return N.SpatialCrossMapLRN(int(g("size", 5)),
+                                    float(g("alpha", 1.0)),
+                                    float(g("beta", 0.75)),
+                                    float(g("k", 1.0)))
+    if t == "nn.SpatialZeroPadding":
+        return N.SpatialZeroPadding(int(g("pad_l", 0)), int(g("pad_r", 0)),
+                                    int(g("pad_t", 0)), int(g("pad_b", 0)))
+    if t == "nn.BatchNormalization":
+        w = g("weight")
+        n = int(g("nOutput", w.shape[0] if w is not None else 0))
+        m = N.BatchNormalization(n, float(g("eps", 1e-5)),
+                                 float(g("momentum", 0.1)),
+                                 affine=w is not None)
+        m = set_params(m, weight=w, bias=g("bias"))
+        st = dict(m.state)
+        if g("running_mean") is not None:
+            st["running_mean"] = jnp.asarray(g("running_mean"), jnp.float32)
+            st["running_var"] = jnp.asarray(g("running_var"), jnp.float32)
+        m.state = st
+        return m
+    if t in ("nn.Sequencer", "nn.Recurrent"):
+        inner = g("module") or g("rnn")
+        cell = _to_module(inner)
+        rec = N.Recurrent(cell)
+        rec.ensure_initialized()
+        rec.params = {"cell": cell.params}
+        cell.params = None
+        return rec
+    if t == "nn.LSTM":
+        # Element-Research-style record: torch Linear layout (out, in) for
+        # i2g/o2g; gate chunk order (i, f, g, o) — bigdl_tpu LSTM layout
+        # transposed. Fixture/round-trip format (TorchFile.scala analog has
+        # no LSTM at all; this extends the set).
+        isize = int(g("inputSize"))
+        hsize = int(g("outputSize", g("hiddenSize", 0)) or g("hiddenSize"))
+        m = N.LSTM(isize, hsize)
+        w_i = g("i2g_weight")
+        w_h = g("o2g_weight")
+        b = g("i2g_bias")
+        m.ensure_initialized()
+        p = dict(m.params)
+        if w_i is not None:
+            p["w_i"] = jnp.asarray(np.ascontiguousarray(w_i.T), jnp.float32)
+        if w_h is not None:
+            p["w_h"] = jnp.asarray(np.ascontiguousarray(w_h.T), jnp.float32)
+        if b is not None:
+            p["bias"] = jnp.asarray(b.reshape(-1), jnp.float32)
+        m.params = p
+        return m
     if t == "nn.Linear":
         w, b = g("weight"), g("bias")
         m = N.Linear(w.shape[1], w.shape[0], with_bias=b is not None)
@@ -377,12 +444,52 @@ def _from_module(m, params, state):
     from .. import nn as N
     t = type(m).__name__
 
-    if isinstance(m, N.Sequential):
+    def container_obj(tname, extra=None):
         mods = {}
         for i, child in enumerate(m.modules):
             mods[i + 1] = _from_module(child, params.get(str(i), {}),
                                        state.get(str(i), {}))
-        return TorchObject("nn.Sequential", {"modules": mods})
+        obj = {"modules": mods}
+        if extra:
+            obj.update(extra)
+        return TorchObject(tname, obj)
+
+    if isinstance(m, N.Sequential):
+        return container_obj("nn.Sequential")
+    if isinstance(m, N.Concat):
+        return container_obj("nn.Concat", {"dimension": m.dimension})
+    if isinstance(m, N.ConcatTable):
+        return container_obj("nn.ConcatTable")
+    if isinstance(m, N.ParallelTable):
+        return container_obj("nn.ParallelTable")
+    if isinstance(m, N.CAddTable):
+        return TorchObject("nn.CAddTable", {})
+    if isinstance(m, N.JoinTable):
+        return TorchObject("nn.JoinTable", {"dimension": m.dimension,
+                                            "nInputDims": m.n_input_dims})
+    if isinstance(m, N.LeakyReLU):
+        return TorchObject("nn.LeakyReLU", {"negval": float(m.negval)})
+    if type(m) is N.Threshold:
+        return TorchObject("nn.Threshold", {"threshold": float(m.th),
+                                            "val": float(m.v)})
+    if isinstance(m, N.SpatialCrossMapLRN):
+        return TorchObject("nn.SpatialCrossMapLRN", {
+            "size": m.size, "alpha": float(m.alpha),
+            "beta": float(m.beta), "k": float(m.k)})
+    if isinstance(m, N.SpatialZeroPadding):
+        return TorchObject("nn.SpatialZeroPadding", {
+            "pad_l": m.l, "pad_r": m.r, "pad_t": m.t, "pad_b": m.b})
+    if isinstance(m, N.Recurrent):
+        cell_obj = _from_module(m.cell, params.get("cell", {}), {})
+        return TorchObject("nn.Sequencer", {"module": cell_obj})
+    if type(m) is N.LSTM:
+        # torch Linear layout (out, in); gate order (i, f, g, o)
+        obj = {"inputSize": m.input_size, "hiddenSize": m.hidden_size,
+               "outputSize": m.hidden_size,
+               "i2g_weight": _np(params["w_i"]).T.copy(),
+               "o2g_weight": _np(params["w_h"]).T.copy(),
+               "i2g_bias": _np(params["bias"]).reshape(-1)}
+        return TorchObject("nn.LSTM", obj)
     if type(m) is N.Linear:
         obj = {"weight": _np(params["weight"])}
         if m.with_bias:
@@ -428,7 +535,7 @@ def _from_module(m, params, state):
             "kW": m.kw, "kH": m.kh, "dW": m.dw, "dH": m.dh,
             "padW": m.pad_w, "padH": m.pad_h,
             "ceil_mode": bool(getattr(m, "ceil_mode", False))})
-    if isinstance(m, N.SpatialBatchNormalization):
+    if isinstance(m, N.BatchNormalization):
         obj = {"nOutput": m.n_output, "eps": float(m.eps),
                "momentum": float(m.momentum),
                "running_mean": _np(state.get("running_mean")),
@@ -436,7 +543,10 @@ def _from_module(m, params, state):
         if m.affine:
             obj["weight"] = _np(params.get("weight"))
             obj["bias"] = _np(params.get("bias"))
-        return TorchObject("nn.SpatialBatchNormalization", obj)
+        tname = ("nn.SpatialBatchNormalization"
+                 if isinstance(m, N.SpatialBatchNormalization)
+                 else "nn.BatchNormalization")
+        return TorchObject(tname, obj)
     simple = {"ReLU": "nn.ReLU", "Tanh": "nn.Tanh", "Sigmoid": "nn.Sigmoid",
               "LogSoftMax": "nn.LogSoftMax", "SoftMax": "nn.SoftMax",
               "Identity": "nn.Identity"}
