@@ -6,6 +6,9 @@ from .dataset import DataSet, LocalDataSet, ShardedDataSet
 from . import mnist
 from . import cifar
 from . import text
+from . import datamining
+from .datamining import (RowTransformer, RowTransformSchema, ColToTensor,
+                         ColsToNumeric)
 from . import movielens
 from . import news20
 from . import segmentation

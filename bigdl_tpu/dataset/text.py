@@ -26,6 +26,20 @@ class SentenceSplitter(Transformer):
                     yield s
 
 
+class SentenceBiPadding(Transformer):
+    """Wrap each sentence as "<start> x <end>"
+    (dataset/text/SentenceBiPadding.scala; default tokens match the
+    reference's SentenceToken start/end)."""
+
+    def __init__(self, start=None, end=None):
+        self.start = start if start is not None else "SENTENCESTART"
+        self.end = end if end is not None else "SENTENCEEND"
+
+    def apply(self, it):
+        for s in it:
+            yield f"{self.start} {s} {self.end}"
+
+
 class SentenceTokenizer(Transformer):
     """Tokenize sentences (dataset/text/SentenceTokenizer.scala)."""
 

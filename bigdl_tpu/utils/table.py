@@ -16,6 +16,8 @@ class Table:
         if len(items) == 1 and isinstance(items[0], (list, tuple)):
             items = tuple(items[0])
         self._items = list(items)
+        self._named = {}      # string-keyed entries (reference Table is an
+        #                       arbitrary-keyed map; RowTransformer uses it)
 
     # -- torch-style 1-indexed access ------------------------------------
     def __getitem__(self, key):
@@ -23,14 +25,33 @@ class Table:
             if key < 1:
                 raise IndexError("Table is 1-indexed (torch convention)")
             return self._items[key - 1]
-        raise TypeError(f"Table index must be int, got {type(key)}")
+        if isinstance(key, str):
+            return self._named[key]
+        raise TypeError(f"Table index must be int or str, got {type(key)}")
 
     def __setitem__(self, key, value):
+        if isinstance(key, str):
+            self._named[key] = value
+            return
         if key < 1:
             raise IndexError("Table is 1-indexed")
         while len(self._items) < key:
             self._items.append(None)
         self._items[key - 1] = value
+
+    def __contains__(self, key):
+        if isinstance(key, str):
+            return key in self._named
+        return isinstance(key, int) and 1 <= key <= len(self._items)
+
+    def keys(self):
+        """Named keys (string-keyed entries only)."""
+        return self._named.keys()
+
+    def update(self, key, value):
+        """Reference ``table.update(key, value)`` alias."""
+        self[key] = value
+        return self
 
     def insert(self, value):
         self._items.append(value)
@@ -49,11 +70,14 @@ class Table:
         return list(self._items)
 
     def __repr__(self):
-        return "Table{" + ", ".join(repr(i) for i in self._items) + "}"
+        parts = [repr(i) for i in self._items]
+        parts += [f"{k}={v!r}" for k, v in self._named.items()]
+        return "Table{" + ", ".join(parts) + "}"
 
     def __eq__(self, other):
         if isinstance(other, Table):
-            return self._items == other._items
+            return (self._items == other._items
+                    and self._named == other._named)
         return NotImplemented
 
     def __hash__(self):
@@ -61,11 +85,20 @@ class Table:
 
 
 def _table_flatten(t: Table):
-    return t._items, None
+    named_keys = tuple(t._named.keys())
+    children = t._items + [t._named[k] for k in named_keys]
+    return children, (len(t._items), named_keys)
 
 
 def _table_unflatten(aux, items):
-    return Table(*items)
+    if aux is None:         # flattened by a pre-r4 treedef
+        return Table(*items)
+    n, named_keys = aux
+    items = list(items)
+    t = Table(*items[:n])
+    for k, v in zip(named_keys, items[n:]):
+        t._named[k] = v
+    return t
 
 
 jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

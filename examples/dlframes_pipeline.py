@@ -31,6 +31,31 @@ def main():
     acc = float((out["prediction"] == out["label"]).mean())
     print(f"pipeline accuracy on train set: {acc:.3f}")
     assert acc > 0.9, acc
+
+    # Raw tabular frame → RowTransformer (dataset/datamining/
+    # RowTransformer.scala analog) → the same estimator: keyed column
+    # schemas assemble the "features"/"label" matrices from loose columns.
+    from bigdl_tpu.dataset import RowTransformer
+    raw = pd.DataFrame({
+        "income": x[:, 0], "debt": x[:, 1],
+        "spend": x[:, 2], "age_norm": x[:, 3], "label": y,
+    })
+    rt = RowTransformer.numeric({
+        "features": ["income", "debt", "spend", "age_norm"],
+        "label": ["label"],
+    })
+    cols = rt.transform_frame(raw)
+    clf2 = DLClassifier(
+        nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2),
+                      nn.LogSoftMax()),
+        nn.ClassNLLCriterion(), [4]) \
+        .set_batch_size(32).set_max_epoch(20).set_learning_rate(5e-2)
+    fitted2 = clf2.fit({"features": cols["features"],
+                        "label": cols["label"].reshape(-1)})
+    out2 = fitted2.transform({"features": cols["features"]})
+    acc2 = float((out2["prediction"] == y).mean())
+    print(f"RowTransformer pipeline accuracy: {acc2:.3f}")
+    assert acc2 > 0.9, acc2
     print("OK")
 
 

@@ -419,3 +419,106 @@ def test_image_frame_read_folder(tmp_path):
     labels = sorted(f["label"] for f in frame)
     assert labels == [1, 1, 2, 2]
     assert frame.features[0]["image"].shape == (24, 24, 3)
+
+
+# ---------------------------------------------------------------------------
+# datamining RowTransformer (r4) + SentenceBiPadding
+# ---------------------------------------------------------------------------
+
+
+def test_row_transformer_atomic_and_numeric():
+    from bigdl_tpu.dataset import RowTransformer, ColToTensor, ColsToNumeric
+    rows = [{"age": 30, "height": 1.8, "name": "ann", "vip": True},
+            {"age": 40, "height": 1.6, "name": "bob", "vip": False}]
+    rt = RowTransformer.atomic(["age", "name", "vip"])
+    tables = list(rt(rows))
+    assert len(tables) == 2
+    t = tables[0]
+    assert t["age"].tolist() == [30.0]
+    assert t["name"].tolist() == ["ann"]
+    assert t["vip"].tolist() == [1.0]        # bool -> 0/1
+    # numeric(): all columns -> one vector under "all" (numeric rows only)
+    num_rows = [[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]
+    nt = list(RowTransformer.numeric()(num_rows))
+    np.testing.assert_allclose(nt[1]["all"], [4.0, 5.0, 6.0])
+    # numeric map: schema_key -> selected fields
+    rt2 = RowTransformer.numeric({"phys": ["height", "age"]})
+    t2 = next(iter(rt2(rows)))
+    np.testing.assert_allclose(t2["phys"], [1.8, 30.0])
+    # mixed
+    rt3 = RowTransformer.atomic_with_numeric(
+        ["name"], {"feat": ["age", "height"]})
+    t3 = next(iter(rt3(rows)))
+    assert t3["name"].tolist() == ["ann"]
+    np.testing.assert_allclose(t3["feat"], [30.0, 1.8])
+
+
+def test_row_transformer_index_selection_and_errors():
+    import pytest as _pytest
+    from bigdl_tpu.dataset import RowTransformer, ColToTensor, ColsToNumeric
+    # index-addressed plain sequences
+    rt = RowTransformer([ColsToNumeric("sel", indices=[2, 0])])
+    t = next(iter(rt([[7.0, 8.0, 9.0]])))
+    np.testing.assert_allclose(t["sel"], [9.0, 7.0])
+    # duplicate keys rejected
+    with _pytest.raises(ValueError, match="replicated"):
+        RowTransformer([ColToTensor("k", 0), ColToTensor("k", 1)])
+    # out-of-bound indices rejected when row_size given
+    with _pytest.raises(ValueError, match="out of bound"):
+        RowTransformer([ColsToNumeric("s", indices=[5])], row_size=3)
+    # field-name selection on a nameless row fails clearly
+    rt2 = RowTransformer([ColsToNumeric("s", field_names=["a"])])
+    with _pytest.raises(ValueError, match="field name"):
+        next(iter(rt2([[1.0]])))
+
+
+def test_row_transformer_pandas_to_dlframes():
+    """transform_frame feeds dlframes: the keyed example end-to-end."""
+    import pandas as pd
+    from bigdl_tpu.dataset import RowTransformer
+    rng = np.random.RandomState(0)
+    df = pd.DataFrame({
+        "a": rng.randn(64).astype(np.float32),
+        "b": rng.randn(64).astype(np.float32),
+        "label": rng.randint(0, 2, 64) + 1.0,
+    })
+    rt = RowTransformer.numeric({"features": ["a", "b"],
+                                 "label": ["label"]})
+    cols = rt.transform_frame(df)
+    assert cols["features"].shape == (64, 2)
+    assert cols["label"].shape == (64, 1)
+    from bigdl_tpu.dlframes import DLClassifier
+    from bigdl_tpu import nn
+    est = DLClassifier(nn.Sequential(nn.Linear(2, 8), nn.ReLU(),
+                                     nn.Linear(8, 2), nn.LogSoftMax()),
+                       nn.ClassNLLCriterion(), [2])
+    est.set_batch_size(16).set_max_epoch(3).set_learning_rate(1e-2)
+    model = est.fit(cols)
+    out = model.transform({"features": cols["features"]})
+    assert len(out["prediction"]) == 64
+
+
+def test_sentence_bipadding():
+    from bigdl_tpu.dataset.text import SentenceBiPadding
+    out = list(SentenceBiPadding()(["hello world", "bye"]))
+    assert out == ["SENTENCESTART hello world SENTENCEEND",
+                   "SENTENCESTART bye SENTENCEEND"]
+    out2 = list(SentenceBiPadding("<s>", "</s>")(["x"]))
+    assert out2 == ["<s> x </s>"]
+    # matches the pyspark-parity free function
+    from bigdl_tpu.dataset.sentence import sentences_bipadding
+    assert out[0] == sentences_bipadding("hello world")
+
+
+def test_table_named_keys_pytree():
+    """string-keyed Table entries flow through jax pytree ops."""
+    import jax
+    from bigdl_tpu.utils.table import Table
+    t = Table(np.ones((2,)))
+    t["x"] = np.zeros((3,))
+    leaves = jax.tree_util.tree_leaves(t)
+    assert len(leaves) == 2
+    t2 = jax.tree_util.tree_map(lambda a: a + 1, t)
+    np.testing.assert_allclose(t2["x"], np.ones((3,)))
+    np.testing.assert_allclose(t2[1], 2 * np.ones((2,)))
+    assert "x" in t2 and list(t2.keys()) == ["x"]
