@@ -472,8 +472,8 @@ def _run_child(which: str, env, timeout: float):
 _TUNNEL_STATE = {"probed": False, "alive": True}
 
 
-def _tunnel_alive(timeout: float = 90.0) -> bool:
-    if _TUNNEL_STATE["probed"]:
+def _tunnel_alive(timeout: float = 90.0, force: bool = False) -> bool:
+    if _TUNNEL_STATE["probed"] and not force:
         return _TUNNEL_STATE["alive"]
     try:
         proc = subprocess.run(
@@ -491,40 +491,81 @@ def _tunnel_alive(timeout: float = 90.0) -> bool:
     return alive
 
 
+def _wait_for_tunnel(budget_s: float) -> bool:
+    """Keep probing (every ~60 s) until the tunnel answers or the budget
+    runs out — lets a capture that starts minutes before a tunnel window
+    succeed live instead of serving cache (r3 verdict item). Returns True
+    when the tunnel came back."""
+    deadline = time.time() + budget_s
+    while time.time() < deadline:
+        remaining = deadline - time.time()
+        print(f"bench: tunnel down, waiting (%.0fs left)" % remaining,
+              file=sys.stderr, flush=True)
+        time.sleep(min(60.0, max(1.0, remaining)))
+        if _tunnel_alive(force=True):
+            return True
+    return False
+
+
+def _wait_budget() -> float:
+    """BENCH_WAIT_S: extra seconds the headline capture may spend waiting
+    for a tunnel window before falling back to cache. Default 600 for the
+    driver's no-flag run; sweeps (--all) default to 0 so nine configs
+    don't each wait."""
+    try:
+        return float(os.environ.get(
+            "BENCH_WAIT_S", "0" if "--all" in sys.argv else "600"))
+    except ValueError:
+        return 0.0
+
+
 def _orchestrate(which: str):
-    """Run a child config: TPU with timeout, retry, then cached-TPU result
-    (a previous real measurement, flagged ``cached``), then CPU fallback."""
+    """Run a child config: TPU with timeout, retry, wait out a tunnel
+    outage within BENCH_WAIT_S, then cached-TPU result (a previous real
+    measurement, flagged ``cached``), then CPU fallback."""
     attempts = [
         (os.environ.copy(), 800.0, "tpu attempt 1"),
         (os.environ.copy(), 600.0, "tpu attempt 2"),
         (os.environ.copy(), 420.0, "tpu attempt 3"),
     ]
     errors = []
+    budget = _wait_budget()
+    wait_deadline = time.time() + budget
     if _TUNNEL_STATE["probed"] and not _TUNNEL_STATE["alive"]:
         attempts = []  # a previous config already proved the tunnel dead
         errors.append("tunnel probe: backend init hung/failed")
     degraded = None
-    for i, (env, tmo, label) in enumerate(attempts):
-        lines, err = _run_child(which, env, tmo)
-        if lines and any(l.get("backend") in ("tpu", "axon")
-                         for l in lines):
-            _cache_tpu_lines(lines)
-            return lines
-        if lines:  # plugin silently degraded to CPU — keep as a last
-            # resort, but cached real-TPU numbers (below) beat it
-            degraded = degraded or lines
-            errors.append(f"{label}: degraded to cpu backend")
-            break  # a second TPU attempt would degrade identically
-        errors.append(f"{label}: {err}")
-        if i + 1 < len(attempts):
-            # the attempt failed on its own timeout budget: one probe child
-            # decides whether a retry can possibly succeed (healthy runs
-            # never pay for the probe)
-            if not _tunnel_alive():
-                errors.append("tunnel probe: backend init hung/failed — "
-                              "skipping retry")
-                break
-            time.sleep(10)
+    while True:
+        for i, (env, tmo, label) in enumerate(attempts):
+            lines, err = _run_child(which, env, tmo)
+            if lines and any(l.get("backend") in ("tpu", "axon")
+                             for l in lines):
+                _cache_tpu_lines(lines)
+                return lines
+            if lines:  # plugin silently degraded to CPU — keep as a last
+                # resort, but cached real-TPU numbers (below) beat it
+                degraded = degraded or lines
+                errors.append(f"{label}: degraded to cpu backend")
+                break  # a second TPU attempt would degrade identically
+            errors.append(f"{label}: {err}")
+            if i + 1 < len(attempts):
+                # the attempt failed on its own timeout budget: one probe
+                # child decides whether a retry can possibly succeed
+                # (healthy runs never pay for the probe)
+                if not _tunnel_alive():
+                    errors.append("tunnel probe: backend init hung/failed "
+                                  "— skipping retry")
+                    break
+                time.sleep(10)
+        if degraded is not None:
+            break
+        remaining = wait_deadline - time.time()
+        if remaining > 30 and not _TUNNEL_STATE["alive"] \
+                and _wait_for_tunnel(remaining):
+            errors.append("tunnel returned within BENCH_WAIT_S — retrying")
+            attempts = [(os.environ.copy(), 800.0, "tpu post-wait")]
+            continue
+        break
     cached = _cached_tpu_lines(which)
     if cached:
         return [dict(l, tunnel_error="; ".join(errors)[-200:])
