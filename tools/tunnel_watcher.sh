@@ -1,18 +1,32 @@
 #!/bin/bash
-# Probe the axon TPU tunnel every ~7 min; when it comes back, run the full
-# live bench sweep (refreshing .bench_tpu_cache.json), then the A/B
-# experiment queue, and log both.
-LOG=/root/repo/docs/R3_ONCHIP_STATUS.md
+# Round-4 tunnel watcher: probe the axon TPU tunnel every ~7 min for the
+# whole round. On every reconnect it refreshes the live bench cache
+# (bench.py --all) and, once per tunnel window, runs the A/B experiment
+# queue (tools/ab_queue.sh). While a window stays up it re-sweeps every
+# ~2h so the cache tracks the latest code. Status lines append to
+# docs/R4_ONCHIP_STATUS.md.
+LOG=/root/repo/docs/R4_ONCHIP_STATUS.md
 cd /root/repo
-for i in $(seq 1 200); do
+queue_done=0
+last_sweep=0
+for i in $(seq 1 2000); do
   if timeout 90 python -c "import jax; ds=jax.devices(); assert any(d.platform in ('tpu','axon') for d in ds)" 2>/dev/null; then
-    echo "watcher: tunnel UP $(date -u +%H:%M:%SZ) — running sweep" >> "$LOG"
-    timeout 5400 python bench.py --all > /tmp/watcher_sweep.out 2>&1
-    echo "watcher: sweep done $(date -u +%H:%M:%SZ) rc=$? ($(grep -c '"backend": "tpu"' /tmp/watcher_sweep.out) tpu lines)" >> "$LOG"
-    /root/repo/tools/ab_queue.sh
-    echo "watcher: ab queue done $(date -u +%H:%M:%SZ)" >> "$LOG"
-    exit 0
+    now=$(date +%s)
+    if [ $((now - last_sweep)) -gt 7200 ]; then
+      echo "watcher: tunnel UP $(date -u +%H:%M:%SZ) — running sweep" >> "$LOG"
+      BENCH_WAIT_S=0 timeout 5400 python bench.py --all > /tmp/watcher_sweep.out 2>&1
+      echo "watcher: sweep done $(date -u +%H:%M:%SZ) rc=$? ($(grep -c '"backend": "tpu"' /tmp/watcher_sweep.out) tpu lines)" >> "$LOG"
+      last_sweep=$(date +%s)
+    fi
+    if [ "$queue_done" = 0 ]; then
+      /root/repo/tools/ab_queue.sh
+      echo "watcher: ab queue done $(date -u +%H:%M:%SZ)" >> "$LOG"
+      queue_done=1
+    fi
+    sleep 600
+  else
+    echo "watcher probe $i down $(date -u +%H:%M:%SZ)" >> /tmp/watcher_probe.log
+    queue_done=0   # next window re-runs the queue (code may have moved)
+    sleep 420
   fi
-  echo "watcher probe $i down $(date -u +%H:%M:%SZ)" >> /tmp/watcher_probe.log
-  sleep 420
 done
