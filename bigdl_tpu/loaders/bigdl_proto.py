@@ -131,10 +131,12 @@ def _enc_storage(data: np.ndarray, sid: int) -> bytes:
     elif dt == _DT_BOOL:
         out += field_packed_varint(4, [int(v) for v in flat])
     elif dt == _DT_DOUBLE:
-        out += field_packed_double(3, [float(v) for v in flat])
+        out += field_bytes(3, np.ascontiguousarray(flat, "<f8").tobytes())
     else:  # FLOAT / BF16 / F16 all travel as f32 floats (exact supersets)
-        out += field_bytes(2, struct.pack(
-            f"<{flat.size}f", *np.asarray(flat, np.float32)))
+        # numpy serializes the buffer directly — struct.pack with varargs
+        # is minutes on multi-million-param models
+        out += field_bytes(2, np.ascontiguousarray(
+            flat, "<f4").tobytes())
     out += field_varint(9, sid)
     return out
 
@@ -667,11 +669,11 @@ def _dec_storage(buf: bytes, storages: Dict[int, np.ndarray]):
         elif f == 9 and w == 0:
             sid = to_signed(v, 32)
         elif f == 2:
-            data = np.array(unpack_packed(v, "float"), np.float32) \
+            data = np.frombuffer(v, "<f4").astype(np.float32) \
                 if w == 2 else np.array([struct.unpack("<f", v)[0]],
                                         np.float32)
         elif f == 3:
-            data = np.array(unpack_packed(v, "double"), np.float64) \
+            data = np.frombuffer(v, "<f8").astype(np.float64) \
                 if w == 2 else np.array([struct.unpack("<d", v)[0]],
                                         np.float64)
         elif f == 4:

@@ -21,6 +21,27 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy full-size checks (big-model forwards, real-TF "
+        "cross-validation). Skipped by default to keep `make test` inside "
+        "the verification budget; run with BIGDL_TPU_SLOW=1 or -m slow. "
+        "Every component keeps an unmarked smoke-size test.")
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("BIGDL_TPU_SLOW") == "1":
+        return
+    if "slow" in (config.getoption("-m") or ""):
+        return
+    skip = pytest.mark.skip(
+        reason="slow: opt in with BIGDL_TPU_SLOW=1 or -m slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)

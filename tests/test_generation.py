@@ -106,85 +106,6 @@ def test_moe_lm_generate_matches_naive():
     assert np.array_equal(np.asarray(out), np.asarray(ids))
 
 
-def test_transformer_translate_matches_naive():
-    """translate() (cached encoder-decoder greedy decode) == the naive
-    re-forward loop through mode='translation' apply."""
-    import jax.numpy as jnp
-    from bigdl_tpu.nn import Transformer
-    from bigdl_tpu.utils.table import Table
-    model = Transformer(vocab_size=31, hidden_size=16, num_heads=2,
-                        filter_size=32, num_hidden_layers=2,
-                        mode="translation", max_len=32)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    src = jnp.asarray(np.random.RandomState(0).randint(1, 31, (2, 7)),
-                      jnp.int32)
-    src = src.at[1, 5:].set(0)  # padded source
-    out = model.translate(params, src, max_new_tokens=6, bos_id=1)
-    assert out.shape == (2, 6)
-
-    tgt = jnp.full((2, 1), 1, jnp.int32)  # BOS
-    for _ in range(6):
-        logits, _ = model.apply(params, {}, Table(src, tgt), training=False)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        tgt = jnp.concatenate([tgt, nxt[:, None]], axis=1)
-    assert np.array_equal(np.asarray(out), np.asarray(tgt[:, 1:]))
-
-
-def test_transformer_translate_eos_masking():
-    """Tokens after the first eos are emitted as 0 (padding)."""
-    import jax.numpy as jnp
-    from bigdl_tpu.nn import Transformer
-    model = Transformer(vocab_size=13, hidden_size=8, num_heads=2,
-                        filter_size=16, num_hidden_layers=1,
-                        mode="translation", max_len=16)
-    params, _ = model.init(jax.random.PRNGKey(1))
-    src = jnp.asarray(np.random.RandomState(1).randint(1, 13, (1, 5)),
-                      jnp.int32)
-    out_free = np.asarray(model.translate(params, src, 8, bos_id=1))
-    # force every token to be "eos": all emissions after the first must be 0
-    eos = int(out_free[0, 0])
-    out = np.asarray(model.translate(params, src, 8, bos_id=1, eos_id=eos))
-    assert out[0, 0] == eos
-    assert (out[0, 1:] == 0).all(), out
-
-
-def test_transformer_translate_beam():
-    """beam_size=1 beam search == greedy translate; wider beams return
-    in-vocab sequences with a no-worse model score than greedy."""
-    import jax.numpy as jnp
-    from bigdl_tpu.nn import Transformer
-    model = Transformer(vocab_size=29, hidden_size=16, num_heads=2,
-                        filter_size=32, num_hidden_layers=2,
-                        mode="translation", max_len=32)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    src = jnp.asarray(np.random.RandomState(0).randint(1, 29, (3, 6)),
-                      jnp.int32)
-    greedy = model.translate(params, src, max_new_tokens=5, bos_id=1)
-    beam1 = model.translate_beam(params, src, max_new_tokens=5,
-                                 beam_size=1, bos_id=1)
-    assert np.array_equal(np.asarray(greedy), np.asarray(beam1))
-
-    beam4 = model.translate_beam(params, src, max_new_tokens=5,
-                                 beam_size=4, bos_id=1)
-    assert beam4.shape == (3, 5)
-    b = np.asarray(beam4)
-    assert ((b >= 0) & (b < 29)).all()
-
-    def seq_logprob(tgt):
-        from bigdl_tpu.utils.table import Table
-        full = jnp.concatenate([jnp.full((3, 1), 1, jnp.int32), tgt], 1)
-        logits, _ = model.apply(params, {}, Table(src, full[:, :-1]),
-                                training=False)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        gold = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
-                                   -1)[..., 0]
-        return np.asarray(jnp.sum(gold, axis=1))
-
-    sg = seq_logprob(jnp.asarray(greedy))
-    sb = seq_logprob(beam4)
-    assert (sb >= sg - 1e-4).all(), (sb, sg)  # beam never worse than greedy
-
-
 def test_lm_generate_eos_masking():
     """generate(eos_id=...): after a row emits eos, later positions are 0;
     rows that never emit eos are unaffected (vs the eos-free output)."""
@@ -206,34 +127,3 @@ def test_lm_generate_eos_masking():
     out = np.asarray(model.generate(params, prompt, 8, eos_id=eos))
     assert out[0, pos] == eos and (out[0, pos + 1:] == 0).all(), out[0]
     assert np.array_equal(out[1], free[1])
-
-
-def test_translate_beam_score_monotone_in_width():
-    """The best final model score is non-decreasing in beam width (a
-    classic beam-search implementation property)."""
-    import jax.numpy as jnp
-    from bigdl_tpu.nn import Transformer
-    from bigdl_tpu.utils.table import Table
-    model = Transformer(vocab_size=17, hidden_size=12, num_heads=2,
-                        filter_size=24, num_hidden_layers=1,
-                        mode="translation", max_len=16)
-    params, _ = model.init(jax.random.PRNGKey(2))
-    src = jnp.asarray(np.random.RandomState(3).randint(1, 17, (2, 5)),
-                      jnp.int32)
-
-    def score(tgt):
-        full = jnp.concatenate([jnp.full((2, 1), 1, jnp.int32), tgt], 1)
-        logits, _ = model.apply(params, {}, Table(src, full[:, :-1]),
-                                training=False)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        gold = jnp.take_along_axis(logp, tgt[..., None].astype(jnp.int32),
-                                   -1)[..., 0]
-        return np.asarray(jnp.sum(gold, axis=1))
-
-    prev = None
-    for k in (1, 2, 4, 8):
-        s = score(model.translate_beam(params, src, 4, beam_size=k,
-                                       bos_id=1))
-        if prev is not None:
-            assert (s >= prev - 1e-4).all(), (k, s, prev)
-        prev = s

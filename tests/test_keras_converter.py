@@ -425,6 +425,7 @@ def test_non_strict_load_skips_unsupported():
     assert np.allclose(np.asarray(root.params["0"]["bias"]), b, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_model_from_json_accepts_modern_tf_keras():
     """model_from_json ingests today's tf.keras ``model.to_json()``
     (keras 2/3 config spellings: units/use_bias/rate/batch_shape,
@@ -457,6 +458,7 @@ def test_model_from_json_accepts_modern_tf_keras():
     assert y.shape == (2, 3)
 
 
+@pytest.mark.slow
 def test_modern_keras_edge_configs():
     """The modern-config translation is complete where it claims to be:
     1D pool sizes honored, channels_last pooling rejected loudly, dilation
@@ -515,6 +517,7 @@ def _keras12_h5(path, keras_model, h5py):
         f.attrs["layer_names"] = names
 
 
+@pytest.mark.slow
 def test_tf_ordered_conv_stack_matches_real_keras(tmp_path):
     """VERDICT r2 #6: a channels_last ('tf'-ordered) conv stack — JSON +
     HDF5 weights from REAL tf.keras — converts through the transposed-weight
@@ -548,6 +551,7 @@ def test_tf_ordered_conv_stack_matches_real_keras(tmp_path):
     np.testing.assert_allclose(out, ref, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_tf_ordered_functional_with_bn_matches_real_keras(tmp_path):
     """Functional channels_last graph with BatchNormalization(axis=-1):
     BN stats stay per-channel across the layout change."""
@@ -583,6 +587,7 @@ def test_tf_ordered_functional_with_bn_matches_real_keras(tmp_path):
     np.testing.assert_allclose(out, ref, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_tf_ordered_conv3d_input_transposed():
     """Rank-4 tf-ordered input shapes (D, H, W, C) transpose to
     (C, D, H, W) — a channels_last Conv3D must not treat D as channels."""
@@ -599,6 +604,7 @@ def test_tf_ordered_conv3d_input_transposed():
     assert out.shape == (1, 4, 5, 6, 6), out.shape
 
 
+@pytest.mark.slow
 def test_tf_ordered_flatten_bn_dense_rejected(tmp_path):
     """A per-feature-parameter layer (BatchNormalization) between Flatten
     and Dense in a tf-ordered model is refused loudly at weight-load time —

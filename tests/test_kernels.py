@@ -103,136 +103,6 @@ def test_flash_dispatcher_interpret_env(monkeypatch):
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-# ---------------------------------------------------------------------------
-# flash wired into the model path (round 3): LM-mode Transformer attention
-# goes through the flash dispatcher; remat and the chunked loss head are
-# numerically invisible
-# ---------------------------------------------------------------------------
-
-def _tiny_lm(**kw):
-    from bigdl_tpu.models import TransformerLM
-    return TransformerLM(vocab_size=97, hidden_size=32, num_heads=2,
-                         filter_size=64, num_layers=2, max_len=64, **kw)
-
-
-def test_lm_flash_path_matches_einsum(monkeypatch):
-    """LM logits with the kernel (interpret) == einsum reference path."""
-    import jax
-    ids = jnp.asarray(np.random.RandomState(0).randint(
-        1, 97, size=(2, 64)).astype(np.int32))
-    model = _tiny_lm(use_flash=True)
-    params, _ = model.init(jax.random.PRNGKey(0))
-    monkeypatch.setenv("BIGDL_TPU_FLASH", "interpret")
-    out_kernel, _ = model.apply(params, {}, ids, training=False)
-    monkeypatch.setenv("BIGDL_TPU_FLASH", "off")
-    out_einsum, _ = model.apply(params, {}, ids, training=False)
-    ref_model = _tiny_lm(use_flash=False)
-    out_ref, _ = ref_model.apply(params, {}, ids, training=False)
-    assert np.allclose(np.asarray(out_kernel), np.asarray(out_ref), atol=2e-4)
-    assert np.allclose(np.asarray(out_einsum), np.asarray(out_ref), atol=1e-5)
-
-
-def test_lm_remat_matches_plain():
-    """remat=True changes memory, not values — fwd and grads identical."""
-    import jax
-    ids = jnp.asarray(np.random.RandomState(1).randint(
-        1, 97, size=(2, 32)).astype(np.int32))
-    plain = _tiny_lm(use_flash=False, remat=False)
-    remat = _tiny_lm(use_flash=False, remat=True)
-    params, _ = plain.init(jax.random.PRNGKey(0))
-
-    def loss(m):
-        def f(p):
-            out, _ = m.apply(p, {}, ids, training=False)
-            return jnp.sum(jnp.tanh(out * 0.01))
-        return f
-
-    l0, g0 = jax.value_and_grad(loss(plain))(params)
-    l1, g1 = jax.value_and_grad(loss(remat))(params)
-    assert np.allclose(float(l0), float(l1), atol=1e-6)
-    flat0 = jax.tree_util.tree_leaves(g0)
-    flat1 = jax.tree_util.tree_leaves(g1)
-    for a, b in zip(flat0, flat1):
-        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
-
-
-def test_moe_lm_remat_matches_plain():
-    """MoE LM remat=True changes memory, not values — fwd (incl. the
-    router aux loss) and grads identical through BOTH block types."""
-    import jax
-    from bigdl_tpu.models import MoETransformerLM
-    ids = jnp.asarray(np.random.RandomState(1).randint(
-        1, 67, size=(2, 16)).astype(np.int32))
-
-    def build(remat):
-        return MoETransformerLM(vocab_size=67, hidden_size=32, num_heads=2,
-                                filter_size=64, num_layers=2, n_experts=4,
-                                moe_every=2, capacity_factor=4.0,
-                                max_len=16, use_flash=False, remat=remat)
-
-    plain, remat = build(False), build(True)
-    params, _ = plain.init(jax.random.PRNGKey(0))
-
-    def loss(m):
-        def f(p):
-            h, aux = m.hidden_states(p, ids, training=False)
-            return jnp.sum(jnp.tanh(h * 0.01)) + 0.1 * aux
-        return f
-
-    l0, g0 = jax.value_and_grad(loss(plain))(params)
-    l1, g1 = jax.value_and_grad(loss(remat))(params)
-    assert np.allclose(float(l0), float(l1), atol=1e-6)
-    for a, b in zip(jax.tree_util.tree_leaves(g0),
-                    jax.tree_util.tree_leaves(g1)):
-        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
-
-
-def test_lm_loss_chunked_matches_full_logits():
-    """lm_loss_chunked == full-logits softmax-CE with RAW (0-based) token
-    ids, values AND gradients (through a scan-of-checkpoint body). The
-    0-based head is what makes argmax(logits) round-trip through
-    generate(); the torch-parity criteria stay 1-based — the identity is
-    chunked(y) == TimeDistributedMaskCriterion(CE)(logits, y+1)."""
-    import jax
-    from bigdl_tpu.models import lm_loss_chunked
-    from bigdl_tpu.nn import (CrossEntropyCriterion,
-                              TimeDistributedMaskCriterion)
-    rng = np.random.RandomState(2)
-    B, T, H, V = 2, 64, 16, 53
-    h = jnp.asarray(rng.randn(B, T, H).astype(np.float32))
-    emb = jnp.asarray(0.1 * rng.randn(V, H).astype(np.float32))
-    y = rng.randint(1, V - 1, size=(B, T)).astype(np.int32)
-    y[0, :5] = 0  # padding positions excluded
-    y = jnp.asarray(y)
-
-    def ref(h, emb):
-        logits = (h @ emb.T).astype(jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, y[..., None], axis=-1)[..., 0]
-        valid = (y != 0).astype(jnp.float32)
-        return jnp.sum((lse - gold) * valid) / jnp.sum(valid)
-
-    def chunked(h, emb):
-        return lm_loss_chunked(h, emb, y, chunk=16)
-
-    l_ref, g_ref = jax.value_and_grad(ref, argnums=(0, 1))(h, emb)
-    l_ch, g_ch = jax.value_and_grad(chunked, argnums=(0, 1))(h, emb)
-    assert np.allclose(float(l_ref), float(l_ch), rtol=1e-5)
-    for a, b in zip(g_ref, g_ch):
-        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
-
-    # identity to the 1-based criterion: shift targets up by one (pad
-    # positions shift to 1 — give the shifted criterion padding_value=1)
-    crit = TimeDistributedMaskCriterion(CrossEntropyCriterion(),
-                                        padding_value=1)
-    l_crit = crit._forward(h @ emb.T, y + 1)
-    assert np.allclose(float(l_crit), float(l_ch), rtol=1e-5)
-
-
-# ---------------------------------------------------------------------------
-# fused BN+ReLU+matmul (+stats) kernel and the FusedBottleneck built on it
-# ---------------------------------------------------------------------------
-
 def test_fused_matmul_forward_and_grads():
     from bigdl_tpu.kernels.fused_matmul import fused_bn_relu_matmul
     rng = np.random.RandomState(0)

@@ -448,6 +448,7 @@ def test_binary_tree_lstm():
     assert m2.forward((words, trees)).shape == (2, 5, 6)
 
 
+@pytest.mark.slow
 def test_inception_v2_shapes():
     from bigdl_tpu.models import Inception_v2_NoAuxClassifier, Inception_v2
     x = np.random.randn(1, 3, 224, 224).astype(np.float32)
@@ -457,6 +458,18 @@ def test_inception_v2_shapes():
     m2 = Inception_v2(class_num=7)
     m2.evaluate()
     assert m2.forward(x).shape == (1, 21)
+
+
+def test_inception_v2_block_smoke():
+    """Unmarked smoke for the v2 BN-everywhere block (the full-model
+    shapes test above is @slow): one inception_layer_v2 stage forwards."""
+    from bigdl_tpu.models.inception import inception_layer_v2
+    blk = inception_layer_v2(64, ([16], [16, 24], [16, 24], ("avg", 24)),
+                             name_prefix="smoke/")
+    blk.evaluate()
+    x = np.random.randn(1, 64, 14, 14).astype(np.float32)
+    out = blk.forward(x)
+    assert out.shape == (1, 16 + 24 + 24 + 24, 14, 14)
 
 
 def test_dynamic_graph_switch_merge():

@@ -2,6 +2,7 @@
 from test_models.py so pytest-xdist loadfile sharding overlaps them
 with the rest (each is tens of seconds of XLA compile on CPU)."""
 import numpy as np
+import pytest
 
 from bigdl_tpu import models
 from test_models import _count_params
@@ -18,6 +19,7 @@ def test_resnet50_forward_tiny():
     assert 23_000_000 < n < 26_000_000, n
 
 
+@pytest.mark.slow
 def test_vgg_cifar_forward():
     m = models.VggForCifar10(10)
     m.evaluate()
@@ -25,6 +27,7 @@ def test_vgg_cifar_forward():
     assert m.forward(x).shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_inception_v1_forward():
     m = models.Inception_v1(1000)
     m.evaluate()

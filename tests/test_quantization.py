@@ -1,6 +1,7 @@
 """Int8 quantization tests (modeled on reference
 nn/quantized specs + quantization accuracy checks)."""
 import numpy as np
+import pytest
 
 from bigdl_tpu import nn
 from bigdl_tpu.models import LeNet5
@@ -260,6 +261,7 @@ def test_sparse_linear_not_quantized():
     assert np.asarray(q.forward(sp)).shape == (3, 4)
 
 
+@pytest.mark.slow
 def test_quantized_resnet50_accuracy_drop():
     """Quantized ResNet-50: int8 predictions agree with float top-1 on
     random-init weights (graph-rewrite over the full bottleneck DAG)."""

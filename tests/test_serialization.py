@@ -315,70 +315,3 @@ def test_bigdl_proto_parses_with_reference_schema(tmp_path):
             os.environ.pop("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", None)
         else:
             os.environ["PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION"] = env_impl
-
-
-# ---------------------------------------------------------------------------
-# universal-tier proto round-trips: the VERDICT r3 named bars
-# ---------------------------------------------------------------------------
-
-
-def _proto_roundtrip_forward(m, x, tmp_path, atol=1e-5):
-    from bigdl_tpu.loaders.bigdl_proto import save_bigdl, load_bigdl
-    m.ensure_initialized()
-    m.evaluate()
-    ref = np.asarray(m.forward(x))
-    path = str(tmp_path / "m.bigdl")
-    save_bigdl(m, path)
-    m2 = load_bigdl(path)
-    m2.evaluate()
-    np.testing.assert_allclose(np.asarray(m2.forward(x)), ref, atol=atol)
-    return m2
-
-
-def test_proto_inception_roundtrip(tmp_path):
-    """Inception-v1 (LRN + Concat heads) through bigdl.proto — the exact
-    case the r3 verdict called out as unserializable."""
-    from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
-    m = Inception_v1_NoAuxClassifier(class_num=10)
-    x = np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32)
-    _proto_roundtrip_forward(m, x, tmp_path, atol=1e-4)
-
-
-def test_proto_lstm_roundtrip(tmp_path):
-    m = nn.Recurrent(nn.LSTM(5, 7))
-    x = np.random.RandomState(1).randn(2, 6, 5).astype(np.float32)
-    _proto_roundtrip_forward(m, x, tmp_path)
-
-
-def test_proto_quantized_lenet_roundtrip(tmp_path):
-    """quantize()d LeNet through bigdl.proto: int8 weights and scales
-    survive with exact forward agreement (QuantSerializer.scala analog)."""
-    import jax
-    from bigdl_tpu.quantization import quantize
-    from bigdl_tpu.loaders.bigdl_proto import save_bigdl, load_bigdl
-    m = LeNet5(class_num=10)
-    m.ensure_initialized()
-    q = quantize(m)
-    q.ensure_initialized()
-    q.evaluate()
-    x = np.random.RandomState(2).randn(2, 1, 28, 28).astype(np.float32)
-    ref = np.asarray(q.forward(x))
-    path = str(tmp_path / "q.bigdl")
-    save_bigdl(q, path)
-    q2 = load_bigdl(path)
-    q2.evaluate()
-    np.testing.assert_allclose(np.asarray(q2.forward(x)), ref, atol=1e-6)
-    # int8 payloads really stayed int8 on the wire
-    int8_leaves = [l for l in jax.tree_util.tree_leaves(q2.params)
-                   if np.asarray(l).dtype == np.int8]
-    assert int8_leaves, "no int8 leaves survived the round-trip"
-
-
-def test_proto_criterion_roundtrip(tmp_path):
-    from bigdl_tpu.loaders.bigdl_proto import save_bigdl, load_bigdl
-    c = nn.TimeDistributedMaskCriterion(nn.ClassNLLCriterion())
-    path = str(tmp_path / "c.bigdl")
-    save_bigdl(c, path)
-    c2 = load_bigdl(path)
-    assert type(c2) is type(c)
-    assert type(c2.critrn) is nn.ClassNLLCriterion
