@@ -74,18 +74,24 @@ def padding_mask(lengths_or_mask, t):
 
 
 class Attention(Module):
+
+    seq_impl = "ring"   # class default: pre-r4 pickles lack the attribute
     """Multi-head attention (nn/Attention.scala). Input Table(query_seq,
     key_value_seq, additive_mask_or_None) or a single tensor (self-attn)."""
 
     def __init__(self, hidden_size: int, num_heads: int,
                  attention_dropout: float = 0.0, use_flash: bool = True,
-                 seq_axis=None, causal: bool = False, name=None):
+                 seq_axis=None, causal: bool = False, seq_impl: str = "ring",
+                 name=None):
         """``seq_axis``: name of a mesh axis the sequence dim is sharded
-        over — attention then runs the ring-flash path
-        (parallel/ring_flash.py: ppermute K/V rotation, Pallas blocks,
-        O(T/n) memory). Only valid inside ``shard_map`` over that axis;
-        self-attention only, masking via ``causal`` (additive masks
-        cannot cross the ring)."""
+        over — attention then runs sequence-parallel. ``seq_impl``
+        picks the scheme: ``"ring"`` (parallel/ring_flash.py: ppermute
+        K/V rotation, Pallas blocks, O(T/n) memory, any head count) or
+        ``"a2a"`` (parallel/seq_all_to_all.py: Ulysses-style
+        head-scatter all_to_all, dense flash locally, needs
+        num_heads % axis_size == 0). Only valid inside ``shard_map``
+        over that axis; self-attention only, masking via ``causal``
+        (additive masks cannot cross devices)."""
         super().__init__(name=name)
         assert hidden_size % num_heads == 0
         if seq_axis is not None and attention_dropout > 0:
@@ -97,6 +103,7 @@ class Attention(Module):
         self.attention_dropout = attention_dropout
         self.use_flash = use_flash
         self.seq_axis = seq_axis
+        self.seq_impl = seq_impl
         self.causal = causal
 
     def _init_params(self, rng):
@@ -160,9 +167,15 @@ class Attention(Module):
                     "seq-parallel attention supports causal masking only "
                     "(set causal=True); additive masks cannot cross the "
                     "ring")
-            from ..parallel.ring_flash import ring_flash_attention
-            o = ring_flash_attention(q, k, v, axis=self.seq_axis,
-                                     causal=self.causal)
+            if self.seq_impl == "a2a":
+                from ..parallel.seq_all_to_all import a2a_attention
+                o = a2a_attention(q, k, v, axis=self.seq_axis,
+                                  causal=self.causal,
+                                  use_flash=self.use_flash)
+            else:
+                from ..parallel.ring_flash import ring_flash_attention
+                o = ring_flash_attention(q, k, v, axis=self.seq_axis,
+                                         causal=self.causal)
         elif (self.causal and mask is None and self.use_flash
               and not (training and self.attention_dropout > 0.0
                        and rng is not None)):

@@ -1,0 +1,76 @@
+"""All-to-all (Ulysses-style) sequence parallelism.
+
+The second of the two sequence-parallel schemes the build goal calls for
+("ring attention or all-to-all sequence/context parallelism"):
+
+- **Ring** (``parallel/ring_attention.py`` / ``ring_flash.py``): K/V blocks
+  rotate via ``ppermute``; communication is n-1 neighbor exchanges riding
+  ICI, overlapped with the block matmuls. Memory O(T/n); works for any
+  head count.
+- **All-to-all** (this module): ONE head-scatter/seq-gather ``all_to_all``
+  converts sequence sharding (B, H, T/n, D) into head sharding
+  (B, H/n, T, D); attention then runs DENSE locally — which means the
+  fused Pallas flash kernel applies unchanged — and one inverse
+  ``all_to_all`` restores sequence sharding. Communication is 2
+  all-to-alls of the activations regardless of n (vs the ring's n-1
+  hops), at the cost of requiring ``num_heads % n == 0`` and O(T)
+  local attention memory per head-shard (flash keeps that O(T) in
+  activations, not O(T^2)).
+
+Reference baseline: the reference Transformer materialises full T×T
+attention on one host (``nn/Transformer.scala``) — no sequence
+parallelism exists there; both schemes here are TPU-first capabilities.
+
+Use inside ``shard_map`` with activations sharded on the sequence dim::
+
+    f = shard_map(partial(a2a_attention, axis="seq", causal=True),
+                  mesh=mesh,
+                  in_specs=(P(None, None, "seq", None),) * 3,
+                  out_specs=P(None, None, "seq", None))
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def a2a_attention(q, k, v, axis: str = "seq", causal: bool = False,
+                  use_flash: bool = True):
+    """Ulysses-style sequence-parallel attention.
+
+    q, k, v: (B, H, T/n, D) local sequence blocks (full head count).
+    Returns the local (B, H, T/n, D) output block. Requires H % n == 0.
+
+    All-to-all #1 scatters heads / gathers sequence → (B, H/n, T, D);
+    dense (flash) attention runs over the full sequence for the local
+    head subset; all-to-all #2 inverts the exchange. Sequence blocks
+    concatenate in axis-index order, so global token positions are
+    correct and causal masking needs no position bookkeeping.
+    """
+    n = lax.axis_size(axis)
+    h = q.shape[1]
+    if h % n:
+        raise ValueError(
+            f"a2a (Ulysses) sequence parallelism needs num_heads ({h}) "
+            f"divisible by the '{axis}' axis size ({n}); use ring "
+            "attention otherwise")
+
+    def scatter_heads(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if use_flash:
+        from .flash import flash_attention
+        o = flash_attention(qh, kh, vh, causal=causal)
+    else:
+        from ..nn.attention import dot_product_attention
+        mask = None
+        if causal:
+            t = qh.shape[-2]
+            mask = jnp.where(
+                jnp.tril(jnp.ones((t, t), jnp.bool_))[None, None],
+                0.0, jnp.float32(-1e30))
+        o = dot_product_attention(qh, kh, vh, mask)
+    return lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)

@@ -37,8 +37,11 @@ run "lm flash q512 k1024" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=512 BIGD
 run "lm remat=0 B32" secondary:transformer BENCH_LM_REMAT=0 BENCH_LM_BATCH=32
 # 6b. ADVICE r3: does the in-step wq/wk/wv concat cost anything on-chip?
 run "lm fused_qkv=0 (three-dot)" secondary:transformer BIGDL_TPU_FUSED_QKV=0
-# 7. layout-preserving Pallas bottleneck vs the winning fused=xla arm
+# 7. layout-preserving Pallas bottleneck vs the winning fused=xla arm,
+# with a block_n sweep (VMEM-residency vs N-tiling DMA tradeoff)
 run "resnet fused=pallas(nhwc)" headline BENCH_FUSED=pallas
+run "resnet fused=pallas(nhwc) bn256" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=256
+run "resnet fused=pallas(nhwc) bn128" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=128
 # 8. space-to-depth stem on top of the fused=xla win (was neutral unfused)
 run "resnet fused=xla s2d" headline BENCH_STEM=s2d
 # 9. where does the fused=xla resnet step spend time now?
