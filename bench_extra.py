@@ -401,9 +401,15 @@ def bench_lm_decode(on_tpu):
     new_tokens = _sized(on_tpu, 256, 6)
     H, F, V = ((1024, 4096, 32000) if on_tpu else (64, 256, 128))
     L = _sized(on_tpu, 12, 2)
-    model = TransformerLM(vocab_size=V, hidden_size=H, num_heads=16
-                          if on_tpu else 2, filter_size=F, num_layers=L,
-                          max_len=prompt_len + new_tokens)
+    heads = 16 if on_tpu else 2
+    # BENCH_DECODE_KV_HEADS < heads = grouped-query attention arm: the
+    # KV caches shrink by the group factor (decode streams the cache
+    # every step, so this is a direct HBM-bandwidth lever)
+    kvh = int(os.environ.get("BENCH_DECODE_KV_HEADS", heads))
+    model = TransformerLM(vocab_size=V, hidden_size=H, num_heads=heads,
+                          filter_size=F, num_layers=L,
+                          max_len=prompt_len + new_tokens,
+                          num_kv_heads=kvh if kvh != heads else None)
     params, _ = model.init(jax.random.PRNGKey(0))
     params = bf16_params(params)
     prompt = jnp.asarray(np.random.RandomState(0).randint(
@@ -437,6 +443,7 @@ def bench_lm_decode(on_tpu):
     int8_tps = timed_decode(quantize_lm_params(params))
     return {"metric": "lm_decode_tokens_per_sec", "value": round(bf16_tps, 1),
             "unit": "tokens/sec", "vs_baseline": None,
+            "kv_heads": kvh,
             "int8_tokens_per_sec": round(int8_tps, 1),
             "int8_speedup": round(int8_tps / max(bf16_tps, 1e-9), 3)}
 

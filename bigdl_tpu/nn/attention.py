@@ -75,14 +75,15 @@ def padding_mask(lengths_or_mask, t):
 
 class Attention(Module):
 
-    seq_impl = "ring"   # class default: pre-r4 pickles lack the attribute
+    seq_impl = "ring"     # class defaults: pre-r4 pickles lack the attrs
+    num_kv_heads = None   # None → MHA (kv heads == query heads)
     """Multi-head attention (nn/Attention.scala). Input Table(query_seq,
     key_value_seq, additive_mask_or_None) or a single tensor (self-attn)."""
 
     def __init__(self, hidden_size: int, num_heads: int,
                  attention_dropout: float = 0.0, use_flash: bool = True,
                  seq_axis=None, causal: bool = False, seq_impl: str = "ring",
-                 name=None):
+                 num_kv_heads=None, name=None):
         """``seq_axis``: name of a mesh axis the sequence dim is sharded
         over — attention then runs sequence-parallel. ``seq_impl``
         picks the scheme: ``"ring"`` (parallel/ring_flash.py: ppermute
@@ -105,36 +106,69 @@ class Attention(Module):
         self.seq_axis = seq_axis
         self.seq_impl = seq_impl
         self.causal = causal
+        self.num_kv_heads = num_kv_heads
+        if num_kv_heads is not None:
+            if num_heads % num_kv_heads:
+                raise ValueError(
+                    f"num_kv_heads ({num_kv_heads}) must divide "
+                    f"num_heads ({num_heads})")
+            if seq_axis is not None and num_kv_heads != num_heads:
+                raise ValueError(
+                    "grouped-query attention is not supported on the "
+                    "sequence-parallel paths (ring/a2a expect equal "
+                    "head counts) — use num_kv_heads=num_heads")
+
+    def _kvh(self):
+        return self.num_kv_heads or self.num_heads
 
     def _init_params(self, rng):
         k = jax.random.split(rng, 4)
         H = self.hidden_size
-        return {"wq": _glorot(k[0], (H, H)), "wk": _glorot(k[1], (H, H)),
-                "wv": _glorot(k[2], (H, H)), "wo": _glorot(k[3], (H, H))}
+        kvd = self._kvh() * (H // self.num_heads)
+        return {"wq": _glorot(k[0], (H, H)), "wk": _glorot(k[1], (H, kvd)),
+                "wv": _glorot(k[2], (H, kvd)), "wo": _glorot(k[3], (H, H))}
 
-    def _split(self, x):
+    def _split(self, x, heads=None):
         b, t, _ = x.shape
-        return x.reshape(b, t, self.num_heads, -1).transpose(0, 2, 1, 3)
+        return x.reshape(b, t, heads or self.num_heads,
+                         -1).transpose(0, 2, 1, 3)
 
     def qkv(self, params, qx, kx=None):
-        """Projected (B, nH, T, D) query/key/value heads.
+        """Projected query (B, nH, T, D) and key/value (B, kvH, T, D)
+        heads — kvH < nH is grouped-query attention (GQA: the KV cache
+        and K/V projections shrink by nH/kvH, the decode-path HBM lever).
 
-        Self-attention projects through ONE (H, 3H) matmul — one read of
-        the activations and a single well-packed MXU contraction instead
-        of three H×H dots. Params stay separate wq/wk/wv (checkpoint
+        Self-attention projects through ONE (H, H+2*kvD) matmul — one
+        read of the activations and a single well-packed MXU contraction
+        instead of three dots. Params stay separate wq/wk/wv (checkpoint
         layout unchanged); the concat is a trace-time weight reshuffle."""
+        kvh = self._kvh()
         ws = (params["wq"], params["wk"], params["wv"])
         if (kx is None or kx is qx) and _fused_qkv_enabled() and all(
                 isinstance(w, jnp.ndarray) for w in ws):
             # int8 QuantizedWeight wrappers (quantization/lm.py) keep the
             # three-dot path: they dequantize per-matmul and can't concat
             w3 = jnp.concatenate(ws, axis=1)
-            q, k, v = jnp.split(qx @ w3, 3, axis=-1)
-            return self._split(q), self._split(k), self._split(v)
+            H = self.hidden_size
+            kvd = ws[1].shape[1]
+            flat = qx @ w3
+            q, k, v = (flat[..., :H], flat[..., H:H + kvd],
+                       flat[..., H + kvd:])
+            return (self._split(q), self._split(k, kvh),
+                    self._split(v, kvh))
         kx = qx if kx is None else kx
         return (self._split(qx @ params["wq"]),
-                self._split(kx @ params["wk"]),
-                self._split(kx @ params["wv"]))
+                self._split(kx @ params["wk"], kvh),
+                self._split(kx @ params["wv"], kvh))
+
+    def _expand_kv(self, k, v):
+        """Broadcast kv heads up to the query head count for the dense/
+        flash/seq-parallel paths (grouped decode never expands — see
+        _decode_attention_gqa)."""
+        g = self.num_heads // self._kvh()
+        if g == 1:
+            return k, v
+        return jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
 
     def _merge(self, o, params):
         b, h, t, d = o.shape
@@ -150,7 +184,11 @@ class Attention(Module):
             k_cache, k_t.astype(k_cache.dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v_t.astype(v_cache.dtype), (0, 0, pos, 0))
-        o = _decode_attention(q, k_cache, v_cache, pos)
+        groups = self.num_heads // self._kvh()
+        if groups > 1:
+            o = _decode_attention_gqa(q, k_cache, v_cache, pos, groups)
+        else:
+            o = _decode_attention(q, k_cache, v_cache, pos)
         return self._merge(o, params), k_cache, v_cache
 
     def _apply(self, params, state, x, training, rng):
@@ -161,6 +199,7 @@ class Attention(Module):
         else:
             qx, kx, mask = x, x, None
         q, k, v = self.qkv(params, qx, kx)
+        k, v = self._expand_kv(k, v)
         if self.seq_axis is not None:
             if mask is not None:
                 raise ValueError(
@@ -204,6 +243,24 @@ def _decode_attention(q, cache_k, cache_v, pos):
     logits = jnp.where(keep, logits, -1e30)
     w = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", w, cache_v)
+
+
+def _decode_attention_gqa(q, cache_k, cache_v, pos, groups):
+    """Grouped-query decode attention WITHOUT materialising expanded
+    caches: q (B, nH, 1, D) is reshaped to (B, kvH, G, D) and contracted
+    against the compact (B, kvH, Tmax, D) caches — each decode step reads
+    nH/kvH times fewer cache bytes from HBM than MHA, which is the whole
+    point of GQA on the decode path."""
+    b, h, _, d = q.shape
+    kvh = h // groups
+    qg = q.reshape(b, kvh, groups, d)
+    logits = jnp.einsum("bkgd,bktd->bkgt", qg, cache_k) / math.sqrt(d)
+    t = cache_k.shape[2]
+    keep = jnp.arange(t)[None, None, None, :] <= pos
+    logits = jnp.where(keep, logits, -1e30)
+    w = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("bkgt,bktd->bkgd", w, cache_v)
+    return o.reshape(b, h, 1, d)
 
 
 class FeedForwardNetwork(Module):
@@ -256,10 +313,11 @@ class TransformerBlock(Module):
     def __init__(self, hidden_size: int, num_heads: int, filter_size: int,
                  attn_dropout: float = 0.0, ffn_dropout: float = 0.0,
                  with_cross: bool = False, causal: bool = False,
-                 use_flash: bool = True, name=None):
+                 use_flash: bool = True, num_kv_heads=None, name=None):
         super().__init__(name=name)
         self.attn = Attention(hidden_size, num_heads, attn_dropout,
-                              use_flash=use_flash, causal=causal)
+                              use_flash=use_flash, causal=causal,
+                              num_kv_heads=num_kv_heads)
         self.ffn = FeedForwardNetwork(hidden_size, filter_size, ffn_dropout)
         self.ln1 = LayerNormalization(hidden_size)
         self.ln2 = LayerNormalization(hidden_size)
@@ -319,10 +377,13 @@ class TransformerBlock(Module):
         same attention implementation it trained with)."""
         n, _ = self.ln1.apply(params["ln1"], {}, h, False, None)
         q, k, v = self.attn.qkv(params["attn"], n)
+        # GQA: attention runs over broadcast heads, but the cache keeps
+        # the compact kv-head form (that compactness IS the decode win)
+        ke, ve = self.attn._expand_kv(k, v)
         if self.attn.use_flash:
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, ke, ve, causal=True)
         else:
-            o = dot_product_attention(q, k, v, causal_mask(q.shape[2]))
+            o = dot_product_attention(q, ke, ve, causal_mask(q.shape[2]))
         h = h + self.attn._merge(o, params["attn"])
         return self._ffn_sublayer(params, h), (k, v)
 
@@ -364,7 +425,8 @@ class Transformer(Module):
                  num_hidden_layers: int = 2, postprocess_dropout: float = 0.0,
                  attention_dropout: float = 0.0, relu_dropout: float = 0.0,
                  mode: str = "lm", max_len: int = 2048,
-                 use_flash: bool = True, remat: bool = False, name=None):
+                 use_flash: bool = True, remat: bool = False,
+                 num_kv_heads=None, name=None):
         """``use_flash``: LM-mode self-attention goes through the fused
         O(T)-memory flash path (Pallas on TPU) instead of materialising the
         (B,H,T,T) score matrix. ``remat``: each block is wrapped in
@@ -383,7 +445,8 @@ class Transformer(Module):
                                         attention_dropout, relu_dropout,
                                         with_cross=(mode == "translation"),
                                         causal=(mode == "lm"),
-                                        use_flash=use_flash)
+                                        use_flash=use_flash,
+                                        num_kv_heads=num_kv_heads)
                        for _ in range(num_hidden_layers)]
         if mode == "translation":
             self.enc_blocks = [TransformerBlock(hidden_size, num_heads,
@@ -453,11 +516,14 @@ class Transformer(Module):
     # Transformer is training-only) --------------------------------------
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
-        """Per-block (k, v) caches shaped (B, nH, max_len, D). Positions
-        beyond the current one hold garbage — decode masks by position."""
-        nh = self.blocks[0].attn.num_heads
-        d = self.hidden_size // nh
-        return [(jnp.zeros((batch, nh, max_len, d), dtype),) * 2
+        """Per-block (k, v) caches shaped (B, kvH, max_len, D) — kvH is
+        the (possibly grouped) KV head count, so a GQA model's caches are
+        nH/kvH smaller. Positions beyond the current one hold garbage —
+        decode masks by position."""
+        attn = self.blocks[0].attn
+        d = self.hidden_size // attn.num_heads
+        kvh = attn._kvh()
+        return [(jnp.zeros((batch, kvh, max_len, d), dtype),) * 2
                 for _ in self.blocks]
 
     def prefill(self, params, ids, max_len: int):

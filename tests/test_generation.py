@@ -3,6 +3,7 @@ their own file so pytest-xdist loadfile sharding overlaps them with
 the model forwards (suite wall time = slowest file)."""
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 
 def test_transformer_lm_generate_matches_naive():
@@ -127,3 +128,61 @@ def test_lm_generate_eos_masking():
     out = np.asarray(model.generate(params, prompt, 8, eos_id=eos))
     assert out[0, pos] == eos and (out[0, pos + 1:] == 0).all(), out[0]
     assert np.array_equal(out[1], free[1])
+
+
+def test_gqa_lm_generate_matches_naive():
+    """Grouped-query attention (num_kv_heads < num_heads): caches are
+    kvH-sized and greedy decode through the grouped cache path matches
+    re-running the full forward at every step."""
+    from bigdl_tpu.models import TransformerLM
+    m = TransformerLM(vocab_size=61, hidden_size=32, num_heads=4,
+                      filter_size=64, num_layers=2, max_len=48,
+                      use_flash=False, num_kv_heads=2)
+    params, _ = m.init(jax.random.PRNGKey(7))
+    prompt = np.array([[5, 9, 2], [11, 3, 7]], np.int32)
+    out = m.generate(params, prompt, max_new_tokens=6)
+    assert out.shape == (2, 9)
+
+    # caches really are kv-head sized
+    caches = m.init_cache(2, 16)
+    assert caches[0][0].shape == (2, 2, 16, 8)
+
+    # naive: argmax over full forward each step
+    ids = prompt.copy()
+    for _ in range(6):
+        logits, _ = m.apply(params, {}, jnp.asarray(ids.astype(np.float32)),
+                            training=False)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1)).astype(np.int32)
+        ids = np.concatenate([ids, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out), ids)
+
+
+def test_gqa_forward_matches_expanded_mha():
+    """A GQA attention layer == an MHA layer whose wk/wv are the grouped
+    weights tiled across each group (same math, bigger projections)."""
+    from bigdl_tpu import nn
+    H, heads, kvh = 24, 6, 2
+    g = heads // kvh
+    d = H // heads
+    gqa = nn.Attention(H, heads, use_flash=False, num_kv_heads=kvh)
+    params, _ = gqa.init(jax.random.PRNGKey(0))
+
+    mha = nn.Attention(H, heads, use_flash=False)
+    wk = np.asarray(params["wk"]).reshape(H, kvh, d)
+    wv = np.asarray(params["wv"]).reshape(H, kvh, d)
+    mp = {"wq": params["wq"],
+          "wk": jnp.asarray(np.repeat(wk, g, axis=1).reshape(H, H)),
+          "wv": jnp.asarray(np.repeat(wv, g, axis=1).reshape(H, H)),
+          "wo": params["wo"]}
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 10, H)
+                    .astype(np.float32))
+    o1, _ = gqa.apply(params, {}, x, training=False)
+    o2, _ = mha.apply(mp, {}, x, training=False)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+
+
+def test_gqa_head_divisibility_rejected():
+    from bigdl_tpu import nn
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="divide"):
+        nn.Attention(32, 4, num_kv_heads=3)

@@ -35,6 +35,8 @@ run "lm flash q256 k512" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=256 BIGDL
 run "lm flash q512 k1024" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=512 BIGDL_TPU_FLASH_BLOCK_K=1024
 # 6. remat OFF + batch 32 (if remat=0 fits, bigger batch may too)
 run "lm remat=0 B32" secondary:transformer BENCH_LM_REMAT=0 BENCH_LM_BATCH=32
+# 6a. grouped-query attention decode arm (4x smaller KV cache)
+run "decode gqa kv4" secondary:decode BENCH_DECODE_KV_HEADS=4
 # 6b. ADVICE r3: does the in-step wq/wk/wv concat cost anything on-chip?
 run "lm fused_qkv=0 (three-dot)" secondary:transformer BIGDL_TPU_FUSED_QKV=0
 # 7. layout-preserving Pallas bottleneck vs the winning fused=xla arm,
