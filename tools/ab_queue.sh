@@ -51,3 +51,8 @@ echo "### profile fused=xla ($(date -u +%H:%M:%SZ))" >> "$LOG"
 timeout 900 python tools/profile_resnet.py > /tmp/profile_fused.out 2>&1 \
   && tail -30 /tmp/profile_fused.out >> "$LOG" \
   || echo "profile FAILED rc=$?" >> "$LOG"
+# 10. and the LM step (38.9% vs ~78% roofline — per-op attribution)
+echo "### profile lm ($(date -u +%H:%M:%SZ))" >> "$LOG"
+timeout 900 python tools/profile_lm.py > /tmp/profile_lm.out 2>&1 \
+  && tail -30 /tmp/profile_lm.out >> "$LOG" \
+  || echo "lm profile FAILED rc=$?" >> "$LOG"

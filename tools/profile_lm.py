@@ -1,0 +1,70 @@
+"""Capture an on-chip profile of the TransformerLM train step and print
+the top time-consuming HLO ops — the LM companion of profile_resnet.py,
+behind the 38.9%-measured vs ~78%-roofline gap (docs/MFU_ROOFLINE.md).
+Runs the exact bench configuration (bench_extra.bench_transformer_lm
+shapes + the BENCH_LM_* env knobs). On the real chip:
+
+    python tools/profile_lm.py [batch] [remat01]
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(batch: int = 16, remat: bool = True,
+            logdir: str = "/tmp/bigdl_prof_lm"):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bigdl_tpu.models import TransformerLM, lm_loss_chunked
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.utils import engine
+    from bigdl_tpu.utils.amp import bf16_params
+
+    engine.set_seed(0)
+    seqlen = int(os.environ.get("PROF_LM_T", 1024))
+    H = int(os.environ.get("PROF_LM_H", 1024))
+    F, V = 4 * H, int(os.environ.get("PROF_LM_V", 32000))
+    L = int(os.environ.get("PROF_LM_L", 12))
+    model = TransformerLM(vocab_size=V, hidden_size=H, num_heads=16,
+                          filter_size=F, num_layers=L, max_len=seqlen,
+                          remat=remat)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    optim = SGD(learningrate=0.01, momentum=0.9)
+    opt_state = optim.init_state(params)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, V, size=(batch, seqlen + 1)).astype(np.int32)
+    x, y = jnp.asarray(ids[:, :-1]), jnp.asarray(ids[:, 1:])
+
+    def train_step(params, opt_state, x, y, lr):
+        def loss_fn(p):
+            p16 = bf16_params(p)
+            h = model.hidden_states(p16, x)
+            return lm_loss_chunked(h, p16["embed"], y)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt = optim.update(grads, params, opt_state, lr)
+        return loss, new_params, new_opt
+
+    lr = jnp.float32(0.01)
+    step = jax.jit(train_step, donate_argnums=(0, 1)) \
+              .lower(params, opt_state, x, y, lr).compile()
+    for _ in range(3):
+        loss, params, opt_state = step(params, opt_state, x, y, lr)
+    float(loss)
+    with jax.profiler.trace(logdir):
+        for _ in range(5):
+            loss, params, opt_state = step(params, opt_state, x, y, lr)
+        float(loss)
+    return logdir
+
+
+if __name__ == "__main__":
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    rm = bool(int(sys.argv[2])) if len(sys.argv) > 2 else True
+    logdir = capture(b, rm)
+    from profile_resnet import report
+    report(logdir)
