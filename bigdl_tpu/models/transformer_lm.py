@@ -25,18 +25,22 @@ def TransformerLM(vocab_size: int = 32000, hidden_size: int = 512,
                   num_heads: int = 8, filter_size: int = 2048,
                   num_layers: int = 6, dropout: float = 0.0,
                   max_len: int = 2048, use_flash: bool = True,
-                  remat: bool = False, num_kv_heads=None):
+                  remat: bool = False, num_kv_heads=None,
+                  pos_encoding: str = "sinusoidal"):
     """``num_kv_heads < num_heads`` turns on grouped-query attention:
     K/V projections and the decode KV caches shrink by the group factor
     — the decode path's HBM-bandwidth lever (each step streams the whole
-    cache; see _decode_attention_gqa)."""
+    cache; see _decode_attention_gqa). ``pos_encoding='rope'`` swaps the
+    additive sinusoidal PE for rotary embeddings on q/k (relative
+    positions; the KV cache stores rotated keys)."""
     return Transformer(vocab_size=vocab_size, hidden_size=hidden_size,
                        num_heads=num_heads, filter_size=filter_size,
                        num_hidden_layers=num_layers,
                        postprocess_dropout=dropout,
                        attention_dropout=dropout, relu_dropout=dropout,
                        mode="lm", max_len=max_len, use_flash=use_flash,
-                       remat=remat, num_kv_heads=num_kv_heads)
+                       remat=remat, num_kv_heads=num_kv_heads,
+                       pos_encoding=pos_encoding)
 
 
 def lm_loss_chunked(h, embed, targets, chunk: int = 128,

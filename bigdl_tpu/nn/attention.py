@@ -40,6 +40,27 @@ def _glorot(rng, shape):
     return jax.random.uniform(rng, shape, minval=-s, maxval=s)
 
 
+def rotary_embedding(x, positions, base: float = 10000.0):
+    """Rotary position embedding (RoPE, rotate-half convention).
+
+    x: (..., T, D) with D even; positions: (T,) integer global positions.
+    Rotation is absolute per position, so attention logits depend only on
+    relative distance — the modern alternative to the reference's additive
+    sinusoidal PE (``nn/TransformerOperation.scala`` getPositionEncode),
+    and the form KV caches prefer (cache entries hold already-rotated K).
+    """
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {d}")
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)          # (T, half)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
 def dot_product_attention(q, k, v, mask=None, dropout_p=0.0, rng=None,
                           training=False):
     """q,k,v: (B, H, T, D). mask: additive (broadcastable) or None."""
@@ -77,13 +98,14 @@ class Attention(Module):
 
     seq_impl = "ring"     # class defaults: pre-r4 pickles lack the attrs
     num_kv_heads = None   # None → MHA (kv heads == query heads)
+    rope = False          # rotary position embedding on q/k
     """Multi-head attention (nn/Attention.scala). Input Table(query_seq,
     key_value_seq, additive_mask_or_None) or a single tensor (self-attn)."""
 
     def __init__(self, hidden_size: int, num_heads: int,
                  attention_dropout: float = 0.0, use_flash: bool = True,
                  seq_axis=None, causal: bool = False, seq_impl: str = "ring",
-                 num_kv_heads=None, name=None):
+                 num_kv_heads=None, rope: bool = False, name=None):
         """``seq_axis``: name of a mesh axis the sequence dim is sharded
         over — attention then runs sequence-parallel. ``seq_impl``
         picks the scheme: ``"ring"`` (parallel/ring_flash.py: ppermute
@@ -107,6 +129,9 @@ class Attention(Module):
         self.seq_impl = seq_impl
         self.causal = causal
         self.num_kv_heads = num_kv_heads
+        self.rope = rope
+        if rope and (hidden_size // num_heads) % 2:
+            raise ValueError("RoPE needs an even head dim")
         if num_kv_heads is not None:
             if num_heads % num_kv_heads:
                 raise ValueError(
@@ -180,6 +205,10 @@ class Attention(Module):
         positions <= pos. x_t: (B, 1, H); caches: (B, nH, Tmax, D).
         Returns (out (B, 1, H), k_cache, v_cache)."""
         q, k_t, v_t = self.qkv(params, x_t)
+        if self.rope:
+            p = jnp.full((1,), pos)
+            q = rotary_embedding(q, p)
+            k_t = rotary_embedding(k_t, p)   # cache holds rotated K
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k_t.astype(k_cache.dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(
@@ -199,6 +228,16 @@ class Attention(Module):
         else:
             qx, kx, mask = x, x, None
         q, k, v = self.qkv(params, qx, kx)
+        if self.rope:
+            if kx is not qx:
+                raise ValueError("RoPE supports self-attention only")
+            t = q.shape[2]
+            pos = jnp.arange(t)
+            if self.seq_axis is not None:
+                # local block → global positions (runs inside shard_map)
+                pos = pos + jax.lax.axis_index(self.seq_axis) * t
+            q = rotary_embedding(q, pos)
+            k = rotary_embedding(k, pos)
         k, v = self._expand_kv(k, v)
         if self.seq_axis is not None:
             if mask is not None:
@@ -296,14 +335,18 @@ def position_encoding(length, hidden_size, dtype=jnp.float32):
     return jnp.asarray(pe, dtype)
 
 
-def embed_ids(embed, ids, hidden_size):
+def embed_ids(embed, ids, hidden_size, with_pe: bool = True):
     """Token embedding + sqrt(d) scale + sinusoidal positions (the LM
     input head shared by Transformer and MoETransformerLM). The PE is cast
     to the embedding dtype — an f32 PE added to bf16 embeddings would
     silently promote EVERY downstream activation (and the KV caches) to
-    f32, doubling HBM traffic in what looks like a bf16 model."""
+    f32, doubling HBM traffic in what looks like a bf16 model.
+    ``with_pe=False`` skips the additive PE (RoPE models position inside
+    attention instead)."""
     h = jnp.take(embed, ids.astype(jnp.int32), axis=0)
     h = h * math.sqrt(hidden_size)
+    if not with_pe:
+        return h
     return h + position_encoding(ids.shape[1], hidden_size, h.dtype)
 
 
@@ -313,11 +356,12 @@ class TransformerBlock(Module):
     def __init__(self, hidden_size: int, num_heads: int, filter_size: int,
                  attn_dropout: float = 0.0, ffn_dropout: float = 0.0,
                  with_cross: bool = False, causal: bool = False,
-                 use_flash: bool = True, num_kv_heads=None, name=None):
+                 use_flash: bool = True, num_kv_heads=None,
+                 rope: bool = False, name=None):
         super().__init__(name=name)
         self.attn = Attention(hidden_size, num_heads, attn_dropout,
                               use_flash=use_flash, causal=causal,
-                              num_kv_heads=num_kv_heads)
+                              num_kv_heads=num_kv_heads, rope=rope)
         self.ffn = FeedForwardNetwork(hidden_size, filter_size, ffn_dropout)
         self.ln1 = LayerNormalization(hidden_size)
         self.ln2 = LayerNormalization(hidden_size)
@@ -377,6 +421,10 @@ class TransformerBlock(Module):
         same attention implementation it trained with)."""
         n, _ = self.ln1.apply(params["ln1"], {}, h, False, None)
         q, k, v = self.attn.qkv(params["attn"], n)
+        if self.attn.rope:
+            pos = jnp.arange(q.shape[2])
+            q = rotary_embedding(q, pos)
+            k = rotary_embedding(k, pos)
         # GQA: attention runs over broadcast heads, but the cache keeps
         # the compact kv-head form (that compactness IS the decode win)
         ke, ve = self.attn._expand_kv(k, v)
@@ -426,7 +474,8 @@ class Transformer(Module):
                  attention_dropout: float = 0.0, relu_dropout: float = 0.0,
                  mode: str = "lm", max_len: int = 2048,
                  use_flash: bool = True, remat: bool = False,
-                 num_kv_heads=None, name=None):
+                 num_kv_heads=None, pos_encoding: str = "sinusoidal",
+                 name=None):
         """``use_flash``: LM-mode self-attention goes through the fused
         O(T)-memory flash path (Pallas on TPU) instead of materialising the
         (B,H,T,T) score matrix. ``remat``: each block is wrapped in
@@ -441,12 +490,20 @@ class Transformer(Module):
         # LM mode: causal masking is a block property (flash-friendly);
         # translation mode keeps additive masks (padding masks cannot be
         # expressed as the flash kernel's static causal pattern)
+        if pos_encoding not in ("sinusoidal", "rope"):
+            raise ValueError(f"pos_encoding must be 'sinusoidal' or "
+                             f"'rope', got {pos_encoding!r}")
+        if pos_encoding == "rope" and mode != "lm":
+            raise ValueError("RoPE is LM-mode only (cross-attention has "
+                             "no rotary form here)")
+        self.pos_encoding = pos_encoding
         self.blocks = [TransformerBlock(hidden_size, num_heads, filter_size,
                                         attention_dropout, relu_dropout,
                                         with_cross=(mode == "translation"),
                                         causal=(mode == "lm"),
                                         use_flash=use_flash,
-                                        num_kv_heads=num_kv_heads)
+                                        num_kv_heads=num_kv_heads,
+                                        rope=(pos_encoding == "rope"))
                        for _ in range(num_hidden_layers)]
         if mode == "translation":
             self.enc_blocks = [TransformerBlock(hidden_size, num_heads,
@@ -469,7 +526,9 @@ class Transformer(Module):
         return p
 
     def _embed(self, params, ids):
-        return embed_ids(params["embed"], ids, self.hidden_size)
+        return embed_ids(params["embed"], ids, self.hidden_size,
+                         with_pe=getattr(self, "pos_encoding",
+                                         "sinusoidal") != "rope")
 
     def _stack(self, blocks, prefix, params, h, mask, training, rng,
                enc=None, enc_mask=None):
@@ -549,10 +608,12 @@ class Transformer(Module):
         callers pass per-block precomputed ``cross`` K/V and the source
         padding ``cross_mask``."""
         emb = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
-        pe = position_encoding(self.max_len, self.hidden_size,
-                               emb.dtype)
-        h = (emb * math.sqrt(self.hidden_size)
-             + jax.lax.dynamic_slice_in_dim(pe, pos, 1, 0))[:, None, :]
+        h = emb * math.sqrt(self.hidden_size)
+        if getattr(self, "pos_encoding", "sinusoidal") != "rope":
+            pe = position_encoding(self.max_len, self.hidden_size,
+                                   emb.dtype)
+            h = h + jax.lax.dynamic_slice_in_dim(pe, pos, 1, 0)
+        h = h[:, None, :]
         new_caches = []
         for i, blk in enumerate(self.blocks):
             h, kv = blk.decode_step(

@@ -123,3 +123,32 @@ def test_a2a_agrees_with_ring():
     np.testing.assert_allclose(np.asarray(jax.jit(fa)(q, k, v)),
                                np.asarray(jax.jit(fr)(q, k, v)),
                                atol=2e-5)
+
+
+def test_rope_seq_parallel_matches_dense():
+    """RoPE under sequence parallelism: local blocks rotate with GLOBAL
+    positions (axis_index offset) — a2a-parallel rope attention == the
+    same module dense on one device."""
+    from bigdl_tpu import nn
+    rng = np.random.RandomState(6)
+    B, T, Hdim, heads = 1, 64, 32, 8
+    x = jnp.asarray(rng.randn(B, T, Hdim).astype(np.float32))
+
+    dense = nn.Attention(Hdim, heads, causal=True, use_flash=False,
+                         rope=True)
+    params, _ = dense.init(jax.random.PRNGKey(0))
+    ref, _ = dense.apply(params, {}, x, training=False)
+
+    sp = nn.Attention(Hdim, heads, causal=True, use_flash=False,
+                      seq_axis="seq", seq_impl="a2a", rope=True)
+    mesh = _mesh()
+
+    def step(p, xb):
+        out, _ = sp.apply(p, {}, xb, training=False)
+        return out
+
+    f = shard_map(step, mesh=mesh,
+                  in_specs=(P(), P(None, "seq", None)),
+                  out_specs=P(None, "seq", None))
+    out = jax.jit(f)(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
