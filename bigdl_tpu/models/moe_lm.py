@@ -30,10 +30,12 @@ class MoETransformerLM(Module):
                  num_layers: int = 4, n_experts: int = 4,
                  moe_every: int = 2, capacity_factor: float = 1.25,
                  max_len: int = 2048, use_flash: bool = True,
-                 remat: bool = False, name=None):
+                 remat: bool = False, num_kv_heads=None,
+                 pos_encoding: str = "sinusoidal", name=None):
         super().__init__(name=name)
         self.vocab_size, self.hidden_size = vocab_size, hidden_size
         self.max_len = max_len
+        self.pos_encoding = pos_encoding
         # jax.checkpoint per block: the router's dispatch/combine one-hots
         # are (T, E, capacity)-sized residuals — at bench scale ~GBs the
         # backward would otherwise keep live (mirrors Transformer's remat)
@@ -46,11 +48,15 @@ class MoETransformerLM(Module):
                 self.blocks.append(_MoEBlock(hidden_size, num_heads,
                                              filter_size, n_experts,
                                              capacity_factor,
-                                             use_flash=use_flash))
+                                             use_flash=use_flash,
+                                             num_kv_heads=num_kv_heads,
+                                             rope=(pos_encoding
+                                                   == "rope")))
             else:
-                self.blocks.append(TransformerBlock(hidden_size, num_heads,
-                                                    filter_size, causal=True,
-                                                    use_flash=use_flash))
+                self.blocks.append(TransformerBlock(
+                    hidden_size, num_heads, filter_size, causal=True,
+                    use_flash=use_flash, num_kv_heads=num_kv_heads,
+                    rope=(pos_encoding == "rope")))
         self.ln_f = LayerNormalization(hidden_size)
 
     def _init_params(self, rng):
@@ -66,7 +72,8 @@ class MoETransformerLM(Module):
         return {"aux_loss": jnp.zeros(())}
 
     def _embed(self, params, ids):
-        return embed_ids(params["embed"], ids, self.hidden_size)
+        return embed_ids(params["embed"], ids, self.hidden_size,
+                         with_pe=self.pos_encoding != "rope")
 
     def hidden_states(self, params, ids, training=False, rng=None):
         """``(h, aux_loss)`` — final pre-projection hidden states plus the
@@ -74,7 +81,8 @@ class MoETransformerLM(Module):
         so callers can fuse the tied projection with the loss
         (``models.lm_loss_chunked``) instead of materialising the full
         (B, T, vocab) logits tensor."""
-        h = embed_ids(params["embed"], ids, self.hidden_size)
+        h = embed_ids(params["embed"], ids, self.hidden_size,
+                      with_pe=self.pos_encoding != "rope")
         # causal masking lives inside the blocks (flash-friendly — no
         # materialised (T, T) mask, mirroring Transformer's LM mode)
         mask = None
@@ -122,9 +130,11 @@ class _MoEBlock(TransformerBlock):
 
     def __init__(self, hidden_size: int, num_heads: int, filter_size: int,
                  n_experts: int, capacity_factor: float,
-                 use_flash: bool = True, name=None):
+                 use_flash: bool = True, num_kv_heads=None,
+                 rope: bool = False, name=None):
         super().__init__(hidden_size, num_heads, filter_size, causal=True,
-                         use_flash=use_flash, name=name)
+                         use_flash=use_flash, num_kv_heads=num_kv_heads,
+                         rope=rope, name=name)
         self.ffn = MixtureOfExperts(hidden_size, n_experts,
                                     ffn_hidden=filter_size,
                                     capacity_factor=capacity_factor)

@@ -625,10 +625,12 @@ class Transformer(Module):
 
     def generate(self, params, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None, top_k: int = 0,
-                 eos_id=None):
+                 top_p: float = 0.0, eos_id=None):
         """Autoregressive generation with a KV cache: prefill the prompt,
         then ``lax.scan`` one fused decode step per token (greedy when
-        ``temperature`` == 0, else temperature/top-k sampling). Returns
+        ``temperature`` == 0, else temperature / top-k / top-p (nucleus)
+        sampling — ``top_p`` keeps the smallest prefix of the sorted
+        distribution whose mass reaches p). Returns
         (B, Tp + max_new_tokens) ids; with ``eos_id``, positions after a
         row's first EOS are emitted as 0 (fixed shape — the scan still
         runs max_new_tokens steps). Jit-compatible end to end.
@@ -658,6 +660,17 @@ class Transformer(Module):
                 # lax.top_k: O(V) threshold, not a full per-step sort
                 kth = jax.lax.top_k(l, k_eff)[0][:, -1:]
                 l = jnp.where(l < kth, -1e30, l)
+            if top_p > 0.0:
+                # nucleus: drop tokens outside the smallest prefix of the
+                # sorted distribution with cumulative mass >= p (the
+                # highest-probability token always survives)
+                srt = jnp.sort(l, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(srt, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep_sorted = cum - probs < top_p
+                n_keep = jnp.maximum(keep_sorted.sum(-1), 1)
+                cutoff = jnp.take_along_axis(srt, n_keep[:, None] - 1, -1)
+                l = jnp.where(l < cutoff, -1e30, l)
             return jax.random.categorical(key, l, axis=-1).astype(jnp.int32)
 
         key0, rng = jax.random.split(rng)

@@ -242,3 +242,47 @@ def test_rope_gqa_compose():
         nxt = np.asarray(jnp.argmax(logits[:, -1], -1)).astype(np.int32)
         ids = np.concatenate([ids, nxt[:, None]], 1)
     np.testing.assert_array_equal(np.asarray(out), ids)
+
+
+def test_moe_lm_rope_gqa_generate_matches_naive():
+    """MoE LM composes RoPE + GQA through the shared decode machinery."""
+    from bigdl_tpu.models import MoETransformerLM
+    m = MoETransformerLM(vocab_size=41, hidden_size=32, num_heads=4,
+                         filter_size=64, num_layers=2, n_experts=2,
+                         capacity_factor=2.0, max_len=32, use_flash=False,
+                         num_kv_heads=2, pos_encoding="rope")
+    params = m._init_params(jax.random.PRNGKey(5))
+    prompt = np.array([[3, 9]], np.int32)
+    out = m.generate(params, prompt, max_new_tokens=5)
+    ids = prompt.copy()
+    for _ in range(5):
+        logits, _ = m.apply(params, m._init_state(),
+                            jnp.asarray(ids.astype(np.float32)),
+                            training=False)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1)).astype(np.int32)
+        ids = np.concatenate([ids, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out), ids)
+
+
+def test_top_p_sampling_masks_tail():
+    """top_p keeps the nucleus: with a sharply peaked distribution and
+    small p, sampling always returns the argmax; top_p=1 leaves the
+    distribution unchanged (all tokens reachable over many draws)."""
+    from bigdl_tpu.models import TransformerLM
+    m = TransformerLM(vocab_size=29, hidden_size=16, num_heads=2,
+                      filter_size=32, num_layers=1, max_len=16,
+                      use_flash=False)
+    params, _ = m.init(jax.random.PRNGKey(0))
+    prompt = np.array([[5]], np.int32)
+    greedy = np.asarray(m.generate(params, prompt, max_new_tokens=4))
+    # tiny p → nucleus collapses to the single top token → greedy
+    nuc = np.asarray(m.generate(params, prompt, max_new_tokens=4,
+                                temperature=0.7, top_p=1e-6,
+                                rng=jax.random.PRNGKey(9)))
+    np.testing.assert_array_equal(nuc, greedy)
+    # generous p still yields valid ids
+    samp = np.asarray(m.generate(params, prompt, max_new_tokens=4,
+                                 temperature=1.0, top_p=0.9,
+                                 rng=jax.random.PRNGKey(10)))
+    assert samp.shape == greedy.shape and (samp >= 0).all() \
+        and (samp < 29).all()
