@@ -1,4 +1,4 @@
-from .optim_method import (OptimMethod, SGD, Adam, ParallelAdam, Adagrad,
+from .optim_method import (OptimMethod, SGD, Adam, ParallelAdam, AdamW, Adagrad,
                            Adadelta, Adamax, RMSprop, Ftrl, LarsSGD, LBFGS,
                            LearningRateSchedule, Default, Poly, Step,
                            MultiStep, EpochStep, EpochDecay, NaturalExp,
