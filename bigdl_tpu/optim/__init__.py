@@ -2,7 +2,7 @@ from .optim_method import (OptimMethod, SGD, Adam, ParallelAdam, AdamW, Adagrad,
                            Adadelta, Adamax, RMSprop, Ftrl, LarsSGD, LBFGS,
                            LearningRateSchedule, Default, Poly, Step,
                            MultiStep, EpochStep, EpochDecay, NaturalExp,
-                           Exponential, Warmup, SequentialSchedule, Regime,
+                           Exponential, Warmup, CosineAnnealing, SequentialSchedule, Regime,
                            EpochSchedule, Plateau, EpochDecayWithWarmUp)
 from .regularizer import (Regularizer, L1Regularizer, L2Regularizer,
                           L1L2Regularizer)

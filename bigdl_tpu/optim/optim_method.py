@@ -145,6 +145,34 @@ class Warmup(LearningRateSchedule):
         return lr + self.delta * state["neval"]
 
 
+class CosineAnnealing(LearningRateSchedule):
+    """Cosine decay lr → min_lr over ``max_iteration`` steps, optionally
+    restarting (SGDR). Beyond the reference's 14 schedules — the
+    transformer-era default; compose with Warmup via SequentialSchedule
+    for the standard warmup+cosine recipe."""
+
+    def __init__(self, max_iteration: int, min_lr: float = 0.0,
+                 restarts: bool = False, t_mult: float = 1.0):
+        self.max_iteration = max_iteration
+        self.min_lr = min_lr
+        self.restarts = restarts
+        self.t_mult = t_mult
+
+    def update_lr(self, lr, state):
+        import math as _m
+        t = state["neval"]
+        period = self.max_iteration
+        if self.restarts:
+            # walk the restart periods (period *= t_mult each cycle)
+            while t >= period:
+                t -= period
+                period = max(1, int(period * self.t_mult))
+        else:
+            t = min(t, period)
+        cos = 0.5 * (1.0 + _m.cos(_m.pi * t / period))
+        return self.min_lr + (lr - self.min_lr) * cos
+
+
 class SequentialSchedule(LearningRateSchedule):
     """Chain schedules, each active for maxIteration steps (SGD.scala:623)."""
 

@@ -538,3 +538,25 @@ def test_adamw_trains():
                          max_iteration(300), batch_size=32)
     opt.optimize()
     assert float(opt.optim_method.state["loss"]) < 0.05
+
+
+def test_cosine_annealing_schedule():
+    from bigdl_tpu.optim import SGD, CosineAnnealing
+    opt = SGD(learningrate=1.0,
+              learningrate_schedule=CosineAnnealing(100, min_lr=0.1))
+    opt.state["neval"] = 0
+    assert abs(opt.current_lr() - 1.0) < 1e-6        # start at lr
+    opt.state["neval"] = 50
+    assert abs(opt.current_lr() - 0.55) < 1e-6       # halfway: mean
+    opt.state["neval"] = 100
+    assert abs(opt.current_lr() - 0.1) < 1e-6        # floor at min_lr
+    opt.state["neval"] = 1000
+    assert abs(opt.current_lr() - 0.1) < 1e-6        # stays at floor
+
+    # SGDR restarts: lr comes back to the peak at each cycle boundary
+    opt2 = SGD(learningrate=1.0,
+               learningrate_schedule=CosineAnnealing(10, restarts=True))
+    opt2.state["neval"] = 10
+    assert abs(opt2.current_lr() - 1.0) < 1e-6
+    opt2.state["neval"] = 25
+    assert abs(opt2.current_lr() - 0.5) < 1e-6
