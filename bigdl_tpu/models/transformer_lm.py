@@ -26,7 +26,8 @@ def TransformerLM(vocab_size: int = 32000, hidden_size: int = 512,
                   num_layers: int = 6, dropout: float = 0.0,
                   max_len: int = 2048, use_flash: bool = True,
                   remat: bool = False, num_kv_heads=None,
-                  pos_encoding: str = "sinusoidal"):
+                  pos_encoding: str = "sinusoidal",
+                  ffn_activation: str = "relu"):
     """``num_kv_heads < num_heads`` turns on grouped-query attention:
     K/V projections and the decode KV caches shrink by the group factor
     — the decode path's HBM-bandwidth lever (each step streams the whole
@@ -40,7 +41,8 @@ def TransformerLM(vocab_size: int = 32000, hidden_size: int = 512,
                        attention_dropout=dropout, relu_dropout=dropout,
                        mode="lm", max_len=max_len, use_flash=use_flash,
                        remat=remat, num_kv_heads=num_kv_heads,
-                       pos_encoding=pos_encoding)
+                       pos_encoding=pos_encoding,
+                       ffn_activation=ffn_activation)
 
 
 def lm_loss_chunked(h, embed, targets, chunk: int = 128,

@@ -95,12 +95,12 @@ def padding_mask(lengths_or_mask, t):
 
 
 class Attention(Module):
+    """Multi-head attention (nn/Attention.scala). Input Table(query_seq,
+    key_value_seq, additive_mask_or_None) or a single tensor (self-attn)."""
 
     seq_impl = "ring"     # class defaults: pre-r4 pickles lack the attrs
     num_kv_heads = None   # None → MHA (kv heads == query heads)
     rope = False          # rotary position embedding on q/k
-    """Multi-head attention (nn/Attention.scala). Input Table(query_seq,
-    key_value_seq, additive_mask_or_None) or a single tensor (self-attn)."""
 
     def __init__(self, hidden_size: int, num_heads: int,
                  attention_dropout: float = 0.0, use_flash: bool = True,
@@ -303,23 +303,45 @@ def _decode_attention_gqa(q, cache_k, cache_v, pos, groups):
 
 
 class FeedForwardNetwork(Module):
-    """Position-wise FFN (nn/FeedForwardNetwork.scala)."""
+    """Position-wise FFN (nn/FeedForwardNetwork.scala).
+
+    ``activation``: 'relu' (reference default), 'gelu', or 'swiglu'
+    (gated: ``(silu(x@w1) * (x@w3)) @ w2`` — the modern LLM default; the
+    gate keeps param count comparable by construction since callers
+    usually shrink filter_size by 2/3)."""
+
+    activation = "relu"   # class default: pre-r4 pickles lack the attr
 
     def __init__(self, hidden_size: int, filter_size: int,
-                 relu_dropout: float = 0.0, name=None):
+                 relu_dropout: float = 0.0, activation: str = "relu",
+                 name=None):
         super().__init__(name=name)
         self.hidden_size, self.filter_size = hidden_size, filter_size
         self.relu_dropout = relu_dropout
+        if activation not in ("relu", "gelu", "swiglu"):
+            raise ValueError(f"activation must be relu/gelu/swiglu, "
+                             f"got {activation!r}")
+        self.activation = activation
 
     def _init_params(self, rng):
-        k1, k2 = jax.random.split(rng)
-        return {"w1": _glorot(k1, (self.hidden_size, self.filter_size)),
-                "b1": jnp.zeros((self.filter_size,)),
-                "w2": _glorot(k2, (self.filter_size, self.hidden_size)),
-                "b2": jnp.zeros((self.hidden_size,))}
+        k1, k2, k3 = jax.random.split(rng, 3)
+        p = {"w1": _glorot(k1, (self.hidden_size, self.filter_size)),
+             "b1": jnp.zeros((self.filter_size,)),
+             "w2": _glorot(k2, (self.filter_size, self.hidden_size)),
+             "b2": jnp.zeros((self.hidden_size,))}
+        if self.activation == "swiglu":
+            p["w3"] = _glorot(k3, (self.hidden_size, self.filter_size))
+        return p
 
     def _apply(self, params, state, x, training, rng):
-        h = jax.nn.relu(x @ params["w1"] + params["b1"])
+        act = self.activation
+        if act == "swiglu":
+            h = jax.nn.silu(x @ params["w1"] + params["b1"]) \
+                * (x @ params["w3"])
+        elif act == "gelu":
+            h = jax.nn.gelu(x @ params["w1"] + params["b1"])
+        else:
+            h = jax.nn.relu(x @ params["w1"] + params["b1"])
         if training and self.relu_dropout > 0 and rng is not None:
             keep = jax.random.bernoulli(rng, 1 - self.relu_dropout, h.shape)
             h = jnp.where(keep, h / (1 - self.relu_dropout), 0.0)
@@ -357,12 +379,14 @@ class TransformerBlock(Module):
                  attn_dropout: float = 0.0, ffn_dropout: float = 0.0,
                  with_cross: bool = False, causal: bool = False,
                  use_flash: bool = True, num_kv_heads=None,
-                 rope: bool = False, name=None):
+                 rope: bool = False, ffn_activation: str = "relu",
+                 name=None):
         super().__init__(name=name)
         self.attn = Attention(hidden_size, num_heads, attn_dropout,
                               use_flash=use_flash, causal=causal,
                               num_kv_heads=num_kv_heads, rope=rope)
-        self.ffn = FeedForwardNetwork(hidden_size, filter_size, ffn_dropout)
+        self.ffn = FeedForwardNetwork(hidden_size, filter_size, ffn_dropout,
+                                      activation=ffn_activation)
         self.ln1 = LayerNormalization(hidden_size)
         self.ln2 = LayerNormalization(hidden_size)
         self.with_cross = with_cross
@@ -475,7 +499,7 @@ class Transformer(Module):
                  mode: str = "lm", max_len: int = 2048,
                  use_flash: bool = True, remat: bool = False,
                  num_kv_heads=None, pos_encoding: str = "sinusoidal",
-                 name=None):
+                 ffn_activation: str = "relu", name=None):
         """``use_flash``: LM-mode self-attention goes through the fused
         O(T)-memory flash path (Pallas on TPU) instead of materialising the
         (B,H,T,T) score matrix. ``remat``: each block is wrapped in
@@ -503,7 +527,8 @@ class Transformer(Module):
                                         causal=(mode == "lm"),
                                         use_flash=use_flash,
                                         num_kv_heads=num_kv_heads,
-                                        rope=(pos_encoding == "rope"))
+                                        rope=(pos_encoding == "rope"),
+                                        ffn_activation=ffn_activation)
                        for _ in range(num_hidden_layers)]
         if mode == "translation":
             self.enc_blocks = [TransformerBlock(hidden_size, num_heads,

@@ -95,3 +95,44 @@ def test_moe_transformer_lm_trains():
             first = float(l)
             assert float(gmax) > 0, "no gradient reached expert weights"
     assert float(l) < first, (first, float(l))
+
+
+def test_ffn_activations_and_swiglu_lm():
+    """FFN activation options: gelu/swiglu match hand-computed forms, and
+    a SwiGLU+RoPE+GQA LM trains and decodes consistently."""
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.attention import FeedForwardNetwork
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 5, 8).astype(np.float32))
+    for act in ("relu", "gelu", "swiglu"):
+        ffn = FeedForwardNetwork(8, 16, activation=act)
+        p, _ = ffn.init(jax.random.PRNGKey(1))
+        out, _ = ffn.apply(p, {}, x, training=False)
+        h = np.asarray(x) @ np.asarray(p["w1"]) + np.asarray(p["b1"])
+        if act == "swiglu":
+            gate = np.asarray(jax.nn.silu(jnp.asarray(h)))
+            ref = (gate * (np.asarray(x) @ np.asarray(p["w3"])))
+            assert "w3" in p
+        elif act == "gelu":
+            ref = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+            assert "w3" not in p
+        else:
+            ref = np.maximum(h, 0)
+        ref = ref @ np.asarray(p["w2"]) + np.asarray(p["b2"])
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    from bigdl_tpu.models import TransformerLM
+    m = TransformerLM(vocab_size=33, hidden_size=16, num_heads=4,
+                      filter_size=32, num_layers=1, max_len=24,
+                      use_flash=False, pos_encoding="rope",
+                      num_kv_heads=2, ffn_activation="swiglu")
+    params, _ = m.init(jax.random.PRNGKey(2))
+    prompt = np.array([[3, 5]], np.int32)
+    out = m.generate(params, prompt, max_new_tokens=4)
+    ids = prompt.copy()
+    for _ in range(4):
+        logits, _ = m.apply(params, {}, jnp.asarray(ids.astype(np.float32)),
+                            training=False)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1)).astype(np.int32)
+        ids = np.concatenate([ids, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out), ids)
