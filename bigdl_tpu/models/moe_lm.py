@@ -25,6 +25,8 @@ from ..utils.table import Table
 class MoETransformerLM(Module):
     """GPT-style decoder with MoE FFNs on a stride (Switch-Transformer)."""
 
+    pos_encoding = "sinusoidal"   # class default: pre-r4 pickles lack it
+
     def __init__(self, vocab_size: int, hidden_size: int = 256,
                  num_heads: int = 4, filter_size: int = 1024,
                  num_layers: int = 4, n_experts: int = 4,
@@ -35,6 +37,9 @@ class MoETransformerLM(Module):
         super().__init__(name=name)
         self.vocab_size, self.hidden_size = vocab_size, hidden_size
         self.max_len = max_len
+        if pos_encoding not in ("sinusoidal", "rope"):
+            raise ValueError(f"pos_encoding must be 'sinusoidal' or "
+                             f"'rope', got {pos_encoding!r}")
         self.pos_encoding = pos_encoding
         # jax.checkpoint per block: the router's dispatch/combine one-hots
         # are (T, E, capacity)-sized residuals — at bench scale ~GBs the

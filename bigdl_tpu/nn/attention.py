@@ -202,8 +202,10 @@ class Attention(Module):
     def decode(self, params, x_t, k_cache, v_cache, pos):
         """One autoregressive step: project the current token, write its
         K/V into the cache at ``pos`` (traced scalar), attend over
-        positions <= pos. x_t: (B, 1, H); caches: (B, nH, Tmax, D).
-        Returns (out (B, 1, H), k_cache, v_cache)."""
+        positions <= pos. x_t: (B, 1, H); caches: (B, kvH, Tmax, D) —
+        kvH = num_kv_heads (== num_heads without GQA; build them with
+        Transformer.init_cache). Returns (out (B, 1, H), k_cache,
+        v_cache)."""
         q, k_t, v_t = self.qkv(params, x_t)
         if self.rope:
             p = jnp.full((1,), pos)

@@ -426,22 +426,30 @@ class AdamW(Adam):
     reference (its optimizers only know L2-via-gradient regularizers,
     which Adam's preconditioner distorts). Decay applies directly to the
     weights at the scheduled lr, outside the moment estimates — the
-    de-facto transformer training default."""
+    de-facto transformer training default.
+
+    ``decay_filter(leaf) -> bool`` selects which leaves decay; the
+    default (ndim >= 2) excludes biases and norm scales/offsets, matching
+    the standard transformer recipe. Pass ``lambda w: True`` to decay
+    everything."""
 
     def __init__(self, learningrate: float = 1e-3,
                  learningrate_decay: float = 0.0, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-8,
-                 weight_decay: float = 0.01, **_ignored):
+                 weight_decay: float = 0.01, decay_filter=None, **_ignored):
         super().__init__(learningrate, learningrate_decay, beta1, beta2,
                          epsilon)
         self.weight_decay = weight_decay
+        self.decay_filter = decay_filter
 
     def update(self, grads, params, opt_state, lr):
         new_params, new_state = super().update(grads, params, opt_state, lr)
         if self.weight_decay:
             wd = self.weight_decay
-            new_params = _tmap(lambda nw, w: nw - lr * wd * w,
-                               new_params, params)
+            keep = self.decay_filter or (lambda w: w.ndim >= 2)
+            new_params = _tmap(
+                lambda nw, w: nw - lr * wd * w if keep(w) else nw,
+                new_params, params)
             new_params = _keep_dtype(new_params, params)
         return new_params, new_state
 

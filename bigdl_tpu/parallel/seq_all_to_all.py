@@ -65,12 +65,7 @@ def a2a_attention(q, k, v, axis: str = "seq", causal: bool = False,
         from .flash import flash_attention
         o = flash_attention(qh, kh, vh, causal=causal)
     else:
-        from ..nn.attention import dot_product_attention
-        mask = None
-        if causal:
-            t = qh.shape[-2]
-            mask = jnp.where(
-                jnp.tril(jnp.ones((t, t), jnp.bool_))[None, None],
-                0.0, jnp.float32(-1e30))
+        from ..nn.attention import causal_mask, dot_product_attention
+        mask = causal_mask(qh.shape[-2]) if causal else None
         o = dot_product_attention(qh, kh, vh, mask)
     return lax.all_to_all(o, axis, split_axis=2, concat_axis=1, tiled=True)

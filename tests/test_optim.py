@@ -496,13 +496,16 @@ def test_async_checkpoint_write_and_resume(tmp_path):
 
 def test_adamw_decoupled_decay():
     """AdamW == Adam + lr*wd*w subtracted from the PRE-step weights (the
-    decoupled form), and a pure-decay case shrinks weights geometrically
-    where Adam's L2-in-gradient would not."""
+    decoupled form); biases/norms (ndim < 2) are excluded by default; a
+    pure-decay case shrinks weights geometrically where Adam's
+    L2-in-gradient would not."""
     import jax
     import jax.numpy as jnp
     from bigdl_tpu.optim import Adam, AdamW
-    params = {"w": jnp.asarray(np.array([1.0, -2.0, 0.5], np.float32))}
-    grads = {"w": jnp.asarray(np.array([0.3, -0.1, 0.2], np.float32))}
+    params = {"w": jnp.asarray(np.array([[1.0, -2.0, 0.5]], np.float32)),
+              "b": jnp.asarray(np.array([0.7], np.float32))}
+    grads = {"w": jnp.asarray(np.array([[0.3, -0.1, 0.2]], np.float32)),
+             "b": jnp.asarray(np.array([0.1], np.float32))}
     lr = jnp.float32(0.1)
 
     adam = Adam()
@@ -515,12 +518,24 @@ def test_adamw_decoupled_decay():
         np.asarray(p_aw["w"]),
         np.asarray(p_adam["w"]) - 0.1 * 0.04 * np.asarray(params["w"]),
         rtol=1e-6)
+    # the 1-D bias does NOT decay (standard recipe excludes biases/norms)
+    np.testing.assert_allclose(np.asarray(p_aw["b"]),
+                               np.asarray(p_adam["b"]), rtol=1e-6)
 
-    # zero gradients: Adam leaves weights alone, AdamW still decays
-    z = {"w": jnp.zeros((3,))}
+    # zero gradients: Adam leaves weights alone, AdamW still decays the
+    # matrix but not the bias
+    z = {"w": jnp.zeros((1, 3)), "b": jnp.zeros((1,))}
     p2, _ = aw.update(z, params, aw.init_state(params), lr)
     np.testing.assert_allclose(np.asarray(p2["w"]),
                                np.asarray(params["w"]) * (1 - 0.1 * 0.04),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p2["b"]),
+                               np.asarray(params["b"]), rtol=1e-6)
+    # opt-out filter decays everything
+    aw2 = AdamW(weight_decay=0.04, decay_filter=lambda w: True)
+    p3, _ = aw2.update(z, params, aw2.init_state(params), lr)
+    np.testing.assert_allclose(np.asarray(p3["b"]),
+                               np.asarray(params["b"]) * (1 - 0.1 * 0.04),
                                rtol=1e-6)
 
 
