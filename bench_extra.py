@@ -441,9 +441,25 @@ def bench_lm_decode(on_tpu):
 
     bf16_tps = timed_decode(params)
     int8_tps = timed_decode(quantize_lm_params(params))
+
+    # decode is HBM-bandwidth bound: every step streams all params plus
+    # the live KV cache. Bytes per BATCH step (B tokens): params once +
+    # avg cache (k+v, kvh heads, mean seq length over the decode range).
+    import jax
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    d_head = H // heads
+    t_avg = prompt_len + new_tokens / 2
+    cache_bytes = 2 * L * kvh * d_head * t_avg * B * 2     # bf16
+    step_bytes = n_params * 2 + cache_bytes
+    bytes_per_token = step_bytes / B
+    # bandwidth utilization vs the chip's public HBM peak (v5e: 819 GB/s)
+    bw_util = (bf16_tps * bytes_per_token) / 819e9 if on_tpu else None
     return {"metric": "lm_decode_tokens_per_sec", "value": round(bf16_tps, 1),
             "unit": "tokens/sec", "vs_baseline": None,
             "kv_heads": kvh,
+            "bytes_per_token": round(bytes_per_token / 1e6, 2),
+            "hbm_bw_util": round(bw_util, 3) if bw_util else None,
             "int8_tokens_per_sec": round(int8_tps, 1),
             "int8_speedup": round(int8_tps / max(bf16_tps, 1e-9), 3)}
 
