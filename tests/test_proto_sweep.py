@@ -240,3 +240,59 @@ def test_sweep_covers_every_public_class():
     specs for classes that no longer exist."""
     missing = [n for n in SPECS if n not in ALL_CLASSES]
     assert not missing, f"specs for non-existent classes: {missing}"
+
+
+def test_proto_random_composition_fuzz(tmp_path):
+    """Fuzz the UNIVERSAL serializer: random Sequential/ConcatTable
+    compositions mixing reference-tier and generic-tier layers must
+    round-trip through bigdl.proto with identical eval outputs (seeded,
+    deterministic)."""
+    import jax
+    rng = np.random.RandomState(77)
+
+    def rand_model(seed):
+        r = np.random.RandomState(seed)
+        dim = int(r.randint(3, 9))
+        layers = [N.Linear(6, dim)]
+        cur = dim
+        for _ in range(int(r.randint(2, 6))):
+            c = r.randint(0, 10)
+            if c == 0:
+                nxt = int(r.randint(3, 9))
+                layers.append(N.Linear(cur, nxt))
+                cur = nxt
+            elif c == 1:
+                layers.append(N.ReLU())
+            elif c == 2:
+                layers.append(N.PReLU(cur))          # generic tier
+            elif c == 3:
+                layers.append(N.BatchNormalization(cur))
+            elif c == 4:
+                layers.append(N.LayerNormalization(cur))  # generic tier
+            elif c == 5:
+                layers.append(N.Highway(cur))        # generic tier
+            elif c == 6:
+                layers.append(N.ELU(0.5))            # generic tier
+            elif c == 7:
+                layers.append(N.Sequential(
+                    N.ConcatTable().add(N.Identity()).add(
+                        N.Linear(cur, cur)),
+                    N.CAddTable()))                  # mixed container
+            elif c == 8:
+                layers.append(N.Dropout(0.2))
+            else:
+                layers.append(N.SoftPlus())          # generic tier
+        return N.Sequential(*layers)
+
+    for i in range(8):
+        m = rand_model(int(rng.randint(0, 10_000)))
+        m.ensure_initialized()
+        m.evaluate()
+        x = np.random.RandomState(i).randn(4, 6).astype(np.float32)
+        ref = np.asarray(m.forward(x))
+        path = str(tmp_path / f"pf{i}.bigdl")
+        save_bigdl(m, path)
+        m2 = load_bigdl(path)
+        m2.evaluate()
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), ref,
+                                   atol=1e-5, err_msg=f"model {i}: {m}")
