@@ -398,56 +398,6 @@ def test_masking():
     assert np.all(out[0, 2] == [3, 0])
 
 
-def test_binary_tree_lstm():
-    """Level-synchronous sweep must equal explicit recursion
-    (reference BinaryTreeLSTM recursiveForward)."""
-    import jax.numpy as jnp
-    np.random.seed(7)
-    # 2-sample batch; sample 0: root(1)=[2,3], leaves 2,3; node 4,5 padding
-    # sample 1: root(1)=[4,5], node4=[2,3] internal, leaves 2,3,5
-    trees = np.zeros((2, 5, 3), np.float32)
-    trees[:, :, 0] = -1
-    trees[0, 0] = [2, 3, -1]
-    trees[0, 1] = [0, 0, 1]
-    trees[0, 2] = [0, 0, 2]
-    trees[1, 0] = [4, 5, -1]
-    trees[1, 3] = [2, 3, 0]
-    trees[1, 1] = [0, 0, 1]
-    trees[1, 2] = [0, 0, 3]
-    trees[1, 4] = [0, 0, 2]
-    words = np.random.randn(2, 3, 4).astype(np.float32)
-    m = nn.BinaryTreeLSTM(4, 6)
-    out = np.asarray(m.forward((words, trees)))
-    assert out.shape == (2, 5, 6)
-    p = m.params
-
-    def leaf(w):
-        return m._leaf(p, jnp.asarray(w))
-
-    # sample 0
-    c2, h2 = leaf(words[0, 0])
-    c3, h3 = leaf(words[0, 1])
-    _, h1 = m._compose(p, c2, h2, c3, h3)
-    assert allclose(out[0, 0], h1, tol=1e-5)
-    assert allclose(out[0, 1], h2, tol=1e-5)
-    assert np.all(out[0, 3] == 0) and np.all(out[0, 4] == 0)
-    # sample 1 (two levels deep)
-    c2, h2 = leaf(words[1, 0])
-    c3, h3 = leaf(words[1, 2])
-    c5, h5 = leaf(words[1, 1])
-    c4, h4 = m._compose(p, c2, h2, c3, h3)
-    _, h1 = m._compose(p, c4, h4, c5, h5)
-    assert allclose(out[1, 0], h1, tol=1e-5)
-    assert allclose(out[1, 3], h4, tol=1e-5)
-    # backward produces grads for inputs
-    g = m.backward((words, trees), np.ones_like(out))
-    assert np.asarray(g[0]).shape == words.shape
-    assert np.isfinite(np.asarray(g[0])).all()
-    # no-gate-output variant
-    m2 = nn.BinaryTreeLSTM(4, 6, gate_output=False)
-    assert m2.forward((words, trees)).shape == (2, 5, 6)
-
-
 @pytest.mark.slow
 def test_inception_v2_shapes():
     from bigdl_tpu.models import Inception_v2_NoAuxClassifier, Inception_v2
@@ -531,63 +481,6 @@ def test_l1_penalty():
         jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(g2), 2.0 / x.size * np.sign(x),
                                rtol=1e-6)
-
-
-def test_recurrent_hoisted_projection_matches_step():
-    # Recurrent scans step_pre when the cell offers precompute (input
-    # projection hoisted out of the loop); must be numerically identical
-    # to the per-step path for every hoistable cell type.
-    import jax
-    import jax.numpy as jnp
-
-    cells = [
-        nn.LSTM(6, 8),
-        nn.GRU(6, 8),
-        nn.RnnCell(6, 8),
-        nn.LSTMPeephole(6, 8),
-        nn.MultiRNNCell([nn.LSTM(6, 8), nn.LSTM(8, 8)]),
-    ]
-    x = jnp.asarray(np.random.RandomState(0).randn(3, 7, 6), np.float32)
-    for cell in cells:
-        rec = nn.Recurrent(cell)
-        p, st = rec.init(jax.random.PRNGKey(0))
-        assert cell.precompute(p["cell"], jnp.moveaxis(x, 1, 0)) is not None
-        y_pre, _ = rec.apply(p, st, x, False, None)
-        # oracle: explicit per-timestep python loop over cell.step
-        h = cell.init_hidden(3, x.dtype)
-        outs = []
-        for t in range(x.shape[1]):
-            out, h = cell.step(p["cell"], x[:, t], h)
-            outs.append(out)
-        y_step = jnp.stack(outs, axis=1)
-        np.testing.assert_allclose(np.asarray(y_pre), np.asarray(y_step),
-                                   atol=1e-5,
-                                   err_msg=type(cell).__name__)
-
-
-def test_maxpool_fast_grad_mode():
-    """grad_mode='fast' (shifted-max tree): identical forward; identical
-    backward on tie-free inputs."""
-    import jax
-    import jax.numpy as jnp
-    rng = np.random.RandomState(0)
-    for fmt, shape in (("NCHW", (2, 3, 11, 13)), ("NHWC", (2, 11, 13, 3))):
-        x = jnp.asarray(rng.rand(*shape) * 10, jnp.float32)  # tie-free
-        for args in ((3, 3, 2, 2, 1, 1), (2, 2, 2, 2, 0, 0),
-                     (3, 2, 1, 2, 0, 1)):
-            exact = nn.SpatialMaxPooling(*args, format=fmt)
-            fast = nn.SpatialMaxPooling(*args, format=fmt, grad_mode="fast")
-            y1 = exact.forward(np.asarray(x))
-            y2 = fast.forward(np.asarray(x))
-            np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
-                                       err_msg=f"{fmt} {args}")
-            p, st = exact.init()
-            g1 = jax.grad(lambda xx: jnp.sum(
-                exact.apply(p, st, xx, False, None)[0] ** 2))(x)
-            g2 = jax.grad(lambda xx: jnp.sum(
-                fast.apply(p, st, xx, False, None)[0] ** 2))(x)
-            np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
-                                       atol=1e-5, err_msg=f"{fmt} {args}")
 
 
 def test_layer_exception_context_notes():
