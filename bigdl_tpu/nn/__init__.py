@@ -69,7 +69,7 @@ from .detection import (Anchor, Nms, PriorBox, Proposal, DetectionOutputSSD,
 from .attention import (Attention, FeedForwardNetwork, Transformer,
                         TransformerBlock, dot_product_attention,
                         flash_attention, position_encoding, causal_mask,
-                        padding_mask)
+                        padding_mask, rotary_embedding)
 from .criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                         CategoricalCrossEntropy, BCECriterion, MSECriterion,
                         AbsCriterion, SmoothL1Criterion,
