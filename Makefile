@@ -8,9 +8,10 @@
 # largest file is ~90 s of single-core work, and full-size model
 # forwards / real-TF cross-validation are @slow (opt-in via
 # BIGDL_TPU_SLOW=1 or `make test-slow`; every component keeps an
-# unmarked smoke-size test). Serial total ~17 min of XLA compiles on
-# one core; a 4-core box lands under ~5 min with `make test`, a 2-core
-# box under ~10 min with NPROC=2.
+# unmarked smoke-size test). Serial total ~18 min of XLA compiles on
+# one core (measured; `make test` with 4 oversubscribed workers on that
+# same 1-core box: 23.5 min); a 4-core box lands around ~5-6 min with
+# `make test`, a 2-core box inside 10 min with NPROC=2.
 PYTEST ?= python -m pytest
 NPROC ?= 4
 
