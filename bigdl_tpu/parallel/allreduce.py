@@ -131,3 +131,32 @@ class AllReduceParameter:
         new_slice, new_state = self.optim.update(gslice, wslice, opt_state, lr)
         new_full = lax.all_gather(new_slice, self.axis, tiled=True)
         return new_full, new_state
+
+
+def sparse_embedding_grad_allreduce(ids, row_grads, vocab_size: int,
+                                    axis: str, mean: bool = True):
+    """Sparsity-aware embedding-gradient aggregation (Parallax,
+    arXiv:1808.02621 — PAPERS.md): data-parallel shards exchange the
+    (token ids, gradient rows) pairs instead of the dense (vocab, H)
+    gradient, then scatter-add locally.
+
+    Wire cost per device: n * B_local * (H + 1) elements over ICI
+    (all_gather of the touched rows) versus vocab * H for a dense psum —
+    the win for recommender/LM embedding tables where the batch touches
+    a tiny fraction of the vocabulary (reference analog: the pyspark
+    LookupTable's sparse gradient path on parameter servers).
+
+    Runs INSIDE shard_map over ``axis``. ids: (B,) int local token ids
+    (flatten (B, T) inputs first); row_grads: (B, H) local per-token
+    gradient rows (dL/d(embed[id])). Returns the aggregated dense
+    (vocab_size, H) gradient, identical on every device — the same
+    result a dense ``psum`` of per-device scatter-adds would give.
+    ``mean=True`` divides by the axis size (matching grad-mean data
+    parallelism)."""
+    all_ids = lax.all_gather(ids.astype(jnp.int32), axis, tiled=True)
+    all_rows = lax.all_gather(row_grads, axis, tiled=True)
+    dense = jnp.zeros((vocab_size, row_grads.shape[-1]),
+                      row_grads.dtype).at[all_ids].add(all_rows)
+    if mean:
+        dense = dense / lax.axis_size(axis)
+    return dense
