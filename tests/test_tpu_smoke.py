@@ -184,8 +184,13 @@ import time, json
 import jax, jax.numpy as jnp, numpy as np
 from bigdl_tpu.models import TransformerLM
 
+import os as _os
+# BIGDL_TPU_SMOKE_KV_HEADS < 16 measures the GQA decode arm (compact
+# caches) through the same driver
+_kvh = int(_os.environ.get("BIGDL_TPU_SMOKE_KV_HEADS", 16))
 model = TransformerLM(vocab_size=32000, hidden_size=1024, num_heads=16,
-                      filter_size=4096, num_layers=12, max_len=1152)
+                      filter_size=4096, num_layers=12, max_len=1152,
+                      num_kv_heads=_kvh if _kvh != 16 else None)
 from bigdl_tpu.utils.amp import bf16_params
 params, _ = model.init(jax.random.PRNGKey(0))
 params = bf16_params(params)
