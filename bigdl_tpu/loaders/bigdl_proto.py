@@ -45,15 +45,23 @@ layer):
    range, so quantized modules round-trip (the analog of the reference's
    ``nn/quantized/QuantSerializer.scala``).
 
-Trust model: the generic tier's pickled-config fallback executes pickle on
-load, exactly like ``Module.load`` — load .bigdl files only from trusted
-sources.
+Trust model: the generic tier's pickled-config fallback runs the pickle VM
+on load. By default ``load_bigdl`` uses a restricted unpickler that only
+resolves bigdl_tpu / numpy / jax / ml_dtypes names (the classes a legitimate
+config can reference), refusing the ``os.system`` / ``builtins.eval`` style
+gadgets a crafted file needs. ``allow_pickle=False`` refuses pickled attrs
+outright (reference-compatible files never carry them — that tier is pure
+protobuf, matching the reference's reflection-only ModuleLoader);
+``allow_pickle="unsafe"`` restores raw pickle for trusted files whose
+configs reference classes outside the whitelist.
 
 Plain containers in either tier store children as ``subModules`` (field 2),
 so a Sequential can mix reference-compatible and native-only layers.
 """
 from __future__ import annotations
 
+import contextvars
+import io
 import pickle
 import struct
 from typing import Dict, List, Optional
@@ -80,6 +88,131 @@ _DT_ARRAY = 15
 # native datatype extension values (outside the reference enum range) —
 # only emitted by the generic tier, never on reference-compatible layers
 _NDT_INT8, _NDT_UINT8, _NDT_BF16, _NDT_F16 = 100, 101, 102, 103
+# Generic-tier float64 (decodes back to f64; the reference DOUBLE enum value
+# keeps its historical load-as-f32 behavior for reference checkpoints).
+_NDT_F64 = 104
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Default unpickler for .bigdl payloads: resolves names from the
+    packages a legitimate generic-tier config can reference, plus
+    user-defined Module/Criterion subclasses from already-imported modules
+    (the generic tier's out-of-package capability). Everything else —
+    os.system, subprocess.*, builtins.eval, numpy's exec-style test
+    helpers, arbitrary callables a pickle REDUCE could invoke — raises
+    UnpicklingError. Restricted mode blocks code execution; it does not
+    make a malicious file fully safe to load (a whitelisted callable could
+    still be REDUCE-invoked with attacker args) — use allow_pickle=False
+    where the reference-compatible tier suffices."""
+    # packages whose own defs may resolve freely (our code, array machinery)
+    _OPEN_PACKAGES = {"bigdl_tpu", "jax", "jaxlib", "ml_dtypes"}
+    # numpy is NOT open (numpy.testing._private.utils.runstring is exec):
+    # only the reconstruction surface pickle actually emits
+    _EXACT = {
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy.core.numeric", "_frombuffer"),
+        ("numpy._core.numeric", "_frombuffer"),
+        ("numpy", "ndarray"), ("numpy", "dtype"),
+        # jax.Array reconstruction (device arrays in pickled param trees)
+        ("jax._src.array", "_reconstruct_array"),
+        ("builtins", "complex"), ("builtins", "set"),
+        ("builtins", "frozenset"), ("builtins", "slice"),
+        ("builtins", "range"), ("builtins", "bytearray"),
+        ("builtins", "object"), ("collections", "OrderedDict"),
+        ("functools", "partial"), ("copyreg", "_reconstructor"),
+    }
+    # numpy scalar types (np.float32, ...), numpy.dtypes dtype classes,
+    # and the umath modules where ufuncs (np.add, ...) live
+    _NUMPY_TYPE_MODULES = {"numpy", "numpy.dtypes",
+                           "numpy.core._multiarray_umath",
+                           "numpy._core._multiarray_umath"}
+
+    def _refuse(self, module, name, why=""):
+        raise pickle.UnpicklingError(
+            f"refusing to unpickle {module}.{name} from a .bigdl file"
+            f"{why}; only bigdl_tpu/jax/ml_dtypes/numpy-array names and "
+            "Module/Criterion subclasses from already-imported modules are "
+            "allowed. If the file is trusted, pass allow_pickle='unsafe' "
+            "to load_bigdl.")
+
+    def _resolve(self, module, name):
+        """Like CPython's find_class, but every step of a dotted name —
+        including the final object — must be a CLASS: module attributes
+        (the protocol-4 STACK_GLOBAL 'pickle.loads' re-export bypass) and
+        methods (Module.load is raw pickle on an attacker path) are both
+        out."""
+        obj = super().find_class(module, name.partition(".")[0])
+        for part in name.split(".")[1:]:
+            if not isinstance(obj, type):
+                self._refuse(module, name,
+                             " (dotted name traverses a non-class)")
+            obj = getattr(obj, part)
+        return obj
+
+    def find_class(self, module, name):
+        import sys
+        top = module.partition(".")[0]
+        if (module, name) in self._EXACT:
+            return super().find_class(module, name)
+        if (module in self._NUMPY_TYPE_MODULES and "." not in name):
+            obj = super().find_class(module, name)
+            # scalar/dtype types and ufuncs (data-only callables a config
+            # like TableOperation(np.add) legitimately references) — but
+            # NOT e.g. np.memmap, an arbitrary file-write primitive
+            if (isinstance(obj, type)
+                    and issubclass(obj, (np.generic, np.dtype))) \
+                    or isinstance(obj, np.ufunc):
+                return obj
+            self._refuse(module, name, " (not a scalar/dtype type/ufunc)")
+        if top in self._OPEN_PACKAGES:
+            obj = self._resolve(module, name)
+            # CLASSES only. Functions are refused outright: the packages'
+            # own loader entry points (load_bigdl, Module.load, File.load,
+            # jnp.load/save) are REDUCE-invocable exec/file primitives,
+            # and a MODULE object would let BUILD rewrite package globals.
+            if not isinstance(obj, type):
+                self._refuse(module, name, " (not a class)")
+            # block foreign re-exports (e.g. `subprocess.Popen` imported
+            # inside an open-package module) from laundering through the
+            # package whitelist
+            owner = getattr(obj, "__module__", None) or ""
+            if owner.partition(".")[0] not in (
+                    self._OPEN_PACKAGES | {"numpy"}):
+                self._refuse(module, name, " (foreign re-export)")
+            return obj
+        # out-of-package Module/Criterion subclasses: only from modules the
+        # process has already imported (no import side effects on behalf of
+        # the attacker)
+        if module in sys.modules:
+            obj = self._resolve(module, name)
+            if isinstance(obj, type) and issubclass(obj,
+                                                    (Module, Criterion)):
+                return obj
+        self._refuse(module, name)
+
+
+# per-call pickle policy, set by load_bigdl: "restricted" (default),
+# False (refuse pickled attrs), or "unsafe" (raw pickle.loads).
+# ContextVar so concurrent load_bigdl calls on different threads can't
+# leak one caller's 'unsafe' into another's default-restricted load.
+_PICKLE_MODE = contextvars.ContextVar("bigdl_pickle_mode",
+                                      default="restricted")
+
+
+def _loads(data: bytes):
+    mode = _PICKLE_MODE.get()
+    if mode == "unsafe":
+        return pickle.loads(data)
+    if mode is False:
+        raise ValueError(
+            "this .bigdl file carries pickled attrs, refused because "
+            "load_bigdl(..., allow_pickle=False); reference-compatible "
+            "files never need pickle — re-save the model or pass "
+            "allow_pickle=True (restricted) / 'unsafe'")
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
 
 
 # ---------------------------------------------------------------------------
@@ -118,8 +251,11 @@ def _tensor_datatype(dtype) -> int:
     return _DT_FLOAT
 
 
-def _enc_storage(data: np.ndarray, sid: int) -> bytes:
+def _enc_storage(data: np.ndarray, sid: int,
+                 keep_dtype: bool = False) -> bytes:
     dt = _tensor_datatype(data.dtype)
+    if dt == _DT_DOUBLE and keep_dtype:
+        dt = _NDT_F64          # generic tier: f64 must round-trip exactly
     out = field_varint(1, dt)
     flat = np.asarray(data).ravel()
     if dt in (_NDT_INT8, _NDT_UINT8):
@@ -130,7 +266,7 @@ def _enc_storage(data: np.ndarray, sid: int) -> bytes:
         out += field_packed_varint(7, [int(v) for v in flat])
     elif dt == _DT_BOOL:
         out += field_packed_varint(4, [int(v) for v in flat])
-    elif dt == _DT_DOUBLE:
+    elif dt in (_DT_DOUBLE, _NDT_F64):
         out += field_bytes(3, np.ascontiguousarray(flat, "<f8").tobytes())
     else:  # FLOAT / BF16 / F16 all travel as f32 floats (exact supersets)
         # numpy serializes the buffer directly — struct.pack with varargs
@@ -147,7 +283,10 @@ def _enc_tensor(arr: np.ndarray, ids: _Ids, keep_dtype: bool = False) -> bytes:
         arr = np.asarray(arr, np.float32)
     sizes = list(arr.shape)
     strides = [int(np.prod(sizes[i + 1:])) for i in range(len(sizes))]
-    out = field_varint(1, _tensor_datatype(arr.dtype))
+    dt = _tensor_datatype(arr.dtype)
+    if dt == _DT_DOUBLE and keep_dtype:
+        dt = _NDT_F64
+    out = field_varint(1, dt)
     for s in sizes:
         out += field_varint(2, s)
     for s in strides:
@@ -157,7 +296,7 @@ def _enc_tensor(arr: np.ndarray, ids: _Ids, keep_dtype: bool = False) -> bytes:
     out += field_varint(6, arr.size)
     if arr.ndim == 0:
         out += field_varint(7, 1)        # isScalar
-    out += field_bytes(8, _enc_storage(arr, ids.take()))
+    out += field_bytes(8, _enc_storage(arr, ids.take(), keep_dtype))
     out += field_varint(9, ids.take())
     return out
 
@@ -966,8 +1105,15 @@ def _resolve_native(mtype: str):
 def _to_jnp_tree(tree):
     import jax
     import jax.numpy as jnp
-    return jax.tree_util.tree_map(
-        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, tree)
+
+    def conv(x):
+        if not isinstance(x, np.ndarray):
+            return x
+        if x.dtype == np.float64 and not jax.config.jax_enable_x64:
+            return x  # jnp.asarray would silently downcast f64 → f32
+        return jnp.asarray(x)
+
+    return jax.tree_util.tree_map(conv, tree)
 
 
 def _cfg_value(val):
@@ -991,7 +1137,7 @@ def _assemble_generic(mod: Dict):
     state: Optional[Dict] = None
 
     if "cfg_pickle" in a:
-        m = pickle.loads(a["cfg_pickle"])
+        m = _loads(a["cfg_pickle"])
     else:
         cls = _resolve_native(mod["moduleType"])
         m = cls.__new__(cls)
@@ -1008,7 +1154,7 @@ def _assemble_generic(mod: Dict):
                 v = _cfg_value(val)
                 setattr(m, key[5:], tuple(v) if isinstance(v, list) else v)
             elif key.startswith("cfgp:"):
-                setattr(m, key[5:], pickle.loads(val))
+                setattr(m, key[5:], _loads(val))
             elif key.startswith("cfg:"):
                 setattr(m, key[4:], _cfg_value(val))
         if isinstance(m, Container):
@@ -1021,7 +1167,7 @@ def _assemble_generic(mod: Dict):
 
     # own params/state from typed attrs (or the pickled-tree fallback)
     if "param_pickle" in a:
-        params = pickle.loads(a["param_pickle"])
+        params = _loads(a["param_pickle"])
     elif mod["hasParameters"] or any(k.startswith(("param:", "paramE:",
                                                    "paramL:"))
                                      for k in a):
@@ -1035,7 +1181,7 @@ def _assemble_generic(mod: Dict):
                 pairs.append((key[7:], _EMPTY_LIST))
         params = _unflatten_pairs(pairs) if pairs else {}
     if "state_pickle" in a:
-        state = pickle.loads(a["state_pickle"])
+        state = _loads(a["state_pickle"])
     else:
         pairs = []
         for key, val in a.items():
@@ -1091,9 +1237,15 @@ def _assemble(mod: Dict):
     return m, p, s
 
 
-def load_bigdl(path_or_bytes):
+def load_bigdl(path_or_bytes, allow_pickle=True):
     """ModuleLoader.loadFromFile parity — builds a bigdl_tpu module (or
-    criterion) from a BigDLModule protobuf, either tier."""
+    criterion) from a BigDLModule protobuf, either tier.
+
+    ``allow_pickle`` governs the generic tier's pickled-attr fallback
+    (see the module docstring's trust model): ``True`` (default) unpickles
+    through a whitelist restricted to bigdl_tpu/numpy/jax/ml_dtypes names,
+    ``False`` refuses pickled attrs entirely (reference-compatible files
+    never carry them), ``"unsafe"`` is raw pickle for trusted files."""
     import jax
     import jax.numpy as jnp
     if isinstance(path_or_bytes, (bytes, bytearray)):
@@ -1101,8 +1253,19 @@ def load_bigdl(path_or_bytes):
     else:
         with open(path_or_bytes, "rb") as f:
             data = f.read()
+    # identity checks: 1 == True / 0 == False would silently pass an `in`
+    if not (allow_pickle is True or allow_pickle is False
+            or allow_pickle == "unsafe"):
+        raise ValueError(
+            f"allow_pickle must be True, False, or 'unsafe', "
+            f"got {allow_pickle!r}")
     mod = decode_bigdl_module(data)
-    m, params, state = _assemble(mod)
+    token = _PICKLE_MODE.set(
+        "restricted" if allow_pickle is True else allow_pickle)
+    try:
+        m, params, state = _assemble(mod)
+    finally:
+        _PICKLE_MODE.reset(token)
     if isinstance(m, Module):
         if params is not None:
             m.params = _to_jnp_tree(params)

@@ -231,7 +231,11 @@ def _convert_layer(layer: Dict, in_channels: Optional[int]):
                                  float(p.get("k", 1.0)))
         return m.set_name(name), in_channels
     if typ == "Concat":
-        return N.JoinTable(2).set_name(name), None  # channels summed by caller
+        # reference Converter fromCaffeConcat honors concat_param.axis
+        # (default 1 = channels); JoinTable is 1-based including batch for
+        # ax >= 0 and takes caffe-style negative axes unchanged
+        ax = int(layer.get("concat_param", {}).get("axis", 1))
+        return N.JoinTable(ax + 1 if ax >= 0 else ax).set_name(name), None
     if typ == "Dropout":
         p = layer.get("dropout_param", {})
         return N.Dropout(float(p.get("dropout_ratio", 0.5))).set_name(name), \
@@ -365,6 +369,14 @@ def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None,
                         "channel count divisible by the top count")
                 step = total // len(tops)
                 points = [step * (i + 1) for i in range(len(tops) - 1)]
+            if total is None and len(points) < len(tops):
+                why = ("axis != 1" if axis != 1
+                       else "channel count of the bottom is untracked")
+                raise ValueError(
+                    f"Slice {layer.get('name')}: the slice-axis extent is "
+                    f"unknown ({why}), so slice_point must give every "
+                    "boundary (len(tops) points) — the last output's "
+                    "extent cannot be derived")
             bounds = [0] + points + ([total] if total is not None else [])
             if len(bounds) < len(tops) + 1:
                 raise ValueError(
@@ -381,7 +393,12 @@ def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None,
             last_top = tops[0] if tops else last_top
             continue
         if typ == "Concat" or typ == 3:
-            in_ch_total = sum(channels.get(b) or 0 for b in bottoms)
+            # channel counts add up only when concatenating ON the channel
+            # axis (1, or -3 on this converter's 4D NCHW blobs); off-axis
+            # concat keeps the bottoms' (common) count
+            cat_ax = int(layer.get("concat_param", {}).get("axis", 1))
+            in_ch_total = sum(channels.get(b) or 0 for b in bottoms) \
+                if cat_ax in (1, -3) else in_ch
         m, out_ch = _convert_layer(layer, in_ch)
         if m is None:
             for t in tops:

@@ -1232,3 +1232,69 @@ def test_t7_lstm_end_to_end(tmp_path):
     assert lstm.torch_typename == "nn.LSTM"
     assert lstm.get("i2g_weight").shape == (20, 6)
     assert lstm.get("o2g_weight").shape == (20, 5)
+
+
+def test_caffe_slice_axis_ne1_with_points_clear_error(tmp_path):
+    """Slice on axis != 1 with explicit slice_point: unsupported (the last
+    output's extent is unknown off the channel axis) — the error must say
+    so instead of a wrong slice_point-count complaint (ADVICE r4)."""
+    proto = """
+input: "data"
+input_dim: 1
+input_dim: 4
+input_dim: 6
+input_dim: 6
+layer { name: "sl" type: "Slice" bottom: "data" top: "s1" top: "s2"
+        slice_param { axis: 2 slice_point: 3 } }
+"""
+    ppath = str(tmp_path / "sl.prototxt")
+    open(ppath, "w").write(proto)
+    with pytest.raises(ValueError, match="axis != 1"):
+        load_caffe(ppath, None, input_channels=4)
+
+
+def test_caffe_slice_axis_ne1_fully_specified_points(tmp_path):
+    """Slice on axis != 1 IS supported when slice_point gives every
+    boundary (len(tops) points) — only the unknown-last-extent case errs."""
+    proto = """
+input: "data"
+input_dim: 1
+input_dim: 4
+input_dim: 6
+input_dim: 6
+layer { name: "sl" type: "Slice" bottom: "data" top: "s1" top: "s2"
+        slice_param { axis: 2 slice_point: 2 slice_point: 6 } }
+layer { name: "cat" type: "Concat" bottom: "s2" bottom: "s1" top: "cat"
+        concat_param { axis: 2 } }
+"""
+    ppath = str(tmp_path / "sl2.prototxt")
+    open(ppath, "w").write(proto)
+    g = load_caffe(ppath, None, input_channels=4).evaluate()
+    x = np.random.RandomState(2).randn(1, 4, 6, 6).astype(np.float32)
+    out = np.asarray(g.forward(x))
+    assert out.shape == (1, 4, 6, 6)
+    np.testing.assert_allclose(
+        out, np.concatenate([x[:, :, 2:6], x[:, :, :2]], axis=2), atol=0)
+
+
+def test_caffe_concat_off_axis_channel_tracking(tmp_path):
+    """Concat on a non-channel axis must NOT sum channel counts — a
+    following Convolution is built with the bottoms' real channel count."""
+    proto = """
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 4
+input_dim: 4
+layer { name: "sl" type: "Slice" bottom: "data" top: "s1" top: "s2"
+        slice_param { axis: 2 slice_point: 2 slice_point: 4 } }
+layer { name: "cat" type: "Concat" bottom: "s2" bottom: "s1" top: "cat"
+        concat_param { axis: 2 } }
+layer { name: "conv" type: "Convolution" bottom: "cat" top: "conv"
+        convolution_param { num_output: 2 kernel_size: 3 } }
+"""
+    ppath = str(tmp_path / "cc.prototxt")
+    open(ppath, "w").write(proto)
+    g = load_caffe(ppath, None, input_channels=3).evaluate()
+    x = np.random.RandomState(3).randn(1, 3, 4, 4).astype(np.float32)
+    assert np.asarray(g.forward(x)).shape == (1, 2, 2, 2)

@@ -194,6 +194,9 @@ class _DtypeBag(Module):
             "b": jnp.asarray(np.array([True, False])),
             "f16": jnp.asarray(np.array([1.5, -2.25], np.float16)),
             "bf16": jnp.asarray(np.array([0.5, -3.0], ml_dtypes.bfloat16)),
+            # plain-numpy f64 leaf: the generic tier must restore it as
+            # exact float64 (_NDT_F64), not the reference DOUBLE→f32 path
+            "f64": np.array([1e-300, 2.5, -7.125], np.float64),
             "scalar": jnp.float32(2.5),
         }
 
@@ -296,3 +299,194 @@ def test_proto_random_composition_fuzz(tmp_path):
         m2.evaluate()
         np.testing.assert_allclose(np.asarray(m2.forward(x)), ref,
                                    atol=1e-5, err_msg=f"model {i}: {m}")
+
+
+# ---------------------------------------------------------------------------
+# pickle trust model (r5 — ADVICE r4 medium finding)
+# ---------------------------------------------------------------------------
+
+
+class _EvilReduce:
+    """Pickles to a REDUCE that would invoke os.system on load."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def __reduce__(self):
+        import os
+        return (os.system, (f"touch {self.path}",))
+
+
+def _crafted_generic_module(attrs):
+    """Minimal generic-tier BigDLModule wire bytes with the given custom
+    (bytes-payload) attrs — what an attacker-controlled .bigdl file is."""
+    from bigdl_tpu.loaders import bigdl_proto as BP
+    from bigdl_tpu.loaders.wire import field_bytes, field_string
+    out = field_string(
+        7, BP._NATIVE_PREFIX + "bigdl_tpu.nn.elementwise.Identity")
+    for k, blob in attrs.items():
+        entry = field_string(1, k) + field_bytes(2, BP._attr_custom(blob))
+        out += field_bytes(8, entry)
+    return out
+
+
+@pytest.mark.parametrize("attr", ["cfg_pickle", "param_pickle",
+                                  "state_pickle", "cfgp:frob"])
+def test_load_refuses_os_system_gadget(attr, tmp_path):
+    """A crafted .bigdl file whose pickled attr REDUCEs to os.system must
+    raise, not execute (default restricted unpickler)."""
+    import pickle as _p
+    marker = tmp_path / "pwned"
+    data = _crafted_generic_module({attr: _p.dumps(_EvilReduce(marker))})
+    with pytest.raises(Exception, match="refusing to unpickle"):
+        load_bigdl(data)
+    assert not marker.exists(), "gadget executed!"
+
+
+def test_allow_pickle_false_refuses_pickled_attrs(tmp_path):
+    """allow_pickle=False refuses any pickled attr with a clear error, and
+    'unsafe' still loads the (benign) file."""
+    m = _TupleTree()
+    m.ensure_initialized()
+    path = str(tmp_path / "t.bigdl")
+    save_bigdl(m, path)  # tuple treedef rides the pickle fallback
+    with pytest.raises(ValueError, match="allow_pickle=False"):
+        load_bigdl(path, allow_pickle=False)
+    m2 = load_bigdl(path, allow_pickle="unsafe")
+    _tree_equal(m.params, m2.params, "_TupleTree-unsafe")
+
+
+def test_allow_pickle_false_loads_reference_tier(tmp_path):
+    """Reference-compatible files never carry pickle — allow_pickle=False
+    must load them unchanged (the reference ModuleLoader trust model)."""
+    m = N.Sequential(N.Linear(6, 5), N.ReLU())
+    m.ensure_initialized()
+    path = str(tmp_path / "ref.bigdl")
+    save_bigdl(m, path)
+    m2 = load_bigdl(path, allow_pickle=False)
+    x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    m.evaluate(), m2.evaluate()
+    np.testing.assert_allclose(np.asarray(m2.forward(x)),
+                               np.asarray(m.forward(x)), atol=1e-6)
+
+
+def test_restricted_unpickler_allows_user_module_subclass(tmp_path):
+    """Out-of-package Module subclasses (this test module) still load under
+    the default restricted policy — the generic tier's documented scope."""
+    m = _TupleTree()
+    m.ensure_initialized()
+    path = str(tmp_path / "user.bigdl")
+    save_bigdl(m, path)
+    m2 = load_bigdl(path)  # default: restricted
+    assert isinstance(m2, _TupleTree)
+    _tree_equal(m.params, m2.params, "_TupleTree-restricted")
+
+
+def _su(s):
+    """Pickle SHORT_BINUNICODE opcode for a short string."""
+    b = s.encode() if isinstance(s, str) else s
+    return b"\x8c" + bytes([len(b)]) + b
+
+
+def _sb(b):
+    """Pickle SHORT_BINBYTES / BINBYTES opcode."""
+    return (b"C" + bytes([len(b)]) if len(b) < 256
+            else b"B" + len(b).to_bytes(4, "little")) + b
+
+
+def _stack_global_pickle(module, name, arg_pickle):
+    """Hand-built protocol-4 stream: STACK_GLOBAL(module, name) REDUCEd on
+    one bytes arg — the dotted-name re-export bypass shape."""
+    return (b"\x80\x04" + _su(module) + _su(name) + b"\x93"
+            + _sb(arg_pickle) + b"\x85R.")
+
+
+def test_load_refuses_stack_global_reexport_bypass(tmp_path):
+    """Protocol-4 STACK_GLOBAL with a dotted name must not reach module
+    attributes of whitelisted packages (e.g. the `pickle` module imported
+    inside bigdl_tpu.loaders.bigdl_proto → pickle.loads → raw unpickle)."""
+    import pickle as _p
+    marker = tmp_path / "pwned2"
+    inner = _p.dumps(_EvilReduce(marker))
+    evil = _stack_global_pickle(
+        "bigdl_tpu.loaders.bigdl_proto", "pickle.loads", inner)
+    data = _crafted_generic_module({"cfg_pickle": evil})
+    with pytest.raises(Exception, match="refusing to unpickle"):
+        load_bigdl(data)
+    assert not marker.exists(), "dotted-name bypass executed!"
+
+
+def test_load_refuses_numpy_exec_helper(tmp_path):
+    """numpy is not an open package: its exec-style helpers
+    (numpy.testing._private.utils.runstring) must be refused."""
+    code = _su("import os; os.system('false')")
+    evil = (b"\x80\x04" + _su("numpy.testing._private.utils")
+            + _su("runstring") + b"\x93" + code + b"}\x86R.")
+    data = _crafted_generic_module({"cfg_pickle": evil})
+    with pytest.raises(Exception, match="refusing to unpickle"):
+        load_bigdl(data)
+
+
+def test_load_refuses_numpy_memmap_file_write(tmp_path):
+    """numpy.memmap is a file-write primitive — the numpy-types branch must
+    admit only scalar/dtype types."""
+    victim = tmp_path / "victim.bin"
+    victim.write_bytes(b"AAAAAAAA")
+    evil = (b"\x80\x04" + _su("numpy") + _su("memmap") + b"\x93"
+            + _su(str(victim)) + b"\x85R.")
+    data = _crafted_generic_module({"cfg_pickle": evil})
+    with pytest.raises(Exception, match="refusing to unpickle"):
+        load_bigdl(data)
+    assert victim.read_bytes() == b"AAAAAAAA"
+
+
+def test_load_refuses_module_object_resolution():
+    """Resolving a MODULE object through an open package would let BUILD
+    rewrite package globals — must be refused (classes/callables only)."""
+    evil = b"\x80\x04" + _su("bigdl_tpu") + _su("loaders") + b"\x93."
+    data = _crafted_generic_module({"cfg_pickle": evil})
+    with pytest.raises(Exception, match="refusing to unpickle"):
+        load_bigdl(data)
+    import bigdl_tpu.loaders
+    assert bigdl_tpu.loaders.bigdl_proto is not None
+
+
+def test_load_refuses_loader_reentry_laundering(tmp_path):
+    """load_bigdl itself must not be REDUCE-invocable: a crafted file could
+    otherwise re-enter load_bigdl(<inner bytes>, 'unsafe') and run raw
+    pickle. Functions are refused wholesale from open packages."""
+    import pickle as _p
+    marker = tmp_path / "pwned3"
+    inner = _crafted_generic_module({"cfg_pickle":
+                                     _p.dumps(_EvilReduce(marker))})
+
+    evil = (b"\x80\x04" + _su("bigdl_tpu.loaders.bigdl_proto")
+            + _su("load_bigdl") + b"\x93" + _sb(inner) + _su("unsafe")
+            + b"\x86R.")
+    data = _crafted_generic_module({"cfg_pickle": evil})
+    with pytest.raises(Exception, match="refusing to unpickle"):
+        load_bigdl(data)
+    assert not marker.exists(), "loader re-entry executed!"
+
+
+def test_allow_pickle_rejects_ambiguous_values():
+    """Falsy-but-not-False values (0, None) must not silently mean
+    'restricted' — only True/False/'unsafe' are accepted."""
+    for bad in (0, None, 1, "restricted"):
+        with pytest.raises(ValueError, match="allow_pickle must be"):
+            load_bigdl(b"", allow_pickle=bad)
+
+
+def test_ufunc_config_roundtrips_under_restricted(tmp_path):
+    """A config holding a numpy ufunc (TableOperation(np.add) style) must
+    load under the default restricted policy — ufuncs are data-only."""
+    m = N.TableOperation(np.add) if hasattr(N, "TableOperation") else None
+    if m is None:
+        pytest.skip("no TableOperation")
+    path = str(tmp_path / "uf.bigdl")
+    save_bigdl(m, path)
+    m2 = load_bigdl(path)
+    a = np.ones((2, 3), np.float32)
+    from bigdl_tpu.utils import Table
+    np.testing.assert_allclose(np.asarray(m2.forward(Table(a, a))),
+                               2 * a, atol=0)
