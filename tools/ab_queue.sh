@@ -21,37 +21,51 @@ run() {
   fi
 }
 echo "## A/B queue run $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$LOG"
-# 1. LM remat arms: the --all sweep runs auto (remat=0 when it fits), so
-# pin remat=1 here to complete the A/B pair
-run "lm remat=1 (pinned)" secondary:transformer BENCH_LM_REMAT=1
-# 2. LM bigger batch under remat (more MXU work per layer-scan step)
-run "lm B32 remat=1" secondary:transformer BENCH_LM_BATCH=32 BENCH_LM_REMAT=1
-# 3. ResNet fused=xla at batch 512 (batch-512 was -5% on the UNFUSED path)
-run "resnet fused=xla B512" headline BENCH_BATCH=512 BENCH_STEPS=10
-# 4. realdata with the loop_epochs + fast-IDCT prefetcher fixes
-run "realdata post-fix" secondary:realdata
-# 5. flash kernel tile sweep at the LM bench shapes
-run "lm flash q256 k512" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=256 BIGDL_TPU_FLASH_BLOCK_K=512
-run "lm flash q512 k1024" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=512 BIGDL_TPU_FLASH_BLOCK_K=1024
-# 6. remat OFF + batch 32 (if remat=0 fits, bigger batch may too)
-run "lm remat=0 B32" secondary:transformer BENCH_LM_REMAT=0 BENCH_LM_BATCH=32
-# 6a. grouped-query attention decode arm (4x smaller KV cache)
-run "decode gqa kv4" secondary:decode BENCH_DECODE_KV_HEADS=4
-# 6b. ADVICE r3: does the in-step wq/wk/wv concat cost anything on-chip?
-run "lm fused_qkv=0 (three-dot)" secondary:transformer BIGDL_TPU_FUSED_QKV=0
-# 7. layout-preserving Pallas bottleneck vs the winning fused=xla arm,
-# with a block_n sweep (VMEM-residency vs N-tiling DMA tradeoff)
+# ---- r5 triage: the watcher runs `bench.py --all` live BEFORE this queue
+# (that sweep alone clears the 3-round measurement debt: headline, LM
+# dtype-overhaul number, decode/moe/realdata first captures). The queue
+# below is ordered so a SHORT window still decides the big open questions
+# first; long-tail sweeps come last.
+
+# 1. THE decider: layout-preserving NHWC Pallas bottleneck vs fused=xla
+# (r3 measured pallas LOSING 1089/1377 vs 2441 img/s on the NCHW arm; this
+# kernel is the round-4 rewrite that was never measured). If it loses too,
+# delete the kernel from the bench path (VERDICT r4: no zombie levers).
 run "resnet fused=pallas(nhwc)" headline BENCH_FUSED=pallas
 run "resnet fused=pallas(nhwc) bn256" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=256
 run "resnet fused=pallas(nhwc) bn128" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=128
-# 8. space-to-depth stem on top of the fused=xla win (was neutral unfused)
+
+# 2. first-ever GQA decode number (roofline predicts ~1.28x over MHA;
+# the decode child also reports the int8 weight-only ratio)
+run "decode gqa kv4" secondary:decode BENCH_DECODE_KV_HEADS=4
+
+# 3. LM A/B pair completion (the --all sweep runs remat=auto; pin remat=1)
+run "lm remat=1 (pinned)" secondary:transformer BENCH_LM_REMAT=1
+run "lm B32 remat=1" secondary:transformer BENCH_LM_BATCH=32 BENCH_LM_REMAT=1
+run "lm remat=0 B32" secondary:transformer BENCH_LM_REMAT=0 BENCH_LM_BATCH=32
+
+# 4. realdata post-fix focus run (target input_wait_frac < 0.15)
+run "realdata post-fix" secondary:realdata
+
+# 5. TPU smoke: does the Pallas flash kernel really engage under a2a
+# shard_map on-chip? (VERDICT r4 weak #5)
+echo "### tpu smoke a2a+flash ($(date -u +%H:%M:%SZ))" >> "$LOG"
+timeout 960 env BIGDL_TPU_SMOKE=1 python -m pytest \
+  tests/test_tpu_smoke.py -q -k a2a -s >> "$LOG" 2>&1 \
+  || echo "a2a smoke FAILED rc=$?" >> "$LOG"
+
+# 6. long-tail arms
+run "resnet fused=xla B512" headline BENCH_BATCH=512 BENCH_STEPS=10
+run "lm flash q256 k512" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=256 BIGDL_TPU_FLASH_BLOCK_K=512
+run "lm flash q512 k1024" secondary:transformer BIGDL_TPU_FLASH_BLOCK_Q=512 BIGDL_TPU_FLASH_BLOCK_K=1024
+run "lm fused_qkv=0 (three-dot)" secondary:transformer BIGDL_TPU_FUSED_QKV=0
 run "resnet fused=xla s2d" headline BENCH_STEM=s2d
-# 9. where does the fused=xla resnet step spend time now?
+
+# 7. xplane profiles (per-op attribution for the next kernel iteration)
 echo "### profile fused=xla ($(date -u +%H:%M:%SZ))" >> "$LOG"
 timeout 900 python tools/profile_resnet.py > /tmp/profile_fused.out 2>&1 \
   && tail -30 /tmp/profile_fused.out >> "$LOG" \
   || echo "profile FAILED rc=$?" >> "$LOG"
-# 10. and the LM step (38.9% vs ~78% roofline — per-op attribution)
 echo "### profile lm ($(date -u +%H:%M:%SZ))" >> "$LOG"
 timeout 900 python tools/profile_lm.py > /tmp/profile_lm.out 2>&1 \
   && tail -30 /tmp/profile_lm.out >> "$LOG" \
