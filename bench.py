@@ -407,6 +407,15 @@ def _cache_tpu_lines(lines):
         os.replace(tmp, _TPU_CACHE)  # atomic: no torn cache on crash
     except (OSError, ValueError, KeyError):
         pass  # a failed cache update must never fail the bench itself
+    else:
+        try:  # the cache writer owns README consistency (test_docs.py
+            # fails CI if the tables drift from the cache)
+            subprocess.run([sys.executable,
+                            os.path.join(os.path.dirname(_TPU_CACHE),
+                                         "tools", "gen_readme_perf.py")],
+                           capture_output=True, timeout=60)
+        except Exception:
+            pass
 
 
 def _cached_tpu_lines(which, max_age_days: float = 14.0):

@@ -24,3 +24,4 @@ Conv1D = Convolution1D
 Conv3D = Convolution3D
 from .converter import (model_from_json, load_keras, load_weights,
                         load_weights_hdf5)
+from .backend import KerasModelWrapper, with_bigdl_backend

@@ -1,0 +1,350 @@
+"""Cross-layer fused residual-epilogue + next-conv Pallas kernel.
+
+docs/MFU_ROOFLINE.md's open claim: ResNet stages 0-1 are HBM-bound "even
+under perfect [per-layer] fusion" — the traffic is "irreducible without
+cross-LAYER fusion". This kernel is that fusion, at the bottleneck
+JUNCTION (the widest tensor in the network):
+
+    out_n   = relu(z3 * a3 + b3 + shortcut)      # block n's epilogue
+    z1_next = out_n @ w1_next (+ stats epilogue)  # block n+1's 1x1 reduce
+
+XLA runs these as an elementwise pass (read z3 + shortcut, write out) and
+a separate matmul (re-read out). Fused, the (B, H, W, 4*nmid) ``out``
+tensor is produced in VMEM, consumed by the matmul in VMEM, and written
+to HBM exactly once (it is still needed later as block n+1's residual) —
+eliminating one full HBM read of the widest activation per junction, in
+the stages the roofline pins as bandwidth-bound. The next conv is the
+REDUCE 1x1 (N = nmid ≤ 512), so a single N tile always suffices and the
+``out`` block is written exactly once per grid step.
+
+Layout-preserving NHWC blocks like ``fused_matmul._fwd4`` (the flattened
+form's relayout copies measured ~1.7x of the whole step on-chip); same
+bf16-contraction / f32-affine-and-stats dtype contract; forward and both
+backward passes are Pallas kernels under ``jax.custom_vjp`` with the
+x_hat rematerialisation + stats-gradient injection scheme of
+``fused_matmul``.
+
+Reference analog: cross-layer fusion is the step past the reference's
+``nn/mkldnn`` per-layer post-ops (SpatialConvolution.scala fuses
+conv+bn+relu; nothing there fuses ACROSS the residual junction).
+Used by ``models/resnet.py`` ``FusedBottleneckChain``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .fused_matmul import _mm, _VMEM_BUDGET, _divisors_desc
+
+
+def residual_chain_reference(z, r, a, b, w, stats=True):
+    """Plain-jnp oracle: (h, z_out, s1, s2) with identical math."""
+    u = (z.astype(jnp.float32) * a.astype(jnp.float32)
+         + b.astype(jnp.float32) + r.astype(jnp.float32))
+    h = jnp.maximum(u, 0.0).astype(z.dtype)
+    zo = jax.lax.dot_general(h, w, (((h.ndim - 1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32
+                             ).astype(z.dtype)
+    if stats:
+        zf = zo.astype(jnp.float32)
+        red = tuple(range(zo.ndim - 1))
+        return h, zo, jnp.sum(zf, red), jnp.sum(zf * zf, red)
+    return h, zo, None, None
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _cfwd_kernel(z_ref, r_ref, a_ref, b_ref, w_ref, h_ref, zo_ref,
+                 s1_ref, s2_ref, acc1, acc2, *, nb, nh, stats):
+    ib = pl.program_id(0)
+    ih = pl.program_id(1)   # innermost sequential
+
+    if stats:
+        @pl.when(jnp.logical_and(ib == 0, ih == 0))
+        def _init():
+            acc1[:] = jnp.zeros_like(acc1)
+            acc2[:] = jnp.zeros_like(acc2)
+
+    zb = z_ref[...]
+    bb, bh, W, K = zb.shape
+    u = (zb.reshape(bb * bh * W, K).astype(jnp.float32)
+         * a_ref[...].astype(jnp.float32)
+         + b_ref[...].astype(jnp.float32)
+         + r_ref[...].reshape(bb * bh * W, K).astype(jnp.float32))
+    h = jnp.maximum(u, 0.0).astype(z_ref.dtype)
+    h_ref[...] = h.reshape(bb, bh, W, K)
+    zo = _mm(h, w_ref[...])                      # (rows, N) f32 accum
+    zo_ref[...] = zo.reshape(bb, bh, W, -1).astype(zo_ref.dtype)
+
+    if stats:
+        acc1[:] += jnp.sum(zo, axis=0, keepdims=True)
+        acc2[:] += jnp.sum(zo * zo, axis=0, keepdims=True)
+
+        @pl.when(jnp.logical_and(ib == nb - 1, ih == nh - 1))
+        def _finish():
+            s1_ref[...] = acc1[:]
+            s2_ref[...] = acc2[:]
+
+
+def _cfwd(z, r, a, b, w, stats, block_b, block_h, interpret):
+    B, H, W, K = z.shape
+    N = w.shape[1]
+    nb, nh = B // block_b, H // block_h
+    a2, b2 = a.reshape(1, K), b.reshape(1, K)
+
+    kernel = functools.partial(_cfwd_kernel, nb=nb, nh=nh, stats=stats)
+    h, zo, s1, s2 = pl.pallas_call(
+        kernel,
+        grid=(nb, nh),
+        in_specs=[
+            pl.BlockSpec((block_b, block_h, W, K),
+                         lambda ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((block_b, block_h, W, K),
+                         lambda ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((1, K), lambda ib, ih: (0, 0)),
+            pl.BlockSpec((1, K), lambda ib, ih: (0, 0)),
+            pl.BlockSpec((K, N), lambda ib, ih: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_b, block_h, W, K),
+                         lambda ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((block_b, block_h, W, N),
+                         lambda ib, ih: (ib, ih, 0, 0)),
+            pl.BlockSpec((1, N), lambda ib, ih: (0, 0)),
+            pl.BlockSpec((1, N), lambda ib, ih: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, W, K), z.dtype),
+            jax.ShapeDtypeStruct((B, H, W, N), z.dtype),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, N), jnp.float32),
+                        pltpu.VMEM((1, N), jnp.float32)],
+        interpret=interpret,
+    )(z, r, a2, b2, w)
+    return h, zo, s1[0], s2[0]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _cbwd_dx_kernel(z_ref, r_ref, a_ref, b_ref, w_ref, dh_ref, dzo_ref,
+                    zo_ref, ds1_ref, ds2_ref, dz_ref, dr_ref, da_ref,
+                    db_ref, acc_da, acc_db, *, nb, nh, stats):
+    ib = pl.program_id(0)
+    ih = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(ib == 0, ih == 0))
+    def _init():
+        acc_da[:] = jnp.zeros_like(acc_da)
+        acc_db[:] = jnp.zeros_like(acc_db)
+
+    zb = z_ref[...]
+    bb, bh, W, K = zb.shape
+    N = dzo_ref.shape[-1]
+    rows = bb * bh * W
+    dzo = dzo_ref[...].reshape(rows, N)
+    if stats:
+        zo = zo_ref[...].reshape(rows, N).astype(jnp.float32)
+        dzo = (dzo.astype(jnp.float32)
+               + ds1_ref[...].astype(jnp.float32)
+               + 2.0 * zo * ds2_ref[...].astype(jnp.float32))
+        dzo = dzo.astype(dzo_ref.dtype)
+    dh_mm = _mm(dzo, w_ref[...].T)               # (rows, K) f32 accum
+    zf = zb.reshape(rows, K).astype(jnp.float32)
+    af = a_ref[...].astype(jnp.float32)
+    u = (zf * af + b_ref[...].astype(jnp.float32)
+         + r_ref[...].reshape(rows, K).astype(jnp.float32))
+    g = jnp.where(u > 0.0,
+                  dh_mm + dh_ref[...].reshape(rows, K).astype(jnp.float32),
+                  0.0)
+    dz_ref[...] = (g * af).reshape(bb, bh, W, K).astype(dz_ref.dtype)
+    dr_ref[...] = g.reshape(bb, bh, W, K).astype(dr_ref.dtype)
+    acc_da[:] += jnp.sum(g * zf, axis=0, keepdims=True)
+    acc_db[:] += jnp.sum(g, axis=0, keepdims=True)
+
+    @pl.when(jnp.logical_and(ib == nb - 1, ih == nh - 1))
+    def _finish():
+        da_ref[...] = acc_da[:]
+        db_ref[...] = acc_db[:]
+
+
+def _cbwd_dw_kernel(z_ref, r_ref, a_ref, b_ref, dzo_ref, zo_ref, ds1_ref,
+                    ds2_ref, dw_ref, acc, *, nb, nh, stats):
+    ib = pl.program_id(0)
+    ih = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(ib == 0, ih == 0))
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    zb = z_ref[...]
+    bb, bh, W, K = zb.shape
+    N = dzo_ref.shape[-1]
+    rows = bb * bh * W
+    u = (zb.reshape(rows, K).astype(jnp.float32)
+         * a_ref[...].astype(jnp.float32)
+         + b_ref[...].astype(jnp.float32)
+         + r_ref[...].reshape(rows, K).astype(jnp.float32))
+    h = jnp.maximum(u, 0.0).astype(z_ref.dtype)
+    dzo = dzo_ref[...].reshape(rows, N)
+    if stats:
+        zo = zo_ref[...].reshape(rows, N).astype(jnp.float32)
+        dzo = (dzo.astype(jnp.float32)
+               + ds1_ref[...].astype(jnp.float32)
+               + 2.0 * zo * ds2_ref[...].astype(jnp.float32))
+        dzo = dzo.astype(dzo_ref.dtype)
+    acc[:] += _mm(h, dzo, ta=True)               # (K, N) f32 accum
+
+    @pl.when(jnp.logical_and(ib == nb - 1, ih == nh - 1))
+    def _finish():
+        dw_ref[...] = acc[:].astype(dw_ref.dtype)
+
+
+def _cbwd(stats, block_b, block_h, interpret, res, grads):
+    z, r, a, b, w, zo = res
+    dh, dzo, ds1, ds2 = grads
+    B, H, W, K = z.shape
+    N = w.shape[1]
+    nb, nh = B // block_b, H // block_h
+    dh = dh.astype(z.dtype)
+    dzo = dzo.astype(z.dtype)
+    zz = zo if stats else jnp.zeros((B, H, W, N), z.dtype)
+    ds1r = (ds1.reshape(1, N).astype(jnp.float32) if stats
+            else jnp.zeros((1, N), jnp.float32))
+    ds2r = (ds2.reshape(1, N).astype(jnp.float32) if stats
+            else jnp.zeros((1, N), jnp.float32))
+    a2, b2 = a.reshape(1, K), b.reshape(1, K)
+
+    dx_kernel = functools.partial(_cbwd_dx_kernel, nb=nb, nh=nh,
+                                  stats=stats)
+    tile4 = lambda ib, ih: (ib, ih, 0, 0)  # noqa: E731
+    whole2 = lambda ib, ih: (0, 0)         # noqa: E731
+    dz, dr, da, db = pl.pallas_call(
+        dx_kernel,
+        grid=(nb, nh),
+        in_specs=[
+            pl.BlockSpec((block_b, block_h, W, K), tile4),
+            pl.BlockSpec((block_b, block_h, W, K), tile4),
+            pl.BlockSpec((1, K), whole2),
+            pl.BlockSpec((1, K), whole2),
+            pl.BlockSpec((K, N), whole2),
+            pl.BlockSpec((block_b, block_h, W, K), tile4),
+            pl.BlockSpec((block_b, block_h, W, N), tile4),
+            pl.BlockSpec((block_b, block_h, W, N), tile4),
+            pl.BlockSpec((1, N), whole2),
+            pl.BlockSpec((1, N), whole2),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_b, block_h, W, K), tile4),
+            pl.BlockSpec((block_b, block_h, W, K), tile4),
+            pl.BlockSpec((1, K), whole2),
+            pl.BlockSpec((1, K), whole2),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, W, K), z.dtype),
+            jax.ShapeDtypeStruct((B, H, W, K), z.dtype),
+            jax.ShapeDtypeStruct((1, K), jnp.float32),
+            jax.ShapeDtypeStruct((1, K), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, K), jnp.float32),
+                        pltpu.VMEM((1, K), jnp.float32)],
+        interpret=interpret,
+    )(z, r, a2, b2, w, dh, dzo, zz, ds1r, ds2r)
+
+    dw_kernel = functools.partial(_cbwd_dw_kernel, nb=nb, nh=nh,
+                                  stats=stats)
+    dw = pl.pallas_call(
+        dw_kernel,
+        grid=(nb, nh),
+        in_specs=[
+            pl.BlockSpec((block_b, block_h, W, K), tile4),
+            pl.BlockSpec((block_b, block_h, W, K), tile4),
+            pl.BlockSpec((1, K), whole2),
+            pl.BlockSpec((1, K), whole2),
+            pl.BlockSpec((block_b, block_h, W, N), tile4),
+            pl.BlockSpec((block_b, block_h, W, N), tile4),
+            pl.BlockSpec((1, N), whole2),
+            pl.BlockSpec((1, N), whole2),
+        ],
+        out_specs=pl.BlockSpec((K, N), whole2),
+        out_shape=jax.ShapeDtypeStruct((K, N), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((K, N), jnp.float32)],
+        interpret=interpret,
+    )(z, r, a2, b2, dzo, zz, ds1r, ds2r)
+
+    return (dz, dr, da[0].astype(a.dtype), db[0].astype(b.dtype),
+            dw.astype(w.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _chain(z, r, a, b, w, stats, block_b, block_h, interpret):
+    return _cfwd(z, r, a, b, w, stats, block_b, block_h, interpret)
+
+
+def _chain_fwd(z, r, a, b, w, stats, block_b, block_h, interpret):
+    h, zo, s1, s2 = _cfwd(z, r, a, b, w, stats, block_b, block_h,
+                          interpret)
+    return (h, zo, s1, s2), (z, r, a, b, w, zo if stats else None)
+
+
+def _chain_bwd(stats, block_b, block_h, interpret, res, grads):
+    return _cbwd(stats, block_b, block_h, interpret, res, grads)
+
+
+_chain.defvjp(_chain_fwd, _chain_bwd)
+
+
+def _chain_vmem_need(rows, K, N, eb):
+    """Worst-case scoped-VMEM across the three pallas_calls (x2 for
+    double-buffered grid-varying blocks; f32 temps dominate in-register
+    so the model charges HBM-block bytes only, like fused_matmul's)."""
+    fwd = 2 * rows * eb * (3 * K + N) + K * N * eb + 4 * N * 4
+    dx = 2 * rows * (eb * 5 * K + N * (2 * eb + 4)) + K * N * eb
+    dw = 2 * rows * (eb * 2 * K + N * (2 * eb + 4)) + 2 * K * N * 4
+    return max(fwd, dx, dw)
+
+
+def fused_residual_matmul_nhwc(z, r, w, scale, bias, *, stats=True,
+                               interpret=False):
+    """relu(z*scale + bias + r) fused with the next 1x1 conv.
+
+    z, r: (B, H, W, K) NHWC (block-n conv3 output and its shortcut);
+    w: (K, N) next block's 1x1-reduce weight; scale/bias: BN3's
+    per-channel affine. Returns ``(h, z_next, s1, s2)`` where ``h`` is
+    block n's output (the next residual) written to HBM exactly once.
+    Returns None when no (block_b, block_h) fits the VMEM budget —
+    callers fall back to the unchained epilogue + conv pair.
+    """
+    B, H, W, K = z.shape
+    N = w.shape[1]
+    eb = z.dtype.itemsize
+
+    def _fits(rows):
+        return _chain_vmem_need(rows, K, N, eb) <= _VMEM_BUDGET
+
+    pick = None
+    for bb in _divisors_desc(B, 64):
+        if _fits(bb * H * W):
+            pick = (bb, H)
+            break
+    if pick is None:
+        for bh in _divisors_desc(H, H)[1:]:
+            if _fits(1 * bh * W):
+                pick = (1, bh)
+                break
+    if pick is None:
+        return None
+    bb, bh = pick
+    return _chain(z, r, scale, bias, w, bool(stats), int(bb), int(bh),
+                  bool(interpret))

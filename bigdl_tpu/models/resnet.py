@@ -277,18 +277,24 @@ class FusedBottleneck(_Module):
         b = beta - mean * a
         return a, b, new
 
-    def _apply(self, params, state, x, training, rng):
-        B, H, W, _ = x.shape
-        dt = x.dtype
+    def _conv1(self, params, x, training):
+        """Block entry: the 1x1 reduce conv (+ BN1's stats epilogue)."""
+        w1 = params["w1"].reshape(self.nin, self.nmid).astype(x.dtype)
+        return self._mm(x, w1, None, None, relu=False, stats=training)
+
+    def _body(self, params, state, z1, s11, s12, x_short, training):
+        """From conv1's output to the pre-epilogue pieces: returns
+        ``(z3, a3, b3, short, new_state)`` — everything block n
+        contributes to ``out = relu(z3*a3 + b3 + short)``. Split out so
+        :class:`FusedBottleneckChain` can fuse that epilogue with the
+        NEXT block's conv1 in one cross-layer Pallas kernel."""
+        B, H, W, _ = z1.shape
+        dt = z1.dtype
         new_state = {}
 
         def cast(v):
             return v.astype(dt)
 
-        # conv1 (1x1): plain input, fused output stats for BN1
-        w1 = cast(params["w1"].reshape(self.nin, self.nmid))
-        z1, s11, s12 = self._mm(x, w1, None, None, relu=False,
-                                stats=training)
         a1, b1, new_state["bn1"] = self._bn_affine(
             params, state, "bn1", s11, s12, B * H * W, training)
         # BN1+ReLU materialises once (the 3x3 conv needs a spatial tensor)
@@ -321,9 +327,9 @@ class FusedBottleneck(_Module):
         # shortcut
         if self.project:
             if self.stride != 1:
-                xs = x[:, ::self.stride, ::self.stride, :]
+                xs = x_short[:, ::self.stride, ::self.stride, :]
             else:
-                xs = x
+                xs = x_short
             wp = cast(params["proj_w"].reshape(self.nin, self.nout))
             zp, sp1, sp2 = self._mm(xs, wp, None, None, relu=False,
                                     stats=training)
@@ -331,10 +337,96 @@ class FusedBottleneck(_Module):
                 params, state, "proj_bn", sp1, sp2, m2, training)
             short = zp * cast(ap) + cast(bp)
         else:
-            short = x
+            short = x_short
+        return z3, a3, b3, short, new_state
 
+    def _apply(self, params, state, x, training, rng):
+        z1, s11, s12 = self._conv1(params, x, training)
+        z3, a3, b3, short, new_state = self._body(
+            params, state, z1, s11, s12, x, training)
         # BN3 + residual add + ReLU: one fused XLA elementwise pass
-        out = jnp.maximum(z3 * cast(a3) + cast(b3) + short, 0)
+        out = jnp.maximum(z3 * a3.astype(x.dtype) + b3.astype(x.dtype)
+                          + short, 0)
+        return out, new_state
+
+
+class FusedBottleneckChain(_Module):
+    """A stage of :class:`FusedBottleneck` blocks with CROSS-LAYER fused
+    junctions (kernels/fused_chain.py).
+
+    Per-layer fusion leaves one HBM pattern on the table at every
+    identity junction: block n's epilogue ``out = relu(z3*a3+b3+short)``
+    is an elementwise pass over the widest tensor, and block n+1's 1x1
+    reduce immediately re-reads ``out``. docs/MFU_ROOFLINE.md pins
+    stages 0-1 as HBM-bound "irreducible without cross-layer fusion" —
+    this module is that fusion: one Pallas kernel computes the epilogue
+    in VMEM, feeds the next conv's MXU matmul from VMEM, and writes
+    ``out`` to HBM exactly once (still needed as the next residual).
+
+    Identical math to the same blocks run sequentially (the fallback
+    path IS that composition, so CPU tests compare the two). Junction
+    fusion applies between consecutive identity blocks; the stage's
+    first (projecting/striding) block keeps its plain epilogue.
+    """
+
+    def __init__(self, blocks, name=None):
+        super().__init__(name=name)
+        self.blocks = list(blocks)
+        assert self.blocks, "empty chain"
+        for blk in self.blocks[1:]:
+            assert not blk.project and blk.stride == 1, \
+                "chained junctions need identity shortcuts"
+
+    def _init_params(self, rng):
+        import jax
+        ks = jax.random.split(rng, len(self.blocks))
+        return {str(i): blk._init_params(k)
+                for i, (blk, k) in enumerate(zip(self.blocks, ks))}
+
+    def _init_state(self):
+        return {str(i): blk._init_state()
+                for i, blk in enumerate(self.blocks)}
+
+    def _junction(self, z3, a3, b3, short, w1n, training):
+        """Fused epilogue+conv1 when the Pallas path is live; the exact
+        unchained composition otherwise (also the oracle in tests)."""
+        dt = z3.dtype
+        a3c, b3c = a3.astype(dt), b3.astype(dt)
+        mode = (FusedBottleneck._mode()
+                if self.blocks[0].kernel != "xla" else "xla")
+        if mode in ("pallas", "interpret"):
+            from ..kernels.fused_chain import fused_residual_matmul_nhwc
+            res = fused_residual_matmul_nhwc(
+                z3, short, w1n, a3c, b3c, stats=training,
+                interpret=(mode == "interpret"))
+            if res is not None:
+                return res
+        out = jnp.maximum(z3 * a3c + b3c + short, 0)
+        z1 = _lax.dot_general(out, w1n, (((out.ndim - 1,), (0,)),
+                                         ((), ())))
+        if training:
+            zf = z1.astype(jnp.float32)
+            red = tuple(range(z1.ndim - 1))
+            return out, z1, jnp.sum(zf, red), jnp.sum(zf * zf, red)
+        return out, z1, None, None
+
+    def _apply(self, params, state, x, training, rng):
+        new_state = {}
+        blk = self.blocks[0]
+        z1, s11, s12 = blk._conv1(params["0"], x, training)
+        z3, a3, b3, short, new_state["0"] = blk._body(
+            params["0"], state["0"], z1, s11, s12, x, training)
+        for i in range(1, len(self.blocks)):
+            nxt = self.blocks[i]
+            key = str(i)
+            w1n = params[key]["w1"].reshape(nxt.nin,
+                                            nxt.nmid).astype(x.dtype)
+            out, z1, s11, s12 = self._junction(z3, a3, b3, short, w1n,
+                                               training)
+            z3, a3, b3, short, new_state[key] = nxt._body(
+                params[key], state[key], z1, s11, s12, out, training)
+        dt = x.dtype
+        out = jnp.maximum(z3 * a3.astype(dt) + b3.astype(dt) + short, 0)
         return out, new_state
 
 _IMAGENET_CFG = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
@@ -375,19 +467,31 @@ def ResNet(class_num: int = 1000, depth: int = 50,
                 f"fused={fused!r} implements shortcut type B only "
                 f"(requested {shortcut_type!r}) — the fused model must "
                 "stay architecture-identical to its unfused A/B partner")
+    import os as _os
+    # cross-layer junction fusion (kernels/fused_chain.py) is on by
+    # default for the fused arms; BIGDL_TPU_FUSED_CHAIN=0 is the
+    # unchained A/B control (trace-time knob like the block-size sweeps)
+    chain = (fused in ("pallas", "xla")
+             and _os.environ.get("BIGDL_TPU_FUSED_CHAIN", "1") != "0")
     nin = 64
     for stage, n_blocks in enumerate(blocks):
         nmid = 64 * (2 ** stage)
+        stage_blocks = []
         for b in range(n_blocks):
             stride = 2 if (stage > 0 and b == 0) else 1
             if fused in ("pallas", "xla"):
-                model.add(FusedBottleneck(nin, nmid, stride, 4,
-                                          zero_init_residual,
-                                          kernel=fused))
+                blk = FusedBottleneck(nin, nmid, stride, 4,
+                                      zero_init_residual, kernel=fused)
+                if chain:
+                    stage_blocks.append(blk)
+                else:
+                    model.add(blk)
             else:
                 model.add(bottleneck(nin, nmid, stride, 4, shortcut_type,
                                      zero_init_residual, fmt))
             nin = nmid * 4
+        if stage_blocks:
+            model.add(FusedBottleneckChain(stage_blocks))
     model.add(SpatialAveragePooling(7, 7, 1, 1, global_pooling=True,
                                     format=fmt))
     model.add(View(nin))

@@ -1,0 +1,127 @@
+"""TensorBoard event-file reader (no TF dependency).
+
+Parity: reference ``visualization/Summary.scala:77`` ``readScalar`` →
+``visualization/tensorboard/FileReader.scala``, which scans the TFRecord
+event files on disk (CRC-checked) and filters scalar summaries by tag — so
+a *restarted* process, or one pointed at another run's log directory, can
+recover training history. The writer side is ``event_writer.EventWriter``;
+this module is its inverse and shares the masked-crc32c implementation.
+
+Corrupt or truncated tails (a crashed writer mid-record) end the scan of
+that file cleanly at the last valid record, matching TFRecord reader
+semantics.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Tuple
+
+from ..loaders.wire import iter_fields
+from .event_writer import _masked_crc
+
+
+def _scan_records(f) -> Iterator[Tuple[bytes, int]]:
+    """Yield (payload, end_offset) for each valid TFRecord frame from the
+    file object's current position. Frame layout: u64 length,
+    masked-crc32c(length), payload, masked-crc32c(payload). A CRC
+    mismatch or short read (truncated tail) stops iteration —
+    ``end_offset`` of the last yielded frame is the resume point."""
+    while True:
+        hdr = f.read(8)
+        lcrc = f.read(4)
+        if len(hdr) < 8 or len(lcrc) < 4:
+            return
+        if _masked_crc(hdr) != struct.unpack("<I", lcrc)[0]:
+            return
+        n = struct.unpack("<Q", hdr)[0]
+        data = f.read(n)
+        dcrc = f.read(4)
+        if len(data) < n or len(dcrc) < 4:
+            return
+        if _masked_crc(data) != struct.unpack("<I", dcrc)[0]:
+            return
+        yield data, f.tell()
+
+
+def iter_records(path: str) -> Iterator[bytes]:
+    """Yield the payload of each valid TFRecord frame in ``path``."""
+    with open(path, "rb") as f:
+        for data, _ in _scan_records(f):
+            yield data
+
+
+def _event_scalars(record: bytes) -> Tuple[int, float, List]:
+    """Decode one Event proto → (step, wall_time, [(tag, value), ...])."""
+    step, wall, vals = 0, 0.0, []
+    for f, w, v in iter_fields(record):
+        if f == 2 and w == 0:        # Event.step
+            step = v
+        elif f == 1 and w == 1:      # Event.wall_time
+            wall = struct.unpack("<d", v)[0]
+        elif f == 5 and w == 2:      # Event.summary
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1 and w2 == 2:          # Summary.value
+                    tag, sv = None, None
+                    for f3, w3, v3 in iter_fields(v2):
+                        if f3 == 1 and w3 == 2:  # Value.tag
+                            tag = v3.decode("utf-8")
+                        elif f3 == 2 and w3 == 5:  # Value.simple_value
+                            sv = struct.unpack("<f", v3)[0]
+                    if tag is not None and sv is not None:
+                        vals.append((tag, sv))
+    return step, wall, vals
+
+
+class ScalarCache:
+    """Incremental event-file scalar reader for one log directory.
+
+    A fresh ``read_scalar`` re-parses every file from byte 0 (with two
+    pure-Python CRC loops per record) — quadratic when polled during
+    training. This cache remembers each file's resume offset and parsed
+    rows, rescanning only appended bytes; a shrunk or replaced file
+    (size below the stored offset) resets that file's entry."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._files = {}   # path -> [offset, [(wall, step, tag, value)]]
+
+    def _refresh(self):
+        try:
+            names = sorted(os.listdir(self.log_dir))
+        except (FileNotFoundError, NotADirectoryError):
+            return
+        for name in names:
+            if "tfevents" not in name:
+                continue
+            path = os.path.join(self.log_dir, name)
+            offset, rows = self._files.setdefault(path, [0, []])
+            try:
+                if os.path.getsize(path) < offset:   # truncated/replaced
+                    offset, rows = 0, []
+                    self._files[path] = [offset, rows]
+                if os.path.getsize(path) == offset:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    for rec, end in _scan_records(f):
+                        step, wall, vals = _event_scalars(rec)
+                        rows.extend((wall, step, t, v) for t, v in vals)
+                        self._files[path][0] = end
+            except OSError:
+                continue
+
+    def read(self, tag: str) -> List[Tuple[int, float]]:
+        self._refresh()
+        rows = [(wall, step, v)
+                for _, (_, rs) in sorted(self._files.items())
+                for wall, step, t, v in rs if t == tag]
+        rows.sort(key=lambda r: (r[0], r[1]))
+        return [(step, v) for _, step, v in rows]
+
+
+def read_scalar(log_dir: str, tag: str) -> List[Tuple[int, float]]:
+    """All (step, value) pairs for ``tag`` across every event file in
+    ``log_dir``, ordered by (wall_time, step) — FileReader.readScalar
+    parity. One-shot form; pollers should hold a :class:`ScalarCache`."""
+    return ScalarCache(log_dir).read(tag)

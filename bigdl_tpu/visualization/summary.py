@@ -1,15 +1,16 @@
 """Training summaries.
 
 Parity: reference ``visualization/TrainSummary.scala`` /
-``visualization/ValidationSummary.scala`` — scalar (and histogram) logging to
-TensorBoard event files, plus in-memory readback (``read_scalar``) used by
-tests and notebooks.
+``visualization/ValidationSummary.scala`` — scalar (and histogram) logging
+to TensorBoard event files, plus readback (``read_scalar``) that parses the
+event files on disk (``visualization/tensorboard/FileReader.scala``
+parity), so history survives a restart and other runs' logs are readable.
 """
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Tuple
 
+from .event_reader import ScalarCache
 from .event_writer import EventWriter
 
 
@@ -17,11 +18,10 @@ class Summary:
     def __init__(self, log_dir: str, app_name: str, sub_dir: str):
         self.log_dir = os.path.join(log_dir, app_name, sub_dir)
         self.writer = EventWriter(self.log_dir)
-        self._scalars: Dict[str, List[Tuple[int, float]]] = {}
+        self._reader = ScalarCache(self.log_dir)
         self._triggers = {}
 
     def add_scalar(self, tag: str, value: float, step: int):
-        self._scalars.setdefault(tag, []).append((step, float(value)))
         self.writer.add_scalar(tag, value, step)
         return self
 
@@ -30,8 +30,12 @@ class Summary:
         return self
 
     def read_scalar(self, tag: str):
-        """Return [(step, value), ...] (parity: Summary.readScalar)."""
-        return list(self._scalars.get(tag, []))
+        """Return [(step, value), ...] parsed from the event files on disk
+        (parity: Summary.readScalar → tensorboard/FileReader.scala) — a
+        restarted process recovers the full history, not just this
+        instance's writes. Incremental: repeated polls rescan only the
+        bytes appended since the last call."""
+        return self._reader.read(tag)
 
     def set_summary_trigger(self, name: str, trigger):
         """Gate when the named tag is recorded (parity:

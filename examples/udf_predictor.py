@@ -1,0 +1,102 @@
+"""Batch-serving a trained TextClassifier as a column UDF.
+
+Parity: reference ``example/udfpredictor`` (Scala) — there a trained text
+classifier is registered as a Spark SQL UDF and applied to a DataFrame's
+text column. The bigdl_tpu analog: wrap ``PredictionService`` (the
+thread-safe serving facade) in a vectorized UDF over a pandas DataFrame —
+one jit-compiled forward serves every row batch.
+
+Usage: python examples/udf_predictor.py [--epochs N]
+Self-contained: trains on a small synthetic topic corpus first.
+"""
+import argparse
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, Sample
+from bigdl_tpu.models import TextClassifier
+from bigdl_tpu.models.textclassifier import tokenize_to_glove_sequences
+from bigdl_tpu.optim import (LocalOptimizer, Adam, PredictionService,
+                             Trigger)
+
+# a deterministic 3-topic corpus (sports / tech / cooking)
+_TOPICS = {
+    1: ["the team won the match with a late goal",
+        "players train hard before the championship game",
+        "the coach praised the defense after the tournament",
+        "fans cheered as the striker scored twice"],
+    2: ["the new processor doubles compute throughput",
+        "software update improves the neural network compiler",
+        "engineers benchmark the accelerator memory bandwidth",
+        "the chip integrates fast matrix units"],
+    3: ["simmer the sauce with garlic and fresh basil",
+        "knead the dough and bake until golden",
+        "season the roasted vegetables with olive oil",
+        "whisk the eggs into the warm butter slowly"],
+}
+
+
+def make_predict_udf(service, seq_len, embedding_dim):
+    """Return a UDF: list/Series of raw texts -> np.ndarray of 1-based
+    class labels. The reference registers the same shape of function as a
+    Spark SQL UDF (example/udfpredictor Utils.scala)."""
+    def udf(texts):
+        texts = list(texts)
+        feats, _ = tokenize_to_glove_sequences(
+            [(t, 1) for t in texts], sequence_length=seq_len,
+            embedding_dim=embedding_dim)
+        return service.predict_class(feats, batch_size=32)
+    return udf
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=40)
+    ap.add_argument("--seq-len", type=int, default=12)
+    ap.add_argument("--embedding-dim", type=int, default=50)
+    args = ap.parse_args()
+
+    corpus = [(t, lbl) for lbl, ts in _TOPICS.items() for t in ts]
+    feats, labels = tokenize_to_glove_sequences(
+        corpus, sequence_length=args.seq_len,
+        embedding_dim=args.embedding_dim)
+
+    model = TextClassifier(len(_TOPICS), embedding_dim=args.embedding_dim,
+                           sequence_length=args.seq_len, encoder="cnn")
+    samples = [Sample(f, l) for f, l in zip(feats, labels)]
+    LocalOptimizer(model, DataSet.array(samples), nn.ClassNLLCriterion(),
+                   Adam(learningrate=0.01),
+                   Trigger.max_epoch(args.epochs),
+                   batch_size=6).optimize()
+
+    # ---- serving: the trained model behind a PredictionService UDF ----
+    model.evaluate()
+    service = PredictionService(model)
+    udf = make_predict_udf(service, args.seq_len, args.embedding_dim)
+
+    try:
+        import pandas as pd
+        df = pd.DataFrame({"text": [t for t, _ in corpus],
+                           "label": labels})
+        df["pred"] = udf(df["text"])
+        acc = float((df["pred"] == df["label"]).mean())
+    except ImportError:  # pandas-free fallback: plain lists
+        preds = udf([t for t, _ in corpus])
+        acc = float((preds == labels).mean())
+    print(f"udf serving accuracy on the training corpus = {acc:.3f}")
+    assert acc >= 0.75, acc
+
+    # unseen rows flow through the same UDF (with real GloVe vectors the
+    # labels would also generalize; the offline fallback embeddings only
+    # guarantee mechanics, not semantics)
+    probe = udf(["the goalkeeper made a great save",
+                 "the gpu runs the model faster",
+                 "stir the soup and add pepper"])
+    print("probe predictions:", probe.tolist())
+    assert probe.shape == (3,)
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -350,3 +350,136 @@ def test_fused_bottleneck_matches_reference_block(monkeypatch):
     for va, vb in zip(jax.tree_util.tree_leaves(g_jnp),
                       jax.tree_util.tree_leaves(g_krn)):
         assert np.allclose(np.asarray(va), np.asarray(vb), atol=1e-3)
+
+
+def test_fused_chain_kernel_forward_and_grads():
+    """Cross-layer junction kernel (kernels/fused_chain.py) vs the jnp
+    oracle: h/z_out/stats values and all five gradients, through a loss
+    touching every output (interpret mode runs the real kernel bodies)."""
+    from bigdl_tpu.kernels.fused_chain import (fused_residual_matmul_nhwc,
+                                               residual_chain_reference)
+    rng = np.random.RandomState(0)
+    B, H, W, K, N = 2, 4, 4, 48, 24
+    z = jnp.asarray(rng.randn(B, H, W, K).astype(np.float32))
+    r = jnp.asarray(rng.randn(B, H, W, K).astype(np.float32))
+    a = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(K).astype(np.float32))
+    w = jnp.asarray(rng.randn(K, N).astype(np.float32) * 0.1)
+
+    h, zo, s1, s2 = fused_residual_matmul_nhwc(z, r, w, a, b,
+                                               interpret=True)
+    hr, zor, s1r, s2r = residual_chain_reference(z, r, a, b, w)
+    assert np.allclose(h, hr, atol=1e-5)
+    assert np.allclose(zo, zor, atol=1e-4)
+    assert np.allclose(s1, s1r, atol=1e-3)
+    assert np.allclose(s2, s2r, atol=1e-2)
+
+    def mk_loss(fn):
+        def loss(z, r, a, b, w):
+            h, zo, s1, s2 = fn(z, r, a, b, w)
+            m = B * H * W
+            mean = s1 / m
+            var = s2 / m - mean ** 2
+            zh = (zo - mean) * jax.lax.rsqrt(var + 1e-5)
+            return jnp.sum(jnp.tanh(zh * 0.3)) + 0.5 * jnp.sum(jnp.sin(h))
+        return loss
+
+    gk = jax.grad(mk_loss(lambda z, r, a, b, w: fused_residual_matmul_nhwc(
+        z, r, w, a, b, interpret=True)), argnums=(0, 1, 2, 3, 4))(
+            z, r, a, b, w)
+    gr = jax.grad(mk_loss(residual_chain_reference),
+                  argnums=(0, 1, 2, 3, 4))(z, r, a, b, w)
+    for name, f, x in zip("zrabw", gk, gr):
+        rel = float(jnp.abs(f - x).max()) / (float(jnp.abs(x).max()) + 1e-9)
+        assert rel < 2e-4, (name, rel)
+
+
+def test_fused_bottleneck_chain_matches_sequential_blocks(monkeypatch):
+    """FusedBottleneckChain == the same FusedBottleneck blocks run
+    sequentially with identical params (train+eval values, running
+    stats, grads); the interpret-mode chain kernel == the jnp fallback."""
+    from bigdl_tpu.models.resnet import (FusedBottleneck,
+                                         FusedBottleneckChain)
+    rng = np.random.RandomState(0)
+    B, H, W, C, nmid = 2, 8, 8, 16, 8
+    x = jnp.asarray(rng.randn(B, H, W, C).astype(np.float32))
+    blocks = [FusedBottleneck(C, nmid, stride=2),
+              FusedBottleneck(4 * nmid, nmid),
+              FusedBottleneck(4 * nmid, nmid)]
+    chain = FusedBottleneckChain(blocks)
+    params, state = chain.init(jax.random.PRNGKey(0))
+
+    def sequential(params, state, x, training):
+        h, sts = x, {}
+        for i, blk in enumerate(blocks):
+            h, sts[str(i)] = blk.apply(params[str(i)], state[str(i)], h,
+                                       training=training)
+        return h, sts
+
+    monkeypatch.setenv("BIGDL_TPU_FLASH", "off")   # jnp composition
+    for training in (True, False):
+        out_c, st_c = chain.apply(params, state, x, training=training)
+        out_s, st_s = sequential(params, state, x, training)
+        assert np.allclose(np.asarray(out_c), np.asarray(out_s),
+                           atol=2e-4), training
+        if training:
+            assert np.allclose(
+                np.asarray(st_c["1"]["bn1"]["running_mean"]),
+                np.asarray(st_s["1"]["bn1"]["running_mean"]), atol=1e-4)
+
+    def loss(p, training=True):
+        out, _ = chain.apply(p, state, x, training=training)
+        return jnp.sum(out * out) * 0.01
+
+    l_jnp, g_jnp = jax.value_and_grad(loss)(params)
+    monkeypatch.setenv("BIGDL_TPU_FLASH", "interpret")  # real kernels
+    l_krn, g_krn = jax.value_and_grad(loss)(params)
+    assert abs(float(l_jnp) - float(l_krn)) < 1e-3
+    for va, vb in zip(jax.tree_util.tree_leaves(g_jnp),
+                      jax.tree_util.tree_leaves(g_krn)):
+        assert np.allclose(np.asarray(va), np.asarray(vb), atol=1e-3)
+    # eval-mode interpret path (stats=False arm of the kernel)
+    out_e, _ = chain.apply(params, state, x, training=False)
+    monkeypatch.setenv("BIGDL_TPU_FLASH", "off")
+    out_o, _ = chain.apply(params, state, x, training=False)
+    assert np.allclose(np.asarray(out_e), np.asarray(out_o), atol=2e-4)
+
+
+def test_resnet50_fused_chain_builds_and_runs(monkeypatch):
+    """ResNet(fused='pallas') assembles FusedBottleneckChain stages by
+    default; BIGDL_TPU_FUSED_CHAIN=0 (the ab_queue control arm) keeps
+    per-block modules; BOTH run (jnp fallback) and agree with the same
+    weights."""
+    from bigdl_tpu.models.resnet import ResNet, FusedBottleneckChain
+    monkeypatch.setenv("BIGDL_TPU_FLASH", "off")
+    m = ResNet(10, 50, format="NHWC", fused="pallas")
+    chains = [mod for mod in m.modules
+              if isinstance(mod, FusedBottleneckChain)]
+    assert len(chains) == 4 and [len(c.blocks) for c in chains] == \
+        [3, 4, 6, 3]
+    monkeypatch.setenv("BIGDL_TPU_FUSED_CHAIN", "0")
+    m0 = ResNet(10, 50, format="NHWC", fused="pallas")
+    assert not any(isinstance(mod, FusedBottleneckChain)
+                   for mod in m0.modules)
+
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(1, 64, 64, 3).astype(np.float32))
+    params, state = m.init(jax.random.PRNGKey(0))
+    # remap the chained trees (stage chains hold {j: block}) onto the
+    # flat per-block Sequential of the control arm
+    p0, s0, k = {}, {}, 0
+    for i, mod in enumerate(m.modules):
+        if isinstance(mod, FusedBottleneckChain):
+            for j in range(len(mod.blocks)):
+                p0[str(k)] = params[str(i)][str(j)]
+                s0[str(k)] = state[str(i)][str(j)]
+                k += 1
+        else:
+            p0[str(k)] = params[str(i)]
+            s0[str(k)] = state[str(i)]
+            k += 1
+    assert k == len(m0.modules)
+    out, _ = m.apply(params, state, x, training=False)
+    out0, _ = m0.apply(p0, s0, x, training=False)
+    assert out.shape == (1, 10)
+    assert np.allclose(np.asarray(out), np.asarray(out0), atol=2e-4)

@@ -31,7 +31,8 @@ echo "## A/B queue run $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$LOG"
 # (r3 measured pallas LOSING 1089/1377 vs 2441 img/s on the NCHW arm; this
 # kernel is the round-4 rewrite that was never measured). If it loses too,
 # delete the kernel from the bench path (VERDICT r4: no zombie levers).
-run "resnet fused=pallas(nhwc)" headline BENCH_FUSED=pallas
+run "resnet fused=pallas(nhwc)+chain" headline BENCH_FUSED=pallas
+run "resnet fused=pallas(nhwc) chain=0 (control)" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_CHAIN=0
 run "resnet fused=pallas(nhwc) bn256" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=256
 run "resnet fused=pallas(nhwc) bn128" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=128
 
