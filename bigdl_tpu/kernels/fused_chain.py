@@ -346,5 +346,8 @@ def fused_residual_matmul_nhwc(z, r, w, scale, bias, *, stats=True,
     if pick is None:
         return None
     bb, bh = pick
-    return _chain(z, r, scale, bias, w, bool(stats), int(bb), int(bh),
-                  bool(interpret))
+    h, zo, s1, s2 = _chain(z, r, scale, bias, w, bool(stats), int(bb),
+                           int(bh), bool(interpret))
+    # stats=False leaves the stat outputs unwritten — never hand callers
+    # uninitialized memory (the oracle returns None there too)
+    return (h, zo, s1, s2) if stats else (h, zo, None, None)

@@ -297,23 +297,43 @@ class FusedBottleneck(_Module):
 
         a1, b1, new_state["bn1"] = self._bn_affine(
             params, state, "bn1", s11, s12, B * H * W, training)
-        # BN1+ReLU materialises once (the 3x3 conv needs a spatial tensor)
-        xh1 = jnp.maximum(z1 * cast(a1) + cast(b1), 0)
 
-        # conv2 (3x3, stride here — v1.5 placement); stats via jnp
-        z2 = _lax.conv_general_dilated(
-            xh1, cast(params["w2"]), window_strides=(self.stride,) * 2,
-            padding=((1, 1), (1, 1)),  # explicit: matches _conv(pad=1),
-            # not SAME (stride-2 SAME pads (0,1) — different taps)
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        H2, W2 = z2.shape[1], z2.shape[2]
-        m2 = B * H2 * W2
-        if training:
-            z2f = z2.astype(jnp.float32)
-            s21 = jnp.sum(z2f, axis=(0, 1, 2))
-            s22 = jnp.sum(z2f * z2f, axis=(0, 1, 2))
-        else:
-            s21 = s22 = None
+        # conv2 (3x3, stride here — v1.5 placement). Default: BN1+ReLU
+        # materialises once (the 3x3 conv needs a spatial tensor) and
+        # BN2's stats are plain jnp reductions. BIGDL_TPU_FUSED_CONV2=1
+        # (trace-time knob, Pallas/interpret modes) folds both into the
+        # conv (kernels/fused_conv.py) — no xh1 write, no z2 stats pass.
+        import os as _os
+        z2 = None
+        mode = self._mode() if self.kernel != "xla" else "xla"
+        if (mode in ("pallas", "interpret")
+                and _os.environ.get("BIGDL_TPU_FUSED_CONV2") == "1"):
+            from ..kernels.fused_conv import fused_bn_relu_conv3x3
+            res = fused_bn_relu_conv3x3(
+                z1, cast(params["w2"]), cast(a1), cast(b1),
+                stride=self.stride, stats=training,
+                interpret=(mode == "interpret"))
+            if res is not None:
+                z2, s21, s22 = res
+                H2, W2 = z2.shape[1], z2.shape[2]
+                m2 = B * H2 * W2
+        if z2 is None:
+            xh1 = jnp.maximum(z1 * cast(a1) + cast(b1), 0)
+            z2 = _lax.conv_general_dilated(
+                xh1, cast(params["w2"]),
+                window_strides=(self.stride,) * 2,
+                padding=((1, 1), (1, 1)),  # explicit: matches
+                # _conv(pad=1), not SAME (stride-2 SAME pads (0,1) —
+                # different taps)
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            H2, W2 = z2.shape[1], z2.shape[2]
+            m2 = B * H2 * W2
+            if training:
+                z2f = z2.astype(jnp.float32)
+                s21 = jnp.sum(z2f, axis=(0, 1, 2))
+                s22 = jnp.sum(z2f * z2f, axis=(0, 1, 2))
+            else:
+                s21 = s22 = None
         a2, b2, new_state["bn2"] = self._bn_affine(
             params, state, "bn2", s21, s22, m2, training)
 

@@ -483,3 +483,93 @@ def test_resnet50_fused_chain_builds_and_runs(monkeypatch):
     out0, _ = m0.apply(p0, s0, x, training=False)
     assert out.shape == (1, 10)
     assert np.allclose(np.asarray(out), np.asarray(out0), atol=2e-4)
+
+
+def test_fused_conv3x3_kernel_forward_and_grads():
+    """Fused BN+ReLU+3x3-conv+stats kernel (kernels/fused_conv.py) vs the
+    jnp oracle at strides 1 and 2 — values and all four gradients."""
+    from bigdl_tpu.kernels.fused_conv import (fused_bn_relu_conv3x3,
+                                              conv3x3_reference)
+    rng = np.random.RandomState(0)
+    for stride in (1, 2):
+        B, H, W, K, N = 2, 8, 8, 16, 24
+        x = jnp.asarray(rng.randn(B, H, W, K).astype(np.float32))
+        w = jnp.asarray(rng.randn(3, 3, K, N).astype(np.float32) * 0.1)
+        a = jnp.asarray(rng.rand(K).astype(np.float32) + 0.5)
+        b = jnp.asarray(rng.randn(K).astype(np.float32))
+        z, s1, s2 = fused_bn_relu_conv3x3(x, w, a, b, stride=stride,
+                                          interpret=True)
+        zr, s1r, s2r = conv3x3_reference(x, w, a, b, stride)
+        assert np.allclose(z, zr, atol=1e-4)
+        assert np.allclose(s1, s1r, atol=1e-3)
+        assert np.allclose(s2, s2r, atol=1e-2)
+
+        def mk_loss(fn):
+            def loss(x, w, a, b):
+                z, s1, s2 = fn(x, w, a, b)
+                m = z.shape[0] * z.shape[1] * z.shape[2]
+                mean = s1 / m
+                var = s2 / m - mean ** 2
+                zh = (z - mean) * jax.lax.rsqrt(var + 1e-5)
+                return jnp.sum(jnp.tanh(zh * 0.3))
+            return loss
+
+        gk = jax.grad(mk_loss(
+            lambda x, w, a, b: fused_bn_relu_conv3x3(
+                x, w, a, b, stride=stride, interpret=True)),
+            argnums=(0, 1, 2, 3))(x, w, a, b)
+        gr = jax.grad(mk_loss(
+            lambda x, w, a, b: conv3x3_reference(x, w, a, b, stride)),
+            argnums=(0, 1, 2, 3))(x, w, a, b)
+        for name, f, r in zip("xwab", gk, gr):
+            rel = (float(jnp.abs(f - r).max())
+                   / (float(jnp.abs(r).max()) + 1e-9))
+            assert rel < 2e-4, (stride, name, rel)
+
+
+def test_fused_bottleneck_conv2_arm_matches(monkeypatch):
+    """BIGDL_TPU_FUSED_CONV2=1 routes conv2 through the fused kernel with
+    identical results (fwd train+eval, grads) vs the default path."""
+    from bigdl_tpu.models.resnet import FusedBottleneck
+    from bigdl_tpu.kernels.fused_conv import fused_bn_relu_conv3x3
+    rng = np.random.RandomState(0)
+    B, H, W, C, nmid = 2, 8, 8, 16, 8
+    x = jnp.asarray(rng.randn(B, H, W, C).astype(np.float32))
+    # guard against vacuous pass: the kernel must actually ENGAGE at the
+    # bottleneck's z1 shape (a VMEM-fitter regression returning None
+    # would silently compare the default path with itself)
+    probe = fused_bn_relu_conv3x3(
+        jnp.zeros((B, H, W, nmid), jnp.float32),
+        jnp.zeros((3, 3, nmid, nmid), jnp.float32),
+        jnp.ones((nmid,), jnp.float32), jnp.zeros((nmid,), jnp.float32),
+        stride=1, interpret=True)
+    assert probe is not None
+    for stride in (1, 2):
+        fb = FusedBottleneck(C, nmid, stride)
+        params, state = fb.init(jax.random.PRNGKey(0))
+        monkeypatch.setenv("BIGDL_TPU_FLASH", "interpret")
+        monkeypatch.delenv("BIGDL_TPU_FUSED_CONV2", raising=False)
+
+        def loss(p):
+            out, _ = fb.apply(p, state, x, training=True)
+            return jnp.sum(out * out) * 0.01
+
+        out_d, st_d = fb.apply(params, state, x, training=True)
+        l_d, g_d = jax.value_and_grad(loss)(params)
+        monkeypatch.setenv("BIGDL_TPU_FUSED_CONV2", "1")
+        out_f, st_f = fb.apply(params, state, x, training=True)
+        l_f, g_f = jax.value_and_grad(loss)(params)
+        assert np.allclose(np.asarray(out_d), np.asarray(out_f),
+                           atol=2e-4)
+        assert np.allclose(
+            np.asarray(st_d["bn2"]["running_mean"]),
+            np.asarray(st_f["bn2"]["running_mean"]), atol=1e-4)
+        assert abs(float(l_d) - float(l_f)) < 1e-3
+        for va, vb in zip(jax.tree_util.tree_leaves(g_d),
+                          jax.tree_util.tree_leaves(g_f)):
+            assert np.allclose(np.asarray(va), np.asarray(vb), atol=1e-3)
+        # eval arm
+        oe_f, _ = fb.apply(params, state, x, training=False)
+        monkeypatch.delenv("BIGDL_TPU_FUSED_CONV2")
+        oe_d, _ = fb.apply(params, state, x, training=False)
+        assert np.allclose(np.asarray(oe_f), np.asarray(oe_d), atol=2e-4)

@@ -33,6 +33,7 @@ echo "## A/B queue run $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> "$LOG"
 # delete the kernel from the bench path (VERDICT r4: no zombie levers).
 run "resnet fused=pallas(nhwc)+chain" headline BENCH_FUSED=pallas
 run "resnet fused=pallas(nhwc) chain=0 (control)" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_CHAIN=0
+run "resnet fused=pallas+chain+conv2" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_CONV2=1
 run "resnet fused=pallas(nhwc) bn256" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=256
 run "resnet fused=pallas(nhwc) bn128" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=128
 
