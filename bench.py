@@ -254,6 +254,18 @@ def _ensure_jpeg_folder(n_images: int, jpeg_size: int):
     return paths, labels
 
 
+def _default_jpeg_workers() -> int:
+    """Decode workers (shared by the realdata bench and
+    tools/bench_input_pipeline.py so the roofline and the training run
+    are measured at the SAME worker count). The r5 steady-state sweep on
+    the 1-core tunnel host measured 4 workers fastest (523 img/s vs 455
+    at 1, 514 at 8 — a few decode threads hide each other's I/O stalls
+    even on one core, while 8 over-subscribe); many-core hosts scale to
+    their cores. BENCH_JPEG_WORKERS overrides."""
+    return int(os.environ.get("BENCH_JPEG_WORKERS",
+                              min(16, max(4, os.cpu_count() or 1))))
+
+
 def bench_resnet50_realdata():
     """ResNet-50 train fed by the C++ libjpeg prefetcher over a folder of
     REAL JPEG files (decode + bilinear resize + normalize on host worker
@@ -280,8 +292,7 @@ def bench_resnet50_realdata():
     # each worker holds one fully-built batch (~154 MB at B256/224²) while
     # blocked on the bounded queue, so the default is capped: memory is
     # workers × batch_bytes beyond the queue itself
-    n_workers = int(os.environ.get("BENCH_JPEG_WORKERS",
-                                   min(16, max(8, os.cpu_count() or 1))))
+    n_workers = _default_jpeg_workers()
     # bf16_nhwc: decode workers emit accelerator-ready batches — no host
     # f32→bf16 cast (measured 0.24 s/batch), no device-side transpose,
     # half the host→device bytes

@@ -46,7 +46,11 @@ run "lm remat=1 (pinned)" secondary:transformer BENCH_LM_REMAT=1
 run "lm B32 remat=1" secondary:transformer BENCH_LM_BATCH=32 BENCH_LM_REMAT=1
 run "lm remat=0 B32" secondary:transformer BENCH_LM_REMAT=0 BENCH_LM_BATCH=32
 
-# 4. realdata post-fix focus run (target input_wait_frac < 0.15)
+# 4. realdata post-fix focus run. Judge the number against the
+# host-only decode roofline (docs/R5_ONCHIP_STATUS.md: ~the high-400s
+# img/s on this 1-core tunnel host), NOT the synthetic headline — the
+# roofline microbench itself needs no device, so it runs at the END of
+# the queue (step 8) rather than burning short-window time here.
 run "realdata post-fix" secondary:realdata
 
 # 5. TPU smoke: does the Pallas flash kernel really engage under a2a
@@ -72,3 +76,9 @@ echo "### profile lm ($(date -u +%H:%M:%SZ))" >> "$LOG"
 timeout 900 python tools/profile_lm.py > /tmp/profile_lm.out 2>&1 \
   && tail -30 /tmp/profile_lm.out >> "$LOG" \
   || echo "lm profile FAILED rc=$?" >> "$LOG"
+
+# 8. host-only input-pipeline roofline (NO device needed — truly last;
+# pairs with the realdata number from step 4 at the same worker policy)
+echo "### input pipeline roofline ($(date -u +%H:%M:%SZ))" >> "$LOG"
+timeout 900 python tools/bench_input_pipeline.py --batches 20 >> "$LOG" 2>&1 \
+  || echo "input pipeline FAILED rc=$?" >> "$LOG"
