@@ -361,6 +361,17 @@ def bench_resnet50_realdata():
 
 
 def child_main(which: str):
+    # Persistent XLA compilation cache: with a flaky tunnel, a child that
+    # dies mid-run (timeout / tunnel flap) otherwise re-pays the full
+    # compile on the next attempt; with the cache, a retry or a later
+    # re-sweep in the same window skips straight to execution. The
+    # watcher/queue scripts export the same dir so probe and profiler
+    # processes share it.
+    from bigdl_tpu.utils.engine import enable_compilation_cache
+    enable_compilation_cache(os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache")))
     if which == "headline":
         results = [bench_resnet50()]
     elif which == "secondary":

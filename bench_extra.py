@@ -439,8 +439,23 @@ def bench_lm_decode(on_tpu):
             denom = dt
         return B * (new_tokens - 1) / denom
 
+    # BENCH_DECODE_WBITS selects the weight-only arms (comma list, e.g.
+    # "8,4"): int8 is per-out-channel, int4 is group-wise packed s4 on
+    # TPU (half the int8 param stream, quarter of bf16). One child times
+    # ONE bf16 baseline and every requested quantized arm against it —
+    # cheaper in a short tunnel window than one child per arm.
+    wbits_list = [int(b) for b in
+                  os.environ.get("BENCH_DECODE_WBITS", "8").split(",")]
+    if any(b not in (4, 8) for b in wbits_list):
+        # fail BEFORE the bf16 baseline burns tunnel-window time
+        raise ValueError(f"BENCH_DECODE_WBITS must be 4s/8s, "
+                         f"got {wbits_list}")
     bf16_tps = timed_decode(params)
-    int8_tps = timed_decode(quantize_lm_params(params))
+    quant = {}
+    for wb in wbits_list:
+        tps = timed_decode(quantize_lm_params(params, bits=wb))
+        quant[f"int{wb}_tokens_per_sec"] = round(tps, 1)
+        quant[f"int{wb}_speedup"] = round(tps / max(bf16_tps, 1e-9), 3)
 
     # decode is HBM-bandwidth bound: every step streams all params plus
     # the live KV cache. Bytes per BATCH step (B tokens): params once +
@@ -460,8 +475,7 @@ def bench_lm_decode(on_tpu):
             "kv_heads": kvh,
             "bytes_per_token": round(bytes_per_token / 1e6, 2),
             "hbm_bw_util": round(bw_util, 3) if bw_util else None,
-            "int8_tokens_per_sec": round(int8_tps, 1),
-            "int8_speedup": round(int8_tps / max(bf16_tps, 1e-9), 3)}
+            **quant}
 
 
 def bench_realdata(on_tpu):

@@ -3,5 +3,6 @@ from .quantize import (quantize, QuantizedLinear, QuantizedSpatialConvolution,
 from .calibration import (calibrate, fold_batchnorm, quantizable_paths,
                           Observer, MinMaxObserver, MovingAverageObserver,
                           PercentileObserver)
-from .lm import (QuantizedWeight, quantize_lm_params,
-                 quantize_weight_int8, lm_quantized_bytes)
+from .lm import (QuantizedWeight, QuantizedWeightInt4, quantize_lm_params,
+                 quantize_weight_int8, quantize_weight_int4,
+                 lm_quantized_bytes)

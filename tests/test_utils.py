@@ -1,4 +1,6 @@
 """Utils tests: Table, Shape, DirectedGraph, File, Engine, misc."""
+import os
+
 import numpy as np
 import jax
 import pytest
@@ -97,3 +99,25 @@ def test_device_memory_stats():
     from bigdl_tpu.utils import device_memory_stats
     stats = device_memory_stats()
     assert len(stats) == 8
+
+
+def test_enable_compilation_cache(tmp_path, monkeypatch):
+    import jax
+
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    prior_dir = jax.config.jax_compilation_cache_dir
+    prior_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        d = str(tmp_path / "xla_cache")
+        got = engine.enable_compilation_cache(d, min_compile_time_secs=0.5)
+        assert got == d and os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.5
+        # env override wins when no explicit dir is passed
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR",
+                           str(tmp_path / "env"))
+        assert engine.enable_compilation_cache() == str(tmp_path / "env")
+    finally:  # global jax config: restore so later tests don't cache here
+        jax.config.update("jax_compilation_cache_dir", prior_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prior_min)

@@ -5,6 +5,9 @@
 # cannot eat the queue.
 cd /root/repo
 LOG=/root/repo/docs/AB_QUEUE_LOG.md
+# share the bench's persistent XLA compile cache (see bench.py child_main)
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=2
 run() {
   local label="$1"; shift
   local cfg="$1"; shift
@@ -37,9 +40,11 @@ run "resnet fused=pallas+chain+conv2" headline BENCH_FUSED=pallas BIGDL_TPU_FUSE
 run "resnet fused=pallas(nhwc) bn256" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=256
 run "resnet fused=pallas(nhwc) bn128" headline BENCH_FUSED=pallas BIGDL_TPU_FUSED_BLOCK_N=128
 
-# 2. first-ever GQA decode number (roofline predicts ~1.28x over MHA;
-# the decode child also reports the int8 weight-only ratio)
-run "decode gqa kv4" secondary:decode BENCH_DECODE_KV_HEADS=4
+# 2. first-ever GQA decode number (roofline predicts ~1.28x over MHA)
+# with BOTH weight-only ratios from one child / one bf16 baseline:
+# int8 per-channel and int4 group-wise (packed s4 — half the int8
+# param stream; decode is param-stream-bound at B=8)
+run "decode gqa kv4 int8+int4" secondary:decode BENCH_DECODE_KV_HEADS=4 BENCH_DECODE_WBITS=8,4
 
 # 3. LM A/B pair completion (the --all sweep runs remat=auto; pin remat=1)
 run "lm remat=1 (pinned)" secondary:transformer BENCH_LM_REMAT=1

@@ -25,6 +25,7 @@ def capture(batch: int = 16, remat: bool = True,
     from bigdl_tpu.utils.amp import bf16_params
 
     engine.set_seed(0)
+    engine.enable_compilation_cache()
     seqlen = int(os.environ.get("PROF_LM_T", 1024))
     H = int(os.environ.get("PROF_LM_H", 1024))
     F, V = 4 * H, int(os.environ.get("PROF_LM_V", 32000))

@@ -25,6 +25,7 @@ def capture(batch: int = 256, logdir: str = "/tmp/bigdl_prof"):
     from bigdl_tpu.utils.amp import bf16_params
 
     engine.set_seed(0)
+    engine.enable_compilation_cache()
     # profile the exact variant the bench runs (shared BENCH_* parser)
     from bench import resnet_bench_variant
     fused, pool_grad, stem = resnet_bench_variant()

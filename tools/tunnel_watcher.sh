@@ -1,16 +1,32 @@
 #!/bin/bash
-# Round-4 tunnel watcher: probe the axon TPU tunnel every ~7 min for the
-# whole round. On every reconnect it refreshes the live bench cache
-# (bench.py --all) and, once per tunnel window, runs the A/B experiment
-# queue (tools/ab_queue.sh). While a window stays up it re-sweeps every
-# ~2h so the cache tracks the latest code. Status lines append to
-# docs/R4_ONCHIP_STATUS.md.
-LOG=/root/repo/docs/R4_ONCHIP_STATUS.md
+# Round-5 tunnel watcher: probe the axon TPU tunnel for the whole round.
+# r5 change vs r4: the probe COMPUTES (tiny matmul block_until_ready),
+# because on 2026-08-01 the tunnel served jax.devices() while hanging
+# every compile/execute RPC — a devices()-only probe green-lights a
+# doomed 90-min sweep. Probe cadence is ~3 min (a window can be short);
+# on every reconnect it refreshes the live bench cache (bench.py --all)
+# and, once per tunnel window, runs the A/B experiment queue
+# (tools/ab_queue.sh). While a window stays up it re-sweeps every ~2h.
+# Status lines append to docs/R5_ONCHIP_STATUS.md.
+LOG=/root/repo/docs/R5_ONCHIP_STATUS.md
 cd /root/repo
+# One shared persistent XLA compile cache for the probe, the sweep and
+# the A/B queue: a probe matmul or bench step compiled once in a window
+# is never re-paid by a later probe/retry/sweep in the same round.
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+# 0, not 2: the probe's tiny matmul compiles in <2 s and must be cached
+# too, or all 4000 probes re-pay it over the tunnel
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0
 queue_done=0
 last_sweep=0
-for i in $(seq 1 2000); do
-  if timeout 90 python -c "import jax; ds=jax.devices(); assert any(d.platform in ('tpu','axon') for d in ds)" 2>/dev/null; then
+for i in $(seq 1 4000); do
+  if timeout 150 python -c "
+import jax, jax.numpy as jnp
+ds = jax.devices()
+assert any(d.platform in ('tpu', 'axon') for d in ds)
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+" 2>/dev/null; then
     now=$(date +%s)
     if [ $((now - last_sweep)) -gt 7200 ]; then
       echo "watcher: tunnel UP $(date -u +%H:%M:%SZ) — running sweep" >> "$LOG"
@@ -27,6 +43,6 @@ for i in $(seq 1 2000); do
   else
     echo "watcher probe $i down $(date -u +%H:%M:%SZ)" >> /tmp/watcher_probe.log
     queue_done=0   # next window re-runs the queue (code may have moved)
-    sleep 420
+    sleep 160
   fi
 done
