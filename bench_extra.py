@@ -457,6 +457,46 @@ def bench_lm_decode(on_tpu):
         quant[f"int{wb}_tokens_per_sec"] = round(tps, 1)
         quant[f"int{wb}_speedup"] = round(tps / max(bf16_tps, 1e-9), 3)
 
+    # BENCH_DECODE_SPEC=k: the speculative-decoding verify primitive —
+    # one (k+1)-token decode_chunk vs k+1 sequential decode_one steps.
+    # Weight-independent (acceptance rates need trained models); the
+    # ratio IS the mechanical case for nn/speculative.py: if a chunked
+    # verify costs about one step, a draft with acceptance a yields
+    # ~(1+a*k)/(1+k*draft_cost_ratio) tokens per weight stream.
+    spec_k = int(os.environ.get("BENCH_DECODE_SPEC", 0))
+    if spec_k > 0:
+        pos = prompt_len
+        _, caches = jax.jit(
+            lambda p, x: model.prefill(p, x, prompt_len + spec_k + 2))(
+                params, prompt)
+        toks = jnp.asarray(np.random.RandomState(2).randint(
+            1, V, (B, spec_k + 1)), jnp.int32)
+
+        chunk_fn = jax.jit(lambda p, t, c: model.decode_chunk(
+            p, t, pos, c)[0])
+
+        def seq_all(p, t, c):
+            outs = []
+            for i in range(spec_k + 1):
+                lg, c = model.decode_one(p, t[:, i], pos + i, c)
+                outs.append(lg)
+            return jnp.stack(outs, 1)
+        seq_fn = jax.jit(seq_all)
+
+        def best_of(fn, n=5):
+            fn(params, toks, caches).block_until_ready()   # compile
+            ts = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                fn(params, toks, caches).block_until_ready()
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        t_chunk, t_seq = best_of(chunk_fn), best_of(seq_fn)
+        quant["spec_chunk_k"] = spec_k
+        quant["spec_verify_speedup"] = round(t_seq / max(t_chunk, 1e-9), 3)
+        quant["spec_chunk_ms"] = round(t_chunk * 1e3, 3)
+
     # decode is HBM-bandwidth bound: every step streams all params plus
     # the live KV cache. Bytes per BATCH step (B tokens): params once +
     # avg cache (k+v, kvh heads, mean seq length over the decode range).

@@ -125,6 +125,9 @@ class MoETransformerLM(Module):
     init_cache = Transformer.init_cache
     prefill = Transformer.prefill
     decode_one = Transformer.decode_one
+    decode_chunk = Transformer.decode_chunk   # decode_one's LM trunk —
+    # and the speculative-verify primitive (nn/speculative.py), so a
+    # MoE LM can serve as speculative target or draft
     generate = Transformer.generate
 
 

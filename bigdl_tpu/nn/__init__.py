@@ -70,6 +70,7 @@ from .attention import (Attention, FeedForwardNetwork, Transformer,
                         TransformerBlock, dot_product_attention,
                         flash_attention, position_encoding, causal_mask,
                         padding_mask, rotary_embedding)
+from .speculative import speculative_generate, SpecStats
 from .criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                         CategoricalCrossEntropy, BCECriterion, MSECriterion,
                         AbsCriterion, SmoothL1Criterion,

@@ -205,21 +205,49 @@ class Attention(Module):
         positions <= pos. x_t: (B, 1, H); caches: (B, kvH, Tmax, D) —
         kvH = num_kv_heads (== num_heads without GQA; build them with
         Transformer.init_cache). Returns (out (B, 1, H), k_cache,
-        v_cache)."""
-        q, k_t, v_t = self.qkv(params, x_t)
+        v_cache). The S=1 case of :meth:`decode_chunk` — one
+        implementation of masked cached-KV attention."""
+        return self.decode_chunk(params, x_t, k_cache, v_cache, pos)
+
+    def decode_chunk(self, params, x, k_cache, v_cache, pos):
+        """S cached positions in ONE forward (the speculative-decode
+        verify primitive, nn/speculative.py): project x (B, S, H), write
+        K/V at positions pos..pos+S-1, attend with causal-within-chunk +
+        everything-before masking. One pass over the whole cache serves
+        all S positions — that amortisation is why verifying k draft
+        tokens costs about one decode step, not k. Returns
+        (out (B, S, H), k_cache, v_cache)."""
+        q, k_t, v_t = self.qkv(params, x)
+        S = q.shape[2]
         if self.rope:
-            p = jnp.full((1,), pos)
+            p = pos + jnp.arange(S)
             q = rotary_embedding(q, p)
             k_t = rotary_embedding(k_t, p)   # cache holds rotated K
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k_t.astype(k_cache.dtype), (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v_t.astype(v_cache.dtype), (0, 0, pos, 0))
+        d = q.shape[-1]
+        t = k_cache.shape[2]
+        keep = (jnp.arange(t)[None, :]
+                <= (pos + jnp.arange(S))[:, None])          # (S, T)
         groups = self.num_heads // self._kvh()
         if groups > 1:
-            o = _decode_attention_gqa(q, k_cache, v_cache, pos, groups)
+            b, h, _, dd = q.shape
+            kvh = h // groups
+            qg = q.reshape(b, kvh, groups, S, dd)
+            logits = jnp.einsum("bkgsd,bktd->bkgst", qg,
+                                k_cache) / math.sqrt(d)
+            logits = jnp.where(keep[None, None, None], logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bkgst,bktd->bkgsd", w,
+                           v_cache).reshape(b, h, S, dd)
         else:
-            o = _decode_attention(q, k_cache, v_cache, pos)
+            logits = jnp.einsum("bhsd,bhtd->bhst", q,
+                                k_cache) / math.sqrt(d)
+            logits = jnp.where(keep[None, None], logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhst,bhtd->bhsd", w, v_cache)
         return self._merge(o, params), k_cache, v_cache
 
     def _apply(self, params, state, x, training, rng):
@@ -268,40 +296,6 @@ class Attention(Module):
             o = dot_product_attention(q, k, v, mask,
                                       self.attention_dropout, rng, training)
         return self._merge(o, params)
-
-
-def _decode_attention(q, cache_k, cache_v, pos):
-    """Single-position attention over a KV cache.
-
-    q: (B, H, 1, D); cache_k/v: (B, H, Tmax, D) with positions > pos
-    holding garbage — masked by position, so the cache never needs
-    zeroing. Returns (B, H, 1, D). O(Tmax) per step; the einsum is tiny
-    (one query row), so no flash kernel is needed on the decode path."""
-    d = q.shape[-1]
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k) / math.sqrt(d)
-    t = cache_k.shape[2]
-    keep = jnp.arange(t)[None, None, None, :] <= pos
-    logits = jnp.where(keep, logits, -1e30)
-    w = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", w, cache_v)
-
-
-def _decode_attention_gqa(q, cache_k, cache_v, pos, groups):
-    """Grouped-query decode attention WITHOUT materialising expanded
-    caches: q (B, nH, 1, D) is reshaped to (B, kvH, G, D) and contracted
-    against the compact (B, kvH, Tmax, D) caches — each decode step reads
-    nH/kvH times fewer cache bytes from HBM than MHA, which is the whole
-    point of GQA on the decode path."""
-    b, h, _, d = q.shape
-    kvh = h // groups
-    qg = q.reshape(b, kvh, groups, d)
-    logits = jnp.einsum("bkgd,bktd->bkgt", qg, cache_k) / math.sqrt(d)
-    t = cache_k.shape[2]
-    keep = jnp.arange(t)[None, None, None, :] <= pos
-    logits = jnp.where(keep, logits, -1e30)
-    w = jax.nn.softmax(logits, axis=-1)
-    o = jnp.einsum("bkgt,bktd->bkgd", w, cache_v)
-    return o.reshape(b, h, 1, d)
 
 
 class FeedForwardNetwork(Module):
@@ -488,6 +482,16 @@ class TransformerBlock(Module):
             h_t = h_t + self.cross._merge(o, params["cross"])
         return self._ffn_sublayer(params, h_t), (k_cache, v_cache)
 
+    def decode_chunk(self, params, h, kv, pos):
+        """S cached positions at once (speculative verify; LM blocks
+        only — no cross-attention). h: (B, S, H); kv: (k_cache,
+        v_cache); pos: traced scalar start position."""
+        n, _ = self.ln1.apply(params["ln1"], {}, h, False, None)
+        a, k_cache, v_cache = self.attn.decode_chunk(
+            params["attn"], n, kv[0], kv[1], pos)
+        h = h + a
+        return self._ffn_sublayer(params, h), (k_cache, v_cache)
+
 
 class Transformer(Module):
     """Transformer (nn/Transformer.scala). ``mode='lm'`` (decoder-only causal
@@ -633,7 +637,12 @@ class Transformer(Module):
         """One cached step. tokens: (B,) int ids at position ``pos``
         (traced scalar). Returns (logits (B, V), caches). Translation-mode
         callers pass per-block precomputed ``cross`` K/V and the source
-        padding ``cross_mask``."""
+        padding ``cross_mask``; the LM path is the S=1 case of
+        :meth:`decode_chunk` (one trunk implementation)."""
+        if cross is None:
+            logits, new_caches = self.decode_chunk(
+                params, tokens.astype(jnp.int32)[:, None], pos, caches)
+            return logits[:, 0], new_caches
         emb = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
         h = emb * math.sqrt(self.hidden_size)
         if getattr(self, "pos_encoding", "sinusoidal") != "rope":
@@ -645,10 +654,32 @@ class Transformer(Module):
         for i, blk in enumerate(self.blocks):
             h, kv = blk.decode_step(
                 params[f"block{i}"], h, caches[i], pos,
-                cross[i] if cross is not None else None, cross_mask)
+                cross[i], cross_mask)
             new_caches.append(kv)
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
         return h[:, 0] @ params["embed"].T, new_caches
+
+    def decode_chunk(self, params, tokens, pos, caches):
+        """S cached steps in one forward (LM mode): tokens (B, S) land
+        at positions pos..pos+S-1; returns (logits (B, S, V), caches).
+        ``logits[:, i]`` is the next-token distribution after consuming
+        ``tokens[:, :i+1]`` — the speculative-decode verification shape
+        (nn/speculative.py)."""
+        assert self.mode == "lm"
+        emb = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+        h = emb * math.sqrt(self.hidden_size)
+        S = tokens.shape[1]
+        if getattr(self, "pos_encoding", "sinusoidal") != "rope":
+            pe = position_encoding(self.max_len, self.hidden_size,
+                                   emb.dtype)
+            h = h + jax.lax.dynamic_slice_in_dim(pe, pos, S, 0)
+        new_caches = []
+        for i, blk in enumerate(self.blocks):
+            h, kvn = blk.decode_chunk(params[f"block{i}"], h, caches[i],
+                                      pos)
+            new_caches.append(kvn)
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
+        return h @ params["embed"].T, new_caches
 
     def generate(self, params, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None, top_k: int = 0,

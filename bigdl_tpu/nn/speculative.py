@@ -1,0 +1,140 @@
+"""Speculative decoding for the LM family (beyond-reference feature).
+
+Autoregressive decode is HBM-bandwidth bound: every generated token
+re-streams all model weights (docs/MFU_ROOFLINE.md decode table).
+Speculative decoding [Leviathan et al. 2023 pattern; no reference-code
+counterpart — the reference's Transformer (nn/Transformer.scala) is
+training-only] breaks the one-token-per-weight-stream coupling: a cheap
+DRAFT model proposes ``k`` tokens one at a time, and the TARGET model
+verifies all ``k`` (plus a bonus token) in ONE cached chunked forward
+(``Transformer.decode_chunk``) — a single weight stream serving up to
+``k+1`` emitted tokens.
+
+This implementation is GREEDY speculative decoding, which is exactly
+output-preserving: the emitted sequence is identical, token for token,
+to ``model.generate(params, ..., temperature=0)`` — the draft only
+changes the *schedule* of target forwards, never the result (tested
+against the dense-generate oracle in tests/test_speculative.py).
+
+Batching: acceptance is LOCKSTEP — each round accepts ``j = min`` over
+the batch of the per-row agreement-prefix lengths, so a single shared
+scalar cache position serves the whole batch. Per-row exactness still
+holds (a row that agreed beyond ``j`` re-emits its own greedy token as
+the bonus), but the expected speedup decays with batch size; B=1 (the
+latency-serving case) is where speculative decoding pays.
+
+TPU notes: the whole loop is one ``lax.while_loop`` under ``jit`` —
+fixed-shape output buffer, masked variable-length emission, no host
+sync per round. KV caches are never rewound: rejected positions hold
+garbage that position-masked decode attention
+(``MultiHeadAttention.decode_chunk``) never reads, and the next round's
+writes overwrite them.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SpecStats(NamedTuple):
+    """Aggregate speculative statistics (returned with the ids)."""
+    rounds: jnp.ndarray          # target verify forwards run
+    drafted: jnp.ndarray         # draft tokens proposed (rounds * k)
+    accepted: jnp.ndarray        # draft tokens accepted by the target
+
+
+def speculative_generate(model, params, draft_model, draft_params,
+                         prompt_ids, max_new_tokens: int, k: int = 4,
+                         return_stats: bool = False):
+    """Greedy speculative generation; output is exactly
+    ``model.generate(params, prompt_ids, max_new_tokens)`` (greedy).
+
+    model / draft_model: LM-mode ``nn.Transformer``s over the SAME
+    vocabulary (the draft is typically far shallower). k: draft tokens
+    per round. Returns (B, Tp + max_new_tokens) ids, plus a
+    :class:`SpecStats` when ``return_stats``. Jit-compatible end to end.
+    """
+    assert model.mode == "lm" and draft_model.mode == "lm"
+    assert model.vocab_size == draft_model.vocab_size, \
+        "draft and target must share a vocabulary"
+    assert k >= 1
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    B, Tp = prompt_ids.shape
+    if max_new_tokens <= 0:
+        return (prompt_ids, SpecStats(*([jnp.zeros((), jnp.int32)] * 3))) \
+            if return_stats else prompt_ids
+    # a round may overshoot the accepted length by up to k positions —
+    # cap the caches (and the emit buffer) accordingly
+    cap = Tp + max_new_tokens + k + 1
+    assert cap <= model.max_len and cap <= draft_model.max_len, \
+        (cap, model.max_len, draft_model.max_len)
+
+    logits_t, caches_t = model.prefill(params, prompt_ids, cap)
+    _, caches_d = draft_model.prefill(draft_params, prompt_ids, cap)
+    first = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+
+    buf = jnp.zeros((B, max_new_tokens + k + 1), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, first[:, None], (0, 0))
+
+    def cond(c):
+        return c["n"] < max_new_tokens
+
+    def body(c):
+        # --- draft phase: k+1 greedy cached steps from the last token.
+        # k steps would suffice to PROPOSE d_1..d_k, but the (k+1)-th
+        # step writes d_k's K/V into the draft cache: on a
+        # fully-accepted round the next round starts past d_k, and a
+        # k-step draft would leave a garbage hole at d_k's position that
+        # poisons every later proposal (exactness would survive — the
+        # target never trusts the draft — but acceptance collapses).
+        def dstep(carry, _):
+            tok, dc, p = carry
+            lg, dc = draft_model.decode_one(draft_params, tok, p, dc)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (nxt, dc, p + 1), nxt
+
+        (_, caches_d, _), drafts = jax.lax.scan(
+            dstep, (c["last"], c["caches_d"], c["pos"]), None,
+            length=k + 1)
+        drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]        # (B, k)
+
+        # --- verify phase: ONE chunked target forward over
+        # [last, d_1..d_k]; logits row i = target's choice after
+        # consuming the first i+1 of those tokens
+        chunk = jnp.concatenate([c["last"][:, None], drafts], axis=1)
+        lg, caches_t = model.decode_chunk(params, chunk, c["pos"],
+                                          c["caches_t"])
+        choices = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # (B, k+1)
+
+        # per-row agreement prefix; lockstep-min across the batch keeps
+        # one shared cache position (see module docstring)
+        match = (drafts == choices[:, :k]).astype(jnp.int32)
+        j = jnp.min(jnp.cumprod(match, axis=1).sum(axis=1))  # scalar
+        idx = jnp.arange(k + 1)
+        bonus = jnp.take_along_axis(
+            choices, jnp.full((B, 1), j), axis=1)[:, 0]      # (B,)
+        dpad = jnp.concatenate(
+            [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)  # (B, k+1)
+        emit = jnp.where(idx[None, :] < j, dpad,
+                         jnp.where(idx[None, :] == j,
+                                   bonus[:, None], 0))
+        out = jax.lax.dynamic_update_slice(c["out"], emit, (0, c["n"]))
+        return dict(
+            caches_t=caches_t, caches_d=caches_d, last=bonus,
+            pos=c["pos"] + j + 1, n=c["n"] + j + 1, out=out,
+            rounds=c["rounds"] + 1, accepted=c["accepted"] + j)
+
+    final = jax.lax.while_loop(cond, body, dict(
+        caches_t=caches_t, caches_d=caches_d, last=first,
+        pos=jnp.int32(Tp), n=jnp.int32(1), out=buf,
+        rounds=jnp.int32(0), accepted=jnp.int32(0)))
+
+    ids = jnp.concatenate(
+        [prompt_ids, final["out"][:, :max_new_tokens]], axis=1)
+    if return_stats:
+        return ids, SpecStats(rounds=final["rounds"],
+                              drafted=final["rounds"] * k,
+                              accepted=final["accepted"])
+    return ids

@@ -1,0 +1,99 @@
+"""Speculative decoding: exact-greedy oracle + chunk-decode consistency.
+
+The load-bearing property is EXACTNESS: greedy speculative output must
+equal dense ``generate`` token for token, for any draft — the draft only
+reschedules target forwards. A random (disagreeing) draft exercises the
+rejection path; draft == target exercises full acceptance.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.models import TransformerLM
+from bigdl_tpu.nn import speculative_generate
+
+
+def _lm(layers=2, heads=2, kv=None, pos="sinusoidal", seed=0, vocab=61):
+    m = TransformerLM(vocab_size=vocab, hidden_size=32, num_heads=heads,
+                      filter_size=64, num_layers=layers, max_len=64,
+                      num_kv_heads=kv, pos_encoding=pos)
+    p, _ = m.init(jax.random.PRNGKey(seed))
+    return m, p
+
+
+def _prompt(b, t, vocab=61, seed=1):
+    return jnp.asarray(np.random.RandomState(seed).randint(1, vocab, (b, t)),
+                       jnp.int32)
+
+
+def test_decode_chunk_matches_sequential_decode():
+    """decode_chunk(S tokens) == S decode_one steps: same logits, and
+    the caches it leaves behind continue identically."""
+    model, params = _lm()
+    ids = _prompt(3, 8)
+    logits, caches = model.prefill(params, ids, 20)
+    toks = _prompt(3, 4, seed=2)
+
+    lg_chunk, caches_c = model.decode_chunk(params, toks, 8, caches)
+    lg_seq = []
+    caches_s = caches
+    for i in range(4):
+        lg, caches_s = model.decode_one(params, toks[:, i], 8 + i, caches_s)
+        lg_seq.append(lg)
+    np.testing.assert_allclose(np.asarray(lg_chunk),
+                               np.stack([np.asarray(l) for l in lg_seq], 1),
+                               rtol=2e-4, atol=2e-4)
+    nxt = _prompt(3, 1, seed=3)[:, 0]
+    a, _ = model.decode_one(params, nxt, 12, caches_c)
+    b, _ = model.decode_one(params, nxt, 12, caches_s)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("kv,pos", [(None, "sinusoidal"), (1, "rope")])
+def test_speculative_exact_vs_dense_greedy(kv, pos):
+    """Random draft (near-zero acceptance) and draft==target (full
+    acceptance): both must reproduce dense greedy exactly — incl. GQA
+    and RoPE targets."""
+    model, params = _lm(layers=2, heads=2, kv=kv, pos=pos)
+    draft, dparams = _lm(layers=1, heads=2, seed=9)
+    ids = _prompt(2, 6)
+    want = np.asarray(model.generate(params, ids, max_new_tokens=10))
+
+    got, stats = speculative_generate(model, params, draft, dparams, ids,
+                                      max_new_tokens=10, k=3,
+                                      return_stats=True)
+    assert (np.asarray(got) == want).all()
+    assert int(stats.rounds) >= 1
+
+    got2, stats2 = speculative_generate(model, params, model, params, ids,
+                                        max_new_tokens=10, k=3,
+                                        return_stats=True)
+    assert (np.asarray(got2) == want).all()
+    # self-draft agrees with itself: every round accepts all k drafts,
+    # so k+1 tokens land per round (after the prefill token)
+    assert int(stats2.accepted) == int(stats2.rounds) * 3
+    assert int(stats2.rounds) <= -(-9 // 4) + 1
+
+
+def test_speculative_jits_and_batches():
+    """End-to-end under jit at B=4; lockstep-min acceptance stays exact
+    per row."""
+    model, params = _lm(layers=2, heads=2)
+    draft, dparams = _lm(layers=1, heads=2, seed=5)
+    ids = _prompt(4, 5, seed=7)
+    want = np.asarray(model.generate(params, ids, max_new_tokens=8))
+    fn = jax.jit(lambda p, dp, x: speculative_generate(
+        model, p, draft, dp, x, max_new_tokens=8, k=2))
+    got = np.asarray(fn(params, dparams, ids))
+    assert got.shape == (4, 13)
+    assert (got == want).all()
+
+
+def test_speculative_rejects_mismatched_vocab():
+    model, params = _lm()
+    draft, dparams = _lm(vocab=17)
+    with pytest.raises(AssertionError):
+        speculative_generate(model, params, draft, dparams,
+                             _prompt(1, 4), max_new_tokens=4)

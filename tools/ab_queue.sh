@@ -44,7 +44,7 @@ run "resnet fused=pallas(nhwc) bn128" headline BENCH_FUSED=pallas BIGDL_TPU_FUSE
 # with BOTH weight-only ratios from one child / one bf16 baseline:
 # int8 per-channel and int4 group-wise (packed s4 — half the int8
 # param stream; decode is param-stream-bound at B=8)
-run "decode gqa kv4 int8+int4" secondary:decode BENCH_DECODE_KV_HEADS=4 BENCH_DECODE_WBITS=8,4
+run "decode gqa kv4 int8+int4+specverify" secondary:decode BENCH_DECODE_KV_HEADS=4 BENCH_DECODE_WBITS=8,4 BENCH_DECODE_SPEC=4
 
 # 3. LM A/B pair completion (the --all sweep runs remat=auto; pin remat=1)
 run "lm remat=1 (pinned)" secondary:transformer BENCH_LM_REMAT=1
