@@ -126,8 +126,14 @@ class MoETransformerLM(Module):
     prefill = Transformer.prefill
     decode_one = Transformer.decode_one
     decode_chunk = Transformer.decode_chunk   # decode_one's LM trunk —
-    # and the speculative-verify primitive (nn/speculative.py), so a
-    # MoE LM can serve as speculative target or draft
+    # and the speculative-verify primitive (nn/speculative.py). Caveat
+    # (same capacity mechanics as the prefill note above): the verify
+    # pass routes S=k+1 tokens per forward, so at tight capacity_factor
+    # it can DROP a token that one-token decode steps never drop —
+    # speculative output then differs from dense greedy exactly where
+    # cached and full-forward decoding already can. A MoE speculative
+    # target is exact whenever capacity is not saturated; dense
+    # TransformerLM targets are exact unconditionally.
     generate = Transformer.generate
 
 

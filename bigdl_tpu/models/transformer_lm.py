@@ -31,7 +31,7 @@ def TransformerLM(vocab_size: int = 32000, hidden_size: int = 512,
     """``num_kv_heads < num_heads`` turns on grouped-query attention:
     K/V projections and the decode KV caches shrink by the group factor
     — the decode path's HBM-bandwidth lever (each step streams the whole
-    cache; see the grouped branch of MultiHeadAttention.decode_chunk).
+    cache; see the grouped branch of Attention.decode_chunk).
     ``pos_encoding='rope'`` swaps the
     additive sinusoidal PE for rotary embeddings on q/k (relative
     positions; the KV cache stores rotated keys)."""

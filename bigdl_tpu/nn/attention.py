@@ -188,8 +188,8 @@ class Attention(Module):
 
     def _expand_kv(self, k, v):
         """Broadcast kv heads up to the query head count for the dense/
-        flash/seq-parallel paths (grouped decode never expands — see
-        _decode_attention_gqa)."""
+        flash/seq-parallel paths (grouped decode never expands — see the
+        grouped branch of :meth:`decode_chunk`)."""
         g = self.num_heads // self._kvh()
         if g == 1:
             return k, v
@@ -466,10 +466,12 @@ class TransformerBlock(Module):
 
     def decode_step(self, params, h_t, kv, pos, cross_kv=None,
                     cross_mask=None):
-        """One cached autoregressive step. h_t: (B, 1, H);
-        kv: (k_cache, v_cache); pos: traced scalar position. For
-        translation-mode blocks pass the precomputed ``cross_kv`` and the
-        additive source-padding ``cross_mask``."""
+        """S cached autoregressive positions (S=1 is the classic decode
+        step). h_t: (B, S, H) landing at positions pos..pos+S-1;
+        kv: (k_cache, v_cache); pos: traced scalar. For translation-mode
+        blocks pass the precomputed ``cross_kv`` and the additive
+        source-padding ``cross_mask`` (cross-attention reads the full
+        encoder output, so it is S-agnostic)."""
         n, _ = self.ln1.apply(params["ln1"], {}, h_t, False, None)
         a, k_cache, v_cache = self.attn.decode(params["attn"], n, kv[0],
                                                kv[1], pos)
@@ -481,16 +483,6 @@ class TransformerBlock(Module):
                                       cross_mask)
             h_t = h_t + self.cross._merge(o, params["cross"])
         return self._ffn_sublayer(params, h_t), (k_cache, v_cache)
-
-    def decode_chunk(self, params, h, kv, pos):
-        """S cached positions at once (speculative verify; LM blocks
-        only — no cross-attention). h: (B, S, H); kv: (k_cache,
-        v_cache); pos: traced scalar start position."""
-        n, _ = self.ln1.apply(params["ln1"], {}, h, False, None)
-        a, k_cache, v_cache = self.attn.decode_chunk(
-            params["attn"], n, kv[0], kv[1], pos)
-        h = h + a
-        return self._ffn_sublayer(params, h), (k_cache, v_cache)
 
 
 class Transformer(Module):
@@ -675,8 +667,8 @@ class Transformer(Module):
             h = h + jax.lax.dynamic_slice_in_dim(pe, pos, S, 0)
         new_caches = []
         for i, blk in enumerate(self.blocks):
-            h, kvn = blk.decode_chunk(params[f"block{i}"], h, caches[i],
-                                      pos)
+            h, kvn = blk.decode_step(params[f"block{i}"], h, caches[i],
+                                     pos)
             new_caches.append(kvn)
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
         return h @ params["embed"].T, new_caches
@@ -755,6 +747,98 @@ class Transformer(Module):
             [prompt_ids, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
         return out
 
+    def generate_beam(self, params, prompt_ids, max_new_tokens: int,
+                      beam_size: int = 4, eos_id=None,
+                      length_penalty: float = 0.0):
+        """Beam-search generation for mode='lm' (beyond the reference —
+        its Transformer is training-only). Prefill runs ONCE on the
+        un-repeated batch; caches are then expanded to the (B*beam)
+        layout and beams ride the same cached decode step as greedy.
+        Score = sum log-prob / (len ** length_penalty); finished beams
+        (emitted ``eos_id``) freeze with their score. Returns
+        (B, Tp + max_new_tokens) ids of the best beam (positions after
+        eos zeroed). ``beam_size=1`` reproduces greedy :meth:`generate`.
+        """
+        assert self.mode == "lm"
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        B, Tp = prompt_ids.shape
+        K, V = beam_size, self.vocab_size
+        if max_new_tokens <= 0:
+            return prompt_ids
+        total = Tp + max_new_tokens
+        assert total <= self.max_len, (total, self.max_len)
+
+        logits, caches = self.prefill(params, prompt_ids, total)
+        caches = jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, K, axis=0), caches)
+        logp0 = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        scores0, tok0 = jax.lax.top_k(logp0, K)              # (B, K)
+        tok = tok0.reshape(-1).astype(jnp.int32)
+        done = (tok == eos_id) if eos_id is not None \
+            else jnp.zeros((B * K,), bool)
+
+        scores, toks, parents = self._beam_scan(
+            lambda t, p, c: self.decode_one(params, t, p, c),
+            caches, tok, scores0.reshape(-1), done, jnp.int32(Tp),
+            max_new_tokens - 1, B, K, eos_id)
+        paths, roots = _beam_backtrack(toks, parents, B, K)
+        root_tok = jnp.take_along_axis(tok0, roots, axis=1)  # (B, K)
+        paths = jnp.concatenate([root_tok[None], paths], axis=0)
+
+        lens = jnp.sum(paths != 0, axis=0).astype(jnp.float32)
+        norm = jnp.maximum(lens, 1.0) ** length_penalty
+        final = scores.reshape(B, K) / norm
+        best = jnp.argmax(final, axis=1)
+        out = jnp.take_along_axis(
+            paths, best[None, :, None], axis=2)[:, :, 0]         # (T, B)
+        return jnp.concatenate([prompt_ids, jnp.moveaxis(out, 0, 1)],
+                               axis=1)
+
+    def _beam_scan(self, step_fn, caches, tok, scores, done, pos0,
+                   steps, B, K, eos_id):
+        """Run ``steps`` beam expansions in the flattened (B*K) layout.
+        ``step_fn(tok, pos, caches) -> (logits, caches)`` is the cached
+        decode step (LM, or a translation closure carrying cross K/V).
+        Candidates are (V+1)-wide: the extra column is a frozen beam's
+        single "stay" continuation (score unchanged) — vocab column 0
+        remains selectable by live beams, preserving exact greedy parity
+        at beam_size=1 and eos_id=0 detection. Returns
+        (scores (B*K,), toks, parents) with toks/parents shaped
+        (steps, B, K) for :func:`_beam_backtrack`."""
+        V = self.vocab_size
+        neg = jnp.float32(-1e30)
+
+        def gather_beams(tree, idx):
+            """idx: (B, K) beam indices into the previous (B*K) layout."""
+            flat = (jnp.arange(B)[:, None] * K + idx).reshape(-1)
+            return jax.tree_util.tree_map(lambda x: x[flat], tree)
+
+        def body(carry, _):
+            caches, tok, pos, scores, done = carry
+            logits, new_caches = step_fn(tok, pos, caches)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            live = jnp.where(done[:, None], neg, logp) + scores[:, None]
+            stay = jnp.where(done, scores, neg)[:, None]
+            cand = jnp.concatenate([live, stay], axis=1)  # (B*K, V+1)
+            cand = cand.reshape(B, K * (V + 1))
+            top, flat_idx = jax.lax.top_k(cand, K)   # (B, K)
+            beam_idx = flat_idx // (V + 1)
+            col = (flat_idx % (V + 1)).astype(jnp.int32)
+            caches = gather_beams(new_caches, beam_idx)
+            done = gather_beams(done, beam_idx)
+            col_flat = col.reshape(-1)
+            emitted = jnp.where(col_flat == V, 0, col_flat)  # stay → pad
+            if eos_id is not None:
+                done = jnp.logical_or(done, jnp.logical_and(
+                    col_flat != V, emitted == eos_id))
+            return (caches, emitted, pos + 1, top.reshape(-1), done), \
+                (emitted, beam_idx)
+
+        (_, _, _, scores, _), (toks, parents) = jax.lax.scan(
+            body, (caches, tok, pos0, scores, done), None, length=steps)
+        return (scores, toks.reshape(steps, B, K),
+                parents.reshape(steps, B, K))
+
     def _encode_src(self, params, src_ids):
         """Shared source-side setup for translate/translate_beam:
         padding mask + encoder stack."""
@@ -827,66 +911,20 @@ class Transformer(Module):
                  for i, blk in enumerate(self.blocks)]
         caches = self.init_cache(B * K, max_new_tokens + 1, enc.dtype)
 
-        neg = jnp.float32(-1e30)
         # beam 0 starts live, the rest dead so the first expansion draws
         # K distinct continuations of BOS rather than K copies
         scores0 = jnp.tile(jnp.concatenate(
-            [jnp.zeros((1,)), jnp.full((K - 1,), neg)]), (B,))
-
-        def gather_beams(tree, idx):
-            """idx: (B, K) beam indices into the previous (B*K) layout."""
-            flat = (jnp.arange(B)[:, None] * K + idx).reshape(-1)
-            return jax.tree_util.tree_map(lambda x: x[flat], tree)
-
-        def body(carry, _):
-            caches, tok, pos, scores, done = carry
-            logits, new_caches = self.decode_one(params, tok, pos, caches,
-                                                 cross, mask_k)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            # candidates are (V + 1)-wide: the extra column is the frozen
-            # beam's single "stay" continuation (score unchanged) — vocab
-            # column 0 remains selectable by live beams, preserving exact
-            # greedy parity at beam_size=1 and eos_id=0 detection
-            live = jnp.where(done[:, None], neg, logp) + scores[:, None]
-            stay = jnp.where(done, scores, neg)[:, None]
-            cand = jnp.concatenate([live, stay], axis=1)  # (B*K, V+1)
-            cand = cand.reshape(B, K * (V + 1))
-            top, flat_idx = jax.lax.top_k(cand, K)   # (B, K)
-            beam_idx = flat_idx // (V + 1)
-            col = (flat_idx % (V + 1)).astype(jnp.int32)
-            caches = gather_beams(new_caches, beam_idx)
-            done = gather_beams(done, beam_idx)
-            col_flat = col.reshape(-1)
-            emitted = jnp.where(col_flat == V, 0, col_flat)  # stay → pad
-            if eos_id is not None:
-                emit_eos = jnp.logical_and(col_flat != V,
-                                           emitted == eos_id)
-                done = jnp.logical_or(done, emit_eos)
-            return (caches, emitted, pos + 1, top.reshape(-1), done), \
-                (emitted, beam_idx)
-
+            [jnp.zeros((1,)), jnp.full((K - 1,), jnp.float32(-1e30))]),
+            (B,))
         bos = jnp.full((B * K,), bos_id, jnp.int32)
         done0 = jnp.zeros((B * K,), bool)
-        (_, _, _, scores, done), (toks, parents) = jax.lax.scan(
-            body, (caches, bos, jnp.int32(0), scores0, done0), None,
-            length=max_new_tokens)
-        # backtrack: beams were physically gathered every step, so the
-        # token at step t for final beam j is found by following parents
-        toks = toks.reshape(max_new_tokens, B, K)
-        parents = parents.reshape(max_new_tokens, B, K)
 
-        # backtrack ALL K final beams (slots are physically re-gathered
-        # every step, so per-slot columns of `toks` mix hypotheses — both
-        # the length penalty and the output must follow parent pointers)
-        def walk(beams, inputs):
-            tk, pr = inputs
-            tok_t = jnp.take_along_axis(tk, beams, axis=1)   # (B, K)
-            beams = jnp.take_along_axis(pr, beams, axis=1)
-            return beams, tok_t
-
-        init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
-        _, rev = jax.lax.scan(walk, init, (toks[::-1], parents[::-1]))
-        paths = rev[::-1]                                     # (T, B, K)
+        scores, toks, parents = self._beam_scan(
+            lambda t, p, c: self.decode_one(params, t, p, c, cross,
+                                            mask_k),
+            caches, bos, scores0, done0, jnp.int32(0), max_new_tokens,
+            B, K, eos_id)
+        paths, _ = _beam_backtrack(toks, parents, B, K)
 
         lens = jnp.sum(paths != 0, axis=0).astype(jnp.float32)  # (B, K)
         norm = jnp.maximum(lens, 1.0) ** length_penalty
@@ -895,3 +933,23 @@ class Transformer(Module):
         out = jnp.take_along_axis(
             paths, best[None, :, None], axis=2)[:, :, 0]        # (T, B)
         return jnp.moveaxis(out, 0, 1)
+
+
+def _beam_backtrack(toks, parents, B, K):
+    """Follow parent pointers from the final beam slots back to step 0.
+    Beam slots are physically re-gathered every expansion, so per-slot
+    columns of ``toks`` mix hypotheses — both the length penalty and the
+    output must walk the parent chain. toks/parents: (steps, B, K).
+    Returns (paths (steps, B, K), roots (B, K)) — ``roots[b, k]`` is
+    final beam k's slot index at entry to step 0 (LM beam search uses it
+    to recover which pre-scan prefill expansion the beam descends
+    from)."""
+    def walk(beams, inputs):
+        tk, pr = inputs
+        tok_t = jnp.take_along_axis(tk, beams, axis=1)   # (B, K)
+        beams = jnp.take_along_axis(pr, beams, axis=1)
+        return beams, tok_t
+
+    init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+    roots, rev = jax.lax.scan(walk, init, (toks[::-1], parents[::-1]))
+    return rev[::-1], roots

@@ -27,8 +27,15 @@ TPU notes: the whole loop is one ``lax.while_loop`` under ``jit`` —
 fixed-shape output buffer, masked variable-length emission, no host
 sync per round. KV caches are never rewound: rejected positions hold
 garbage that position-masked decode attention
-(``MultiHeadAttention.decode_chunk``) never reads, and the next round's
-writes overwrite them.
+(``Attention.decode_chunk``) never reads, and the next round's writes
+overwrite them.
+
+Exactness scope: unconditional for dense ``TransformerLM`` targets. A
+``MoETransformerLM`` target is exact only while expert capacity is not
+saturated — the k+1-token verify forward recomputes routing per chunk,
+so tight ``capacity_factor`` can drop a token there that one-token
+steps keep (the same cached-vs-full caveat documented on the MoE LM's
+inference bindings).
 """
 from __future__ import annotations
 

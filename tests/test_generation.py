@@ -286,3 +286,57 @@ def test_top_p_sampling_masks_tail():
                                  rng=jax.random.PRNGKey(10)))
     assert samp.shape == greedy.shape and (samp >= 0).all() \
         and (samp < 29).all()
+
+
+def test_lm_generate_beam_width1_is_greedy():
+    """generate_beam(beam_size=1) == greedy generate, token for token,
+    incl. eos masking."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    from bigdl_tpu.models import TransformerLM
+
+    model = TransformerLM(vocab_size=53, hidden_size=32, num_heads=2,
+                          filter_size=64, num_layers=2, max_len=48)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(1, 53, (2, 6)),
+                      jnp.int32)
+    greedy = np.asarray(model.generate(params, ids, max_new_tokens=8))
+    beam1 = np.asarray(jax.jit(lambda p, x: model.generate_beam(
+        p, x, max_new_tokens=8, beam_size=1))(params, ids))
+    assert (beam1 == greedy).all()
+
+    eos = int(greedy[0, 8])  # force an early stop on row 0's path
+    g = np.asarray(model.generate(params, ids, max_new_tokens=8,
+                                  eos_id=eos))
+    b = np.asarray(model.generate_beam(params, ids, max_new_tokens=8,
+                                       beam_size=1, eos_id=eos))
+    assert (b == g).all()
+
+
+def test_lm_generate_beam_score_monotone_in_width():
+    """Wider beams can only improve the model's own sequence log-prob
+    (no eos, no length penalty: beam-1's path is in beam-3's candidate
+    set)."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    from bigdl_tpu.models import TransformerLM
+
+    model = TransformerLM(vocab_size=31, hidden_size=32, num_heads=2,
+                          filter_size=64, num_layers=2, max_len=32)
+    params, _ = model.init(jax.random.PRNGKey(3))
+    ids = jnp.asarray(np.random.RandomState(5).randint(1, 31, (2, 4)),
+                      jnp.int32)
+
+    def seq_logprob(full):
+        full = jnp.asarray(full)
+        lg, _ = model.apply(params, {}, full[:, :-1], training=False)
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        cont = full[:, 1:]
+        tot = jnp.take_along_axis(lp, cont[..., None], -1)[..., 0]
+        return np.asarray(tot[:, 3:].sum(axis=1))  # continuation only
+
+    s1 = seq_logprob(model.generate_beam(params, ids, 6, beam_size=1))
+    s3 = seq_logprob(model.generate_beam(params, ids, 6, beam_size=3))
+    assert (s3 >= s1 - 1e-4).all(), (s1, s3)

@@ -97,3 +97,19 @@ def test_speculative_rejects_mismatched_vocab():
     with pytest.raises(AssertionError):
         speculative_generate(model, params, draft, dparams,
                              _prompt(1, 4), max_new_tokens=4)
+
+
+def test_speculative_composes_with_weight_only_quantization():
+    """A quantized target (int4 group-wise) under speculative decoding
+    must equal the SAME quantized params run through dense greedy —
+    quantization and speculation are orthogonal decode levers."""
+    from bigdl_tpu.quantization import quantize_lm_params
+
+    model, params = _lm(layers=2, heads=2)
+    draft, dparams = _lm(layers=1, heads=2, seed=11)
+    q4 = quantize_lm_params(params, bits=4, group=16)
+    ids = _prompt(2, 5, seed=13)
+    want = np.asarray(model.generate(q4, ids, max_new_tokens=8))
+    got = np.asarray(speculative_generate(model, q4, draft, dparams, ids,
+                                          max_new_tokens=8, k=3))
+    assert (got == want).all()
