@@ -124,6 +124,8 @@ class MoETransformerLM(Module):
     # exactly when the full forward would have dropped a token ----
     init_cache = Transformer.init_cache
     prefill = Transformer.prefill
+    prefill_chunked = Transformer.prefill_chunked
+    _decode_trunk = Transformer._decode_trunk
     decode_one = Transformer.decode_one
     decode_chunk = Transformer.decode_chunk   # decode_one's LM trunk —
     # and the speculative-verify primitive (nn/speculative.py). Caveat

@@ -624,6 +624,29 @@ class Transformer(Module):
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
         return h[:, -1] @ params["embed"].T, caches
 
+    def prefill_chunked(self, params, ids, max_len: int,
+                        chunk: int = 512):
+        """Prompt prefill in fixed-size pieces through the cached decode
+        trunk: O(chunk·Tp) attention scratch instead of
+        :meth:`prefill`'s O(Tp·Tp) — the long-context serving shape,
+        where a 100k-token prompt must not materialise a full
+        prompt-wide forward. Only the LAST position is projected to
+        vocab (one (B, H)·(H, V) dot total — per-chunk logits would
+        often cost more than the transformer itself). Returns
+        (last-position logits, caches) like :meth:`prefill`; the chunk
+        loop is unrolled at trace time (static shapes per piece; the
+        tail piece may compile one extra shape)."""
+        assert self.mode == "lm"
+        ids = jnp.asarray(ids, jnp.int32)
+        B, Tp = ids.shape
+        assert Tp <= max_len
+        caches = self.init_cache(B, max_len, params["embed"].dtype)
+        h = None
+        for s in range(0, Tp, chunk):
+            h, caches = self._decode_trunk(
+                params, ids[:, s:s + chunk], s, caches)
+        return h[:, -1] @ params["embed"].T, caches
+
     def decode_one(self, params, tokens, pos, caches, cross=None,
                    cross_mask=None):
         """One cached step. tokens: (B,) int ids at position ``pos``
@@ -651,12 +674,12 @@ class Transformer(Module):
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
         return h[:, 0] @ params["embed"].T, new_caches
 
-    def decode_chunk(self, params, tokens, pos, caches):
-        """S cached steps in one forward (LM mode): tokens (B, S) land
-        at positions pos..pos+S-1; returns (logits (B, S, V), caches).
-        ``logits[:, i]`` is the next-token distribution after consuming
-        ``tokens[:, :i+1]`` — the speculative-decode verification shape
-        (nn/speculative.py)."""
+    def _decode_trunk(self, params, tokens, pos, caches):
+        """Shared cached-decode trunk: embed + PE + block stack + final
+        LayerNorm for S tokens landing at positions pos..pos+S-1.
+        Returns (hidden (B, S, H), caches) WITHOUT the vocab projection
+        — chunked prefill projects only the last position, decode_chunk
+        projects all S."""
         assert self.mode == "lm"
         emb = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
         h = emb * math.sqrt(self.hidden_size)
@@ -671,6 +694,15 @@ class Transformer(Module):
                                      pos)
             new_caches.append(kvn)
         h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
+        return h, new_caches
+
+    def decode_chunk(self, params, tokens, pos, caches):
+        """S cached steps in one forward (LM mode): tokens (B, S) land
+        at positions pos..pos+S-1; returns (logits (B, S, V), caches).
+        ``logits[:, i]`` is the next-token distribution after consuming
+        ``tokens[:, :i+1]`` — the speculative-decode verification shape
+        (nn/speculative.py)."""
+        h, new_caches = self._decode_trunk(params, tokens, pos, caches)
         return h @ params["embed"].T, new_caches
 
     def generate(self, params, prompt_ids, max_new_tokens: int,
@@ -784,15 +816,8 @@ class Transformer(Module):
         paths, roots = _beam_backtrack(toks, parents, B, K)
         root_tok = jnp.take_along_axis(tok0, roots, axis=1)  # (B, K)
         paths = jnp.concatenate([root_tok[None], paths], axis=0)
-
-        lens = jnp.sum(paths != 0, axis=0).astype(jnp.float32)
-        norm = jnp.maximum(lens, 1.0) ** length_penalty
-        final = scores.reshape(B, K) / norm
-        best = jnp.argmax(final, axis=1)
-        out = jnp.take_along_axis(
-            paths, best[None, :, None], axis=2)[:, :, 0]         # (T, B)
-        return jnp.concatenate([prompt_ids, jnp.moveaxis(out, 0, 1)],
-                               axis=1)
+        out = _beam_select(scores, paths, B, K, length_penalty)
+        return jnp.concatenate([prompt_ids, out], axis=1)
 
     def _beam_scan(self, step_fn, caches, tok, scores, done, pos0,
                    steps, B, K, eos_id):
@@ -925,14 +950,7 @@ class Transformer(Module):
             caches, bos, scores0, done0, jnp.int32(0), max_new_tokens,
             B, K, eos_id)
         paths, _ = _beam_backtrack(toks, parents, B, K)
-
-        lens = jnp.sum(paths != 0, axis=0).astype(jnp.float32)  # (B, K)
-        norm = jnp.maximum(lens, 1.0) ** length_penalty
-        final = scores.reshape(B, K) / norm
-        best = jnp.argmax(final, axis=1)                        # (B,)
-        out = jnp.take_along_axis(
-            paths, best[None, :, None], axis=2)[:, :, 0]        # (T, B)
-        return jnp.moveaxis(out, 0, 1)
+        return _beam_select(scores, paths, B, K, length_penalty)
 
 
 def _beam_backtrack(toks, parents, B, K):
@@ -953,3 +971,18 @@ def _beam_backtrack(toks, parents, B, K):
     init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
     roots, rev = jax.lax.scan(walk, init, (toks[::-1], parents[::-1]))
     return rev[::-1], roots
+
+
+def _beam_select(scores, paths, B, K, length_penalty):
+    """Pick each row's best beam under the length penalty and return its
+    token path as (B, T). One implementation of the scoring convention
+    (length = count of non-pad tokens, clamped to 1;
+    score = sum log-prob / len**penalty) for both LM and translation
+    beam search."""
+    lens = jnp.sum(paths != 0, axis=0).astype(jnp.float32)  # (B, K)
+    norm = jnp.maximum(lens, 1.0) ** length_penalty
+    final = scores.reshape(B, K) / norm
+    best = jnp.argmax(final, axis=1)                        # (B,)
+    out = jnp.take_along_axis(
+        paths, best[None, :, None], axis=2)[:, :, 0]        # (T, B)
+    return jnp.moveaxis(out, 0, 1)

@@ -10,25 +10,24 @@ verifies all ``k`` (plus a bonus token) in ONE cached chunked forward
 (``Transformer.decode_chunk``) — a single weight stream serving up to
 ``k+1`` emitted tokens.
 
-This implementation is GREEDY speculative decoding, which is exactly
-output-preserving: the emitted sequence is identical, token for token,
-to ``model.generate(params, ..., temperature=0)`` — the draft only
-changes the *schedule* of target forwards, never the result (tested
-against the dense-generate oracle in tests/test_speculative.py).
+Two modes:
 
-Batching: acceptance is LOCKSTEP — each round accepts ``j = min`` over
-the batch of the per-row agreement-prefix lengths, so a single shared
-scalar cache position serves the whole batch. Per-row exactness still
-holds (a row that agreed beyond ``j`` re-emits its own greedy token as
-the bonus), but the expected speedup decays with batch size; B=1 (the
-latency-serving case) is where speculative decoding pays.
-
-TPU notes: the whole loop is one ``lax.while_loop`` under ``jit`` —
-fixed-shape output buffer, masked variable-length emission, no host
-sync per round. KV caches are never rewound: rejected positions hold
-garbage that position-masked decode attention
-(``Attention.decode_chunk``) never reads, and the next round's writes
-overwrite them.
+- ``temperature == 0`` — GREEDY speculative decoding, exactly
+  output-preserving: the emitted sequence is identical, token for
+  token, to ``model.generate(params, ..., temperature=0)`` — the draft
+  only changes the *schedule* of target forwards, never the result
+  (tested against the dense-generate oracle in
+  tests/test_speculative.py).
+- ``temperature > 0`` — SAMPLING speculative decoding via rejection
+  sampling: proposal ``d_i`` (drawn from the draft distribution
+  ``p_d``) is accepted with probability ``min(1, p_t(d_i)/p_d(d_i))``;
+  on rejection the token is re-drawn from the residual
+  ``max(p_t - p_d, 0)`` (renormalised), and on a fully-accepted round
+  the bonus token is drawn from ``p_t`` directly. Each emitted token is
+  distributed EXACTLY as target sampling at that temperature — the
+  draft changes the schedule and the random-number consumption, never
+  the distribution (statistically tested against the enumerated target
+  marginal).
 
 Exactness scope: unconditional for dense ``TransformerLM`` targets. A
 ``MoETransformerLM`` target is exact only while expert capacity is not
@@ -36,6 +35,23 @@ saturated — the k+1-token verify forward recomputes routing per chunk,
 so tight ``capacity_factor`` can drop a token there that one-token
 steps keep (the same cached-vs-full caveat documented on the MoE LM's
 inference bindings).
+
+Batching: acceptance is LOCKSTEP — each round accepts ``j = min`` over
+the batch of the per-row acceptance-prefix lengths, so a single shared
+scalar cache position serves the whole batch. Per-row correctness still
+holds (a row that accepted beyond ``j`` emits its own accepted draft
+token at position ``j+1``; a row that rejected there re-draws from its
+own residual), but the expected speedup decays with batch size; B=1
+(the latency-serving case) is where speculative decoding pays.
+
+TPU notes: the whole loop is one ``lax.while_loop`` under ``jit`` —
+fixed-shape output buffer, masked variable-length emission, no host
+sync per round. KV caches are never rewound: rejected positions hold
+garbage that position-masked decode attention
+(``Attention.decode_chunk``) never reads, and the next round's writes
+overwrite them. The sampling mode carries the draft's per-step
+distribution rows ((B, k, V) f32) through the round — at bench scale
+(B8, k4, V32k) that is ~4 MB, negligible next to the KV caches.
 """
 from __future__ import annotations
 
@@ -54,9 +70,10 @@ class SpecStats(NamedTuple):
 
 def speculative_generate(model, params, draft_model, draft_params,
                          prompt_ids, max_new_tokens: int, k: int = 4,
+                         temperature: float = 0.0, rng=None,
                          return_stats: bool = False):
-    """Greedy speculative generation; output is exactly
-    ``model.generate(params, prompt_ids, max_new_tokens)`` (greedy).
+    """Speculative generation (greedy at ``temperature == 0``, rejection
+    sampling above — see module docstring for the guarantees).
 
     model / draft_model: LM-mode ``nn.Transformer``s over the SAME
     vocabulary (the draft is typically far shallower). k: draft tokens
@@ -67,6 +84,7 @@ def speculative_generate(model, params, draft_model, draft_params,
     assert model.vocab_size == draft_model.vocab_size, \
         "draft and target must share a vocabulary"
     assert k >= 1
+    sampling = temperature > 0.0
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     B, Tp = prompt_ids.shape
     if max_new_tokens <= 0:
@@ -77,10 +95,18 @@ def speculative_generate(model, params, draft_model, draft_params,
     cap = Tp + max_new_tokens + k + 1
     assert cap <= model.max_len and cap <= draft_model.max_len, \
         (cap, model.max_len, draft_model.max_len)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
 
     logits_t, caches_t = model.prefill(params, prompt_ids, cap)
     _, caches_d = draft_model.prefill(draft_params, prompt_ids, cap)
-    first = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+    key0, rng = jax.random.split(rng)
+    if sampling:
+        first = jax.random.categorical(
+            key0, logits_t.astype(jnp.float32) / temperature,
+            axis=-1).astype(jnp.int32)
+    else:
+        first = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
 
     buf = jnp.zeros((B, max_new_tokens + k + 1), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, first[:, None], (0, 0))
@@ -89,52 +115,94 @@ def speculative_generate(model, params, draft_model, draft_params,
         return c["n"] < max_new_tokens
 
     def body(c):
-        # --- draft phase: k+1 greedy cached steps from the last token.
+        key, kd, ka, kr = jax.random.split(c["key"], 4)
+
+        # --- draft phase: k+1 cached steps from the last token.
         # k steps would suffice to PROPOSE d_1..d_k, but the (k+1)-th
         # step writes d_k's K/V into the draft cache: on a
         # fully-accepted round the next round starts past d_k, and a
         # k-step draft would leave a garbage hole at d_k's position that
-        # poisons every later proposal (exactness would survive — the
+        # poisons every later proposal (correctness would survive — the
         # target never trusts the draft — but acceptance collapses).
-        def dstep(carry, _):
+        def dstep(carry, i):
             tok, dc, p = carry
             lg, dc = draft_model.decode_one(draft_params, tok, p, dc)
-            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            return (nxt, dc, p + 1), nxt
+            if sampling:
+                lf = lg.astype(jnp.float32) / temperature
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(kd, i), lf, axis=-1)
+                probs = jax.nn.softmax(lf, axis=-1)
+            else:
+                nxt = jnp.argmax(lg, axis=-1)
+                probs = jnp.zeros((B, 0), jnp.float32)  # unused
+            return (nxt.astype(jnp.int32), dc, p + 1), (nxt, probs)
 
-        (_, caches_d, _), drafts = jax.lax.scan(
-            dstep, (c["last"], c["caches_d"], c["pos"]), None,
-            length=k + 1)
-        drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]        # (B, k)
+        (_, caches_d, _), (drafts_all, pdraft_all) = jax.lax.scan(
+            dstep, (c["last"], c["caches_d"], c["pos"]),
+            jnp.arange(k + 1))
+        drafts = jnp.moveaxis(drafts_all, 0, 1)[:, :k].astype(jnp.int32)
 
         # --- verify phase: ONE chunked target forward over
-        # [last, d_1..d_k]; logits row i = target's choice after
-        # consuming the first i+1 of those tokens
+        # [last, d_1..d_k]; logits row i = the target's next-token
+        # distribution after consuming the first i+1 of those tokens
         chunk = jnp.concatenate([c["last"][:, None], drafts], axis=1)
         lg, caches_t = model.decode_chunk(params, chunk, c["pos"],
                                           c["caches_t"])
-        choices = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # (B, k+1)
 
-        # per-row agreement prefix; lockstep-min across the batch keeps
-        # one shared cache position (see module docstring)
-        match = (drafts == choices[:, :k]).astype(jnp.int32)
-        j = jnp.min(jnp.cumprod(match, axis=1).sum(axis=1))  # scalar
-        idx = jnp.arange(k + 1)
-        bonus = jnp.take_along_axis(
-            choices, jnp.full((B, 1), j), axis=1)[:, 0]      # (B,)
         dpad = jnp.concatenate(
             [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)  # (B, k+1)
+        idx = jnp.arange(k + 1)
+        if sampling:
+            p_t = jax.nn.softmax(
+                lg.astype(jnp.float32) / temperature, axis=-1)
+            p_d = jnp.moveaxis(pdraft_all, 0, 1)[:, :k]      # (B, k, V)
+            d_idx = drafts[..., None]
+            pt_d = jnp.take_along_axis(p_t[:, :k], d_idx, -1)[..., 0]
+            pd_d = jnp.take_along_axis(p_d, d_idx, -1)[..., 0]
+            u = jax.random.uniform(ka, (B, k))
+            # accept iff u < p_t/p_d, written division-free (pd_d -> 0
+            # limit accepts whenever the target gives the token mass)
+            acc = (u * pd_d < pt_d).astype(jnp.int32)
+            a_row = jnp.cumprod(acc, axis=1).sum(axis=1)     # (B,)
+            j = jnp.min(a_row)
+            # token at position j: accepted rows keep their draft;
+            # rejected rows re-draw from the residual max(p_t-p_d, 0).
+            # On a fully-accepted round (j == k) there is no proposal:
+            # zeroing p_d makes the residual p_t itself — the standard
+            # bonus draw — so one code path serves both cases.
+            pt_j = jax.lax.dynamic_index_in_dim(p_t, j, 1, False)
+            pd_j = jax.lax.dynamic_index_in_dim(
+                p_d, jnp.minimum(j, k - 1), 1, False)
+            pd_j = jnp.where(j == k, 0.0, pd_j)
+            res = jnp.maximum(pt_j - pd_j, 0.0)
+            res = jnp.where(res.sum(-1, keepdims=True) > 0, res, pt_j)
+            res_tok = jax.random.categorical(
+                kr, jnp.log(jnp.maximum(res, 1e-38)),
+                axis=-1).astype(jnp.int32)
+            draft_j = jnp.take_along_axis(
+                dpad, jnp.full((B, 1), j), axis=1)[:, 0]
+            nxt = jnp.where(a_row > j, draft_j, res_tok)
+        else:
+            choices = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            match = (drafts == choices[:, :k]).astype(jnp.int32)
+            a_row = jnp.cumprod(match, axis=1).sum(axis=1)
+            j = jnp.min(a_row)
+            # greedy: every row's token at position j is the target's
+            # own argmax there (rows that matched beyond j agree with
+            # their draft anyway)
+            nxt = jnp.take_along_axis(
+                choices, jnp.full((B, 1), j), axis=1)[:, 0]
+
         emit = jnp.where(idx[None, :] < j, dpad,
-                         jnp.where(idx[None, :] == j,
-                                   bonus[:, None], 0))
+                         jnp.where(idx[None, :] == j, nxt[:, None], 0))
         out = jax.lax.dynamic_update_slice(c["out"], emit, (0, c["n"]))
         return dict(
-            caches_t=caches_t, caches_d=caches_d, last=bonus,
+            caches_t=caches_t, caches_d=caches_d, last=nxt, key=key,
             pos=c["pos"] + j + 1, n=c["n"] + j + 1, out=out,
             rounds=c["rounds"] + 1, accepted=c["accepted"] + j)
 
     final = jax.lax.while_loop(cond, body, dict(
-        caches_t=caches_t, caches_d=caches_d, last=first,
+        caches_t=caches_t, caches_d=caches_d, last=first, key=rng,
         pos=jnp.int32(Tp), n=jnp.int32(1), out=buf,
         rounds=jnp.int32(0), accepted=jnp.int32(0)))
 

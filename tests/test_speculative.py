@@ -113,3 +113,58 @@ def test_speculative_composes_with_weight_only_quantization():
     got = np.asarray(speculative_generate(model, q4, draft, dparams, ids,
                                           max_new_tokens=8, k=3))
     assert (got == want).all()
+
+
+def test_speculative_sampling_self_draft_accepts():
+    """temperature > 0 with draft == target: the acceptance ratio
+    p_t/p_d is ~1 everywhere, so essentially every proposal is
+    accepted, and the run is jit-compatible end to end."""
+    model, params = _lm(layers=2, heads=2)
+    ids = _prompt(2, 6)
+    fn = jax.jit(lambda p, x, key: speculative_generate(
+        model, p, model, p, x, max_new_tokens=10, k=3, temperature=0.8,
+        rng=key, return_stats=True))
+    got, stats = fn(params, ids, jax.random.PRNGKey(1))
+    assert got.shape == (2, 16)
+    assert ((np.asarray(got) >= 0) & (np.asarray(got) < 61)).all()
+    assert int(stats.accepted) >= 0.9 * int(stats.drafted)
+
+
+def test_speculative_sampling_preserves_target_distribution():
+    """Rejection-sampling speculative decoding must emit tokens from
+    EXACTLY the target distribution. Check the second generated token:
+    its true marginal is sum_t1 p(t1) p(t2|t1), enumerable at V=9; the
+    empirical distribution over 512 iid batch rows x 4 seeds (draft and
+    target DISAGREE, so the rejection path is exercised) must match
+    within 4-sigma binomial tolerance."""
+    V = 9
+    model, params = _lm(layers=2, heads=2, vocab=V, seed=21)
+    draft, dparams = _lm(layers=1, heads=2, vocab=V, seed=22)
+    temp = 1.0
+    prompt = _prompt(1, 4, vocab=V, seed=23)
+    B = 512
+    ids = jnp.tile(prompt, (B, 1))
+
+    fn = jax.jit(lambda key: speculative_generate(
+        model, params, draft, dparams, ids, max_new_tokens=2, k=2,
+        temperature=temp, rng=key))
+    samples = np.concatenate([
+        np.asarray(fn(jax.random.PRNGKey(s)))[:, prompt.shape[1] + 1]
+        for s in range(4)])
+    emp = np.bincount(samples, minlength=V) / samples.size
+
+    # enumerate the exact marginal of token 2 under pure target sampling
+    lg, _ = model.apply(params, {}, prompt, training=False)
+    p1 = np.asarray(jax.nn.softmax(lg[0, -1].astype(jnp.float32) / temp))
+    marg = np.zeros(V)
+    for t1 in range(V):
+        ext = jnp.concatenate(
+            [prompt, jnp.full((1, 1), t1, jnp.int32)], axis=1)
+        lg2, _ = model.apply(params, {}, ext, training=False)
+        p2 = np.asarray(jax.nn.softmax(
+            lg2[0, -1].astype(jnp.float32) / temp))
+        marg += p1[t1] * p2
+
+    tol = 4 * np.sqrt(marg * (1 - marg) / samples.size) + 1e-3
+    assert (np.abs(emp - marg) < tol).all(), \
+        np.stack([emp, marg, np.abs(emp - marg), tol])
