@@ -83,6 +83,39 @@ def shard_params(params, mesh: Mesh):
         lambda x: put_global(x, mesh, P()), params)
 
 
+def transformer_tp_specs(params, axis: str = "model"):
+    """PartitionSpec tree for Transformer/TransformerLM params —
+    Megatron-style tensor parallelism: attention q/k/v column-sharded,
+    output projection row-sharded; FFN w1 (and SwiGLU's w3 gate)
+    column-, w2 row-sharded; everything else (embedding, norms, biases
+    except b1) replicated. Works for training (the ``__graft_entry__``
+    dryrun jits the full train step over these) AND inference:
+    ``jax.jit(model.generate)`` over params placed with these specs
+    decodes tensor-parallel, XLA inserting the per-layer psum — the
+    multi-chip serving path (tested on the 8-device mesh in
+    tests/test_distributed.py). Head-count caveat: the column shards
+    must not split a head — num_heads (and num_kv_heads, and
+    filter_size) should be divisible by the axis size."""
+
+    def spec_for(path, leaf):
+        keys = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+        joined = "/".join(keys)
+        if leaf.ndim == 2:
+            if any(k in joined for k in ("wq", "wk", "wv")):
+                return P(None, axis)
+            if "wo" in joined:
+                return P(axis, None)
+            if "w1" in joined or "w3" in joined:   # w3: SwiGLU gate
+                return P(None, axis)
+            if "w2" in joined:
+                return P(axis, None)
+        if "b1" in joined and leaf.ndim == 1:
+            return P(axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
 def tp_linear_rules(axis: str = "model"):
     """PartitionSpecs for a column→row parallel Linear pair (Megatron-style):
     first Linear's (out, in) weight column-sharded, second row-sharded;

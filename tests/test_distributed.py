@@ -339,3 +339,29 @@ def test_sparse_embedding_grad_allreduce_matches_dense_psum():
                   check_vma=False)
     np.testing.assert_allclose(out, np.asarray(jax.jit(g)(ids, rows)),
                                atol=1e-5)
+
+
+def test_tensor_parallel_decode_matches_single_device():
+    """Multi-chip serving path: generate (prefill + cached decode scan)
+    jitted over TP-sharded params on a 1x8 'model' mesh emits exactly
+    the single-device tokens — XLA inserts the per-layer psums from the
+    transformer_tp_specs placement alone."""
+    from jax.sharding import Mesh, NamedSharding
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.parallel import transformer_tp_specs
+
+    model = TransformerLM(vocab_size=67, hidden_size=32, num_heads=8,
+                          filter_size=64, num_layers=2, max_len=32)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(1).randint(1, 67, (2, 6)),
+                      jnp.int32)
+    want = np.asarray(model.generate(params, ids, max_new_tokens=8))
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("model",))
+    specs = transformer_tp_specs(params)
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)
+    got = np.asarray(jax.jit(lambda p, x: model.generate(
+        p, x, max_new_tokens=8))(sharded, ids))
+    assert (got == want).all()
