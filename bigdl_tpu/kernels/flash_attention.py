@@ -60,7 +60,8 @@ def _mm(a, b, ta=False, tb=False):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, block_q, block_k, causal, kv_len, nk):
+                *, scale, block_q, block_k, causal, kv_len, nk,
+                q_offset=0):
     i = pl.program_id(2)   # query-block index
     j = pl.program_id(3)   # key-block index (sequential, innermost)
 
@@ -70,7 +71,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q_off = i * block_q
+    # q_offset: q row r sits at GLOBAL position q_offset + r (chunked
+    # prefill over a KV cache — rectangular causal); 0 for self-attention
+    q_off = i * block_q + q_offset
     k_off = j * block_k
     # key blocks strictly above the causal diagonal contribute nothing
     needed = (k_off <= q_off + block_q - 1) if causal else (j >= 0)
@@ -123,19 +126,27 @@ def _sds(shape, dtype, vma):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               vma=None):
+               vma=None, q_offset=0, kv_len=None):
     b, h, t_q, d = q.shape
     t_kv = k.shape[2]
+    # kv_len < t_kv: attend only the first kv_len positions (the VALID
+    # prefix of a decode cache — chunked prefill). The GRID is bounded
+    # to ceil(kv_len / bk) key blocks, so the garbage tail of the cache
+    # is never DMA'd and the caller needs no slice copy of K/V.
+    kv_len = t_kv if kv_len is None else int(kv_len)
     bq = _pick_block(t_q, block_q)
-    bk = _pick_block(t_kv, block_k)
+    bk = _pick_block(kv_len, block_k)
     tq_pad = (t_q + bq - 1) // bq * bq
-    tkv_pad = (t_kv + bk - 1) // bk * bk
-    qp, kp, vp = _pad_t(q, tq_pad), _pad_t(k, tkv_pad), _pad_t(v, tkv_pad)
-    nq, nk = tq_pad // bq, tkv_pad // bk
+    nk = (kv_len + bk - 1) // bk
+    tkv_need = nk * bk
+    qp = _pad_t(q, tq_pad)
+    kp = _pad_t(k, tkv_need) if tkv_need > t_kv else k
+    vp = _pad_t(v, tkv_need) if tkv_need > t_kv else v
+    nq = tq_pad // bq
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=bq, block_k=bk, causal=causal,
-        kv_len=t_kv, nk=nk)
+        kv_len=kv_len, nk=nk, q_offset=q_offset)
     o, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -355,3 +366,25 @@ def flash_attention_fused(q, k, v, causal: bool = False,
         scale = 1.0 / math.sqrt(q.shape[-1])
     return _flash(q, k, v, bool(causal), float(scale),
                   int(block_q), int(block_k), bool(interpret))
+
+
+def flash_chunk_attention(q, k, v, q_offset: int, kv_len: int = None,
+                          scale: float | None = None,
+                          block_q: int = 512, block_k: int = 512,
+                          interpret: bool = False):
+    """Rectangular-causal flash attention for CHUNKED cached decode:
+    q (B, H, S, D) holds positions q_offset..q_offset+S-1; k/v are a KV
+    cache whose first ``kv_len`` positions are valid (default: all of
+    it) and already contain this chunk's keys. Row r attends columns
+    <= q_offset + r. Pass the FULL cache with ``kv_len`` — the grid is
+    bounded to the valid key blocks, so the garbage tail is never
+    streamed and no slice copy is made. O(S) memory scratch per block
+    instead of the einsum path's (B, H, S, kv_len) logits — what makes
+    ``Transformer.prefill_chunked`` practical at 100k-token prompts.
+    Forward-only (inference path; no vjp)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    o, _ = _flash_fwd(q, k, v, True, float(scale), int(block_q),
+                      int(block_k), bool(interpret),
+                      q_offset=int(q_offset), kv_len=kv_len)
+    return o

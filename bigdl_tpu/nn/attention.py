@@ -229,9 +229,23 @@ class Attention(Module):
             v_cache, v_t.astype(v_cache.dtype), (0, 0, pos, 0))
         d = q.shape[-1]
         t = k_cache.shape[2]
+        groups = self.num_heads // self._kvh()
+        if (groups == 1 and self.use_flash and isinstance(pos, int)
+                and S >= 8):
+            # static offset (chunked prefill: the chunk loop is unrolled
+            # with Python-int positions) → the rectangular-causal flash
+            # kernel streams the valid cache prefix in tiles instead of
+            # materialising (B, H, S, pos+S) logits. The FULL cache is
+            # passed with kv_len — the kernel bounds its grid to the
+            # valid key blocks, no slice copy. Traced pos (speculative
+            # verify, S = k+1 ~ 5) keeps the einsum below — its logits
+            # are tiny there.
+            from ..parallel.flash import flash_chunk_attention
+            o = flash_chunk_attention(q, k_cache, v_cache, q_offset=pos,
+                                      kv_len=pos + S)
+            return self._merge(o, params), k_cache, v_cache
         keep = (jnp.arange(t)[None, :]
                 <= (pos + jnp.arange(S))[:, None])          # (S, T)
-        groups = self.num_heads // self._kvh()
         if groups > 1:
             b, h, _, dd = q.shape
             kvh = h // groups

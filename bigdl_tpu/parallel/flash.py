@@ -66,35 +66,73 @@ def _flash_blocks():
             "block_k": int(os.environ.get("BIGDL_TPU_FLASH_BLOCK_K", 512))}
 
 
-def flash_attention(q, k, v, causal: bool = False):
-    """q, k, v: (B, H, T, D)."""
+def _dispatch(name, kernel_fn, fallback_fn):
+    """The ONE dispatch policy (off / interpret / pallas-with-logged-
+    fallback / einsum) shared by every flash entry point.
+    ``kernel_fn(interpret)`` runs the Pallas kernel; ``fallback_fn()``
+    the einsum path."""
     mode = flash_mode()
     if os.environ.get("BIGDL_TPU_FLASH") == "off":
-        return _einsum_fallback(q, k, v, causal)  # explicit: no warning
+        return fallback_fn()          # explicit opt-out: no warning
     if mode == "interpret":
-        from ..kernels.flash_attention import flash_attention_fused
-        return flash_attention_fused(q, k, v, causal=causal, interpret=True,
-                                     **_flash_blocks())
-
+        return kernel_fn(True)
     try:
         backend = jax.default_backend()
     except Exception:
         backend = "cpu"
     if mode == "pallas":
         try:
-            # import inside the branch: a jax build without pallas must not
-            # break the einsum path for non-TPU callers
-            from ..kernels.flash_attention import flash_attention_fused
-            return flash_attention_fused(q, k, v, causal=causal,
-                                         **_flash_blocks())
+            return kernel_fn(False)
         except Exception as e:
-            _warn_once(("kernel", backend),
-                       "Pallas flash-attention kernel failed on backend %r "
-                       "(%s); falling back to O(T^2) einsum attention",
-                       backend, e)
-            return _einsum_fallback(q, k, v, causal)
-    _warn_once(("backend", backend),
-               "flash attention: non-TPU backend %r uses the einsum path "
-               "(set BIGDL_TPU_FLASH=interpret to run the Pallas kernel "
-               "in interpreter mode)", backend)
-    return _einsum_fallback(q, k, v, causal)
+            _warn_once((name, "kernel", backend),
+                       "Pallas %s kernel failed on backend %r (%s); "
+                       "falling back to the einsum path", name, backend, e)
+            return fallback_fn()
+    _warn_once((name, "backend", backend),
+               "%s: non-TPU backend %r uses the einsum path (set "
+               "BIGDL_TPU_FLASH=interpret to run the Pallas kernel in "
+               "interpreter mode)", name, backend)
+    return fallback_fn()
+
+
+def flash_attention(q, k, v, causal: bool = False):
+    """q, k, v: (B, H, T, D)."""
+
+    def kernel(interpret):
+        # import inside the branch: a jax build without pallas must not
+        # break the einsum path for non-TPU callers
+        from ..kernels.flash_attention import flash_attention_fused
+        return flash_attention_fused(q, k, v, causal=causal,
+                                     interpret=interpret, **_flash_blocks())
+
+    return _dispatch("flash attention", kernel,
+                     lambda: _einsum_fallback(q, k, v, causal))
+
+
+def _einsum_chunk_fallback(q, k, v, q_offset, kv_len):
+    from ..nn.attention import dot_product_attention
+    k, v = k[:, :, :kv_len], v[:, :, :kv_len]
+    s = q.shape[-2]
+    mask = jnp.where(
+        jnp.arange(kv_len)[None, :] <= q_offset + jnp.arange(s)[:, None],
+        0.0, -1e9)[None, None]
+    return dot_product_attention(q, k, v, mask)
+
+
+def flash_chunk_attention(q, k, v, q_offset: int, kv_len: int = None):
+    """Rectangular-causal chunk attention over the first ``kv_len``
+    positions of a KV cache (Transformer.prefill_chunked):
+    q (B, H, S, D) at global positions q_offset... Same dispatch policy
+    as :func:`flash_attention`; the einsum fallback materialises the
+    (S, kv_len) mask/logits the kernel exists to avoid."""
+    if kv_len is None:
+        kv_len = k.shape[2]
+
+    def kernel(interpret):
+        from ..kernels.flash_attention import flash_chunk_attention as fck
+        return fck(q, k, v, q_offset, kv_len=kv_len, interpret=interpret,
+                   **_flash_blocks())
+
+    return _dispatch("chunk attention", kernel,
+                     lambda: _einsum_chunk_fallback(q, k, v, q_offset,
+                                                    kv_len))

@@ -168,3 +168,21 @@ def test_speculative_sampling_preserves_target_distribution():
     tol = 4 * np.sqrt(marg * (1 - marg) / samples.size) + 1e-3
     assert (np.abs(emp - marg) < tol).all(), \
         np.stack([emp, marg, np.abs(emp - marg), tol])
+
+
+def test_speculative_moe_target_exact_at_loose_capacity():
+    """A MoE target with unsaturated expert capacity is exact under
+    speculative decoding (the documented caveat bites only when the
+    k+1-token verify forward overflows capacity and drops a token)."""
+    from bigdl_tpu.models import MoETransformerLM
+
+    moe = MoETransformerLM(vocab_size=61, hidden_size=32, num_heads=2,
+                           filter_size=64, num_layers=2, n_experts=2,
+                           capacity_factor=4.0, max_len=64)
+    mp, _ = moe.init(jax.random.PRNGKey(17))
+    draft, dp = _lm(layers=1, heads=2, seed=18)
+    ids = _prompt(2, 6, seed=19)
+    want = np.asarray(moe.generate(mp, ids, max_new_tokens=8))
+    got = np.asarray(speculative_generate(moe, mp, draft, dp, ids,
+                                          max_new_tokens=8, k=3))
+    assert (got == want).all()
