@@ -5,7 +5,7 @@ from .collective import (all_reduce_sum, all_reduce_mean, all_gather,
                          pmean)
 from .allreduce import AllReduceParameter, FP16CompressPolicy
 from .sharding import (replicated, data_sharding, shard_batch, shard_params,
-                       tp_linear_rules, transformer_tp_specs)
+                       tp_linear_rules, transformer_tp_specs, fsdp_specs)
 from .ring_attention import ring_attention
 from .failure import (probe_mesh, MeshProbeResult, Heartbeat, HeartbeatLost,
                       StragglerMonitor)
