@@ -397,9 +397,10 @@ def test_fsdp_sharded_training_matches_replicated():
         return loss, p, s
 
     # replicated oracle (two steps)
+    step_j = jax.jit(step)
     s0 = optim.init_state(params)
-    l1, p_r, s_r = jax.jit(step)(params, s0, x, y)
-    l2, p_r, _ = jax.jit(step)(p_r, s_r, x, y)
+    l1, p_r, s_r = step_j(params, s0, x, y)
+    l2, p_r, _ = step_j(p_r, s_r, x, y)
 
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
     specs = fsdp_specs(params, mesh, min_elems=256)
@@ -412,8 +413,8 @@ def test_fsdp_sharded_training_matches_replicated():
     xb = jax.device_put(x, NamedSharding(mesh, P("data")))
     yb = jax.device_put(y, NamedSharding(mesh, P("data")))
     sf = optim.init_state(fp)
-    f1, p_f, s_f = jax.jit(step)(fp, sf, xb, yb)
-    f2, p_f, _ = jax.jit(step)(p_f, s_f, xb, yb)
+    f1, p_f, s_f = step_j(fp, sf, xb, yb)
+    f2, p_f, _ = step_j(p_f, s_f, xb, yb)
 
     np.testing.assert_allclose(float(l1), float(f1), rtol=1e-5)
     np.testing.assert_allclose(float(l2), float(f2), rtol=1e-5)
