@@ -13,4 +13,5 @@ from .pipeline import gpipe, stack_stage_params, unstack_stage_params
 from .moe import moe_ffn, top1_routing
 from .ring_flash import ring_flash_attention, make_ring_flash_attention
 from .seq_all_to_all import a2a_attention
+from .seq_decode import make_seq_sharded_decoder
 from .allreduce import sparse_embedding_grad_allreduce

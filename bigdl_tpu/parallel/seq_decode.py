@@ -1,0 +1,105 @@
+"""Sequence-sharded KV-cache decode — long-context DISTRIBUTED serving.
+
+A 100k-token conversation's KV cache can exceed one chip's HBM even
+with GQA and quantization. Here the cache is sharded over a mesh axis
+along TIME (device d owns global positions d*Tl .. (d+1)*Tl - 1); each
+decode step runs one partial attention per device over its shard and
+combines the per-device online-softmax statistics with three tiny
+collectives (pmax of the running max, psum of the rescaled weights and
+weighted values) — the same math that merges key blocks inside the
+flash kernel, applied across devices. Per step each device touches only
+its 1/n of the cache: HBM traffic AND cache memory both scale down with
+the axis.
+
+Beyond the reference: its inference path (``PredictionService``/local
+Predictor) is data-parallel over complete models; the reference never
+shards a single sequence's state. The training-side analog of this
+module is ring attention (``parallel/ring_attention.py``); at decode
+there is one query token, so no ppermute ring is needed — statistics
+merging is cheaper than rotating K/V.
+
+Correctness oracle: ``tests/test_distributed.py`` drives a multi-step
+decode against the single-device cached path — token-identical.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _partial_decode_attention(q, k_shard, v_shard, pos, axis):
+    """Per-device body (runs inside shard_map): q (B, H, 1, D)
+    replicated; k/v shards (B, kvH, Tl, D) holding this device's global
+    positions d*Tl..; returns the globally combined (B, H, 1, D)."""
+    d_ix = jax.lax.axis_index(axis)
+    tl = k_shard.shape[2]
+    base = d_ix * tl
+    dh = q.shape[-1]
+    groups = q.shape[1] // k_shard.shape[1]
+    b, h, _, dd = q.shape
+    # the grouped form covers MHA too: groups == 1 makes the reshape a
+    # no-op and the einsum the plain (B, H, 1, Tl) score
+    qg = q.reshape(b, h // groups, groups, dd)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg,
+                   k_shard) / math.sqrt(dh)            # (B,kvH,G,Tl)
+    keep = (base + jnp.arange(tl)) <= pos
+    s = jnp.where(keep[None, None, None], s, -1e30)
+    m_loc = jnp.max(s, axis=-1)                        # (B,kvH,G)
+    m = jax.lax.pmax(m_loc, axis)
+    p = jnp.exp(s - m[..., None])
+    l = jax.lax.psum(jnp.sum(p, axis=-1), axis)        # (B,kvH,G)
+    o = jnp.einsum("bkgt,bktd->bkgd", p.astype(v_shard.dtype),
+                   v_shard)
+    o = jax.lax.psum(o, axis)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(b, h, 1, dh).astype(q.dtype)
+
+
+def _shard_write(cache, x_t, pos, axis):
+    """Write x_t (B, kvH, 1, D) at GLOBAL position ``pos`` into the
+    device's time shard — a no-op on every device but the owner."""
+    d_ix = jax.lax.axis_index(axis)
+    tl = cache.shape[2]
+    local = pos - d_ix * tl
+    owns = jnp.logical_and(local >= 0, local < tl)
+    upd = jax.lax.dynamic_update_slice(
+        cache, x_t.astype(cache.dtype),
+        (0, 0, jnp.clip(local, 0, tl - 1), 0))
+    return jnp.where(owns, upd, cache)
+
+
+def make_seq_sharded_decoder(mesh: Mesh, axis: str = "seq"):
+    """Build a decode step over a time-sharded KV cache.
+
+    The returned ``decode(q, k_t, v_t, k_cache, v_cache, pos)`` writes
+    this step's K/V (B, kvH, 1, D) at global ``pos`` into the owning
+    device's shard, attends q (B, nH, 1, D) over every valid position,
+    and returns (out, k_cache, v_cache). Cache arrays are
+    (B, kvH, Tmax, D) global, sharded P(None, None, axis, None) — Tmax
+    must divide by the axis size. GQA welcome (compact shards).
+
+    Capacity: ``pos`` MUST be < Tmax — like every fixed-size KV cache
+    here, a step past capacity is not representable; with a traced
+    ``pos`` it cannot raise, and the write would be silently dropped
+    (no device owns the position), so size Tmax for the full
+    generation up front. ``pos`` is a traced scalar: jit ONE step for
+    the whole loop, and donate the cache buffers —
+    ``jax.jit(decode, donate_argnums=(3, 4))`` — or each step pays a
+    full extra cache copy for the functional update."""
+
+    def body(q, k_t, v_t, k_cache, v_cache, pos):
+        k_cache = _shard_write(k_cache, k_t, pos, axis)
+        v_cache = _shard_write(v_cache, v_t, pos, axis)
+        out = _partial_decode_attention(q, k_cache, v_cache, pos, axis)
+        return out, k_cache, v_cache
+
+    spec_c = P(None, None, axis, None)
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P(), P(), spec_c, spec_c, P()),
+        out_specs=(P(), spec_c, spec_c),
+        check_vma=False)
