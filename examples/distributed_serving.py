@@ -1,0 +1,93 @@
+"""Multi-chip serving tour: TP decode, FSDP params, sharded KV cache.
+
+Three round-5, beyond-the-reference ways to put a mesh behind
+inference (the reference's PredictionService is data-parallel over
+complete model replicas only):
+
+1. TENSOR-PARALLEL decode — `transformer_tp_specs` places the LM's
+   matmul weights Megatron-style; `jax.jit(generate)` over that
+   placement decodes with XLA-inserted per-layer psums,
+   token-identical to single-device.
+2. FSDP/ZeRO-3 placement — `fsdp_specs` stores every big leaf at 1/N
+   per device; the SAME jitted generate serves from the sharded copy.
+3. SEQUENCE-SHARDED KV cache — `make_seq_sharded_decoder` shards the
+   cache itself along time (the 100k-token-conversation regime where
+   the cache, not the weights, outgrows a chip).
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     PYTHONPATH=. python examples/distributed_serving.py
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.models import TransformerLM
+from bigdl_tpu.parallel import (transformer_tp_specs, fsdp_specs,
+                                make_seq_sharded_decoder)
+
+
+def main():
+    n = len(jax.devices())
+    assert n >= 8, f"want an 8-device mesh (XLA_FLAGS), got {n}"
+    model = TransformerLM(vocab_size=211, hidden_size=64, num_heads=8,
+                          filter_size=128, num_layers=2, max_len=64)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(np.random.RandomState(1).randint(1, 211, (2, 8)),
+                         jnp.int32)
+    want = np.asarray(model.generate(params, prompt, max_new_tokens=12))
+    gen = jax.jit(lambda p, x: model.generate(p, x, max_new_tokens=12))
+
+    # 1. tensor-parallel decode
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("model",))
+    tp = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(v, NamedSharding(mesh, s)),
+        params, transformer_tp_specs(params))
+    assert (np.asarray(gen(tp, prompt)) == want).all()
+    print("1. TP decode == single-device (per-layer psums from placement)")
+
+    # 2. FSDP-placed params serve through the same jitted generate
+    dmesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+    fp = jax.tree_util.tree_map(
+        lambda v, s: jax.device_put(v, NamedSharding(dmesh, s)),
+        params, fsdp_specs(params, dmesh, min_elems=1024))
+    shard = fp["embed"].addressable_shards[0].data
+    assert shard.size == fp["embed"].size // 8
+    assert (np.asarray(gen(fp, prompt)) == want).all()
+    print(f"2. FSDP decode == single-device (embed stored "
+          f"{shard.shape} of {tuple(fp['embed'].shape)} per device)")
+
+    # 3. sequence-sharded KV cache, decoded step by step
+    smesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("seq",))
+    dec = jax.jit(make_seq_sharded_decoder(smesh, "seq"),
+                  donate_argnums=(3, 4))
+    B, kvH, D, Tmax = 1, 2, 16, 32
+    sh = NamedSharding(smesh, P(None, None, "seq", None))
+    kc = jax.device_put(jnp.zeros((B, kvH, Tmax, D), jnp.float32), sh)
+    vc = jax.device_put(jnp.zeros((B, kvH, Tmax, D), jnp.float32), sh)
+    rng = np.random.RandomState(2)
+    ks = np.zeros((B, kvH, Tmax, D), np.float32)
+    vs = np.zeros_like(ks)
+    for pos in range(12):
+        q = jnp.asarray(rng.randn(B, 4, 1, D), jnp.float32)
+        kt = jnp.asarray(rng.randn(B, kvH, 1, D), jnp.float32)
+        vt = jnp.asarray(rng.randn(B, kvH, 1, D), jnp.float32)
+        o, kc, vc = dec(q, kt, vt, kc, vc, jnp.int32(pos))
+        ks[:, :, pos], vs[:, :, pos] = kt[:, :, 0], vt[:, :, 0]
+        ke, ve = np.repeat(ks, 2, 1), np.repeat(vs, 2, 1)
+        s = np.einsum("bhqd,bhtd->bhqt", np.asarray(q), ke) / math.sqrt(D)
+        s[..., pos + 1:] = -1e30
+        w = np.exp(s - s.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        ref = np.einsum("bhqt,bhtd->bhqd", w, ve)
+        assert np.abs(np.asarray(o) - ref).max() < 1e-4
+    assert kc.addressable_shards[0].data.shape[2] == Tmax // 8
+    print("3. sequence-sharded cache: 12 steps across shard boundaries "
+          "== dense oracle; each device stores Tmax/8 positions")
+    print("distributed serving tour OK")
+
+
+if __name__ == "__main__":
+    main()

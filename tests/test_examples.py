@@ -35,7 +35,7 @@ def test_example_runs(script):
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     if script in ("long_context_ring.py", "transformer_lm_distributed.py",
-                  "wide_deep_sparse.py"):
+                  "wide_deep_sparse.py", "distributed_serving.py"):
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     proc = subprocess.run([sys.executable,
                            os.path.join(_REPO, "examples", script)],
