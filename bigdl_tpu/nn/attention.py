@@ -137,11 +137,14 @@ class Attention(Module):
                 raise ValueError(
                     f"num_kv_heads ({num_kv_heads}) must divide "
                     f"num_heads ({num_heads})")
-            if seq_axis is not None and num_kv_heads != num_heads:
-                raise ValueError(
-                    "grouped-query attention is not supported on the "
-                    "sequence-parallel paths (ring/a2a expect equal "
-                    "head counts) — use num_kv_heads=num_heads")
+            # GQA composes with the sequence-parallel paths: K/V heads
+            # are broadcast up to num_heads BEFORE the ring/a2a exchange
+            # (_apply's _expand_kv), so the kernels see equal head
+            # counts. The broadcast costs the GQA K/V memory saving on
+            # the TRAINING path only — the decode-path win (compact
+            # caches) is untouched. r4 rejected this combination; r5
+            # lifted it with the ring/a2a-vs-dense GQA oracle test
+            # (tests/test_seq_parallel.py).
 
     def _kvh(self):
         return self.num_kv_heads or self.num_heads

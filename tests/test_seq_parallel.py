@@ -152,3 +152,35 @@ def test_rope_seq_parallel_matches_dense():
                   out_specs=P(None, "seq", None))
     out = jax.jit(f)(params, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["a2a", "ring"])
+def test_gqa_composes_with_seq_parallel(impl):
+    """GQA + sequence parallelism (restriction lifted in r5): K/V heads
+    broadcast up before the exchange, so the seq-parallel module must
+    equal the same GQA module run dense on one device — incl. RoPE."""
+    from bigdl_tpu import nn
+    rng = np.random.RandomState(7)
+    B, T, Hdim, heads, kvh = 2, 64, 32, 8, 2
+    x = jnp.asarray(rng.randn(B, T, Hdim).astype(np.float32))
+
+    dense = nn.Attention(Hdim, heads, causal=True, use_flash=False,
+                         num_kv_heads=kvh, rope=True)
+    params, _ = dense.init(jax.random.PRNGKey(0))
+    ref, _ = dense.apply(params, {}, x, training=False)
+
+    sp = nn.Attention(Hdim, heads, causal=True, use_flash=False,
+                      seq_axis="seq", seq_impl=impl, num_kv_heads=kvh,
+                      rope=True)
+    mesh = _mesh()
+
+    def step(p, xb):
+        out, _ = sp.apply(p, {}, xb, training=False)
+        return out
+
+    f = shard_map(step, mesh=mesh,
+                  in_specs=(P(), P(None, "seq", None)),
+                  out_specs=P(None, "seq", None))
+    out = jax.jit(f)(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
