@@ -372,8 +372,10 @@ def child_main(which: str):
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_cache")))
+    from bigdl_tpu import observability as obs
     if which == "headline":
-        results = [bench_resnet50()]
+        with obs.span("bench/headline"):
+            results = [bench_resnet50()]
     elif which == "secondary":
         from bench_extra import bench_secondary
         results = bench_secondary()
@@ -382,6 +384,12 @@ def child_main(which: str):
         results = [bench_one(which.split(":", 1)[1])]
     else:
         raise SystemExit(f"unknown child config {which!r}")
+    # the parent owns line->registry accounting (_write_metrics_dump);
+    # the child's contribution is the bench/* spans — exportable with
+    # BIGDL_TPU_TRACE=1 BENCH_TRACE_OUT=/path/trace.json
+    trace_out = os.environ.get("BENCH_TRACE_OUT")
+    if trace_out and obs.enabled():
+        obs.write_chrome_trace(trace_out)
     for r in results:
         print(json.dumps(r), flush=True)
 
@@ -613,6 +621,46 @@ def _orchestrate(which: str):
              "vs_baseline": 0, "error": "; ".join(errors)[-500:]}]
 
 
+def _load_observability():
+    """Import bigdl_tpu.observability WITHOUT importing bigdl_tpu (whose
+    ``__init__`` pulls jax — this parent process must never import jax).
+    The subpackage is pure stdlib, so it loads standalone from its file
+    path under a private name."""
+    import importlib.util
+    name = "_bench_observability"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkgdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bigdl_tpu", "observability")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkgdir, "__init__.py"),
+        submodule_search_locations=[pkgdir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_metrics_dump(all_lines):
+    """Mirror the final bench lines through the observability registry
+    and write the BENCH_*-compatible metrics dump — bench results and
+    runtime metrics share one {"metric", "value", "unit"} schema.
+    Opt out with BENCH_METRICS_OUT=''."""
+    out = os.environ.get("BENCH_METRICS_OUT", "BENCH_METRICS.json")
+    if not out or not all_lines:
+        return
+    if not os.path.isabs(out):
+        out = os.path.join(os.path.dirname(os.path.abspath(__file__)), out)
+    try:
+        obs = _load_observability()
+        reg = obs.MetricsRegistry()
+        for line in all_lines:
+            obs.record_bench_line(line, reg)
+        obs.write_metrics_dump(out, reg)
+    except Exception as e:  # the dump must never fail the bench itself
+        print(f"bench: metrics dump failed: {e}", file=sys.stderr)
+
+
 def main():
     if "--child" in sys.argv:
         child_main(sys.argv[sys.argv.index("--child") + 1])
@@ -626,6 +674,7 @@ def main():
             from bench_extra import CONFIGS
             configs += [f"secondary:{k}" for k in CONFIGS]
         failed = False
+        all_lines = []
         for which in configs:
             env = _cpu_env()
             if which in ("secondary:transformer", "secondary:moe"):
@@ -640,11 +689,15 @@ def main():
                 failed = True
             for line in lines:
                 print(json.dumps(line), flush=True)
+                all_lines.append(line)
+        _write_metrics_dump(all_lines)
         if failed:
             raise SystemExit(1)
         return
+    all_lines = []
     for line in _orchestrate("headline"):
         print(json.dumps(line), flush=True)
+        all_lines.append(line)
     if "--all" in sys.argv:
         # one child per config: a slow compile in one config can't starve
         # the rest, and each gets the full retry/cache/fallback ladder
@@ -652,6 +705,8 @@ def main():
         for key in CONFIGS:
             for line in _orchestrate(f"secondary:{key}"):
                 print(json.dumps(line), flush=True)
+                all_lines.append(line)
+    _write_metrics_dump(all_lines)
 
 
 if __name__ == "__main__":

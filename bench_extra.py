@@ -545,15 +545,18 @@ def bench_one(key: str):
     Exceptions propagate: a failed config must exit rc!=0 so the bench.py
     orchestrator's retry -> cached-TPU -> CPU ladder engages."""
     from bench import _init_backend_with_retry
+    from bigdl_tpu import observability as obs
     backend = _init_backend_with_retry()
     on_tpu = backend in ("tpu", "axon")
-    r = globals()[CONFIGS[key][0]](on_tpu)
+    with obs.span(f"bench/{key}"):
+        r = globals()[CONFIGS[key][0]](on_tpu)
     r["backend"] = backend
     return r
 
 
 def bench_secondary():
     from bench import _init_backend_with_retry
+    from bigdl_tpu import observability as obs
     backend = _init_backend_with_retry()
     on_tpu = backend in ("tpu", "axon")
     results = []
@@ -561,7 +564,8 @@ def bench_secondary():
                bench_transformer_lm, bench_moe_lm, bench_lm_decode,
                bench_realdata):
         try:
-            r = fn(on_tpu)
+            with obs.span(f"bench/{fn.__name__}"):
+                r = fn(on_tpu)
         except Exception as e:  # one broken config must not hide the rest
             r = {"metric": f"{fn.__name__}_failed", "value": 0,
                  "unit": "error", "vs_baseline": 0, "error": str(e)[-300:]}

@@ -11,6 +11,7 @@ lax.scan / Pallas kernels instead of MKL primitives.
 
 __version__ = "0.1.0"
 
+from . import observability
 from . import utils
 from .utils import Table, T, Shape
 from .utils import engine as Engine

@@ -8,10 +8,12 @@ without host copies.
 """
 from __future__ import annotations
 
+import time
 from typing import Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from .. import observability as obs
 from .minibatch import MiniBatch
 from .sample import Sample
 from .transformer import SampleToMiniBatch, Transformer
@@ -116,4 +118,25 @@ class ShardedDataSet(AbstractDataSet):
         return self
 
     def data(self, train: bool = True):
-        return self.to_batch.apply(iter(self.dataset.data(train)))
+        it = self.to_batch.apply(iter(self.dataset.data(train)))
+        if not obs.enabled():
+            return it
+        return _timed_batches(it)
+
+
+def _timed_batches(it):
+    """Wrap a MiniBatch iterator with batch-produce latency collection
+    (``dataset/batch_produce_s``) — the host-side number to compare
+    against ``step/dispatch`` when deciding whether training is
+    input-bound."""
+    hist = obs.histogram("dataset/batch_produce_s", unit="s")
+    produced = obs.counter("dataset/batches_produced")
+    while True:
+        t0 = time.perf_counter()
+        try:
+            mb = next(it)
+        except StopIteration:
+            return
+        hist.observe(time.perf_counter() - t0)
+        produced.inc()
+        yield mb

@@ -12,6 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 
 import numpy as np
 
@@ -209,12 +210,25 @@ class NativePrefetcher:
         else:
             x_shape, x_dtype = ((self.batch_size, self.c, self.h, self.w),
                                 np.float32)
+        from .. import observability as obs
+        if obs.enabled():
+            obs.gauge("dataset/queue_capacity").set(self.queue_capacity)
         while True:
             x = np.empty(x_shape, x_dtype)
             y = np.empty((self.batch_size,), np.float32)
+            # stamped unconditionally: one clock read per batch is noise
+            # next to a jpeg decode, and a mid-block obs.enable() must
+            # never pair a real end time with a zero start
+            t_wait = time.perf_counter()
             got = self.lib.pf_next(
                 self.handle, ctypes.c_void_p(x.ctypes.data),
                 y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if obs.enabled():
+                # time blocked in pf_next ≈ queue starvation: near-zero
+                # means the decode queue stayed full (compute-bound);
+                # large means the queue ran dry (input-bound)
+                obs.histogram("dataset/native_next_wait_s", unit="s") \
+                    .observe(time.perf_counter() - t_wait)
             if got == 0:
                 self._epoch_open = False
                 failed = self.decode_failures

@@ -1,0 +1,43 @@
+"""Unified tracing + metrics (the production-operator view of training).
+
+The reference exposed training progress only through TensorBoard
+``TrainSummary``/``ValidationSummary``; everything else — step phase
+timing, collective bytes, probe latency — lived in ad-hoc dicts and
+prints. This package is the one subsystem the rest of the codebase
+reports into:
+
+* ``trace`` — span-based tracer: ``with observability.span("step/dispatch"):``
+  nests via a thread-local stack, stamps monotonic clocks, and survives
+  exceptions (the span closes and is tagged with the error type).
+* ``metrics`` — a process-global registry of counters, gauges and
+  histograms (reservoir quantiles), keyed by slash-namespaced names
+  (``optim/step_time``, ``collective/psum_bytes``).
+* ``exporters`` — Chrome trace-event JSON (load in Perfetto /
+  chrome://tracing), Prometheus text format, a bridge into the existing
+  ``visualization.Summary`` event files (TensorBoard keeps working), and
+  the BENCH_*.json-compatible metric-line dump shared with ``bench.py``.
+
+Zero-overhead when disabled: ``span()`` returns a shared no-op context
+manager and call-sites guard metric writes with ``enabled()`` — the
+disabled cost in the optimizer hot loop is one module-global flag read
+per phase. Enable with ``observability.enable()`` or
+``BIGDL_TPU_TRACE=1`` in the environment.
+
+Span naming convention: ``<subsystem>/<phase>`` with the subsystem as a
+stable prefix (``step/``, ``eval/``, ``predict/``, ``bench/``); nested
+phases extend the parent's name (``step`` > ``step/data_fetch``).
+"""
+from __future__ import annotations
+
+import os as _os
+
+from .trace import (Tracer, enable, disable, enabled, span, instant,
+                    get_tracer, reset)
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      registry, counter, gauge, histogram)
+from .exporters import (chrome_trace, write_chrome_trace, prometheus_text,
+                        SummaryBridge, metrics_dump, write_metrics_dump,
+                        record_bench_line)
+
+if _os.environ.get("BIGDL_TPU_TRACE") == "1":
+    enable()

@@ -7,6 +7,7 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
+from .. import observability as obs
 from ..dataset.dataset import AbstractDataSet, ShardedDataSet
 from ..utils.table import Table
 
@@ -33,13 +34,20 @@ class Evaluator:
         batched = ShardedDataSet(dataset, batch_size, drop_last=False)
         results = [None] * len(methods)
         for mb in batched.data(train=False):
-            x = mb.get_input()
-            x = jax.tree_util.tree_map(jnp.asarray, x) \
-                if isinstance(x, Table) else jnp.asarray(x)
-            out = fwd(self.model.params, self.model.state, x)
-            for i, m in enumerate(methods):
-                r = m(out, mb.get_target())
-                results[i] = r if results[i] is None else results[i] + r
+            sp = obs.span("eval/batch")
+            with sp:
+                x = mb.get_input()
+                x = jax.tree_util.tree_map(jnp.asarray, x) \
+                    if isinstance(x, Table) else jnp.asarray(x)
+                out = fwd(self.model.params, self.model.state, x)
+                for i, m in enumerate(methods):
+                    r = m(out, mb.get_target())
+                    results[i] = r if results[i] is None else results[i] + r
+            if obs.enabled():
+                # one clock source: the histogram reads the span's own
+                # duration rather than timing the interval a second time
+                obs.histogram("eval/batch_s", unit="s").observe(
+                    sp.duration_s)
         return results
 
 

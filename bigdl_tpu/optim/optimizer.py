@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import observability as obs
 from .optim_method import OptimMethod, SGD
 from .regularizer import regularizer_tree, regularization_loss
 from .trigger import Trigger, max_epoch as _max_epoch
@@ -98,6 +99,8 @@ class _AsyncCheckpointWriter:
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
         self._q.put((path, payload))
+        if obs.enabled():
+            obs.gauge("checkpoint/queue_depth").set(self._q.qsize())
 
     def flush(self):
         if self._thread is not None:
@@ -118,17 +121,31 @@ class _AsyncCheckpointWriter:
 
 
 class Metrics:
-    """Per-phase timing metrics (parity: optim/Metrics.scala)."""
+    """Per-phase timing metrics (parity: optim/Metrics.scala).
 
-    def __init__(self):
+    Retained as the optimizer-local view (``.values`` is part of the
+    public surface); when observability is enabled every ``add`` also
+    mirrors into the process-global registry as an
+    ``optim/<name>`` histogram, so the Prometheus/Chrome exporters and
+    the TensorBoard bridge see the same numbers without a second
+    collection path."""
+
+    def __init__(self, namespace: str = "optim"):
         self.values = {}
+        self._namespace = namespace
 
     def add(self, name, value):
         self.values.setdefault(name, []).append(value)
+        if obs.enabled():
+            obs.histogram(f"{self._namespace}/{name}").observe(value)
 
     def mean(self, name):
-        v = self.values.get(name, [])
-        return sum(v) / len(v) if v else 0.0
+        if name not in self.values:
+            raise KeyError(
+                f"no metric named {name!r} has been recorded "
+                f"(seen: {sorted(self.values)})")
+        v = self.values[name]
+        return sum(v) / len(v)
 
     def summary(self):
         return {k: self.mean(k) for k in self.values}
@@ -391,7 +408,12 @@ class BaseOptimizer:
         def step(params, opt_state, mstate, x, y, lr, rng):
             (loss, new_mstate), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, mstate, x, y, rng)
-            grads = _clip_grads(grads, clip_const, clip_norm)
+            # trace-time span: this body runs under jit, so the span
+            # appears once per compile (under the first step/dispatch)
+            # and measures clip *trace* cost — the per-step clip itself
+            # is fused into the compiled program
+            with obs.span("step/grad_clip", traced=True):
+                grads = _clip_grads(grads, clip_const, clip_norm)
             if frozen_mask is not None:
                 grads = _tmap(lambda g, m: g * m, grads, frozen_mask)
             new_params, new_opt = optim.update(grads, params, opt_state, lr)
@@ -430,10 +452,12 @@ class BaseOptimizer:
             "optim_host_state": dict(self.optim_method.state),
             "epoch": state["epoch"], "neval": state["neval"],
         }
-        if self.checkpoint_async:
-            self._ckpt_writer.submit(path, payload)
-        else:
-            _atomic_pickle(path, payload)
+        with obs.span("step/checkpoint_submit",
+                      async_write=self.checkpoint_async):
+            if self.checkpoint_async:
+                self._ckpt_writer.submit(path, payload)
+            else:
+                _atomic_pickle(path, payload)
 
     def wait_for_checkpoints(self):
         """Block until every async checkpoint write has landed (re-raising
@@ -461,9 +485,10 @@ class BaseOptimizer:
         was_training = self.model.train_mode
         self.model.evaluate()
         from .evaluator import Evaluator
-        results = Evaluator(self.model).evaluate(
-            self.validation_set, self.validation_methods,
-            self.validation_batch)
+        with obs.span("step/validate", neval=state["neval"]):
+            results = Evaluator(self.model).evaluate(
+                self.validation_set, self.validation_methods,
+                self.validation_batch)
         if was_training:
             self.model.training()
         scores = {}
@@ -490,7 +515,8 @@ class BaseOptimizer:
         if opt_state is None:
             opt_state = self.optim_method.init_state(params)
         params, opt_state, mstate = self._prepare(params, opt_state, mstate)
-        self._step_fn = self._build_step()
+        with obs.span("optimizer/build_step"):
+            self._step_fn = self._build_step()
         self._pending_loss = None  # never consume a dead run's loss
 
         optim = self.optim_method
@@ -502,79 +528,90 @@ class BaseOptimizer:
             batched.shuffle()
             epoch_start = time.time()
             for mb in batched.data(train=True):
-                t0 = time.time()
-                x, y = self._place_batch(mb.get_input(), mb.get_target())
-                t1 = time.time()
-                lr = optim.current_lr()
-                rng = engine.next_rng_key()
-                loss, params, opt_state, mstate = self._step_fn(
-                    params, opt_state, mstate, x, y,
-                    jnp.asarray(lr, jnp.float32), rng)
-                if self.sync_policy == "async":
-                    # examine the PREVIOUS step's loss: the device keeps
-                    # computing while the host preps the next batch
-                    prev, self._pending_loss = self._pending_loss, loss
-                    loss_val = float(prev if prev is not None else loss)
-                else:
-                    loss_val = float(loss)
-                t2 = time.time()
-                if not np.isfinite(loss_val):
-                    nan_streak += 1
-                    if self.nan_policy == "error":
-                        raise FloatingPointError(
-                            f"non-finite loss {loss_val} at iteration "
-                            f"{state['neval']} — enable "
-                            f"set_nan_policy('skip') to drop such steps")
-                    if nan_streak > self.max_nan_retries:
-                        raise FloatingPointError(
-                            f"{nan_streak} consecutive non-finite steps "
-                            f"(nan_policy='{self.nan_policy}') — data or "
-                            "hyperparameters are unrecoverably bad")
-                    if self.nan_policy == "resume":
-                        self.wait_for_checkpoints()  # in-flight writes
-                        snap = self._latest_checkpoint()
-                        if snap is None:
+                with obs.span("step", neval=state["neval"]):
+                    t0 = time.time()
+                    with obs.span("step/data_fetch"):
+                        x, y = self._place_batch(mb.get_input(), mb.get_target())
+                    t1 = time.time()
+                    lr = optim.current_lr()
+                    rng = engine.next_rng_key()
+                    with obs.span("step/dispatch"):
+                        loss, params, opt_state, mstate = self._step_fn(
+                            params, opt_state, mstate, x, y,
+                            jnp.asarray(lr, jnp.float32), rng)
+                    with obs.span("step/loss_sync"):
+                        if self.sync_policy == "async":
+                            # examine the PREVIOUS step's loss: the device
+                            # keeps computing while the host preps the next
+                            # batch
+                            prev, self._pending_loss = self._pending_loss, loss
+                            loss_val = float(prev if prev is not None else loss)
+                        else:
+                            loss_val = float(loss)
+                    t2 = time.time()
+                    if not np.isfinite(loss_val):
+                        nan_streak += 1
+                        if self.nan_policy == "error":
                             raise FloatingPointError(
-                                "non-finite loss with nan_policy='resume' "
-                                "but no checkpoint saved yet — call "
-                                "set_checkpoint(...) first")
-                        with open(snap, "rb") as f:
-                            payload = pickle.load(f)
-                        self.optim_method.state.update(
-                            payload["optim_host_state"])
-                        params, opt_state, mstate =                             self._restore_step_state(payload)
-                        self._pending_loss = None  # refers to pre-restore
-                        self.metrics.add("nan_resumes", 1.0)
+                                f"non-finite loss {loss_val} at iteration "
+                                f"{state['neval']} — enable "
+                                f"set_nan_policy('skip') to drop such steps")
+                        if nan_streak > self.max_nan_retries:
+                            raise FloatingPointError(
+                                f"{nan_streak} consecutive non-finite steps "
+                                f"(nan_policy='{self.nan_policy}') — data or "
+                                "hyperparameters are unrecoverably bad")
+                        if self.nan_policy == "resume":
+                            self.wait_for_checkpoints()  # in-flight writes
+                            snap = self._latest_checkpoint()
+                            if snap is None:
+                                raise FloatingPointError(
+                                    "non-finite loss with nan_policy='resume' "
+                                    "but no checkpoint saved yet — call "
+                                    "set_checkpoint(...) first")
+                            with open(snap, "rb") as f:
+                                payload = pickle.load(f)
+                            self.optim_method.state.update(
+                                payload["optim_host_state"])
+                            params, opt_state, mstate =                             self._restore_step_state(payload)
+                            self._pending_loss = None  # refers to pre-restore
+                            self.metrics.add("nan_resumes", 1.0)
+                            obs.instant("step/nan_resume", neval=state["neval"])
+                            continue
+                        # 'skip': the in-step guard already kept the previous
+                        # params; count the iteration so end triggers advance
+                        self.metrics.add("nan_skips", 1.0)
+                        obs.instant("step/nan_skip", neval=state["neval"])
+                        state["neval"] += 1
                         continue
-                    # 'skip': the in-step guard already kept the previous
-                    # params; count the iteration so end triggers advance
-                    self.metrics.add("nan_skips", 1.0)
+                    nan_streak = 0
                     state["neval"] += 1
-                    continue
-                nan_streak = 0
-                state["neval"] += 1
-                state["loss"] = loss_val
-                state["epoch_finished"] = False
-                self.metrics.add("data_time", t1 - t0)
-                self.metrics.add("step_time", t2 - t1)
-                if self.train_summary is not None:
-                    rec = self.train_summary.should_record
-                    if rec("Loss", state):
-                        self.train_summary.add_scalar("Loss", loss_val,
-                                                      state["neval"])
-                    if rec("LearningRate", state):
-                        self.train_summary.add_scalar("LearningRate", lr,
-                                                      state["neval"])
-                    if rec("Throughput", state):
-                        self.train_summary.add_scalar(
-                            "Throughput",
-                            self.batch_size / max(t2 - t0, 1e-9),
-                            state["neval"])
-                if self._fire_mid_epoch(state, params, opt_state, mstate):
-                    pass
-                if self.end_trigger(state):
-                    done = True
-                    break
+                    state["loss"] = loss_val
+                    state["epoch_finished"] = False
+                    self.metrics.add("data_time", t1 - t0)
+                    self.metrics.add("step_time", t2 - t1)
+                    if obs.enabled():
+                        obs.counter("optim/steps").inc()
+                        obs.gauge("optim/throughput", unit="samples/s").set(
+                            self.batch_size / max(t2 - t0, 1e-9))
+                    if self.train_summary is not None:
+                        rec = self.train_summary.should_record
+                        if rec("Loss", state):
+                            self.train_summary.add_scalar("Loss", loss_val,
+                                                          state["neval"])
+                        if rec("LearningRate", state):
+                            self.train_summary.add_scalar("LearningRate", lr,
+                                                          state["neval"])
+                        if rec("Throughput", state):
+                            self.train_summary.add_scalar(
+                                "Throughput",
+                                self.batch_size / max(t2 - t0, 1e-9),
+                                state["neval"])
+                    if self._fire_mid_epoch(state, params, opt_state, mstate):
+                        pass
+                    if self.end_trigger(state):
+                        done = True
+                        break
             if not done:
                 state["epoch"] += 1
                 state["epoch_finished"] = True
@@ -749,7 +786,7 @@ class DistriOptimizer(BaseOptimizer):
         if self.parameter_mode != "zero1":
             return super()._build_step()
 
-        from jax import shard_map
+        from ..utils.compat import shard_map
         from jax.flatten_util import ravel_pytree
         model, criterion = self.model, self.criterion
         reg_tree = regularizer_tree(model)

@@ -2,10 +2,12 @@
 ``optim/LocalPredictor.scala`` / ``optim/PredictionService.scala``)."""
 from __future__ import annotations
 
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as obs
 from ..dataset.dataset import AbstractDataSet, ShardedDataSet, DataSet
 from ..utils.table import Table
 
@@ -32,10 +34,16 @@ class Predictor:
         fwd = self._forward_fn()
         batched = ShardedDataSet(dataset, batch_size, drop_last=False)
         for mb in batched.data(train=False):
-            x = mb.get_input()
-            x = jax.tree_util.tree_map(jnp.asarray, x) \
-                if isinstance(x, Table) else jnp.asarray(x)
-            yield np.asarray(fwd(self.model.params, self.model.state, x))
+            sp = obs.span("predict/batch")
+            with sp:
+                x = mb.get_input()
+                x = jax.tree_util.tree_map(jnp.asarray, x) \
+                    if isinstance(x, Table) else jnp.asarray(x)
+                out = np.asarray(fwd(self.model.params, self.model.state, x))
+            if obs.enabled():
+                obs.histogram("predict/batch_s", unit="s").observe(
+                    sp.duration_s)
+            yield out
 
     def predict(self, dataset, batch_size: int = 32):
         outs = list(self._iter_outputs(dataset, batch_size))

@@ -32,10 +32,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from ..utils.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from jax.flatten_util import ravel_pytree
+
+from .. import observability as obs
 
 
 class FP16CompressPolicy:
@@ -93,6 +95,17 @@ class AllReduceParameter:
         """Build the flat view and the sharded optimizer state."""
         self.flat = FlatParameter(params, self.n)
         flat_w = self.flat.flatten(params)
+        if obs.enabled():
+            # per-step per-device wire budget: the psum_scatter ships the
+            # (possibly compressed) full gradient vector, the all_gather
+            # ships the updated f32 weight slices back
+            gbytes = 2 if self.compress in (FP16CompressPolicy.BF16,
+                                            FP16CompressPolicy.FP16) else 4
+            obs.gauge("allreduce/param_elems").set(self.flat.orig_size)
+            obs.gauge("allreduce/shard_elems").set(self.flat.shard_size)
+            obs.gauge("allreduce/bytes_per_step", unit="B").set(
+                self.flat.padded_size * (gbytes + 4))
+            obs.gauge("allreduce/n_shards").set(self.n)
 
         def init_slice(w_full):
             i = lax.axis_index(self.axis)
@@ -122,6 +135,11 @@ class AllReduceParameter:
         i = lax.axis_index(self.axis)
         dtype = grads_flat.dtype
         g = FP16CompressPolicy.compress(grads_flat, self.compress)
+        if obs.enabled():
+            # trace-time accounting (this body runs under jit, once per
+            # compile): bytes entering the hardware reduce-scatter
+            obs.counter("collective/reduce_scatter_traced_bytes",
+                        unit="B").inc(float(g.size * g.dtype.itemsize))
         # aggregated gradient for my slice (mean over data shards)
         gslice = lax.psum_scatter(g, self.axis, scatter_dimension=0,
                                   tiled=True)
@@ -158,5 +176,5 @@ def sparse_embedding_grad_allreduce(ids, row_grads, vocab_size: int,
     dense = jnp.zeros((vocab_size, row_grads.shape[-1]),
                       row_grads.dtype).at[all_ids].add(all_rows)
     if mean:
-        dense = dense / lax.axis_size(axis)
+        dense = dense / axis_size(axis)
     return dense

@@ -4,6 +4,14 @@ Parity note: the reference implements gradient aggregation as a Spark shuffle
 to per-partition owners (``parameters/AllReduceParameter.scala:putGradients``)
 — a software parameter server. Here every collective is an XLA primitive that
 lowers to ICI hardware collectives; these wrappers only fix axis-name plumbing.
+
+Observability: when tracing is enabled each wrapper records call count and
+bytes into the global registry (``collective/<op>_calls`` /
+``collective/<op>_traced_bytes``). These wrappers execute at *trace* time
+(inside jit), so the numbers are per-compilation accounting of what the
+compiled program moves per step — not a per-step runtime counter. That is
+exactly the number an operator needs to budget ICI bandwidth; multiply by
+steps/sec for the live rate.
 """
 from __future__ import annotations
 
@@ -11,39 +19,69 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import observability as obs
+
+from ..utils.compat import axis_size
+
+
+def _record(op: str, x):
+    """Trace-time byte accounting (no-op unless observability is on;
+    symbolic shapes simply skip the bytes counter)."""
+    if not obs.enabled():
+        return
+    obs.counter(f"collective/{op}_calls").inc()
+    try:
+        nbytes = float(x.size * x.dtype.itemsize)
+    except (AttributeError, TypeError):
+        return
+    obs.counter(f"collective/{op}_traced_bytes", unit="B").inc(nbytes)
+
+
+def _record_tree(op: str, tree):
+    for leaf in jax.tree_util.tree_leaves(tree):
+        _record(op, leaf)
+
 
 def psum(x, axis: str = "data"):
+    _record("psum", x)
     return lax.psum(x, axis_name=axis)
 
 
 def pmean(x, axis: str = "data"):
+    _record("pmean", x)
     return lax.pmean(x, axis_name=axis)
 
 
 def all_reduce_sum(tree, axis: str = "data"):
+    _record_tree("psum", tree)
     return jax.tree_util.tree_map(lambda t: lax.psum(t, axis), tree)
 
 
 def all_reduce_mean(tree, axis: str = "data"):
+    _record_tree("pmean", tree)
     return jax.tree_util.tree_map(lambda t: lax.pmean(t, axis), tree)
 
 
 def all_gather(x, axis: str = "data", tiled: bool = True):
+    _record("all_gather", x)
     return lax.all_gather(x, axis_name=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis: str = "data", scatter_dimension: int = 0):
+    _record("reduce_scatter", x)
     return lax.psum_scatter(x, axis_name=axis,
                             scatter_dimension=scatter_dimension, tiled=True)
 
 
 def ppermute_ring(x, axis: str = "data", shift: int = 1):
     """Rotate shards around the ring (basis of ring attention)."""
-    n = lax.axis_size(axis)
+    _record("ppermute", x)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
 
 def all_to_all(x, axis: str, split_axis: int, concat_axis: int):
+    _record("all_to_all", x)
     return lax.all_to_all(x, axis_name=axis, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)

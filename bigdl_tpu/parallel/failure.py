@@ -20,6 +20,7 @@ to three layers, implemented here and in the optimizers:
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
@@ -27,6 +28,10 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .. import observability as obs
+
+_LOG = logging.getLogger("bigdl_tpu.parallel.failure")
 
 
 def _run_with_timeout(fn, timeout_s: float) -> Dict:
@@ -66,7 +71,7 @@ def probe_mesh(mesh, timeout_s: float = 30.0) -> MeshProbeResult:
     """Run a psum of ones over every mesh axis with a timeout. A dead or hung
     device makes the collective never complete — the timeout converts that
     into a detectable failure instead of a stall."""
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     axes = tuple(mesh.axis_names)
@@ -87,15 +92,21 @@ def probe_mesh(mesh, timeout_s: float = 30.0) -> MeshProbeResult:
     t0 = time.time()
     result = _run_with_timeout(ones_sum, timeout_s)
     if result.get("timeout"):
-        return MeshProbeResult(False, n, time.time() - t0,
-                               f"collective did not complete in {timeout_s}s")
-    if "error" in result:
-        return MeshProbeResult(False, n, time.time() - t0, result["error"])
-    val, latency = result["value"]
-    ok = val == n
-    return MeshProbeResult(ok, n, latency,
-                           None if ok else
-                           f"psum returned {val}, expected {n}")
+        res = MeshProbeResult(False, n, time.time() - t0,
+                              f"collective did not complete in {timeout_s}s")
+    elif "error" in result:
+        res = MeshProbeResult(False, n, time.time() - t0, result["error"])
+    else:
+        val, latency = result["value"]
+        ok = val == n
+        res = MeshProbeResult(ok, n, latency,
+                              None if ok else
+                              f"psum returned {val}, expected {n}")
+    if obs.enabled():
+        obs.histogram("failure/probe_latency_s", unit="s").observe(
+            res.latency_s)
+        obs.gauge("failure/probe_ok").set(1.0 if res.ok else 0.0)
+    return res
 
 
 class HeartbeatLost(RuntimeError):
@@ -119,11 +130,44 @@ class Heartbeat:
     a clean halt. The timed-out gather thread is a daemon — it cannot be
     cancelled, which is fine because detection is followed by process exit."""
 
-    def __init__(self, stale_after: int = 3):
+    def __init__(self, stale_after: int = 3,
+                 expected_interval_s: Optional[float] = None):
         self.stale_after = stale_after
+        # when set, a beat arriving more than expected_interval_s after
+        # the previous one logs a structured late-beat warning (the loop
+        # stalled — slow step, GC pause, hung host IO)
+        self.expected_interval_s = expected_interval_s
         self.beat_no = 0
         self.last_seen: Dict[int, int] = {}
         self.counters: Dict[int, int] = {}
+        self._last_beat_t: Optional[float] = None
+
+    @property
+    def last_beat_age_s(self) -> float:
+        """Seconds since the last completed beat (monotonic clock);
+        ``inf`` before the first beat. Exported as the
+        ``failure/last_beat_age_s`` gauge — the number a liveness alert
+        should page on."""
+        if self._last_beat_t is None:
+            return float("inf")
+        return time.monotonic() - self._last_beat_t
+
+    def _register_gauge(self):
+        # a LIVE gauge (computed at export time): the age must keep
+        # growing while the loop that would have written it is hung —
+        # precisely the condition the alert exists to catch. Held via
+        # weakref so the registry never pins a finished run's Heartbeat:
+        # once it is collected the gauge reads NaN (distinguishable from
+        # both "healthy" and "hung"). With several Heartbeats the most
+        # recent beat owns the gauge.
+        import weakref
+        ref = weakref.ref(self)
+
+        def age() -> float:
+            hb = ref()
+            return hb.last_beat_age_s if hb is not None else float("nan")
+
+        obs.gauge("failure/last_beat_age_s", unit="s").set_fn(age)
 
     @property
     def n_processes(self) -> int:
@@ -156,10 +200,25 @@ class Heartbeat:
         With ``timeout_s``, a hung or failed exchange raises
         :class:`HeartbeatLost` instead of stalling forever."""
         self.beat_no += 1
+        now = time.monotonic()
+        if (self.expected_interval_s is not None
+                and self._last_beat_t is not None
+                and now - self._last_beat_t > self.expected_interval_s):
+            _LOG.warning(
+                "late heartbeat: beat_no=%d age_s=%.3f "
+                "expected_interval_s=%.3f process=%d",
+                self.beat_no, now - self._last_beat_t,
+                self.expected_interval_s, jax.process_index())
+            if obs.enabled():
+                obs.counter("failure/late_beats").inc()
         if timeout_s is not None:
             counters = self._gather_with_timeout(self.beat_no, timeout_s)
         else:
             counters = self._gather(self.beat_no)
+        self._last_beat_t = time.monotonic()
+        if obs.enabled():
+            self._register_gauge()
+            obs.counter("failure/beats").inc()
         stale = []
         for pid, c in enumerate(counters):
             if c > self.counters.get(pid, -1):
@@ -168,6 +227,10 @@ class Heartbeat:
             elif self.beat_no - self.last_seen.get(pid, 0) >= \
                     self.stale_after:
                 stale.append(pid)
+        if stale:
+            _LOG.warning(
+                "stale heartbeat peers: processes=%s beat_no=%d "
+                "stale_after=%d", stale, self.beat_no, self.stale_after)
         return stale
 
 

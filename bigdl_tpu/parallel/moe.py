@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size
+
 
 def expert_capacity(tokens: int, n_experts: int, factor: float) -> int:
     """Per-expert queue length: ceil(factor * tokens / n_experts), min 1."""
@@ -69,7 +71,7 @@ def moe_ffn(expert_fn: Callable, axis: str = "expert",
     """
 
     def run(router_w, expert_params, x):
-        E = lax.axis_size(axis)
+        E = axis_size(axis)
         tloc, d = x.shape
         def _squeeze(a):
             if a.ndim and a.shape[0] != 1:

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size, pvary
+
 
 def gpipe(stage_fn: Callable, axis: str = "pipe"):
     """Build the per-device pipelined apply.
@@ -40,7 +42,7 @@ def gpipe(stage_fn: Callable, axis: str = "pipe"):
     """
 
     def run(params, x_stack):
-        n_stages = lax.axis_size(axis)
+        n_stages = axis_size(axis)
         idx = lax.axis_index(axis)
         n_micro = x_stack.shape[0]
         ticks = n_micro + n_stages - 1
@@ -51,10 +53,7 @@ def gpipe(stage_fn: Callable, axis: str = "pipe"):
         # the carry varies per device from tick 1 on; mark the initial
         # zeros as axis-varying so the scan carry type is stable
         def _vary(a):
-            try:
-                return lax.pcast(a, axis, to="varying")
-            except (AttributeError, TypeError):  # older jax spelling
-                return lax.pvary(a, axis)
+            return pvary(a, axis)
         zeros = _vary(jnp.zeros_like(x_stack[0]))
         outs0 = _vary(jnp.zeros_like(x_stack))
 

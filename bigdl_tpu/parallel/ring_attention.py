@@ -24,10 +24,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size
+
 
 def ring_attention(q, k, v, axis: str = "seq", causal: bool = False):
     """q, k, v: (B, H, Tblock, D) local blocks. Returns local output block."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     tb = q.shape[-2]
     d = q.shape[-1]
@@ -69,7 +71,7 @@ def ring_attention(q, k, v, axis: str = "seq", causal: bool = False):
 
 def make_ring_attention(mesh, axis: str = "seq", causal: bool = False):
     """Build a shard_mapped ring attention over (B, H, T, D) global arrays."""
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
     spec = P(None, None, axis, None)
     return shard_map(partial(ring_attention, axis=axis, causal=causal),

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size, pvary
+
 # ONE shared dispatch policy + warn-once registry (parallel/flash.py) and
 # the kernels' own masking constant — no second copy to drift
 from .flash import _warn_once, flash_mode as _block_mode
@@ -129,10 +131,7 @@ def _as_axes(axes):
 def _vary(x, axes):
     """Mark a fresh constant as varying over ``axes`` (strict-VMA
     shard_map requires cond branches / scan carries to agree)."""
-    try:
-        return lax.pcast(x, axes, to="varying")
-    except (AttributeError, TypeError):  # older jax spelling
-        return lax.pvary(x, axes)
+    return pvary(x, axes)
 
 
 def _vma_axes(x, ring_axis):
@@ -165,7 +164,7 @@ def ring_flash_attention(q, k, v, axis: str = "seq",
 
 
 def _ring_fwd(q, k, v, axis, causal):
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     vaxes = _vma_axes(q, axis)
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -218,7 +217,7 @@ def _ring_vjp_bwd(axis, causal, res, do):
     contribution is independent given them, so on TPU the per-block work
     is the Pallas backward kernels themselves."""
     q, k, v, o, lse = res
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     vaxes = _vma_axes(q, axis)
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -268,7 +267,7 @@ ring_flash_attention.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 def make_ring_flash_attention(mesh, axis: str = "seq",
                               causal: bool = False):
     """shard_mapped ring-flash attention over (B, H, T, D) global arrays."""
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
     spec = P(None, None, axis, None)
     return shard_map(

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compat import axis_size
+
 
 def a2a_attention(q, k, v, axis: str = "seq", causal: bool = False,
                   use_flash: bool = True):
@@ -48,7 +50,7 @@ def a2a_attention(q, k, v, axis: str = "seq", causal: bool = False,
     concatenate in axis-index order, so global token positions are
     correct and causal masking needs no position bookkeeping.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     h = q.shape[1]
     if h % n:
         raise ValueError(

@@ -27,7 +27,7 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..utils.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

@@ -15,7 +15,7 @@ jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=n,
                            process_id=pid)
 import numpy as np
 import jax.numpy as jnp
-from jax import shard_map
+from bigdl_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.experimental import multihost_utils
 

@@ -159,3 +159,34 @@ def test_wait_ladder_budget_zero_serves_cache(bench_mod, monkeypatch):
     monkeypatch.setenv("BENCH_WAIT_S", "0")
     lines = b._orchestrate("headline")
     assert lines[0]["cached"] and lines[0]["value"] == 7.0
+
+
+def test_metrics_dump_written_from_lines(bench_mod, tmp_path, monkeypatch):
+    b = bench_mod
+    out = tmp_path / "BENCH_METRICS.json"
+    monkeypatch.setenv("BENCH_METRICS_OUT", str(out))
+    b._write_metrics_dump([
+        {"metric": "resnet50_train_images_per_sec_per_chip", "value": 2436.9,
+         "unit": "images/sec/chip", "vs_baseline": 40.6, "backend": "tpu"},
+        {"metric": "bench_failed", "value": 0, "unit": "error"},
+    ])
+    dump = json.load(open(out))
+    by = {l["metric"]: l for l in dump}
+    assert by["bench/resnet50_train_images_per_sec_per_chip"]["value"] == \
+        2436.9
+    assert by["bench/resnet50_train_images_per_sec_per_chip"]["unit"] == \
+        "images/sec/chip"
+    assert by[
+        "bench/resnet50_train_images_per_sec_per_chip/vs_baseline"
+    ]["value"] == 40.6
+    # every line speaks the bench schema
+    assert all({"metric", "value", "unit"} <= set(l) for l in dump)
+
+
+def test_metrics_dump_opt_out_and_never_raises(bench_mod, monkeypatch):
+    b = bench_mod
+    monkeypatch.setenv("BENCH_METRICS_OUT", "")
+    b._write_metrics_dump([{"metric": "x", "value": 1, "unit": "u"}])  # no-op
+    # unwritable path must not raise (the dump never fails the bench)
+    monkeypatch.setenv("BENCH_METRICS_OUT", "/nonexistent_dir/x.json")
+    b._write_metrics_dump([{"metric": "x", "value": 1, "unit": "u"}])

@@ -108,7 +108,7 @@ def test_ring_attention_causal_matches_full():
 
 
 def test_collectives():
-    from jax import shard_map
+    from bigdl_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from bigdl_tpu.parallel import collective as C
     mesh = data_parallel_mesh(8)
@@ -309,7 +309,7 @@ def test_sparse_embedding_grad_allreduce_matches_dense_psum():
     scatter-added embedding gradients, including duplicate ids within
     and across shards."""
     from functools import partial
-    from jax import shard_map
+    from bigdl_tpu.utils.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     from bigdl_tpu.parallel import sparse_embedding_grad_allreduce
 

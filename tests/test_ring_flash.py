@@ -114,7 +114,7 @@ def test_ring_flash_interpret_kernel_path(causal, monkeypatch):
 def test_attention_module_seq_parallel_matches_dense():
     """nn.Attention(seq_axis='seq', causal=True) inside shard_map equals
     the same module's dense path — long-context through the MODEL API."""
-    from jax import shard_map
+    from bigdl_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
     from bigdl_tpu import nn
     from bigdl_tpu.nn.attention import causal_mask
@@ -154,7 +154,8 @@ def test_dp_sp_combined_training_step_matches_dense():
     over 'seq'; the loss and parameter gradients must match the dense
     single-device computation (the scaling-book recipe: shardings in,
     psum'd grads out)."""
-    from jax import lax, shard_map
+    from jax import lax
+    from bigdl_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     B, T, D, HEADS, V = 4, 32, 16, 2, 43

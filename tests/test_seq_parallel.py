@@ -8,7 +8,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from bigdl_tpu.utils.compat import shard_map
 from functools import partial
 
 from bigdl_tpu.parallel.seq_all_to_all import a2a_attention

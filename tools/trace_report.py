@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Summarize a Chrome trace-event dump into a per-phase time table.
+
+Reads the ``traceEvents`` JSON written by
+``bigdl_tpu.observability.write_chrome_trace`` (or any spec-conformant
+complete-event trace) and prints, per span name:
+
+  count, total wall ms, SELF ms (total minus time covered by child
+  spans on the same thread), mean ms — sorted by self-time descending.
+
+Self-time is the number that answers "where does the step actually
+go": a ``step`` span's total includes dispatch/data_fetch children, but
+its self-time is only the host bookkeeping between them.
+
+Usage:
+    python tools/trace_report.py trace.json [--top N] [--prefix step/]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path: str):
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    out = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        out.append((ev.get("pid", 0), ev.get("tid", 0),
+                    float(ev["ts"]), float(ev.get("dur", 0.0)),
+                    ev["name"]))
+    return out
+
+
+def self_times(events):
+    """Per-name aggregate {name: [count, total_us, self_us]}.
+
+    Nesting is recovered per (pid, tid) by containment: events sorted by
+    (start, -dur) visit parents before children; a stack tracks open
+    ancestors and each event's duration is subtracted from its nearest
+    enclosing parent's self-time."""
+    agg = defaultdict(lambda: [0, 0.0, 0.0])
+    by_thread = defaultdict(list)
+    for pid, tid, ts, dur, name in events:
+        by_thread[(pid, tid)].append((ts, dur, name))
+    for evs in by_thread.values():
+        evs.sort(key=lambda e: (e[0], -e[1]))
+        stack = []  # (end_ts, name)
+        for ts, dur, name in evs:
+            while stack and stack[-1][0] <= ts:
+                stack.pop()
+            a = agg[name]
+            a[0] += 1
+            a[1] += dur
+            a[2] += dur
+            if stack:
+                agg[stack[-1][1]][2] -= dur
+            stack.append((ts + dur, name))
+    return agg
+
+
+def report(agg, top: int = 20, prefix: str = ""):
+    rows = [(name, c, tot, self_us)
+            for name, (c, tot, self_us) in agg.items()
+            if name.startswith(prefix)]
+    rows.sort(key=lambda r: -r[3])
+    lines = [f"{'span':<32} {'count':>7} {'total_ms':>10} "
+             f"{'self_ms':>10} {'mean_ms':>9}"]
+    for name, c, tot, self_us in rows[:top]:
+        lines.append(f"{name:<32} {c:>7} {tot / 1e3:>10.3f} "
+                     f"{self_us / 1e3:>10.3f} {tot / c / 1e3:>9.3f}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="Chrome trace-event JSON file")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows to print (by self-time)")
+    ap.add_argument("--prefix", default="",
+                    help="only spans whose name starts with this")
+    args = ap.parse_args(argv)
+    events = load_events(args.trace)
+    if not events:
+        print("no complete ('ph': 'X') events in trace", file=sys.stderr)
+        return 1
+    print(report(self_times(events), args.top, args.prefix))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
