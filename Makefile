@@ -14,10 +14,21 @@
 # `make test`, a 2-core box inside 10 min with NPROC=2.
 PYTEST ?= python -m pytest
 NPROC ?= 4
+SHELL := /bin/bash
 
-.PHONY: test test-slow test-serial test-examples
+.PHONY: test test-slow test-serial test-examples tier1 check-no-sync
 test:
 	$(PYTEST) tests/ -q -n $(NPROC) --dist loadfile
+
+# The ROADMAP "Tier-1 verify" command, verbatim (single-worker, not-slow,
+# DOTS_PASSED summary) — what the driver runs after every PR. Depends on
+# the sync-point lint so an un-annotated float()/block_until_ready in the
+# hot loop fails before the 15-minute suite starts.
+tier1: check-no-sync
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+check-no-sync:
+	python tools/check_no_sync.py
 
 test-slow:
 	BIGDL_TPU_SLOW=1 $(PYTEST) tests/ -q -n $(NPROC) --dist loadfile

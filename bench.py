@@ -430,7 +430,11 @@ def _cache_tpu_lines(lines):
     try:
         stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         for l in tpu:
-            existing[l["metric"]] = dict(l, measured_at=stamp)
+            # strip serve-time provenance so a re-cached line can never
+            # carry a previous outage's context as its own
+            clean = {k: v for k, v in l.items()
+                     if k not in ("cached", "cache_from", "tunnel_error")}
+            existing[l["metric"]] = dict(clean, measured_at=stamp)
         tmp = _TPU_CACHE + ".tmp"
         with open(tmp, "w") as f:
             json.dump(list(existing.values()), f, indent=1)
@@ -472,7 +476,18 @@ def _cached_tpu_lines(which, max_age_days: float = 14.0):
             age = None
         if age is not None and age > max_age_days * 86400:
             continue
-        out.append(dict(l, cached=True))
+        # provenance on reuse: the measurement time moves to `cache_from`
+        # (a served line must never look freshly measured), and any error
+        # text a previous serve attached is dropped — it described THAT
+        # run's outage, not this one (BENCH_r05 re-emitted a stale
+        # tunnel_error verbatim)
+        line = dict(l)
+        line.pop("tunnel_error", None)
+        line.pop("error", None)
+        ts = line.pop("measured_at", None)
+        if ts:
+            line["cache_from"] = ts
+        out.append(dict(line, cached=True))
     return out
 
 

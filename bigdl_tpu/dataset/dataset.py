@@ -56,21 +56,42 @@ class AbstractDataSet:
 
 
 class LocalDataSet(AbstractDataSet):
+    """In-memory sample store with ONE authoritative shuffle.
+
+    Historically ``shuffle()`` permuted ``self._data`` in place AND
+    ``data(train=True)`` drew a second, independent permutation, so the
+    epoch order depended on how many times each had been called — not
+    reproducible per seed. Now the order is a pure function of
+    ``(seed, epoch)``: ``shuffle()`` advances the epoch counter, and
+    every ``data(train=True)`` in between yields the SAME deterministic
+    permutation (``data(train=False)`` always yields insertion order).
+    """
+
     def __init__(self, data: List, seed: int = 1):
         self._data = list(data)
-        self._rng = np.random.RandomState(seed)
+        self._seed = int(seed)
+        self._epoch = 0
+        self._order = None
 
     def size(self):
         return len(self._data)
 
     def shuffle(self):
-        self._rng.shuffle(self._data)
+        self._epoch += 1
+        self._order = None
         return self
+
+    def _train_order(self):
+        if self._order is None or len(self._order) != len(self._data):
+            rng = np.random.RandomState(
+                [self._seed & 0x7FFFFFFF, self._epoch])
+            self._order = rng.permutation(len(self._data))
+        return self._order
 
     def data(self, train: bool = True):
         if train:
-            idx = self._rng.permutation(len(self._data))
-            return (self._data[i] for i in idx)
+            order = self._train_order()
+            return (self._data[i] for i in order)
         return iter(self._data)
 
 

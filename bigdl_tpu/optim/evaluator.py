@@ -9,17 +9,21 @@ import jax.numpy as jnp
 
 from .. import observability as obs
 from ..dataset.dataset import AbstractDataSet, ShardedDataSet
+from .staging import staged
+from ..utils import engine
 from ..utils.table import Table
 
 
 class Evaluator:
-    def __init__(self, model):
+    def __init__(self, model, prefetch_depth: int = 2):
         self.model = model
+        self.prefetch_depth = prefetch_depth
         self._fwd = None
 
     def _forward_fn(self):
         if self._fwd is None:
             model = self.model
+            engine.maybe_enable_compilation_cache()
 
             def fwd(params, state, x):
                 out, _ = model.apply(params, state, x, training=False)
@@ -27,27 +31,39 @@ class Evaluator:
             self._fwd = jax.jit(fwd)
         return self._fwd
 
+    @staticmethod
+    def _stage(mb):
+        """Host batch -> (device input, host MiniBatch); runs on the
+        stager thread so the next batch transfers while the current one
+        evaluates (the host-side target stays host-resident for the
+        numpy metric methods)."""
+        from .staging import place_host_value
+        return place_host_value(mb.get_input()), mb
+
     def evaluate(self, dataset: AbstractDataSet, methods: List,
                  batch_size: int = 32):
         self.model.ensure_initialized()
         fwd = self._forward_fn()
         batched = ShardedDataSet(dataset, batch_size, drop_last=False)
         results = [None] * len(methods)
-        for mb in batched.data(train=False):
-            sp = obs.span("eval/batch")
-            with sp:
-                x = mb.get_input()
-                x = jax.tree_util.tree_map(jnp.asarray, x) \
-                    if isinstance(x, Table) else jnp.asarray(x)
-                out = fwd(self.model.params, self.model.state, x)
-                for i, m in enumerate(methods):
-                    r = m(out, mb.get_target())
-                    results[i] = r if results[i] is None else results[i] + r
-            if obs.enabled():
-                # one clock source: the histogram reads the span's own
-                # duration rather than timing the interval a second time
-                obs.histogram("eval/batch_s", unit="s").observe(
-                    sp.duration_s)
+        batches = staged(batched.data(train=False), self._stage,
+                         depth=self.prefetch_depth, name="eval_stager")
+        try:
+            for x, mb in batches:
+                sp = obs.span("eval/batch")
+                with sp:
+                    out = fwd(self.model.params, self.model.state, x)
+                    for i, m in enumerate(methods):
+                        r = m(out, mb.get_target())
+                        results[i] = r if results[i] is None \
+                            else results[i] + r
+                if obs.enabled():
+                    # one clock source: the histogram reads the span's own
+                    # duration rather than timing the interval a second time
+                    obs.histogram("eval/batch_s", unit="s").observe(
+                        sp.duration_s)
+        finally:
+            batches.close()
         return results
 
 

@@ -31,6 +31,7 @@ import pickle
 import queue
 import threading
 import time
+from collections import deque
 from typing import List, Optional
 
 import jax
@@ -45,6 +46,7 @@ from .trigger import Trigger, max_epoch as _max_epoch
 from ..dataset.dataset import AbstractDataSet, ShardedDataSet, DataSet
 from ..dataset.minibatch import MiniBatch
 from ..nn.module import Module, Criterion
+from .staging import staged
 from ..utils import engine
 from ..utils.table import Table
 
@@ -220,8 +222,10 @@ class BaseOptimizer:
         self.clip_norm = None
         self.nan_policy = "error"  # or "skip" / "resume"
         self.max_nan_retries = 10  # consecutive non-finite steps before abort
-        self.sync_policy = "sync"  # or "async" (lagged loss reads)
+        self.sync_policy = "sync"  # or "async" / "window:K"
+        self.prefetch_depth = 2    # >= 2 enables the lookahead stager
         self._pending_loss = None
+        self._loss_window = deque()
         self.metrics = Metrics()
         self._step_fn = None
 
@@ -348,10 +352,42 @@ class BaseOptimizer:
         while the device still computes (loss logging, NaN detection and
         min-loss triggers lag one step; the in-step NaN guard keeps params
         safe on-device either way). Use 'async' for device-bound training.
+
+        'window:K' generalizes async: up to K losses stay in flight as
+        device arrays and the host resolves the OLDEST only once the
+        window is full, so loss observation (logging, NaN detection,
+        min-loss triggers) lags K-1 steps and the device pipeline is
+        never drained by a blocking read. 'window:1' == 'sync'. The NaN
+        policy semantics are preserved — a non-finite resolved loss
+        raises/skips/replays-from-checkpoint exactly like sync, just K-1
+        steps later (params stay safe meanwhile via the in-step guard).
         """
-        assert policy in ("sync", "async")
+        if isinstance(policy, str) and policy.startswith("window:"):
+            k = int(policy.split(":", 1)[1])
+            if k < 1:
+                raise ValueError(f"window size must be >= 1, got {k}")
+        else:
+            assert policy in ("sync", "async")
         self.sync_policy = policy
         return self
+
+    def set_prefetch(self, depth: int):
+        """Lookahead depth of the batch stager: with ``depth >= 2`` a
+        host thread produces and device_puts batches N+1..N+depth while
+        step N runs, collapsing ``step/data_fetch`` to a queue pop.
+        ``0``/``1`` keep the serial fetch (exact A/B switch — the staged
+        loop is order-preserving, so trajectories are identical)."""
+        depth = int(depth)
+        if depth < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+        self.prefetch_depth = depth
+        return self
+
+    def _window_k(self) -> Optional[int]:
+        if isinstance(self.sync_policy, str) and \
+                self.sync_policy.startswith("window:"):
+            return int(self.sync_policy.split(":", 1)[1])
+        return None
 
     def set_nan_policy(self, policy: str):
         """'error' raises, 'skip' drops the step, 'resume' rolls back to the
@@ -434,10 +470,58 @@ class BaseOptimizer:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _place_batch(self, x, y):
-        return (jnp.asarray(x) if not isinstance(x, Table) else
-                _tmap(jnp.asarray, x),
-                jnp.asarray(y) if not isinstance(y, Table) else
-                _tmap(jnp.asarray, y))
+        from .staging import place_host_value
+        return place_host_value(x), place_host_value(y)
+
+    def _stage_minibatch(self, mb):
+        """Produce-side staging: host MiniBatch -> device-resident (x, y).
+        Runs on the stager thread when prefetch is enabled (the native
+        bf16_nhwc prefetcher's batches pass through as a cast-free
+        device_put), inline otherwise."""
+        return self._place_batch(mb.get_input(), mb.get_target())
+
+    def _observe_loss(self, loss):
+        """Apply the sync policy to this step's device loss. Returns the
+        resolved host float to examine this iteration, or None when the
+        windowed policy has not filled its in-flight budget yet."""
+        k = self._window_k()
+        if k is not None:
+            self._loss_window.append(loss)
+            if obs.enabled():
+                obs.gauge("optim/loss_window_inflight").set(
+                    len(self._loss_window))
+            if len(self._loss_window) < k:
+                return None
+            # sync-ok: windowed resolve of the OLDEST in-flight loss
+            return float(self._loss_window.popleft())
+        if self.sync_policy == "async":
+            # examine the PREVIOUS step's loss: the device keeps
+            # computing while the host preps the next batch
+            prev, self._pending_loss = self._pending_loss, loss
+            # sync-ok: lagged read (first step resolves its own loss)
+            return float(prev if prev is not None else loss)
+        # sync-ok: sync policy blocks on every step by definition
+        return float(loss)
+
+    def _drain_pending_losses(self, state):
+        """Resolve losses still in flight when the loop ends (async's one
+        pending read, window:K's up-to-K-1 tail) — a NaN pending on the
+        final steps must not be swallowed."""
+        pending = list(self._loss_window)
+        self._loss_window.clear()
+        if self._pending_loss is not None:
+            pending.append(self._pending_loss)
+            self._pending_loss = None
+        for dev in pending:
+            final = float(dev)  # sync-ok: end-of-run drain
+            if np.isfinite(final):
+                state["loss"] = final
+            elif self.nan_policy == "error":
+                raise FloatingPointError(
+                    f"non-finite loss {final} on a final step "
+                    f"({self.sync_policy} lagged read)")
+            else:
+                self.metrics.add("nan_skips", 1.0)
 
     def _checkpoint(self, params, opt_state, mstate, state):
         tag = "" if self.checkpoint_overwrite else \
@@ -515,9 +599,15 @@ class BaseOptimizer:
         if opt_state is None:
             opt_state = self.optim_method.init_state(params)
         params, opt_state, mstate = self._prepare(params, opt_state, mstate)
+        engine.maybe_enable_compilation_cache()
         with obs.span("optimizer/build_step"):
             self._step_fn = self._build_step()
-        self._pending_loss = None  # never consume a dead run's loss
+        if obs.enabled():
+            obs.gauge("engine/compile_cache_entries").set(
+                engine.compilation_cache_entries())
+        # never consume a dead run's in-flight losses
+        self._pending_loss = None
+        self._loss_window.clear()
 
         optim = self.optim_method
         state = optim.state  # {'neval', 'epoch', ...}
@@ -527,11 +617,57 @@ class BaseOptimizer:
         while not done:
             batched.shuffle()
             epoch_start = time.time()
-            for mb in batched.data(train=True):
+            # the stager owns produce + device placement; with
+            # prefetch_depth >= 2 both run on a lookahead thread while
+            # the device computes, otherwise inline (the serial loop)
+            batches = staged(batched.data(train=True), self._stage_minibatch,
+                             depth=self.prefetch_depth, name="stager")
+            box = {"params": params, "opt_state": opt_state,
+                   "mstate": mstate, "nan_streak": nan_streak, "done": done}
+            try:
+                self._run_epoch_steps(batches, state, box)
+            finally:
+                batches.close()  # join the stager thread — no leaks, ever
+            params, opt_state, mstate = \
+                box["params"], box["opt_state"], box["mstate"]
+            nan_streak, done = box["nan_streak"], box["done"]
+            if not done:
+                state["epoch"] += 1
+                state["epoch_finished"] = True
+                self.metrics.add("epoch_time", time.time() - epoch_start)
+                self._fire_epoch(state, params, opt_state, mstate)
+                if self.end_trigger(state):
+                    done = True
+
+        # drain the async/window in-flight losses (a NaN pending on the
+        # final steps must not be swallowed)
+        self._drain_pending_losses(state)
+        self.model.params, self.model.state = \
+            self._collect(params, mstate, opt_state)
+        self.model.grad_params = _tmap(jnp.zeros_like, self.model.params)
+        self._close_checkpoints()  # land async writes, stop the writer
+        return self.model
+
+    def _run_epoch_steps(self, batches, state, box):
+        """One epoch of the pipelined step loop. ``batches`` yields
+        device-resident (x, y) (already staged by the caller's stager);
+        mutable step state travels in ``box``
+        (params/opt_state/mstate/nan_streak/done) so every exit path —
+        exhaustion, end trigger, an exception mid-step — leaves the
+        caller with the latest device handles."""
+        optim = self.optim_method
+        params, opt_state, mstate = \
+            box["params"], box["opt_state"], box["mstate"]
+        nan_streak = box["nan_streak"]
+        try:
+            while True:
                 with obs.span("step", neval=state["neval"]):
                     t0 = time.time()
                     with obs.span("step/data_fetch"):
-                        x, y = self._place_batch(mb.get_input(), mb.get_target())
+                        try:
+                            x, y = next(batches)
+                        except StopIteration:
+                            return
                     t1 = time.time()
                     lr = optim.current_lr()
                     rng = engine.next_rng_key()
@@ -540,16 +676,9 @@ class BaseOptimizer:
                             params, opt_state, mstate, x, y,
                             jnp.asarray(lr, jnp.float32), rng)
                     with obs.span("step/loss_sync"):
-                        if self.sync_policy == "async":
-                            # examine the PREVIOUS step's loss: the device
-                            # keeps computing while the host preps the next
-                            # batch
-                            prev, self._pending_loss = self._pending_loss, loss
-                            loss_val = float(prev if prev is not None else loss)
-                        else:
-                            loss_val = float(loss)
+                        loss_val = self._observe_loss(loss)
                     t2 = time.time()
-                    if not np.isfinite(loss_val):
+                    if loss_val is not None and not np.isfinite(loss_val):
                         nan_streak += 1
                         if self.nan_policy == "error":
                             raise FloatingPointError(
@@ -573,8 +702,11 @@ class BaseOptimizer:
                                 payload = pickle.load(f)
                             self.optim_method.state.update(
                                 payload["optim_host_state"])
-                            params, opt_state, mstate =                             self._restore_step_state(payload)
-                            self._pending_loss = None  # refers to pre-restore
+                            params, opt_state, mstate = \
+                                self._restore_step_state(payload)
+                            # in-flight losses refer to pre-restore steps
+                            self._pending_loss = None
+                            self._loss_window.clear()
                             self.metrics.add("nan_resumes", 1.0)
                             obs.instant("step/nan_resume", neval=state["neval"])
                             continue
@@ -584,9 +716,13 @@ class BaseOptimizer:
                         obs.instant("step/nan_skip", neval=state["neval"])
                         state["neval"] += 1
                         continue
-                    nan_streak = 0
+                    if loss_val is not None:
+                        # windowed policies have no resolved loss until K
+                        # are in flight — the streak/loss state only moves
+                        # on an actually-observed value
+                        nan_streak = 0
+                        state["loss"] = loss_val
                     state["neval"] += 1
-                    state["loss"] = loss_val
                     state["epoch_finished"] = False
                     self.metrics.add("data_time", t1 - t0)
                     self.metrics.add("step_time", t2 - t1)
@@ -596,7 +732,7 @@ class BaseOptimizer:
                             self.batch_size / max(t2 - t0, 1e-9))
                     if self.train_summary is not None:
                         rec = self.train_summary.should_record
-                        if rec("Loss", state):
+                        if loss_val is not None and rec("Loss", state):
                             self.train_summary.add_scalar("Loss", loss_val,
                                                           state["neval"])
                         if rec("LearningRate", state):
@@ -610,32 +746,11 @@ class BaseOptimizer:
                     if self._fire_mid_epoch(state, params, opt_state, mstate):
                         pass
                     if self.end_trigger(state):
-                        done = True
-                        break
-            if not done:
-                state["epoch"] += 1
-                state["epoch_finished"] = True
-                self.metrics.add("epoch_time", time.time() - epoch_start)
-                self._fire_epoch(state, params, opt_state, mstate)
-                if self.end_trigger(state):
-                    done = True
-
-        if self._pending_loss is not None:  # drain the lagged async read
-            final = float(self._pending_loss)
-            self._pending_loss = None
-            if np.isfinite(final):
-                state["loss"] = final
-            elif self.nan_policy == "error":
-                raise FloatingPointError(
-                    f"non-finite loss {final} on the final step "
-                    "(async lagged read)")
-            else:
-                self.metrics.add("nan_skips", 1.0)
-        self.model.params, self.model.state = \
-            self._collect(params, mstate, opt_state)
-        self.model.grad_params = _tmap(jnp.zeros_like, self.model.params)
-        self._close_checkpoints()  # land async writes, stop the writer
-        return self.model
+                        box["done"] = True
+                        return
+        finally:
+            box.update(params=params, opt_state=opt_state, mstate=mstate,
+                       nan_streak=nan_streak)
 
     def _fire_mid_epoch(self, state, params, opt_state, mstate):
         fired = False

@@ -70,6 +70,34 @@ def test_cached_lines_filter_by_config_and_age(bench_mod):
     assert b._cached_tpu_lines("secondary:transformer") == []
 
 
+def test_cached_lines_provenance_on_reuse(bench_mod):
+    """A cache hit must not impersonate a fresh measurement: the
+    timestamp moves to `cache_from` and any error text a previous serve
+    attached is dropped (BENCH_r05 re-emitted a stale tunnel_error)."""
+    b = bench_mod
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    json.dump([
+        {"metric": "resnet50_train_images_per_sec_per_chip", "value": 5,
+         "backend": "tpu", "measured_at": now,
+         "tunnel_error": "old outage text", "error": "stale"},
+    ], open(b._TPU_CACHE, "w"))
+    got = b._cached_tpu_lines("headline")
+    assert len(got) == 1
+    line = got[0]
+    assert line["cached"] is True
+    assert line["cache_from"] == now
+    assert "measured_at" not in line
+    assert "tunnel_error" not in line and "error" not in line
+
+    # and re-caching a served line never persists serve-time fields
+    b._cache_tpu_lines([dict(line, backend="tpu",
+                             tunnel_error="current outage")])
+    stored = json.load(open(b._TPU_CACHE))[0]
+    assert "tunnel_error" not in stored and "cached" not in stored
+    assert "cache_from" not in stored
+    assert "measured_at" in stored
+
+
 def test_corrupt_cache_resets_instead_of_blocking(bench_mod):
     b = bench_mod
     with open(b._TPU_CACHE, "w") as f:

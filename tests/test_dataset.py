@@ -34,11 +34,25 @@ def test_minibatch_padding():
 
 
 def test_dataset_shuffle_iterate():
+    """One authoritative shuffle: the epoch order is a pure function of
+    (seed, epoch) — data() alone never reshuffles, shuffle() advances."""
     ds = DataSet.array(list(range(100)))
     a = list(ds.data(train=True))
     b = list(ds.data(train=True))
     assert sorted(a) == list(range(100))
-    assert a != b  # shuffled differently
+    assert a == b  # no hidden second shuffle inside data()
+    ds.shuffle()
+    c = list(ds.data(train=True))
+    assert sorted(c) == list(range(100))
+    assert c != a  # shuffle() is what advances the order
+
+    # reproducible per seed: a fresh dataset replays the same epochs
+    ds2 = DataSet.array(list(range(100)))
+    assert list(ds2.data(train=True)) == a
+    ds2.shuffle()
+    assert list(ds2.data(train=True)) == c
+    # eval order is insertion order, untouched by shuffles
+    assert list(ds2.data(train=False)) == list(range(100))
 
 
 def test_multi_feature_samples():

@@ -234,7 +234,13 @@ def test_zero1_nan_resume_and_checkpoint_layout(tmp_path):
     import pickle, os
     mesh = make_mesh((8,), ("data",))
     xs = np.random.randn(32, 6).astype(np.float32)
-    xs[17] = np.nan
+    # poison a sample that lands in the LAST batch of epoch 1 (the shuffle
+    # is deterministic per (seed, epoch)), so checkpoints exist before the
+    # NaN step and 'resume' has a snapshot to replay
+    probe = DataSet.array(list(range(32)))
+    probe.shuffle()
+    bad = list(probe.data(train=True))[-1]
+    xs[bad] = np.nan
     samples = [Sample(xs[i], np.float32(i % 3 + 1)) for i in range(32)]
     model = nn.Sequential(nn.Linear(6, 3))
     opt = DistriOptimizer(model, DataSet.array(samples),

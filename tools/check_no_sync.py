@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Lint: the training hot loop must not grow un-annotated host<->device
+sync points.
+
+PR 1's spans showed the step loop was host-bound partly because of a
+blocking ``float(loss)`` every iteration; PR 2 restructured the loop so
+every remaining sync is deliberate. This check keeps it that way: inside
+the hot-loop functions listed below, any ``float(...)`` call or
+``.block_until_ready(`` use must carry a ``# sync-ok: <reason>``
+annotation on the same line or the line above — an un-annotated sync is
+a build failure, not a silent 2x step-time regression six PRs later.
+
+Run: ``python tools/check_no_sync.py`` (wired as ``make check-no-sync``,
+a prerequisite of ``make tier1``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# file -> function names whose bodies form the training hot path
+HOT_FUNCS = {
+    "bigdl_tpu/optim/optimizer.py": {
+        "optimize", "_run_epoch_steps", "_observe_loss",
+        "_drain_pending_losses", "_stage_minibatch", "_place_batch",
+    },
+    "bigdl_tpu/optim/staging.py": {"_run", "__next__"},
+}
+
+SYNC = re.compile(r"(?<![\w.])float\(|\.block_until_ready\(")
+OK = re.compile(r"#\s*sync-ok\s*:")
+
+
+def _hot_ranges(tree, wanted):
+    """(name, first_line, last_line) for every wanted def, however nested."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                node.name in wanted:
+            out.append((node.name, node.lineno, node.end_lineno))
+    return out
+
+
+def check(repo: str = REPO):
+    violations = []
+    for rel, wanted in HOT_FUNCS.items():
+        path = os.path.join(repo, rel)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        lines = src.splitlines()
+        found = set()
+        for name, lo, hi in _hot_ranges(ast.parse(src), wanted):
+            found.add(name)
+            for i in range(lo, hi + 1):
+                line = lines[i - 1]
+                if not SYNC.search(line):
+                    continue
+                prev = lines[i - 2] if i >= 2 else ""
+                if OK.search(line) or OK.search(prev):
+                    continue
+                violations.append(
+                    f"{rel}:{i}: un-annotated sync point in {name}(): "
+                    f"{line.strip()}")
+        missing = wanted - found
+        if missing:
+            violations.append(
+                f"{rel}: hot functions not found (lint out of date — "
+                f"update HOT_FUNCS): {sorted(missing)}")
+    return violations
+
+
+def main():
+    violations = check()
+    if violations:
+        print("check_no_sync: FAIL — a sync point in the step loop stalls "
+              "the device pipeline.\nAnnotate deliberate syncs with "
+              "'# sync-ok: <reason>' (same line or the line above):\n")
+        for v in violations:
+            print("  " + v)
+        return 1
+    print("check_no_sync: ok — every hot-loop sync point is annotated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
