@@ -95,10 +95,15 @@ def resnet_bench_variant():
     return fused, pool_grad, stem
 
 
-def _build_resnet_step(batch, size):
+def _build_resnet_step(batch, size, superstep: int = 1):
     """Compile the ResNet-50 train step (fwd + CE loss + bwd + momentum
     SGD, donated buffers). Returns (step, carry, lr, flops_per_step) —
-    shared by the synthetic headline and the real-data config."""
+    shared by the synthetic headline and the real-data config.
+
+    ``superstep > 1`` compiles K fused steps as one ``lax.scan`` program
+    over ``[K, batch, ...]`` stacks (the optimizer's superstep mode, in
+    bench form): one dispatch and one loss readback per K steps;
+    ``flops_per_step`` then reports the whole K-step program."""
     import jax
     import jax.numpy as jnp
     from bigdl_tpu.models import ResNet
@@ -139,12 +144,28 @@ def _build_resnet_step(batch, size):
         new_params, new_opt = optim.update(grads, params, opt_state, lr)
         return loss, new_params, new_opt, new_mstate
 
-    x = jnp.zeros((batch, size, size, 3), jnp.bfloat16)
-    y = jnp.zeros((batch,), jnp.int32)
+    def train_superstep(params, opt_state, mstate, xs, ys, lr):
+        def body(carry, inp):
+            p, o, m = carry
+            bx, by = inp
+            loss, p, o, m = train_step(p, o, m, bx, by, lr)
+            return (p, o, m), loss
+        (params, opt_state, mstate), losses = jax.lax.scan(
+            body, (params, opt_state, mstate), (xs, ys))
+        return losses, params, opt_state, mstate
+
+    if superstep > 1:
+        x = jnp.zeros((superstep, batch, size, size, 3), jnp.bfloat16)
+        y = jnp.zeros((superstep, batch), jnp.int32)
+        fn = train_superstep
+    else:
+        x = jnp.zeros((batch, size, size, 3), jnp.bfloat16)
+        y = jnp.zeros((batch,), jnp.int32)
+        fn = train_step
     lr = jnp.float32(0.1)
     # AOT-compile once and reuse the executable for the timed loop (a plain
     # jit call after .lower().compile() would trace+compile a second time).
-    step = jax.jit(train_step, donate_argnums=(0, 1, 2)) \
+    step = jax.jit(fn, donate_argnums=(0, 1, 2)) \
               .lower(params, opt_state, mstate, x, y, lr).compile()
 
     flops_per_step = None
@@ -157,7 +178,8 @@ def _build_resnet_step(batch, size):
         pass
     if not flops_per_step:
         # analytic fallback: 4.09 GMAC fwd/image * 2 flops/MAC * 3 (train)
-        flops_per_step = 2 * 4.089e9 * 3 * batch * (size / 224.0) ** 2
+        flops_per_step = (2 * 4.089e9 * 3 * batch * (size / 224.0) ** 2
+                          * max(1, superstep))
     return step, [params, opt_state, mstate], lr, flops_per_step
 
 
@@ -174,26 +196,36 @@ def bench_resnet50():
     batch = int(os.environ.get("BENCH_BATCH", 256 if on_tpu else 4))
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 2))
     warmup = int(os.environ.get("BENCH_WARMUP", 3 if on_tpu else 1))
+    # BENCH_SUPERSTEP=K fuses K steps per dispatch (lax.scan) — the K
+    # sweep companion of the optimizer's set_superstep mode
+    superstep = max(1, int(os.environ.get("BENCH_SUPERSTEP", "1")))
     size = 224 if on_tpu else 64
 
-    step, carry, lr, flops_per_step = _build_resnet_step(batch, size)
+    step, carry, lr, flops_per_step = _build_resnet_step(batch, size,
+                                                         superstep)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(batch, size, size, 3).astype(np.float32),
                     jnp.bfloat16)
     y = jnp.asarray(rng.randint(1, 1001, size=(batch,)).astype(np.int32))
+    if superstep > 1:
+        x = jnp.stack([x] * superstep)
+        y = jnp.stack([y] * superstep)
+    dispatches = max(1, steps // superstep)
 
     for _ in range(warmup):
         loss, *carry = step(*carry, x, y, lr)
-    float(loss)  # full sync (block_until_ready is unreliable over the tunnel)
+    # full sync (block_until_ready is unreliable over the tunnel); under a
+    # superstep the loss is a [K] vector — still ONE readback
+    final = np.asarray(loss)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(dispatches):
         loss, *carry = step(*carry, x, y, lr)
-    final_loss = float(loss)  # forces the whole chained step sequence
+    final = np.asarray(loss)  # forces the whole chained step sequence
     dt = time.perf_counter() - t0
-    assert np.isfinite(final_loss)
-    img_per_sec = batch * steps / dt
+    assert np.isfinite(final).all()
+    img_per_sec = batch * superstep * dispatches / dt
     peak = _peak_flops(jax.devices()[0].device_kind)
-    mfu = flops_per_step * steps / dt / peak
+    mfu = flops_per_step * dispatches / dt / peak
 
     return {
         "metric": "resnet50_train_images_per_sec_per_chip",
@@ -201,6 +233,8 @@ def bench_resnet50():
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
         "mfu": round(mfu, 4),
+        "superstep_k": superstep,
+        "dispatches": dispatches,
         "backend": backend,
         "device": jax.devices()[0].device_kind,
     }
@@ -298,10 +332,14 @@ def bench_resnet50_realdata():
     # half the host→device bytes
     # augment=True: the realdata config trains with the reference's real
     # ImageNet transform (RandomResizedCrop + hflip) on the decode workers
+    # stage_to_device: the decode workers' output buffer (reusable host
+    # staging ring) hands straight to device_put — no per-batch numpy
+    # allocation or copy between libjpeg and the chip
     pf = JpegFolderPrefetcher(
         paths, labels, size, size, mean=(124.0, 117.0, 104.0),
         std=(59.0, 57.0, 57.0), batch_size=batch, n_workers=n_workers,
-        queue_capacity=4, out="bf16_nhwc", augment=True)
+        queue_capacity=4, out="bf16_nhwc", augment=True,
+        stage_to_device=True)
 
     step, carry, lr, flops_per_step = _build_resnet_step(batch, size)
 
@@ -309,12 +347,11 @@ def bench_resnet50_realdata():
         """Endless stream of device-resident (x, y). loop_epochs keeps the
         decode workers running across epoch boundaries (a cold restart
         refills the whole queue: 7-11 s stall on a 1-core host); batches
-        arrive bf16 NHWC so the host path is decode → async device_put."""
+        arrive bf16 NHWC as DEVICE arrays (the prefetcher's staging ring
+        already device_put them) — only the label cast remains."""
         while True:
             for mb in pf.data(train=True, loop_epochs=1000):
-                x = jnp.asarray(np.asarray(mb.input))  # (B, H, W, 3) bf16
-                y = jnp.asarray(np.asarray(mb.target), jnp.int32)
-                yield x, y
+                yield mb.input, jnp.asarray(mb.target, jnp.int32)
 
     def pull(it, wait):
         """next(it) is where the host blocks on the input pipeline."""
@@ -373,6 +410,11 @@ def child_main(which: str):
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_cache")))
     from bigdl_tpu import observability as obs
+    # observability ON in every bench child: the jax compilation-cache
+    # monitoring events only bridge into the engine/compile_cache_hits|
+    # misses counters while enabled, and those counters ride every
+    # result line so the perf trajectory shows cache effectiveness
+    obs.enable()
     if which == "headline":
         with obs.span("bench/headline"):
             results = [bench_resnet50()]
@@ -384,6 +426,12 @@ def child_main(which: str):
         results = [bench_one(which.split(":", 1)[1])]
     else:
         raise SystemExit(f"unknown child config {which!r}")
+    reg = obs.registry()
+    for r in results:
+        r.setdefault("compile_cache_hits",
+                     int(reg.counter("engine/compile_cache_hits").value))
+        r.setdefault("compile_cache_misses",
+                     int(reg.counter("engine/compile_cache_misses").value))
     # the parent owns line->registry accounting (_write_metrics_dump);
     # the child's contribution is the bench/* spans — exportable with
     # BIGDL_TPU_TRACE=1 BENCH_TRACE_OUT=/path/trace.json

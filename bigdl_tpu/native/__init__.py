@@ -117,18 +117,92 @@ def available() -> bool:
     return load_library() is not None
 
 
+def _device_put_copies(shape, dtype) -> bool:
+    """Whether ``jax.device_put`` COPIES a host numpy buffer of exactly
+    this shape/dtype on this backend (TPU/GPU: always — host→HBM DMA;
+    CPU XLA: may zero-copy ALIAS, and the decision can depend on size,
+    dtype and alignment — so the probe uses the REAL buffer spec, not a
+    small proxy). Put, mutate the source, compare."""
+    import jax
+    probe = np.zeros(shape, dtype)
+    arr = jax.device_put(probe)
+    arr.block_until_ready()
+    probe.reshape(-1)[0] = 1
+    return bool(np.asarray(arr).reshape(-1)[0] == 0)
+
+
+class HostStagingRing:
+    """Reusable host staging buffers for the decode→device handoff
+    (ROADMAP open item #3: drop the per-batch numpy round-trip).
+
+    The decode workers fill a preallocated slot buffer (the practical
+    analog of a pinned transfer buffer — stable address, no per-batch
+    allocator traffic) and the SAME memory is handed straight to
+    ``device_put``. A slot is only reused after its previous transfer's
+    device arrays are ready (the fence below), which with
+    ``slots > queue_capacity`` has almost always already happened.
+    Backends where ``device_put`` aliases instead of copying (CPU XLA
+    zero-copy) are detected at construction and degrade to a fresh
+    buffer per batch — correctness never depends on copy behavior."""
+
+    def __init__(self, x_shape, x_dtype, y_shape, y_dtype, slots: int = 3):
+        # both buffer specs must copy for reuse to be safe (the aliasing
+        # decision can differ per shape/dtype on CPU XLA)
+        self._copies = (_device_put_copies(x_shape, x_dtype) and
+                        _device_put_copies(y_shape, y_dtype))
+        self._slots = max(2, int(slots))
+        self._x_shape, self._x_dtype = x_shape, x_dtype
+        self._y_shape, self._y_dtype = y_shape, y_dtype
+        self._bufs = [
+            (np.empty(x_shape, x_dtype), np.empty(y_shape, y_dtype))
+            for _ in range(self._slots)] if self._copies else None
+        self._inflight = [None] * self._slots
+        self._i = 0
+
+    def acquire(self):
+        """Next (x, y) host buffers to decode into."""
+        if not self._copies:
+            return (np.empty(self._x_shape, self._x_dtype),
+                    np.empty(self._y_shape, self._y_dtype))
+        self._i = (self._i + 1) % self._slots
+        pending = self._inflight[self._i]
+        if pending is not None:
+            for a in pending:
+                # sync-ok: reuse fence — the transfer issued slots-1
+                # batches ago has already landed in the steady state
+                a.block_until_ready()
+            self._inflight[self._i] = None
+        return self._bufs[self._i]
+
+    def to_device(self, x_view, y_view):
+        """device_put the filled buffers (straight from the staging
+        memory — no intermediate numpy copy) and track them as this
+        slot's in-flight transfer."""
+        import jax
+        xd, yd = jax.device_put(x_view), jax.device_put(y_view)
+        if self._copies:
+            self._inflight[self._i] = (xd, yd)
+        return xd, yd
+
+
 class NativePrefetcher:
     """Threaded native decode+normalize pipeline producing float CHW batches.
 
     Usable as a dataset for the optimizers: ``data(train)`` yields MiniBatch
     with inputs shaped (B, C, H, W) and 1-based float labels.
-    """
+
+    ``stage_to_device=True`` stages each decoded batch into a reusable
+    host buffer ring and hands it straight to ``device_put``: the
+    yielded MiniBatches hold DEVICE arrays, the optimizer's place call
+    becomes a no-op, and the bf16_nhwc handoff loses its per-batch numpy
+    allocation + copy (ROADMAP open item #3)."""
 
     _out_format = 0  # 0 = f32 CHW; 1 = bf16 NHWC (JpegFolderPrefetcher)
 
     def __init__(self, images: np.ndarray, labels: np.ndarray,
                  mean, std, batch_size: int = 32, n_workers: int = 4,
-                 queue_capacity: int = 4, seed: int = 1):
+                 queue_capacity: int = 4, seed: int = 1,
+                 stage_to_device: bool = False):
         """images: uint8 (N, C, H, W); labels: 1-based int."""
         self.lib = load_library()
         if self.lib is None:
@@ -156,6 +230,7 @@ class NativePrefetcher:
         self.queue_capacity = queue_capacity
         self._rng = np.random.RandomState(seed)
         self._epoch_open = False
+        self._stage_to_device = stage_to_device
 
     # dataset protocol ---------------------------------------------------
     def size(self):
@@ -213,9 +288,19 @@ class NativePrefetcher:
         from .. import observability as obs
         if obs.enabled():
             obs.gauge("dataset/queue_capacity").set(self.queue_capacity)
+        ring = None
+        if self._stage_to_device:
+            # slots > queue_capacity: by the time a slot cycles back, its
+            # transfer left the bounded native queue long ago
+            ring = HostStagingRing(x_shape, x_dtype, (self.batch_size,),
+                                   np.float32,
+                                   slots=self.queue_capacity + 2)
         while True:
-            x = np.empty(x_shape, x_dtype)
-            y = np.empty((self.batch_size,), np.float32)
+            if ring is not None:
+                x, y = ring.acquire()
+            else:
+                x = np.empty(x_shape, x_dtype)
+                y = np.empty((self.batch_size,), np.float32)
             # stamped unconditionally: one clock read per batch is noise
             # next to a jpeg decode, and a mid-block obs.enable() must
             # never pair a real end time with a zero start
@@ -238,7 +323,10 @@ class NativePrefetcher:
                         "%d samples failed to decode so far (substituted "
                         "with zero images)", failed)
                 return
-            yield MiniBatch(x[:got], y[:got])
+            if ring is not None:
+                yield MiniBatch(*ring.to_device(x[:got], y[:got]))
+            else:
+                yield MiniBatch(x[:got], y[:got])
 
     @property
     def decode_failures(self) -> int:
@@ -347,7 +435,8 @@ class JpegFolderPrefetcher(NativePrefetcher):
     def __init__(self, paths, labels, height: int, width: int, mean, std,
                  batch_size: int = 32, n_workers: int = 4,
                  queue_capacity: int = 4, seed: int = 1,
-                 out: str = "f32_chw", augment: bool = False):
+                 out: str = "f32_chw", augment: bool = False,
+                 stage_to_device: bool = False):
         """``out="bf16_nhwc"`` makes the decode workers emit
         accelerator-ready batches: normalized bf16 in NHWC, so the host
         path is decode → device_put with no f32→bf16 cast, no transpose,
@@ -384,6 +473,7 @@ class JpegFolderPrefetcher(NativePrefetcher):
         self.queue_capacity = queue_capacity
         self._rng = np.random.RandomState(seed)
         self._epoch_open = False
+        self._stage_to_device = stage_to_device
         self._out_format = 1 if out == "bf16_nhwc" else 0
         if self.lib.pf_set_format(self.handle, self._out_format) != 0:
             raise RuntimeError(f"pf_set_format({out}) rejected")

@@ -166,7 +166,9 @@ def record_bench_line(line: Dict, reg: Optional[MetricsRegistry] = None):
     if not name or not isinstance(line.get("value"), (int, float)):
         return
     reg.gauge(f"bench/{name}", unit=line.get("unit", "")).set(line["value"])
-    for extra in ("vs_baseline", "mfu", "input_wait_frac"):
+    for extra in ("vs_baseline", "mfu", "input_wait_frac", "superstep_k",
+                  "dispatches", "compile_cache_hits",
+                  "compile_cache_misses"):
         if isinstance(line.get(extra), (int, float)):
             reg.gauge(f"bench/{name}/{extra}").set(line[extra])
 

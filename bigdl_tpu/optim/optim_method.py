@@ -317,6 +317,28 @@ class OptimMethod:
     def current_lr(self):
         return self.learningrate
 
+    def current_lr_vector(self, k: int):
+        """Learning rates for the next ``k`` steps — the schedule
+        vectorization a superstep dispatch needs: ``[lr(neval), ...,
+        lr(neval + k - 1)]`` precomputed host-side so K fused updates
+        each see exactly the lr the K=1 loop would have fed them.
+        Implemented by advancing ``state['neval']`` through the window
+        (so stateful schedules observe one ``update_lr`` call per step,
+        same as K=1) and restoring it; loss/score-driven schedules see
+        the values as of the superstep start — the same observation lag
+        ``window:K`` introduces."""
+        if k == 1:
+            return [self.current_lr()]
+        n0 = self.state["neval"]
+        try:
+            lrs = []
+            for i in range(k):
+                self.state["neval"] = n0 + i
+                lrs.append(self.current_lr())
+        finally:
+            self.state["neval"] = n0
+        return lrs
+
     def clone(self):
         import copy
         return copy.deepcopy(self)

@@ -28,7 +28,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Callable, Iterable, Iterator
+from typing import Callable, Iterable, Iterator, Optional
 
 from .. import observability as obs
 
@@ -44,14 +44,34 @@ class BatchStager:
 
     Iterate it like the source iterable; call :meth:`close` (or use as a
     context manager) to shut the worker down early — e.g. when an end
-    trigger fires mid-epoch."""
+    trigger fires mid-epoch.
+
+    Stacking stage (superstep fusion): with ``group=K`` and a
+    ``group_fn``, the worker collects up to K staged items and emits ONE
+    ``group_fn([item, ...])`` result per group — the optimizer's group
+    fn assembles the ``[K, batch, ...]`` stacked device arrays a
+    superstep dispatch consumes, so the whole stack+place cost rides the
+    stager thread and the hot loop still dequeues one element. The final
+    group of an epoch may be smaller than K (epoch-end clamping)."""
 
     def __init__(self, source: Iterable, stage_fn: Callable, depth: int = 2,
-                 name: str = "stager"):
+                 name: str = "stager", group: int = 1,
+                 group_fn: Optional[Callable] = None,
+                 group_key: Optional[Callable] = None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
+        if group < 1:
+            raise ValueError(f"group must be >= 1, got {group}")
+        if group > 1 and group_fn is None:
+            raise ValueError("group > 1 requires a group_fn")
         self._source = source
         self._stage_fn = stage_fn
+        self._group = group
+        self._group_fn = group_fn
+        # items whose key differs cannot share a stack (a prefetcher's
+        # ragged final batch must not np.stack against full ones): a key
+        # change flushes the pending group and starts a new one
+        self._group_key = group_key or (lambda item: None)
         self._name = name
         # per-instance metric names: a mid-training eval/predict stager
         # must not clobber the training stager's queue-depth signal
@@ -67,14 +87,16 @@ class BatchStager:
     # -- worker ----------------------------------------------------------
     def _run(self):
         it = iter(self._source)
+        pending = []  # staged items awaiting a full group (group > 1)
         try:
-            while not self._stop.is_set():
+            exhausted = False
+            while not self._stop.is_set() and not exhausted:
                 with obs.span(f"{self._name}/source_wait"):
                     t0 = time.perf_counter()
                     try:
                         item = next(it)
                     except StopIteration:
-                        break
+                        exhausted = True
                 if obs.enabled():
                     # time the worker spent blocked on the upstream
                     # iterator (dataset produce): large values mean the
@@ -82,13 +104,31 @@ class BatchStager:
                     # won't help
                     obs.histogram(f"optim/{self._name}_source_wait_s",
                                   unit="s").observe(time.perf_counter() - t0)
-                staged = self._stage_fn(item)
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(staged, timeout=0.1)
-                        break
-                    except queue.Full:
+                if exhausted:
+                    emit = []
+                    if pending:  # epoch tail: a smaller final group
+                        emit, pending = [self._group_fn(pending)], []
+                elif self._group > 1:
+                    staged = self._stage_fn(item)
+                    emit = []
+                    if pending and self._group_key(staged) != \
+                            self._group_key(pending[0]):
+                        emit, pending = [self._group_fn(pending)], []
+                    pending.append(staged)
+                    if len(pending) == self._group:
+                        emit.append(self._group_fn(pending))
+                        pending = []
+                    if not emit:
                         continue
+                else:
+                    emit = [self._stage_fn(item)]
+                for staged in emit:
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(staged, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
                 if obs.enabled():
                     obs.gauge(self._depth_gauge).set(self._q.qsize())
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
@@ -170,17 +210,41 @@ class BatchStager:
 class _SerialStager:
     """Depth-0/1 fallback with the same iterator + ``close()`` surface:
     stages each item inline at ``next()`` — the serial loop, unchanged,
-    so ``set_prefetch(0)`` is an exact A/B switch."""
+    so ``set_prefetch(0)`` is an exact A/B switch. ``group``/``group_fn``
+    stack inline with the same semantics as the threaded stager."""
 
-    def __init__(self, source: Iterable, stage_fn: Callable):
+    def __init__(self, source: Iterable, stage_fn: Callable,
+                 group: int = 1, group_fn: Optional[Callable] = None,
+                 group_key: Optional[Callable] = None):
+        if group > 1 and group_fn is None:
+            raise ValueError("group > 1 requires a group_fn")
         self._it = iter(source)
         self._stage_fn = stage_fn
+        self._group = group
+        self._group_fn = group_fn
+        self._group_key = group_key or (lambda item: None)
+        self._carry = []  # lookahead item that broke the previous group
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        return self._stage_fn(next(self._it))
+        if self._group <= 1:
+            return self._stage_fn(next(self._it))
+        pending, self._carry = self._carry, []
+        while len(pending) < self._group:
+            try:
+                staged = self._stage_fn(next(self._it))
+            except StopIteration:
+                if pending:
+                    break  # epoch tail: a smaller final group
+                raise
+            if pending and self._group_key(staged) != \
+                    self._group_key(pending[0]):
+                self._carry = [staged]  # shape break: next group starts here
+                break
+            pending.append(staged)
+        return self._group_fn(pending)
 
     def close(self):
         close = getattr(self._it, "close", None)
@@ -199,12 +263,19 @@ class _SerialStager:
 
 
 def staged(source: Iterable, stage_fn: Callable, depth: int = 2,
-           name: str = "stager"):
+           name: str = "stager", group: int = 1,
+           group_fn: Optional[Callable] = None,
+           group_key: Optional[Callable] = None):
     """Pick the pipelined or serial staging wrapper by ``depth``
-    (>= 2 spawns the lookahead thread; 0/1 stays inline)."""
+    (>= 2 spawns the lookahead thread; 0/1 stays inline). ``group``/
+    ``group_fn``/``group_key`` enable the superstep stacking stage on
+    either."""
     if depth >= 2:
-        return BatchStager(source, stage_fn, depth=depth, name=name)
-    return _SerialStager(source, stage_fn)
+        return BatchStager(source, stage_fn, depth=depth, name=name,
+                           group=group, group_fn=group_fn,
+                           group_key=group_key)
+    return _SerialStager(source, stage_fn, group=group, group_fn=group_fn,
+                         group_key=group_key)
 
 
 def stager_threads_alive() -> int:

@@ -13,6 +13,15 @@ class Trigger:
     def __call__(self, state) -> bool:
         return bool(self._fn(state))
 
+    def probe(self, state) -> bool:
+        """Side-effect-free preview: would this trigger fire at ``state``?
+        Used by superstep boundary clamping (the optimizer simulates the
+        next K iteration counters to size a dispatch so it never
+        straddles a firing point). The state dict is copied so the
+        predicate cannot mutate the caller's live table; stateful
+        triggers override this to avoid advancing their own bookkeeping."""
+        return bool(self._fn(dict(state)))
+
 
 class _EveryEpoch(Trigger):
     """Fires when an epoch boundary was just crossed (Trigger.scala:37)."""
@@ -27,6 +36,12 @@ class _EveryEpoch(Trigger):
                     return True
             return False
         super().__init__(fn)
+
+    def probe(self, state) -> bool:
+        # pure: does NOT advance last_epoch (mid-superstep probes carry
+        # epoch_finished=False, so this is False everywhere the clamp asks)
+        return bool(state.get("epoch_finished", False)) and \
+            state["epoch"] != self.last_epoch
 
 
 class _SeveralIteration(Trigger):

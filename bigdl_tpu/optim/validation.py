@@ -59,10 +59,33 @@ class ContiguousResult(LossResult):
 
 
 class ValidationMethod:
-    """Apply to (output, target) of one batch → ValidationResult."""
+    """Apply to (output, target) of one batch → ValidationResult.
+
+    Device-side accumulation protocol (ROADMAP open item #4): a method
+    that overrides :meth:`device_stats` exposes its per-batch statistics
+    as a small jit-traceable device vector; the Evaluator then keeps a
+    RUNNING SUM of those vectors on device across the whole batch loop
+    and reads the total back ONCE per epoch (instead of syncing
+    output→host every batch for the numpy path). ``result_from_stats``
+    turns the summed host vector back into a ValidationResult. Methods
+    without an override (rank-based metrics like HitRatio/NDCG) keep the
+    per-batch numpy path — the Evaluator falls back automatically."""
 
     def __call__(self, output, target):
         raise NotImplementedError
+
+    def device_stats(self, output, target):
+        """Traced under jit with device ``(output, target)``; return a
+        1-D summable stats vector (float32), or leave unimplemented for
+        the host path. Must agree with ``__call__``'s result when summed
+        across batches and fed to ``result_from_stats``."""
+        raise NotImplementedError
+
+    def result_from_stats(self, stats) -> "ValidationResult":
+        raise NotImplementedError
+
+    def supports_device_stats(self) -> bool:
+        return type(self).device_stats is not ValidationMethod.device_stats
 
     def __repr__(self):
         return type(self).__name__
@@ -94,6 +117,24 @@ def _target_classes(target, n_classes):
     return t.reshape(-1)
 
 
+def _device_logits_targets(output, target):
+    """Traced analog of the host reshape + ``_target_classes``
+    discrimination: returns ``(logits (N, C), classes (N,))``. The
+    one-hot-vs-index choice is made on STATIC shapes — the only case the
+    host's additional 0/1 data check can decide is one where the index
+    branch would be shape-inconsistent anyway (see _target_classes)."""
+    out = output if output.ndim > 1 else output[None]
+    out = out.reshape(-1, out.shape[-1])
+    n_classes, n_rows = out.shape[-1], out.shape[0]
+    t = target
+    if t.ndim >= 2 and t.shape[-1] == n_classes and n_classes > 1 and \
+            t.size // n_classes == n_rows:
+        t = jnp.argmax(t.reshape(-1, n_classes), axis=-1) + 1
+    else:
+        t = t.reshape(-1)
+    return out, t
+
+
 class Top1Accuracy(ValidationMethod):
     """optim/ValidationMethod.scala:170."""
 
@@ -106,6 +147,16 @@ class Top1Accuracy(ValidationMethod):
         pred = np.argmax(out, axis=-1) + 1
         correct = int(np.sum(pred == t.astype(np.int64)))
         return AccuracyResult(correct, t.size)
+
+    def device_stats(self, output, target):
+        out, t = _device_logits_targets(output, target)
+        pred = jnp.argmax(out, axis=-1) + 1
+        correct = jnp.sum(pred == t.astype(jnp.int32))
+        return jnp.stack([correct.astype(jnp.float32),
+                          jnp.float32(t.size)])
+
+    def result_from_stats(self, stats):
+        return AccuracyResult(int(stats[0]), int(stats[1]))
 
     def __repr__(self):
         return "Top1Accuracy"
@@ -120,9 +171,23 @@ class Top5Accuracy(ValidationMethod):
             out = out[None]
         out = out.reshape(-1, out.shape[-1])  # (B*T..., C)
         t = _target_classes(target, out.shape[-1]).astype(np.int64)
-        top5 = np.argsort(-out, axis=-1)[:, :5] + 1
+        # stable sort: equal logits rank by class index on BOTH the host
+        # and device paths (jnp.argsort is stable; numpy's default is
+        # not), so device-accumulated Top5 agrees exactly with this one
+        top5 = np.argsort(-out, axis=-1, kind="stable")[:, :5] + 1
         correct = int(np.sum(np.any(top5 == t[:, None], axis=-1)))
         return AccuracyResult(correct, t.size)
+
+    def device_stats(self, output, target):
+        out, t = _device_logits_targets(output, target)
+        top5 = jnp.argsort(-out, axis=-1)[:, :5] + 1
+        correct = jnp.sum(jnp.any(top5 == t.astype(jnp.int32)[:, None],
+                                  axis=-1))
+        return jnp.stack([correct.astype(jnp.float32),
+                          jnp.float32(t.size)])
+
+    def result_from_stats(self, stats):
+        return AccuracyResult(int(stats[0]), int(stats[1]))
 
     def __repr__(self):
         return "Top5Accuracy"
@@ -143,6 +208,14 @@ class Loss(ValidationMethod):
         n = np.asarray(output).shape[0]
         return LossResult(l * n, n)
 
+    def device_stats(self, output, target):
+        l = self.criterion._forward(output, target)
+        n = output.shape[0]
+        return jnp.stack([l.astype(jnp.float32) * n, jnp.float32(n)])
+
+    def result_from_stats(self, stats):
+        return LossResult(float(stats[0]), int(stats[1]))
+
     def __repr__(self):
         return "Loss"
 
@@ -156,6 +229,14 @@ class MAE(ValidationMethod):
         l = float(np.mean(np.abs(out - t)))
         n = out.shape[0]
         return LossResult(l * n, n)
+
+    def device_stats(self, output, target):
+        l = jnp.mean(jnp.abs(output - target))
+        n = output.shape[0]
+        return jnp.stack([l.astype(jnp.float32) * n, jnp.float32(n)])
+
+    def result_from_stats(self, stats):
+        return LossResult(float(stats[0]), int(stats[1]))
 
     def __repr__(self):
         return "MAE"

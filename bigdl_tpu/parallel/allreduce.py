@@ -128,10 +128,16 @@ class AllReduceParameter:
         return jax.tree_util.tree_map(
             lambda s: P(self.axis) if s.ndim >= 1 else P(), shapes)
 
-    def update(self, grads_flat, params_flat, opt_state, lr):
+    def update(self, grads_flat, params_flat, opt_state, lr,
+               traced_steps: int = 1):
         """Runs INSIDE shard_map over the mesh: grads_flat/params_flat are
         the full (replicated) vectors on each device; opt_state is the local
-        slice. Returns (new full params, new state slice)."""
+        slice. Returns (new full params, new state slice).
+
+        ``traced_steps``: how many times this traced body executes per
+        dispatch (K under a superstep ``lax.scan`` — the body traces once
+        but the hardware reduce-scatter runs every scan iteration), so the
+        trace-time byte counter stays an honest per-dispatch wire total."""
         i = lax.axis_index(self.axis)
         dtype = grads_flat.dtype
         g = FP16CompressPolicy.compress(grads_flat, self.compress)
@@ -139,7 +145,8 @@ class AllReduceParameter:
             # trace-time accounting (this body runs under jit, once per
             # compile): bytes entering the hardware reduce-scatter
             obs.counter("collective/reduce_scatter_traced_bytes",
-                        unit="B").inc(float(g.size * g.dtype.itemsize))
+                        unit="B").inc(
+                float(g.size * g.dtype.itemsize) * traced_steps)
         # aggregated gradient for my slice (mean over data shards)
         gslice = lax.psum_scatter(g, self.axis, scatter_dimension=0,
                                   tiled=True)

@@ -77,6 +77,26 @@ def shard_batch(batch, mesh: Mesh, axis: str = "data"):
     return jax.tree_util.tree_map(put, batch)
 
 
+def shard_stacked_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Place a superstep-stacked ``[K, batch, ...]`` host batch: the
+    microbatch (scan) dim replicated, the per-step batch dim split over
+    ``axis`` — each of the K fused steps then runs with exactly the
+    layout ``shard_batch`` gives a single step. Multi-controller: the
+    local stack concatenates over processes along dim 1, matching the
+    per-step local-split contract of ``shard_batch``."""
+    multi = is_multi_process(mesh)
+
+    def put(x):
+        if x is None:
+            return None
+        x = np.asarray(x)
+        sh = NamedSharding(mesh, P(None, axis) if x.ndim >= 2 else P())
+        if multi:
+            return jax.make_array_from_process_local_data(sh, x)
+        return jax.device_put(x, sh)
+    return jax.tree_util.tree_map(put, batch)
+
+
 def shard_params(params, mesh: Mesh):
     """Replicate params across the mesh (multi-controller safe)."""
     return jax.tree_util.tree_map(

@@ -84,6 +84,30 @@ def next_rng_key():
     return sub
 
 
+def _split_many(key, k):
+    """k chained splits in one compiled program; returns (chain, [k] subs)."""
+    return jax.lax.scan(lambda c, _: tuple(jax.random.split(c)), key,
+                        None, length=k)
+
+
+_split_many_jit = None
+
+
+def next_rng_keys(k: int):
+    """``k`` fresh keys from the global stream, stacked ``[k, ...]``, in
+    ONE dispatch — bitwise the keys ``k`` successive :func:`next_rng_key`
+    calls would return (each split depends only on its input key, so the
+    scanned chain reproduces the sequential chain exactly). The superstep
+    loop uses this so per-dispatch host work stays O(1) in K."""
+    if _state["rng_key"] is None:
+        set_seed(42 if _state["seed"] is None else _state["seed"])
+    global _split_many_jit
+    if _split_many_jit is None:
+        _split_many_jit = jax.jit(_split_many, static_argnums=1)
+    _state["rng_key"], subs = _split_many_jit(_state["rng_key"], int(k))
+    return subs
+
+
 def node_number() -> int:
     return _state["node_number"]
 

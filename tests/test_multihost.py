@@ -21,7 +21,7 @@ import sys
 
 import pytest
 
-from multihost_util import _DRIVER, _free_port
+from multihost_util import _DRIVER, _free_port, skip_if_backend_unsupported
 
 
 @pytest.mark.parametrize("n", [2])
@@ -51,6 +51,7 @@ def test_multi_process_distributed(n):
                 p2.kill()
             raise
         outs.append((pid, proc.returncode, out, err))
+    skip_if_backend_unsupported(outs)
     for pid, rc, out, err in outs:
         assert rc == 0, f"process {pid} failed:\n{err[-3000:]}"
         assert f"MULTIHOST_OK_{pid}" in out

@@ -25,10 +25,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # file -> function names whose bodies form the training hot path
 HOT_FUNCS = {
     "bigdl_tpu/optim/optimizer.py": {
-        "optimize", "_run_epoch_steps", "_observe_loss",
-        "_drain_pending_losses", "_stage_minibatch", "_place_batch",
+        "optimize", "_run_epoch_steps", "_run_epoch_supersteps",
+        "_clamp_superstep", "_observe_loss", "_drain_pending_losses",
+        "_stage_minibatch", "_stage_minibatch_host", "_stage_group",
+        "_place_batch", "_place_group",
     },
     "bigdl_tpu/optim/staging.py": {"_run", "__next__"},
+    # forward-only loops: device-side metric/output accumulation means
+    # the per-batch body must stay sync-free (one readback per epoch)
+    "bigdl_tpu/optim/evaluator.py": {
+        "_evaluate_device", "_stage_device", "_stage",
+    },
+    "bigdl_tpu/optim/predictor.py": {"_iter_outputs", "predict", "_stage"},
 }
 
 SYNC = re.compile(r"(?<![\w.])float\(|\.block_until_ready\(")
