@@ -16,19 +16,30 @@ PYTEST ?= python -m pytest
 NPROC ?= 4
 SHELL := /bin/bash
 
-.PHONY: test test-slow test-serial test-examples tier1 check-no-sync
+.PHONY: test test-slow test-serial test-examples tier1 check-no-sync \
+	serve-smoke
 test:
 	$(PYTEST) tests/ -q -n $(NPROC) --dist loadfile
 
 # The ROADMAP "Tier-1 verify" command, verbatim (single-worker, not-slow,
 # DOTS_PASSED summary) — what the driver runs after every PR. Depends on
 # the sync-point lint so an un-annotated float()/block_until_ready in the
-# hot loop fails before the 15-minute suite starts.
-tier1: check-no-sync
+# hot loop fails before the 15-minute suite starts, and on the serving
+# smoke so a broken engine fails in seconds, not mid-suite.
+tier1: check-no-sync serve-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 check-no-sync:
 	python tools/check_no_sync.py
+
+# End-to-end serving engine drive on CPU with LeNet: warmup-compiled
+# buckets, concurrent clients, result-vs-direct-forward check, clean
+# drain — seconds, not minutes (BENCH_METRICS_OUT='' keeps the smoke
+# from touching the committed bench evidence). Full measured run:
+# `python bench_serving.py` (16 clients, enforces the 3x acceptance).
+serve-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
+		python bench_serving.py --smoke
 
 test-slow:
 	BIGDL_TPU_SLOW=1 $(PYTEST) tests/ -q -n $(NPROC) --dist loadfile

@@ -28,3 +28,4 @@ from . import quantization
 from . import loaders
 from . import dlframes
 from . import native
+from . import serving

@@ -1,0 +1,31 @@
+"""Online serving: dynamic micro-batching over the compiled forward.
+
+Training got prefetch, superstep fusion and a persistent compile cache;
+this package is the inference-side counterpart for the "heavy traffic
+from millions of users" regime — many small concurrent requests that
+must be coalesced into device-efficient batches under a latency
+deadline, instead of the per-request dispatch an RPC-per-inference
+design pays (the overhead 1805.08430 "RPC Considered Harmful" measures).
+
+* ``engine`` — :class:`ServingEngine`: bounded request queue →
+  batcher thread → padded shape-bucket dispatch of the ONE compiled
+  forward shared with ``optim.Predictor`` → per-request futures.
+  Flushes on ``max_batch`` OR ``max_wait_ms``; typed ``QueueFull``
+  backpressure; per-request deadlines; drain-then-shutdown.
+* ``batching`` — request/future types, typed rejections, per-request-
+  isolated batch assembly, bucket math re-exported from
+  ``optim.predictor``.
+* ``registry`` — :class:`ModelRegistry`: versioned params with
+  background load + atomic activate; the engine snapshots the active
+  version once per batch, so hot swap never mixes versions inside a
+  response.
+
+Metrics (`docs/OBSERVABILITY.md`): ``serve/queue_depth``,
+``serve/batch_occupancy``, ``serve/latency_ms``, ``serve/rejected``,
+``serve/timeouts``, ``serve/batches``, ``serve/requests``; one
+``serve/batch`` span per dispatch. Tuning guide: `docs/SERVING.md`.
+"""
+from .batching import (QueueFull, DeadlineExceeded, EngineStopped,
+                       ServeFuture, Request, assemble)
+from .registry import ModelRegistry, ModelVersion
+from .engine import ServingEngine, serving_threads_alive, THREAD_NAME

@@ -1,0 +1,101 @@
+"""Micro-batch assembly: requests, futures, typed rejections, padding.
+
+The shape-bucket math itself (``bucket_for`` / ``shape_buckets`` /
+``pad_leading``) lives in ``optim/predictor.py`` next to the ONE
+compiled forward both consumers share — this module re-exports it and
+adds the request-side machinery: the future a client waits on, the
+typed exceptions admission control raises, and the per-request-isolated
+batch assembly (one malformed input fails ITS future, never the batch
+around it, never the batcher).
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..optim.predictor import (bucket_for, pad_leading,  # noqa: F401
+                               shape_buckets, leading_dim)
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the bounded request queue is at capacity.
+    Typed so load balancers / clients can branch on it (shed, retry
+    with backoff) without string-matching."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before its batch dispatched."""
+
+
+class EngineStopped(RuntimeError):
+    """submit() after shutdown began (drain accepts no new work)."""
+
+
+class ServeFuture(Future):
+    """`concurrent.futures.Future` plus the model ``version`` that
+    answered (stamped at scatter time — a hot-swap test's witness that
+    a batch is never split across versions)."""
+
+    def __init__(self):
+        super().__init__()
+        self.version: Optional[str] = None
+
+
+class Request:
+    """One queued inference request: the raw input, the future the
+    client holds, and its timing (enqueue time for the latency
+    histogram, absolute monotonic deadline or None)."""
+
+    __slots__ = ("x", "future", "t_enqueue", "deadline")
+
+    def __init__(self, x, deadline_s: Optional[float] = None):
+        self.x = x
+        self.future = ServeFuture()
+        self.t_enqueue = time.monotonic()
+        self.deadline = (self.t_enqueue + deadline_s
+                         if deadline_s is not None else None)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now or time.monotonic()) > self.deadline)
+
+
+def assemble(requests: Sequence[Request],
+             template_shape: Optional[Tuple[int, ...]] = None,
+             dtype=np.float32) -> Tuple[Optional[np.ndarray], List[Request]]:
+    """Stack per-request sample arrays into one ``[n, ...]`` host batch.
+
+    Error isolation happens HERE: each request's input is converted and
+    shape-checked independently — a failure sets that request's future
+    (so the client sees the real exception) and drops it from the batch;
+    the survivors still dispatch. Returns ``(batch, live_requests)``
+    with ``batch is None`` when nothing survived.
+
+    ``template_shape`` (the engine's configured ``input_shape``) is the
+    authority when given; otherwise the first convertible request sets
+    the template — later mismatches fail their own future.
+    """
+    xs: List[np.ndarray] = []
+    live: List[Request] = []
+    shape = template_shape
+    for r in requests:
+        try:
+            a = np.asarray(r.x, dtype=dtype)
+            if shape is None:
+                shape = a.shape
+            if a.shape != shape:
+                raise ValueError(
+                    f"request sample shape {a.shape} != expected {shape} "
+                    "(submit ONE unbatched sample per request)")
+        except BaseException as e:  # noqa: BLE001 — routed to the future
+            if not r.future.cancelled():
+                r.future.set_exception(e)
+            continue
+        xs.append(a)
+        live.append(r)
+    if not live:
+        return None, []
+    return np.stack(xs, axis=0), live

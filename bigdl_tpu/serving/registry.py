@@ -1,0 +1,121 @@
+"""Versioned model registry with atomic hot swap.
+
+The serving engine never holds params directly — it reads
+``registry.current()`` ONCE per micro-batch, so a swap lands exactly on
+a batch boundary: every request in a batch is answered by one version,
+the old version keeps serving the batches already cut against it until
+they drain, and no batch ever mixes versions. ``publish()`` does the
+expensive part (host→device placement of the new params) on the CALLER's
+thread — the batcher keeps dispatching against the active version while
+the new one loads — and ``activate()`` is a pointer write under a lock.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class ModelVersion:
+    """Immutable (version id, device-resident params/state) snapshot."""
+
+    __slots__ = ("version", "params", "state")
+
+    def __init__(self, version: str, params, state):
+        self.version = version
+        self.params = params
+        self.state = state
+
+    def __repr__(self):
+        return f"ModelVersion({self.version!r})"
+
+
+def _place(tree):
+    """Host→device placement of a params/state pytree (no-op leaves that
+    are already device arrays)."""
+    if tree is None:
+        return None
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+class ModelRegistry:
+    """Thread-safe version store: ``publish`` loads, ``activate`` swaps.
+
+    Old versions stay resident until :meth:`retire` — instant rollback is
+    ``activate(previous)``. Retiring the active version is refused (it
+    may be mid-batch)."""
+
+    def __init__(self):
+        self._versions: Dict[str, ModelVersion] = {}
+        self._order: List[str] = []
+        self._active: Optional[str] = None
+        self._counter = 0
+        self._used: set = set()  # every id EVER published — retire must
+        self._lock = threading.Lock()  # not let an id be re-minted
+
+    def publish(self, params, state=None, version: Optional[str] = None,
+                activate: bool = False) -> str:
+        """Load a new version (device placement happens HERE, on the
+        calling thread — the background-load half of a hot swap) and
+        optionally activate it. Returns the version id (auto-assigned
+        ``v<n>`` when not given)."""
+        placed = ModelVersion("", _place(params), _place(state))
+        with self._lock:
+            if version is None:
+                # skip ids ever taken (explicit publishes AND retired
+                # versions) — re-minting an id would let one version
+                # string name two different models in the audit trail
+                while f"v{self._counter}" in self._used:
+                    self._counter += 1
+                version = f"v{self._counter}"
+                self._counter += 1
+            elif version in self._used:
+                raise ValueError(f"version {version!r} already published "
+                                 "(versions are immutable — pick a new id)")
+            self._used.add(version)
+            placed.version = version
+            self._versions[version] = placed
+            self._order.append(version)
+            if activate or self._active is None:
+                self._active = version
+        return version
+
+    def activate(self, version: str):
+        """Atomic swap: the next ``current()`` read — i.e. the next
+        micro-batch — serves this version."""
+        with self._lock:
+            if version not in self._versions:
+                raise KeyError(f"unknown version {version!r}; published: "
+                               f"{self._order}")
+            self._active = version
+
+    def current(self) -> Optional[ModelVersion]:
+        with self._lock:
+            return (self._versions[self._active]
+                    if self._active is not None else None)
+
+    def get(self, version: str) -> ModelVersion:
+        with self._lock:
+            return self._versions[version]
+
+    def versions(self) -> List[str]:
+        with self._lock:
+            return list(self._order)
+
+    @property
+    def active_version(self) -> Optional[str]:
+        with self._lock:
+            return self._active
+
+    def retire(self, version: str):
+        """Drop a drained version's device memory. The active version is
+        protected — activate a replacement first."""
+        with self._lock:
+            if version == self._active:
+                raise ValueError(f"version {version!r} is active — "
+                                 "activate a replacement before retiring")
+            self._versions.pop(version, None)
+            if version in self._order:
+                self._order.remove(version)

@@ -1,0 +1,351 @@
+"""Online serving engine (bigdl_tpu/serving/): shape-bucket correctness,
+coalescing, backpressure, deadlines, hot swap, drain, thread hygiene."""
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.nn import Linear
+from bigdl_tpu.optim.predictor import (Predictor, bucket_for, pad_leading,
+                                       shape_buckets, shared_forward)
+from bigdl_tpu.optim.staging import place_host_value, stager_threads_alive
+from bigdl_tpu.serving import (DeadlineExceeded, EngineStopped, ModelRegistry,
+                               QueueFull, ServingEngine,
+                               serving_threads_alive)
+
+
+def _tiny_model():
+    m = Linear(4, 3)
+    m.ensure_initialized()
+    return m
+
+
+def _engine(model=None, **kw):
+    kw.setdefault("warmup", False)
+    return ServingEngine(model or _tiny_model(), **kw)
+
+
+# -- bucket math -----------------------------------------------------------
+
+def test_bucket_for_and_shape_buckets():
+    assert [bucket_for(n, 16) for n in (1, 2, 3, 5, 9, 16, 40)] == \
+        [1, 2, 4, 8, 16, 16, 16]
+    assert shape_buckets(16) == (1, 2, 4, 8, 16)
+    assert shape_buckets(24) == (1, 2, 4, 8, 16, 24)
+    assert bucket_for(17, 24) == 24  # pow2 would overshoot the cap
+    with pytest.raises(ValueError):
+        bucket_for(0, 16)
+
+
+def test_padded_bucket_forward_bitwise_equals_unpadded():
+    """The core serving invariant: zero-padding a batch to its bucket
+    and slicing the result back is BITWISE equal to dispatching the
+    unpadded shape directly."""
+    m = LeNet5()
+    m.ensure_initialized()
+    fwd = shared_forward(m)
+    x = np.random.RandomState(0).randn(6, 784).astype(np.float32)
+    for n in (1, 3, 5, 6):
+        direct = np.asarray(fwd(m.params, m.state,
+                                place_host_value(x[:n])))
+        bucket = bucket_for(n, 8)
+        padded = np.asarray(fwd(m.params, m.state,
+                                place_host_value(pad_leading(x[:n],
+                                                             bucket))))[:n]
+        assert (direct == padded).all(), f"n={n} bucket={bucket}"
+
+
+# -- the ONE compiled forward (Predictor + engine share it) ---------------
+
+def test_predictor_and_engine_share_one_compiled_forward():
+    m = _tiny_model()
+    eng = _engine(m)
+    assert Predictor(m)._forward_fn() is shared_forward(m)
+    assert eng._fwd is shared_forward(m)
+
+
+def test_predictor_ragged_tail_pads_to_bucket():
+    """predict() over a ragged dataset dispatches only bucket shapes:
+    10 samples at batch 4 → shapes {4, 2}, never a bare 2-row compile
+    outside the bucket set — and results match the direct forward."""
+    m = _tiny_model()
+    fwd = shared_forward(m)
+    x = np.random.RandomState(1).randn(10, 4).astype(np.float32)
+    preds = Predictor(m, prefetch_depth=1).predict(x, batch_size=4)
+    want = np.asarray(fwd(m.params, m.state, place_host_value(x[:8])))
+    assert preds.shape == (10, 3)
+    assert np.allclose(preds[:8], want, atol=1e-6)
+    n_shapes = fwd.compiled_shape_count()
+    assert n_shapes == -1 or n_shapes <= len(shape_buckets(4)) + 1
+
+
+# -- engine basics ---------------------------------------------------------
+
+def test_engine_serves_and_matches_direct_forward():
+    m = _tiny_model()
+    fwd = shared_forward(m)
+    x = np.random.RandomState(2).randn(5, 4).astype(np.float32)
+    with _engine(m, max_batch=4, max_wait_ms=1.0) as eng:
+        futs = [eng.submit(x[i]) for i in range(5)]
+        outs = [f.result(timeout=10) for f in futs]
+    want = np.asarray(fwd(m.params, m.state, place_host_value(x)))
+    for i, o in enumerate(outs):
+        assert np.allclose(o, want[i], rtol=1e-5, atol=1e-6)
+        assert futs[i].version == "v0"
+
+
+def test_coalescing_prestart_queue_is_one_batch():
+    """Deterministic coalescing: 16 requests queued before start() form
+    exactly ONE full micro-batch when the batcher comes up."""
+    m = _tiny_model()
+    eng = _engine(m, max_batch=16, max_queue=32)
+    x = np.random.RandomState(3).randn(16, 4).astype(np.float32)
+    futs = [eng.submit(x[i]) for i in range(16)]
+    eng.start()
+    for f in futs:
+        f.result(timeout=10)
+    eng.shutdown()
+    st = eng.stats()
+    assert st["completed"] == 16
+    assert st["batches"] == 1
+
+
+def test_coalescing_under_concurrent_clients():
+    m = _tiny_model()
+    n_clients, rounds = 8, 6
+    x = np.random.RandomState(4).randn(n_clients, 4).astype(np.float32)
+    with _engine(m, max_batch=n_clients, max_wait_ms=20.0,
+                 max_queue=64) as eng:
+        def client(i):
+            for _ in range(rounds):
+                eng.submit(x[i]).result(timeout=30)
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        st = eng.stats()
+    assert st["completed"] == n_clients * rounds
+    # closed-loop clients resubmit together; the 20ms window must fuse
+    # them — strictly fewer dispatches than requests is the whole point
+    assert st["batches"] < st["completed"]
+
+
+# -- robustness ------------------------------------------------------------
+
+def test_queue_full_backpressure_is_typed():
+    eng = _engine(max_queue=2, max_batch=2)  # not started: queue holds
+    x = np.zeros(4, np.float32)
+    f1, f2 = eng.submit(x), eng.submit(x)
+    with pytest.raises(QueueFull):
+        eng.submit(x)
+    assert eng.stats()["rejected"] == 1
+    eng.start()  # admitted requests still serve after the rejection
+    assert f1.result(timeout=10).shape == (3,)
+    assert f2.result(timeout=10).shape == (3,)
+    eng.shutdown()
+
+
+def test_deadline_timeout_fails_typed():
+    eng = _engine(max_queue=8)
+    f = eng.submit(np.zeros(4, np.float32), deadline_ms=1.0)
+    time.sleep(0.05)  # deadline passes while queued (engine not started)
+    eng.start()
+    with pytest.raises(DeadlineExceeded):
+        f.result(timeout=10)
+    eng.shutdown()
+    assert eng.stats()["timeouts"] == 1
+
+
+def test_poisoned_request_fails_its_future_not_the_batch():
+    m = _tiny_model()
+    with _engine(m, max_batch=4, max_queue=16,
+                 input_shape=(4,)) as eng:
+        good1 = eng.submit(np.zeros(4, np.float32))
+        bad = eng.submit(np.zeros(7, np.float32))  # wrong shape
+        good2 = eng.submit(np.zeros(4, np.float32))
+        assert good1.result(timeout=10).shape == (3,)
+        assert good2.result(timeout=10).shape == (3,)
+        with pytest.raises(ValueError):
+            bad.result(timeout=10)
+        # the batcher survived: a fresh request still serves
+        assert eng.predict(np.ones(4, np.float32), timeout=10).shape == (3,)
+        assert eng.stats()["request_errors"] == 1
+        assert eng.stats()["batch_errors"] == 0
+
+
+def test_drain_on_shutdown_resolves_everything():
+    m = _tiny_model()
+    eng = _engine(m, max_batch=4, max_queue=64)
+    x = np.random.RandomState(5).randn(20, 4).astype(np.float32)
+    futs = [eng.submit(x[i % 20]) for i in range(20)]
+    eng.start()
+    eng.shutdown(drain=True)
+    assert all(f.done() for f in futs)
+    assert all(f.exception() is None for f in futs)
+    assert eng.stats()["completed"] == 20
+    with pytest.raises(EngineStopped):
+        eng.submit(x[0])
+
+
+def test_shutdown_without_drain_fails_queued_typed():
+    eng = _engine(max_queue=8)  # never started: requests stay queued
+    futs = [eng.submit(np.zeros(4, np.float32)) for _ in range(3)]
+    eng.shutdown(drain=False)
+    for f in futs:
+        with pytest.raises(EngineStopped):
+            f.result(timeout=1)
+
+
+def test_no_thread_leaks():
+    m = _tiny_model()
+    for _ in range(3):
+        with _engine(m) as eng:
+            eng.predict(np.zeros(4, np.float32), timeout=10)
+    assert serving_threads_alive() == 0
+    assert stager_threads_alive() == 0
+
+
+# -- hot swap --------------------------------------------------------------
+
+def test_registry_publish_activate_retire():
+    reg = ModelRegistry()
+    v0 = reg.publish({"w": np.ones(2)}, version="v0")
+    assert reg.active_version == "v0"  # first publish auto-activates
+    v1 = reg.publish({"w": np.zeros(2)})
+    assert v1 == "v1" and reg.active_version == "v0"
+    reg.activate(v1)
+    assert reg.current().version == "v1"
+    with pytest.raises(ValueError):
+        reg.retire(v1)  # active version is protected
+    reg.activate(v0)  # rollback
+    reg.retire(v1)
+    assert reg.versions() == ["v0"]
+    with pytest.raises(KeyError):
+        reg.activate("v9")
+    with pytest.raises(ValueError):
+        reg.publish({"w": np.ones(2)}, version="v0")  # immutable ids
+    reg.publish({"w": np.ones(2)}, version="v2")
+    assert reg.publish({"w": np.ones(2)}) == "v3"  # auto skips taken ids
+
+
+def test_hot_swap_mid_traffic_never_mixes_versions():
+    m = _tiny_model()
+    zero_params = jax.tree_util.tree_map(lambda a: a * 0, m.params)
+    fwd = shared_forward(m)
+    n_clients = 6
+    x = np.random.RandomState(6).randn(n_clients, 4).astype(np.float32)
+    ref_v0 = np.asarray(fwd(m.params, m.state, place_host_value(x)))
+    results = []  # (client, version, output)
+    lock = threading.Lock()
+    with _engine(m, max_batch=n_clients, max_wait_ms=1.0,
+                 max_queue=64) as eng:
+        stop = threading.Event()
+
+        def client(i):
+            while not stop.is_set():
+                f = eng.submit(x[i])
+                out = f.result(timeout=30)
+                with lock:
+                    results.append((i, f.version, out))
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(n_clients)]
+        for t in ts:
+            t.start()
+        # deterministic mid-traffic: swap only after v0 demonstrably
+        # served, stop only after v1 demonstrably served
+        deadline = time.monotonic() + 30
+
+        def _served(n):
+            while eng.stats()["completed"] < n:
+                assert time.monotonic() < deadline, "traffic stalled"
+                time.sleep(0.002)
+        _served(2 * n_clients)
+        v1 = eng.swap(zero_params, m.state)
+        _served(eng.stats()["completed"] + 2 * n_clients)
+        stop.set()
+        for t in ts:
+            t.join()
+    assert v1 == "v1"
+    saw = {v for _, v, _ in results}
+    assert saw == {"v0", "v1"}, f"swap landed outside traffic: {saw}"
+    for i, v, out in results:
+        if v == "v0":
+            assert np.allclose(out, ref_v0[i], rtol=1e-5, atol=1e-6), \
+                f"client {i}: v0-stamped result isn't v0's output"
+        else:
+            # zero weights + zero bias ⇒ exactly zero, ANY nonzero row
+            # would mean params mixed across versions inside a batch
+            assert (out == 0).all(), \
+                f"client {i}: v1-stamped result isn't v1's output"
+
+
+def test_swap_is_recompile_free():
+    """New params run through the SAME compiled executable: the shape
+    cache must not grow on swap."""
+    m = _tiny_model()
+    fwd = shared_forward(m)
+    with _engine(m, max_batch=4) as eng:
+        eng.predict(np.zeros(4, np.float32), timeout=10)
+        before = fwd.compiled_shape_count()
+        eng.swap(jax.tree_util.tree_map(lambda a: a + 1, m.params), m.state)
+        out = eng.predict(np.zeros(4, np.float32), timeout=10)
+        assert fwd.compiled_shape_count() == before
+    assert out is not None
+
+
+# -- warmup + observability -----------------------------------------------
+
+def test_warmup_precompiles_every_bucket():
+    m = _tiny_model()
+    fwd = shared_forward(m)
+    eng = ServingEngine(m, input_shape=(4,), max_batch=8, warmup=True)
+    with eng:
+        n = fwd.compiled_shape_count()
+        assert n == -1 or n >= len(shape_buckets(8))
+        # first real request pays zero compile: every bucket is warm
+        assert eng.predict(np.zeros(4, np.float32), timeout=10).shape == (3,)
+        m2 = fwd.compiled_shape_count()
+        assert m2 == -1 or m2 == n
+
+
+def test_serve_metrics_are_recorded():
+    from bigdl_tpu import observability as obs
+    obs.enable()
+    try:
+        m = _tiny_model()
+        with _engine(m, max_batch=4, max_queue=2) as eng:
+            for _ in range(3):
+                eng.predict(np.zeros(4, np.float32), timeout=10)
+        reg = obs.registry()
+        assert reg.get("serve/batches").value >= 1
+        assert reg.get("serve/requests").value == 3
+        lat = reg.get("serve/latency_ms")
+        assert lat.count == 3 and lat.quantile(0.99) >= lat.quantile(0.5)
+        occ = reg.get("serve/batch_occupancy")
+        assert occ.count >= 1 and 0 < occ.mean <= 1.0
+        spans = [s for s in obs.get_tracer().events()
+                 if s.name == "serve/batch"]
+        assert spans, "no serve/batch trace span recorded"
+    finally:
+        obs.disable()
+        obs.reset()
+
+
+# -- acceptance (the full measured run; tier1 runs the smoke via make) ----
+
+@pytest.mark.slow
+def test_bench_acceptance_3x_over_per_request_predict():
+    import bench_serving
+    lines, st, bad, dropped = bench_serving.bench_serving(
+        n_clients=16, n_requests=32, max_batch=16, max_wait_ms=2.0,
+        deadline_ms=1000.0)
+    by = {l["metric"]: l for l in lines}
+    assert bad == 0 and dropped == 0
+    assert st["timeouts"] == 0 and st["rejected"] == 0
+    assert by["serving_batched_req_per_s"]["latency_p99_ms"] <= 1000.0
+    assert by["serving_batching_speedup"]["value"] >= 3.0

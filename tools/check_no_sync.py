@@ -37,6 +37,13 @@ HOT_FUNCS = {
         "_evaluate_device", "_stage_device", "_stage",
     },
     "bigdl_tpu/optim/predictor.py": {"_iter_outputs", "predict", "_stage"},
+    # serving batcher hot loop: a stray sync between dispatches stalls
+    # every queued client, not just one training step (the readback in
+    # _dispatch and the warmup block are the two deliberate ones)
+    "bigdl_tpu/serving/engine.py": {
+        "_batcher", "_collect", "_dispatch", "submit", "warmup",
+    },
+    "bigdl_tpu/serving/batching.py": {"assemble"},
 }
 
 SYNC = re.compile(r"(?<![\w.])float\(|\.block_until_ready\(")
