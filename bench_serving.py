@@ -139,6 +139,13 @@ def bench_serving(n_clients: int, n_requests: int, max_batch: int,
               if not np.allclose(outputs[i], want[i], rtol=1e-5, atol=1e-6))
     lat = reg.get("serve/latency_ms")
     occ = reg.get("serve/batch_occupancy")
+    # per-request stage decomposition: where does the p99 actually go —
+    # the batching window (queue_wait), host stacking (assemble), or
+    # the device round-trip (dispatch)?
+    stages = {name: reg.get(f"serve/{name}_ms")
+              for name in ("queue_wait", "assemble", "dispatch")}
+    stage_p99 = {name: (round(h.quantile(0.99), 3) if h else 0.0)
+                 for name, h in stages.items()}
     dropped = total - st["completed"]
     thr_batched = total / dt_batched
     thr_per_req = total / dt_per_req
@@ -152,6 +159,9 @@ def bench_serving(n_clients: int, n_requests: int, max_batch: int,
         "batches": st["batches"],
         "latency_p50_ms": round(lat.quantile(0.5), 3) if lat else 0.0,
         "latency_p99_ms": round(lat.quantile(0.99), 3) if lat else 0.0,
+        "queue_wait_p99_ms": stage_p99["queue_wait"],
+        "assemble_p99_ms": stage_p99["assemble"],
+        "dispatch_p99_ms": stage_p99["dispatch"],
         "rejected": st["rejected"], "timeouts": st["timeouts"],
         "dropped": dropped, "mismatches": bad,
         "backend": "cpu",
@@ -226,6 +236,10 @@ def main():
     p99 = lines[0]["latency_p99_ms"]
     if p99 > deadline_ms:
         failures.append(f"p99 {p99}ms exceeds the {deadline_ms}ms deadline")
+    if not any(lines[0][f"{s}_p99_ms"] > 0.0
+               for s in ("queue_wait", "assemble", "dispatch")):
+        failures.append("per-request stage decomposition missing "
+                        "(serve/queue_wait|assemble|dispatch_ms empty)")
     speedup = by_metric["serving_batching_speedup"]["value"]
     if not smoke and speedup < 3.0:
         # the smoke run is a plumbing check on whatever loaded CI box runs
@@ -238,7 +252,10 @@ def main():
     print(f"bench_serving: ok — {lines[0]['value']} req/s batched vs "
           f"{by_metric['serving_per_request_req_per_s']['value']} req/s "
           f"per-request predict() ({speedup}x), occupancy "
-          f"{lines[0]['batch_occupancy_mean']}, p99 {p99}ms")
+          f"{lines[0]['batch_occupancy_mean']}, p99 {p99}ms "
+          f"(queue_wait {lines[0]['queue_wait_p99_ms']}ms / assemble "
+          f"{lines[0]['assemble_p99_ms']}ms / dispatch "
+          f"{lines[0]['dispatch_p99_ms']}ms)")
 
 
 if __name__ == "__main__":

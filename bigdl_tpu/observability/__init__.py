@@ -16,6 +16,14 @@ reports into:
   chrome://tracing), Prometheus text format, a bridge into the existing
   ``visualization.Summary`` event files (TensorBoard keeps working), and
   the BENCH_*.json-compatible metric-line dump shared with ``bench.py``.
+* ``health`` — whether the system is ALIVE: a stall watchdog over
+  per-component progress beacons (``health/stall`` events), rolling
+  loss/grad-norm anomaly detectors (spikes, plateaus, NaN streaks),
+  device-memory telemetry (``mem/*`` live gauges), and env-gated
+  ``jax.profiler`` windows (``BIGDL_TPU_PROFILE=start:stop``).
+* ``flight`` — a bounded ring of recent structured events dumped as a
+  JSON crash bundle on unhandled failure; render post-mortems with
+  ``tools/flight_report.py``.
 
 Zero-overhead when disabled: ``span()`` returns a shared no-op context
 manager and call-sites guard metric writes with ``enabled()`` — the
@@ -32,12 +40,14 @@ from __future__ import annotations
 import os as _os
 
 from .trace import (Tracer, enable, disable, enabled, span, instant,
-                    get_tracer, reset)
+                    complete, get_tracer, reset)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       registry, counter, gauge, histogram)
 from .exporters import (chrome_trace, write_chrome_trace, prometheus_text,
                         SummaryBridge, metrics_dump, write_metrics_dump,
                         record_bench_line)
+from . import flight
+from . import health
 
 if _os.environ.get("BIGDL_TPU_TRACE") == "1":
     enable()

@@ -91,12 +91,17 @@ def _fmt(v: float) -> str:
 def prometheus_text(reg: Optional[MetricsRegistry] = None,
                     prefix: str = "bigdl") -> str:
     """Text exposition format. Counters keep their value as-is (callers
-    count events or bytes); histograms export as summaries with
-    p50/p90/p99 quantile labels plus _sum/_count/_min/_max."""
+    count events or bytes); histograms export as proper summaries with
+    ``quantile="0.5|0.9|0.99"`` labels plus _sum/_count/_min/_max. Every
+    family gets a ``# HELP`` line carrying the registry name and unit.
+    A live gauge whose callback raises exports NaN (and bumps
+    ``obs/gauge_fn_errors``) instead of aborting the scrape."""
     reg = reg or _default_registry()
     lines: List[str] = []
     for inst in reg.instruments():
         base = _prom_name(f"{prefix}_{inst.name}" if prefix else inst.name)
+        unit = f" ({inst.unit})" if inst.unit else ""
+        lines.append(f"# HELP {base} {inst.name}{unit}")
         if isinstance(inst, Counter):
             lines.append(f"# TYPE {base} counter")
             lines.append(f"{base} {_fmt(inst.value)}")
@@ -168,7 +173,8 @@ def record_bench_line(line: Dict, reg: Optional[MetricsRegistry] = None):
     reg.gauge(f"bench/{name}", unit=line.get("unit", "")).set(line["value"])
     for extra in ("vs_baseline", "mfu", "input_wait_frac", "superstep_k",
                   "dispatches", "compile_cache_hits",
-                  "compile_cache_misses"):
+                  "compile_cache_misses", "queue_wait_p99_ms",
+                  "assemble_p99_ms", "dispatch_p99_ms"):
         if isinstance(line.get(extra), (int, float)):
             reg.gauge(f"bench/{name}/{extra}").set(line[extra])
 

@@ -62,8 +62,15 @@ class Gauge:
         if self._fn is not None:
             try:
                 return float(self._fn())
-            except Exception:  # a dead callback must not kill an export
-                return self._value
+            except Exception:
+                # a raising callback must not break snapshot()/
+                # prometheus_text for every OTHER instrument: this gauge
+                # reads NaN (distinguishable from any real value), the
+                # failure is counted, and the export proceeds. The error
+                # counter lives in the DEFAULT registry regardless of
+                # which registry owns the gauge — one place to alert on.
+                _registry.counter("obs/gauge_fn_errors").inc()
+                return float("nan")
         return self._value
 
 

@@ -127,6 +127,17 @@ class Tracer:
         st.append(sp)
         return _SpanHandle(self, sp)
 
+    def _append(self, sp: Span):
+        # lock: reset() clears the list + re-stamps the epoch; an append
+        # racing it would land a pre-epoch span (negative export ts).
+        # The ONE capacity gate for every recording path (finish/
+        # instant/complete) — the drop policy must not fork per path.
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(sp)
+
     def _finish(self, sp: Span):
         sp.end_ns = time.perf_counter_ns()
         st = self._stack()
@@ -136,24 +147,32 @@ class Tracer:
             st.pop()
         if st:
             st.pop()
-        # lock: reset() clears the list + re-stamps the epoch; an append
-        # racing it would land a pre-epoch span (negative export ts)
-        with self._lock:
-            if len(self._events) >= self.max_events:
-                self.dropped += 1
-                return
-            self._events.append(sp)
+        self._append(sp)
+
+    def complete(self, name: str, start_ns: int, end_ns: int,
+                 tid: Optional[int] = None, **args):
+        """Record an already-finished span from caller-supplied
+        ``perf_counter_ns`` stamps (depth 0) — for intervals whose
+        start predates the recording call, e.g. a serving request's
+        queue wait measured from its enqueue stamp when its batch is
+        finally cut. Bypasses the nesting stack. ``tid`` defaults to
+        the current thread; pass a synthetic (e.g. negative) id when
+        several retro spans OVERLAP — complete events on one tid are
+        nested-by-containment in the trace format and in
+        ``tools/trace_report.py``, so overlapping siblings must each
+        ride their own virtual lane to keep self-times honest."""
+        sp = Span(name, int(start_ns),
+                  threading.get_ident() if tid is None else tid, 0,
+                  args or None)
+        sp.end_ns = int(end_ns)
+        self._append(sp)
 
     def instant(self, name: str, **args):
         """Zero-duration marker event (nan skips, trigger fires)."""
         sp = Span(name, time.perf_counter_ns(), threading.get_ident(),
                   len(self._stack()), args or None)
         sp.end_ns = sp.start_ns
-        with self._lock:
-            if len(self._events) >= self.max_events:
-                self.dropped += 1
-                return
-            self._events.append(sp)
+        self._append(sp)
 
     # -- reading ---------------------------------------------------------
     def events(self) -> List[Span]:
@@ -212,3 +231,12 @@ def span(name: str, **args):
 def instant(name: str, **args):
     if _enabled:
         _tracer.instant(name, **args)
+
+
+def complete(name: str, start_ns: int, end_ns: int,
+             tid: Optional[int] = None, **args):
+    """Record a retrospective span from explicit ``perf_counter_ns``
+    stamps (no-op when disabled). See :meth:`Tracer.complete` for the
+    ``tid`` contract on overlapping spans."""
+    if _enabled:
+        _tracer.complete(name, start_ns, end_ns, tid=tid, **args)

@@ -40,6 +40,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import observability as obs
+from ..observability import flight as _flight
+from ..observability import health as _health
 from .optim_method import OptimMethod, SGD
 from .regularizer import regularizer_tree, regularization_loss
 from .trigger import Trigger, max_epoch as _max_epoch
@@ -257,8 +259,18 @@ class BaseOptimizer:
         self.superstep = 1         # K fused steps per dispatch (lax.scan)
         self._pending_loss = None
         self._loss_window = deque()
+        self._resolved_step = None  # provenance of the last resolved loss
         self.metrics = Metrics()
         self._step_fn = None
+        # health layer (active only while observability is enabled):
+        # stall watchdog deadline/callback, anomaly-detector config
+        # (None disables; a dict overrides SeriesMonitor defaults)
+        self.stall_deadline_s = None   # None -> BIGDL_TPU_STALL_S default
+        self.on_stall = None
+        self.anomaly_config: Optional[dict] = {}
+        self._step_beacon = _health.NULL_BEACON
+        self._loss_monitor = None
+        self._profiler = None
 
     # -- reference API surface ------------------------------------------
     def set_model(self, model):
@@ -448,6 +460,31 @@ class BaseOptimizer:
             return int(self.sync_policy.split(":", 1)[1])
         return None
 
+    def set_stall_deadline(self, seconds: float, on_stall=None):
+        """Arm the stall watchdog for this optimizer's loops: the step
+        loop and its batch stager pulse progress beacons, and a beacon
+        quiet for ``seconds`` fires a structured ``health/stall`` event
+        (plus ``on_stall(beacon, age_s)`` when given) instead of the run
+        silently hanging — the remote-TPU 'no output' failure mode.
+        Active only while observability is enabled; the default deadline
+        without this call is ``BIGDL_TPU_STALL_S`` (600s)."""
+        seconds = float(seconds)
+        if seconds <= 0:
+            raise ValueError(f"stall deadline must be > 0, got {seconds}")
+        self.stall_deadline_s = seconds
+        self.on_stall = on_stall
+        return self
+
+    def set_anomaly_detection(self, enabled: bool = True, **config):
+        """Configure the rolling loss anomaly detector (spikes,
+        plateaus, NaN streaks — ``observability.health.SeriesMonitor``;
+        kwargs override its defaults, e.g. ``spike_sigma=6``,
+        ``plateau_window=500``). It consumes the loss floats the sync
+        policy already resolves — zero extra device readbacks.
+        ``enabled=False`` turns it off entirely."""
+        self.anomaly_config = dict(config) if enabled else None
+        return self
+
     def set_nan_policy(self, policy: str):
         """'error' raises, 'skip' drops the step, 'resume' rolls back to the
         latest checkpoint (requires set_checkpoint) — the step-level analog of
@@ -577,15 +614,22 @@ class BaseOptimizer:
         leaves = jax.tree_util.tree_leaves(x)
         return leaves[0].shape[0] if leaves else 0
 
-    def _observe_loss(self, loss):
+    def _observe_loss(self, loss, step=None):
         """Apply the sync policy to this step's device loss. Returns the
         resolved host float to examine this iteration, or None when the
         windowed policy has not filled its in-flight budget yet. Every
         resolution is one host<->device sync, counted in
-        ``optim/loss_syncs`` (supersteps cut this K-fold)."""
+        ``optim/loss_syncs`` (supersteps cut this K-fold).
+
+        ``step`` is the iteration this DISPATCH belongs to; under
+        ``async``/``window:K`` the returned float describes an OLDER
+        dispatch, and ``self._resolved_step`` names it — the health
+        layer (flight ring, anomaly detector) must attribute a lagged
+        loss to the step that produced it, not the step that read it."""
         k = self._window_k()
+        self._resolved_step = step
         if k is not None:
-            self._loss_window.append(loss)
+            self._loss_window.append((step, loss))
             if obs.enabled():
                 obs.gauge("optim/loss_window_inflight").set(
                     len(self._loss_window))
@@ -593,16 +637,19 @@ class BaseOptimizer:
                 return None
             if obs.enabled():
                 obs.counter("optim/loss_syncs").inc()
+            self._resolved_step, oldest = self._loss_window.popleft()
             # sync-ok: windowed resolve of the OLDEST in-flight loss
-            return float(self._loss_window.popleft())
+            return float(oldest)
         if obs.enabled():
             obs.counter("optim/loss_syncs").inc()
         if self.sync_policy == "async":
             # examine the PREVIOUS step's loss: the device keeps
             # computing while the host preps the next batch
-            prev, self._pending_loss = self._pending_loss, loss
+            prev, self._pending_loss = self._pending_loss, (step, loss)
+            if prev is not None:
+                self._resolved_step, loss = prev
             # sync-ok: lagged read (first step resolves its own loss)
-            return float(prev if prev is not None else loss)
+            return float(loss)
         # sync-ok: sync policy blocks on every step by definition
         return float(loss)
 
@@ -615,7 +662,7 @@ class BaseOptimizer:
         if self._pending_loss is not None:
             pending.append(self._pending_loss)
             self._pending_loss = None
-        for dev in pending:
+        for _step, dev in pending:
             final = float(dev)  # sync-ok: end-of-run drain
             if np.isfinite(final):
                 state["loss"] = final
@@ -645,6 +692,10 @@ class BaseOptimizer:
                 self._ckpt_writer.submit(path, payload)
             else:
                 _atomic_pickle(path, payload)
+        if obs.enabled():
+            _flight.record("checkpoint", path=path, neval=state["neval"],
+                           epoch=state["epoch"],
+                           async_write=self.checkpoint_async)
 
     def wait_for_checkpoints(self):
         """Block until every async checkpoint write has landed (re-raising
@@ -695,6 +746,51 @@ class BaseOptimizer:
 
     # -- main loop -------------------------------------------------------
     def optimize(self) -> Module:
+        """Run training to the end trigger. With observability enabled
+        the run is health-instrumented: the step loop and stager pulse
+        stall beacons, the resolved losses feed the anomaly detector
+        and the flight recorder, device-memory gauges register when the
+        backend supports them, and an unhandled failure (including the
+        NaN-policy aborts) dumps a flight-recorder crash bundle before
+        re-raising — ``tools/flight_report.py`` renders it."""
+        self._step_beacon = _health.beacon(
+            "optim/step", deadline_s=self.stall_deadline_s,
+            on_stall=self.on_stall)
+        self._profiler = _health.profiler_window_from_env()
+        self._loss_monitor = None
+        if obs.enabled():
+            _health.ensure_memory_telemetry()
+            if self.anomaly_config is not None:
+                self._loss_monitor = _health.SeriesMonitor(
+                    "loss", **self.anomaly_config)
+            st = self.optim_method.state
+            _flight.record("train/start", epoch=st.get("epoch"),
+                           neval=st.get("neval"), seed=engine.get_seed(),
+                           batch_size=self.batch_size,
+                           superstep=self.superstep,
+                           sync_policy=self.sync_policy)
+        try:
+            return self._optimize_impl()
+        except BaseException as e:
+            if obs.enabled():
+                st = self.optim_method.state
+                _flight.dump_crash_bundle(error=e, context={
+                    "component": "optimizer",
+                    "epoch": st.get("epoch"), "neval": st.get("neval"),
+                    "seed": engine.get_seed(),
+                    "batch_size": self.batch_size,
+                    "superstep": self.superstep,
+                    "sync_policy": self.sync_policy,
+                    "nan_policy": self.nan_policy})
+            raise
+        finally:
+            self._step_beacon.close()
+            self._step_beacon = _health.NULL_BEACON
+            if self._profiler is not None:
+                self._profiler.close()
+                self._profiler = None
+
+    def _optimize_impl(self) -> Module:
         self.model.ensure_initialized()
         self.model.training()
         params, mstate = self.model.params, self.model.state
@@ -732,11 +828,13 @@ class BaseOptimizer:
                                  depth=self.prefetch_depth, name="stager",
                                  group=self.superstep,
                                  group_fn=self._stage_group,
-                                 group_key=self._stage_group_key)
+                                 group_key=self._stage_group_key,
+                                 stall_deadline_s=self.stall_deadline_s)
             else:
                 batches = staged(batched.data(train=True),
                                  self._stage_minibatch,
-                                 depth=self.prefetch_depth, name="stager")
+                                 depth=self.prefetch_depth, name="stager",
+                                 stall_deadline_s=self.stall_deadline_s)
             box = {"params": params, "opt_state": opt_state,
                    "mstate": mstate, "nan_streak": nan_streak, "done": done}
             try:
@@ -753,6 +851,10 @@ class BaseOptimizer:
                 state["epoch"] += 1
                 state["epoch_finished"] = True
                 self.metrics.add("epoch_time", time.time() - epoch_start)
+                if obs.enabled():
+                    _flight.record("epoch", epoch=state["epoch"] - 1,
+                                   neval=state["neval"],
+                                   epoch_time_s=time.time() - epoch_start)
                 self._fire_epoch(state, params, opt_state, mstate)
                 if self.end_trigger(state):
                     done = True
@@ -779,6 +881,7 @@ class BaseOptimizer:
         nan_streak = box["nan_streak"]
         try:
             while True:
+                self._step_beacon.pulse()
                 with obs.span("step", neval=state["neval"]):
                     t0 = time.time()
                     with obs.span("step/data_fetch"):
@@ -796,10 +899,23 @@ class BaseOptimizer:
                     if obs.enabled():
                         obs.counter("engine/dispatches").inc()
                     with obs.span("step/loss_sync"):
-                        loss_val = self._observe_loss(loss)
+                        # step provenance: the dispatch just issued is
+                        # iteration neval+1; async/window:K resolve an
+                        # OLDER one — _resolved_step names it
+                        loss_val = self._observe_loss(
+                            loss, state["neval"] + 1)
                     t2 = time.time()
                     if loss_val is not None and not np.isfinite(loss_val):
                         nan_streak += 1
+                        if obs.enabled():
+                            _flight.record("nan",
+                                           neval=self._resolved_step,
+                                           epoch=state["epoch"],
+                                           loss=loss_val,
+                                           policy=self.nan_policy)
+                            if self._loss_monitor is not None:
+                                self._loss_monitor.observe(
+                                    loss_val, self._resolved_step)
                         if self.nan_policy == "error":
                             raise FloatingPointError(
                                 f"non-finite loss {loss_val} at iteration "
@@ -844,6 +960,18 @@ class BaseOptimizer:
                         state["loss"] = loss_val
                     state["neval"] += 1
                     state["epoch_finished"] = False
+                    if loss_val is not None and obs.enabled():
+                        # provenance rides the already-resolved host
+                        # float — no extra readback; under async/
+                        # window:K the loss belongs to _resolved_step,
+                        # up to K-1 before the current iteration
+                        _flight.record("step", neval=self._resolved_step,
+                                       epoch=state["epoch"], loss=loss_val)
+                        if self._loss_monitor is not None:
+                            self._loss_monitor.observe(
+                                loss_val, self._resolved_step)
+                    if self._profiler is not None:
+                        self._profiler.maybe_tick(state["neval"])
                     self.metrics.add("data_time", t1 - t0)
                     self.metrics.add("step_time", t2 - t1)
                     if obs.enabled():
@@ -913,6 +1041,7 @@ class BaseOptimizer:
         pending = None  # clamped remainder of a group (device slices)
         try:
             while True:
+                self._step_beacon.pulse()
                 t0 = time.time()
                 if pending is not None:
                     (k, xs, ys), pending = pending, None
@@ -958,6 +1087,18 @@ class BaseOptimizer:
                 for i, loss_val in enumerate(losses.tolist()):
                     if not np.isfinite(loss_val):
                         nan_streak += 1
+                        if obs.enabled():
+                            # superstep-vector aware: the host replay of
+                            # the batched [k] readback feeds the recorder
+                            # and detector per microstep
+                            _flight.record("nan", neval=state["neval"],
+                                           epoch=state["epoch"],
+                                           loss=loss_val,
+                                           policy=self.nan_policy,
+                                           superstep_k=k, microstep=i)
+                            if self._loss_monitor is not None:
+                                self._loss_monitor.observe(loss_val,
+                                                           state["neval"])
                         if self.nan_policy == "error":
                             raise FloatingPointError(
                                 f"non-finite loss {loss_val} at iteration "
@@ -1000,6 +1141,13 @@ class BaseOptimizer:
                     state["loss"] = loss_val
                     state["neval"] += 1
                     state["epoch_finished"] = False
+                    if obs.enabled():
+                        _flight.record("step", neval=state["neval"],
+                                       epoch=state["epoch"], loss=loss_val,
+                                       superstep_k=k, microstep=i)
+                        if self._loss_monitor is not None:
+                            self._loss_monitor.observe(loss_val,
+                                                       state["neval"])
                     if self.train_summary is not None:
                         rec = self.train_summary.should_record
                         if rec("Loss", state):
@@ -1015,6 +1163,8 @@ class BaseOptimizer:
                                 state["neval"])
                 if restored:
                     continue
+                if self._profiler is not None:
+                    self._profiler.maybe_tick(state["neval"])
                 # checkpoint/validation/end triggers evaluate ONCE at the
                 # superstep boundary, where params and the iteration
                 # counter are consistent: clamping already aligned every

@@ -31,6 +31,7 @@ import time
 from typing import Callable, Iterable, Iterator, Optional
 
 from .. import observability as obs
+from ..observability import health as _health
 
 THREAD_NAME = "bigdl_tpu-stager"
 
@@ -57,7 +58,8 @@ class BatchStager:
     def __init__(self, source: Iterable, stage_fn: Callable, depth: int = 2,
                  name: str = "stager", group: int = 1,
                  group_fn: Optional[Callable] = None,
-                 group_key: Optional[Callable] = None):
+                 group_key: Optional[Callable] = None,
+                 stall_deadline_s: Optional[float] = None):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         if group < 1:
@@ -80,6 +82,14 @@ class BatchStager:
         self._stop = threading.Event()
         self._err = None
         self._done = False
+        # stall watchdog: the worker pulses per source item AND while
+        # healthily blocked on a full queue (the consumer owns that
+        # wait) — silence therefore means the worker is wedged inside
+        # next(source) or stage_fn (a hung decode or device_put), the
+        # exact "training stopped, no error" case the watchdog pages on.
+        # No-op beacon when observability is disabled.
+        self._beacon = _health.beacon(f"stager/{name}",
+                                      deadline_s=stall_deadline_s)
         self._thread = threading.Thread(
             target=self._run, name=THREAD_NAME, daemon=True)
         self._thread.start()
@@ -97,6 +107,8 @@ class BatchStager:
                         item = next(it)
                     except StopIteration:
                         exhausted = True
+                self._beacon.pulse()  # per source ITEM — a group-mode
+                # iteration may `continue` below while still pending
                 if obs.enabled():
                     # time the worker spent blocked on the upstream
                     # iterator (dataset produce): large values mean the
@@ -128,12 +140,16 @@ class BatchStager:
                             self._q.put(staged, timeout=0.1)
                             break
                         except queue.Full:
+                            # a full queue is the CONSUMER's wait, not a
+                            # stager stall — keep the beacon fresh
+                            self._beacon.pulse()
                             continue
                 if obs.enabled():
                     obs.gauge(self._depth_gauge).set(self._q.qsize())
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
             self._err = e
         finally:
+            self._beacon.close()
             close = getattr(it, "close", None)
             if close is not None:
                 try:
@@ -197,6 +213,9 @@ class BatchStager:
             logging.getLogger(__name__).warning(
                 "stager %r worker did not join within 30s (blocked in "
                 "stage_fn?) — daemon thread leaked", self._name)
+        # a wedged worker never reaches its own finally — the closed
+        # run must not keep paging the watchdog
+        self._beacon.close()
         self._done = True
 
     def __enter__(self):
@@ -265,15 +284,19 @@ class _SerialStager:
 def staged(source: Iterable, stage_fn: Callable, depth: int = 2,
            name: str = "stager", group: int = 1,
            group_fn: Optional[Callable] = None,
-           group_key: Optional[Callable] = None):
+           group_key: Optional[Callable] = None,
+           stall_deadline_s: Optional[float] = None):
     """Pick the pipelined or serial staging wrapper by ``depth``
     (>= 2 spawns the lookahead thread; 0/1 stays inline). ``group``/
     ``group_fn``/``group_key`` enable the superstep stacking stage on
-    either."""
+    either. ``stall_deadline_s`` arms the threaded stager's watchdog
+    beacon (None = the ``BIGDL_TPU_STALL_S`` default); the serial
+    stager runs inline under the caller's own beacon."""
     if depth >= 2:
         return BatchStager(source, stage_fn, depth=depth, name=name,
                            group=group, group_fn=group_fn,
-                           group_key=group_key)
+                           group_key=group_key,
+                           stall_deadline_s=stall_deadline_s)
     return _SerialStager(source, stage_fn, group=group, group_fn=group_fn,
                          group_key=group_key)
 

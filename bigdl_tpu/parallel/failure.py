@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as obs
+from ..observability import health as _health
 
 _LOG = logging.getLogger("bigdl_tpu.parallel.failure")
 
@@ -106,6 +107,12 @@ def probe_mesh(mesh, timeout_s: float = 30.0) -> MeshProbeResult:
         obs.histogram("failure/probe_latency_s", unit="s").observe(
             res.latency_s)
         obs.gauge("failure/probe_ok").set(1.0 if res.ok else 0.0)
+        if not res.ok:
+            # a failed mesh probe is a first-class health event: it is
+            # the "chip is gone" signal the stall watchdog cannot see
+            _health.emit("probe_failed", n_devices=res.n_devices,
+                         latency_s=round(res.latency_s, 3),
+                         error=res.error)
     return res
 
 
@@ -141,6 +148,7 @@ class Heartbeat:
         self.last_seen: Dict[int, int] = {}
         self.counters: Dict[int, int] = {}
         self._last_beat_t: Optional[float] = None
+        self._beacon = None
 
     @property
     def last_beat_age_s(self) -> float:
@@ -168,6 +176,22 @@ class Heartbeat:
             return hb.last_beat_age_s if hb is not None else float("nan")
 
         obs.gauge("failure/last_beat_age_s", unit="s").set_fn(age)
+
+    def _ensure_beacon(self):
+        # the prober registers with the stall watchdog like any other
+        # long-running component: deadline = a full staleness budget
+        # (expected_interval_s * stale_after) when an interval is
+        # declared, else the global default. weakref.finalize
+        # unregisters on GC so a finished run's heartbeat never pages.
+        if self._beacon is not None or not obs.enabled():
+            return
+        import weakref
+        deadline = (self.expected_interval_s * self.stale_after
+                    if self.expected_interval_s is not None else None)
+        self._beacon = _health.beacon("failure/heartbeat",
+                                      deadline_s=deadline)
+        if self._beacon is not _health.NULL_BEACON:
+            weakref.finalize(self, self._beacon.close)
 
     @property
     def n_processes(self) -> int:
@@ -211,6 +235,9 @@ class Heartbeat:
                 self.expected_interval_s, jax.process_index())
             if obs.enabled():
                 obs.counter("failure/late_beats").inc()
+                _health.emit("heartbeat_late", beat_no=self.beat_no,
+                             age_s=round(now - self._last_beat_t, 3),
+                             expected_interval_s=self.expected_interval_s)
         if timeout_s is not None:
             counters = self._gather_with_timeout(self.beat_no, timeout_s)
         else:
@@ -218,6 +245,9 @@ class Heartbeat:
         self._last_beat_t = time.monotonic()
         if obs.enabled():
             self._register_gauge()
+            self._ensure_beacon()
+            if self._beacon is not None:
+                self._beacon.pulse()
             obs.counter("failure/beats").inc()
         stale = []
         for pid, c in enumerate(counters):
@@ -231,6 +261,10 @@ class Heartbeat:
             _LOG.warning(
                 "stale heartbeat peers: processes=%s beat_no=%d "
                 "stale_after=%d", stale, self.beat_no, self.stale_after)
+            if obs.enabled():
+                _health.emit("heartbeat_stale", peers=stale,
+                             beat_no=self.beat_no,
+                             stale_after=self.stale_after)
         return stale
 
 

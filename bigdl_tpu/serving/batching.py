@@ -35,26 +35,40 @@ class EngineStopped(RuntimeError):
 
 
 class ServeFuture(Future):
-    """`concurrent.futures.Future` plus the model ``version`` that
-    answered (stamped at scatter time — a hot-swap test's witness that
-    a batch is never split across versions)."""
+    """`concurrent.futures.Future` plus serving provenance: the model
+    ``version`` that answered (stamped at scatter time — a hot-swap
+    test's witness that a batch is never split across versions), the
+    request id ``rid`` minted at submit, and ``trace`` — the
+    per-request stage decomposition ``{rid, queue_wait_ms,
+    assemble_ms, dispatch_ms, bucket, version}`` attached when its
+    batch dispatches, so a slow response is attributable to queueing
+    vs assembly vs the device without correlating logs."""
 
     def __init__(self):
         super().__init__()
         self.version: Optional[str] = None
+        self.rid: Optional[int] = None
+        self.trace: Optional[dict] = None
 
 
 class Request:
     """One queued inference request: the raw input, the future the
-    client holds, and its timing (enqueue time for the latency
-    histogram, absolute monotonic deadline or None)."""
+    client holds, its timing (enqueue time for the latency histogram,
+    absolute monotonic deadline or None), and the request id the
+    engine minted at ``submit()`` — ``t_enqueue_ns`` is the
+    ``perf_counter_ns`` stamp the queue-wait stage span starts from."""
 
-    __slots__ = ("x", "future", "t_enqueue", "deadline")
+    __slots__ = ("x", "future", "t_enqueue", "t_enqueue_ns", "deadline",
+                 "rid")
 
-    def __init__(self, x, deadline_s: Optional[float] = None):
+    def __init__(self, x, deadline_s: Optional[float] = None,
+                 rid: Optional[int] = None):
         self.x = x
         self.future = ServeFuture()
+        self.rid = rid
+        self.future.rid = rid
         self.t_enqueue = time.monotonic()
+        self.t_enqueue_ns = time.perf_counter_ns()
         self.deadline = (self.t_enqueue + deadline_s
                          if deadline_s is not None else None)
 

@@ -26,6 +26,7 @@ version per batch, every response is old-or-new, never mixed.
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -35,6 +36,8 @@ import jax
 import numpy as np
 
 from .. import observability as obs
+from ..observability import flight as _flight
+from ..observability import health as _health
 from ..optim.predictor import bucket_for, pad_leading, shape_buckets, \
     shared_forward
 from ..optim.staging import place_host_value
@@ -67,6 +70,9 @@ class ServingEngine:
         :class:`QueueFull`.
     default_deadline_ms : per-request deadline applied when ``submit``
         does not pass one (None = no deadline).
+    stall_deadline_s : watchdog deadline for the batcher's progress
+        beacon (None = the ``BIGDL_TPU_STALL_S`` default; active only
+        while observability is enabled).
     """
 
     def __init__(self, model, *, input_shape: Optional[Sequence[int]] = None,
@@ -74,7 +80,8 @@ class ServingEngine:
                  max_wait_ms: float = 2.0, max_queue: int = 128,
                  default_deadline_ms: Optional[float] = None,
                  registry: Optional[ModelRegistry] = None,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 stall_deadline_s: Optional[float] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue < 1:
@@ -102,6 +109,12 @@ class ServingEngine:
         self._cond = threading.Condition()
         self._stats = dict.fromkeys(_STAT_KEYS, 0)
         self._stats_lock = threading.Lock()
+        # per-request trace ids, minted at submit(): the id flows
+        # queue→assemble→dispatch→scatter so the three stage spans and
+        # the future's trace dict all name the same request
+        self._rids = itertools.count()
+        self.stall_deadline_s = stall_deadline_s
+        self._beacon = _health.NULL_BEACON
 
     # -- lifecycle -------------------------------------------------------
 
@@ -115,6 +128,11 @@ class ServingEngine:
             raise EngineStopped("engine was shut down; build a new one")
         if self._warmup_on_start and self.input_shape is not None:
             self.warmup()
+        # the batcher registers with the stall watchdog: it pulses per
+        # collect cycle (bounded 50ms idle poll), so silence means a
+        # wedged dispatch — every queued client is stuck behind it
+        self._beacon = _health.beacon("serving/batcher",
+                                      deadline_s=self.stall_deadline_s)
         self._thread = threading.Thread(
             target=self._batcher, name=THREAD_NAME, daemon=True)
         self._thread.start()
@@ -159,6 +177,7 @@ class ServingEngine:
                 import logging
                 logging.getLogger(__name__).warning(
                     "serving batcher did not join within %.0fs", timeout)
+        self._beacon.close()
         # anything still queued (hard stop, or a wedged batcher) fails
         # typed rather than hanging its client forever
         while True:
@@ -195,7 +214,8 @@ class ServingEngine:
         server can begin admitting while warmup compiles."""
         ms = deadline_ms if deadline_ms is not None else \
             self.default_deadline_ms
-        req = Request(x, deadline_s=ms / 1000.0 if ms is not None else None)
+        req = Request(x, deadline_s=ms / 1000.0 if ms is not None else None,
+                      rid=next(self._rids))
         try:
             # closed-check and enqueue are ONE atomic step vs shutdown's
             # close (same lock): an admitted request is therefore in the
@@ -253,12 +273,23 @@ class ServingEngine:
     # -- batcher ---------------------------------------------------------
 
     def _batcher(self):
-        while not self._stop.is_set():
-            batch = self._collect()
-            if batch:
-                self._dispatch(batch)
-            elif self._closed:
-                break  # drained: closed engine with an empty queue
+        try:
+            while not self._stop.is_set():
+                self._beacon.pulse()
+                batch = self._collect()
+                if batch:
+                    self._dispatch(batch)
+                elif self._closed:
+                    break  # drained: closed engine with an empty queue
+        except BaseException as e:  # noqa: BLE001 — post-mortem, then die
+            # per-batch errors are contained in _dispatch; anything that
+            # escapes is a batcher crash — every future client would
+            # hang, so leave a flight-recorder bundle for the operator
+            if obs.enabled():
+                _flight.dump_crash_bundle(error=e, context={
+                    "component": "serving/batcher",
+                    "stats": self.stats()})
+            raise
 
     def _collect(self):
         """One micro-batch: first request blocks (bounded poll so
@@ -287,7 +318,13 @@ class ServingEngine:
         return batch
 
     def _dispatch(self, batch):
-        """Serve one micro-batch against ONE version snapshot."""
+        """Serve one micro-batch against ONE version snapshot. The
+        per-request trace decomposes here: queue wait (enqueue → batch
+        cut, retro-span from the request's own stamp), assemble (stack
+        + validate), dispatch (pad + place + forward + readback) — each
+        stage gets a span carrying the request ids and a histogram, and
+        every future leaves with its ``trace`` dict attached."""
+        t_cut_ns = time.perf_counter_ns()  # the batch is cut HERE
         now = time.monotonic()
         ready = []
         for r in batch:
@@ -307,24 +344,44 @@ class ServingEngine:
             if not r.future.set_running_or_notify_cancel():
                 continue
             ready.append(r)
-        x, live = assemble(ready, template_shape=self.input_shape,
-                           dtype=self.input_dtype)
+        with obs.span("serve/assemble", rids=[r.rid for r in ready]):
+            x, live = assemble(ready, template_shape=self.input_shape,
+                               dtype=self.input_dtype)
+        t_asm_ns = time.perf_counter_ns()
         if len(ready) != len(live):
             self._bump("request_errors", len(ready) - len(live))
         if x is None:
             return
         n = len(live)
+        rids = [r.rid for r in live]
+        assemble_ms = (t_asm_ns - t_cut_ns) / 1e6
+        if obs.enabled():
+            qh = obs.histogram("serve/queue_wait_ms", unit="ms")
+            for r in live:
+                # retro-span from the request's enqueue stamp: the wait
+                # is over by the time it is measurable. One virtual
+                # lane per request (tid=-(rid+1)): a batch's waits all
+                # end at the cut and would otherwise fake-nest as
+                # contained siblings on the batcher thread
+                obs.complete("serve/queue_wait", r.t_enqueue_ns, t_cut_ns,
+                             tid=-(r.rid + 1), rid=r.rid)
+                qh.observe((t_cut_ns - r.t_enqueue_ns) / 1e6)
+            obs.histogram("serve/assemble_ms", unit="ms").observe(
+                assemble_ms)
         bucket = bucket_for(n, self.max_batch)
         mv = self.registry.current()  # ONE version per batch — swap boundary
         sp = obs.span("serve/batch", bucket=bucket, n=n, version=mv.version)
+        t_fwd_ns = time.perf_counter_ns()
         try:
             with sp:
-                xd = place_host_value(pad_leading(x, bucket))
-                out = self._fwd(mv.params, mv.state, xd)
-                # sync-ok: serving result readback — the micro-batch is
-                # the pipeline unit; its clients are blocked on exactly
-                # this result
-                host = np.asarray(out)
+                with obs.span("serve/dispatch", rids=rids, bucket=bucket,
+                              version=mv.version):
+                    xd = place_host_value(pad_leading(x, bucket))
+                    out = self._fwd(mv.params, mv.state, xd)
+                    # sync-ok: serving result readback — the micro-batch
+                    # is the pipeline unit; its clients are blocked on
+                    # exactly this result
+                    host = np.asarray(out)
         except BaseException as e:  # noqa: BLE001 — batch fails, batcher lives
             self._bump("batch_errors")
             if obs.enabled():
@@ -335,8 +392,20 @@ class ServingEngine:
                 except Exception:
                     pass
             return
+        dispatch_ms = (time.perf_counter_ns() - t_fwd_ns) / 1e6
+        if obs.enabled():
+            obs.histogram("serve/dispatch_ms", unit="ms").observe(
+                dispatch_ms)
         for i, r in enumerate(live):
             r.future.version = mv.version
+            r.future.trace = {
+                "rid": r.rid,
+                "queue_wait_ms": (t_cut_ns - r.t_enqueue_ns) / 1e6,
+                "assemble_ms": assemble_ms,
+                "dispatch_ms": dispatch_ms,
+                "bucket": bucket,
+                "version": mv.version,
+            }
             try:
                 # copy, not a view: a client caching its row must not pin
                 # the whole [bucket, ...] readback buffer in memory
@@ -349,6 +418,10 @@ class ServingEngine:
             obs.counter("serve/batches").inc()
             obs.counter("serve/requests").inc(n)
             obs.histogram("serve/batch_occupancy").observe(n / bucket)
+            _flight.record("serve/batch", n=n, bucket=bucket,
+                           version=mv.version, rid_first=rids[0],
+                           rid_last=rids[-1],
+                           dispatch_ms=round(dispatch_ms, 3))
 
     # -- internals -------------------------------------------------------
 

@@ -1,0 +1,620 @@
+"""Health-layer tests: stall watchdog (injected stager stall detected
+within its deadline), anomaly detectors (spike/plateau/NaN-streak with
+step provenance), device-memory telemetry degradation, profiler
+windows, flight-recorder crash bundles (written on an injected step
+failure and parseable by ``tools/flight_report.py``), per-request
+serving stage traces (request id in all three stage spans), gauge
+``set_fn`` hardening, the folded-stack trace report, and the
+disabled-mode zero-new-events guarantee."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu import observability as obs
+from bigdl_tpu.observability import flight, health
+from bigdl_tpu.observability.metrics import MetricsRegistry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts disabled with empty tracer/registry/flight ring
+    and no live beacons, and cannot leak state into unrelated tests."""
+    obs.disable()
+    obs.reset()
+    obs.registry().reset()
+    flight.reset()
+    health.reset()
+    yield
+    obs.disable()
+    obs.reset()
+    obs.registry().reset()
+    flight.reset()
+    health.reset()
+    t_end = time.monotonic() + 5.0
+    while health.watchdog_threads_alive() and time.monotonic() < t_end:
+        time.sleep(0.02)
+    assert health.watchdog_threads_alive() == 0
+
+
+def _mlp():
+    return nn.Sequential().add(nn.Linear(16, 8)).add(nn.ReLU()) \
+                          .add(nn.Linear(8, 1))
+
+
+def _train(steps=4, batch=8, model=None, end_trigger=None, **opt_kw):
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch * steps, 16).astype(np.float32)
+    y = rng.rand(batch * steps, 1).astype(np.float32)
+    opt = LocalOptimizer(model or _mlp(), (x, y), nn.MSECriterion(),
+                         optim_method=SGD(learningrate=0.01),
+                         end_trigger=end_trigger or max_iteration(steps),
+                         batch_size=batch)
+    for k, v in opt_kw.items():
+        setattr(opt, k, v)
+    opt.optimize()
+    return opt
+
+
+# ---------------------------------------------------------------- watchdog
+
+def test_watchdog_detects_injected_stager_stall():
+    """A stager whose source hangs mid-epoch must fire ``health/stall``
+    before 2x its deadline (the ISSUE acceptance bound)."""
+    from bigdl_tpu.optim.staging import BatchStager
+    obs.enable()
+    release = threading.Event()
+    fired = threading.Event()
+    events = []
+
+    def listener(ev):
+        if ev["kind"] == "health/stall" and \
+                ev.get("component", "").startswith("stager/"):
+            events.append(ev)
+            fired.set()
+    health.listeners.append(listener)
+
+    def source():
+        yield 1
+        yield 2
+        release.wait(10.0)  # injected stall: the source wedges here
+        yield 3
+
+    deadline = 0.25
+    stager = BatchStager(source(), lambda v: v, depth=2,
+                         name="stall_test", stall_deadline_s=deadline)
+    try:
+        assert next(stager) == 1
+        assert next(stager) == 2
+        t0 = time.monotonic()
+        assert fired.wait(2 * deadline + 1.0), "stall never detected"
+        detect_s = time.monotonic() - t0
+        assert detect_s <= 2 * deadline + 0.5, \
+            f"stall detected after {detect_s:.2f}s (deadline {deadline}s)"
+        ev = events[0]
+        assert ev["component"] == "stager/stall_test"
+        assert ev["deadline_s"] == deadline
+        assert ev["age_s"] > deadline
+        # structured sinks: counter + instant span + flight entry
+        assert obs.registry().get("health/stall").value >= 1.0
+        assert any(e.name == "health/stall"
+                   for e in obs.get_tracer().events())
+        assert any(e["kind"] == "health/stall"
+                   for e in flight.recorder().events())
+    finally:
+        release.set()
+        stager.close()
+
+
+def test_group_mode_stager_pulses_per_item_not_per_group():
+    """Superstep stacking: the worker emits one element per K source
+    items, but the beacon must pulse per ITEM — a healthy-but-slow
+    producer under K>1 must not page as a stall."""
+    from bigdl_tpu.optim.staging import BatchStager
+    obs.enable()
+
+    def source():
+        for i in range(8):
+            time.sleep(0.08)  # per-item < deadline, per-GROUP(4) > deadline
+            yield i
+
+    stager = BatchStager(source(), lambda v: v, depth=2, name="group_test",
+                         group=4, group_fn=lambda items: list(items),
+                         stall_deadline_s=0.2)
+    try:
+        assert next(stager) == [0, 1, 2, 3]
+        assert next(stager) == [4, 5, 6, 7]
+    finally:
+        stager.close()
+    assert obs.registry().get("health/stall") is None, \
+        "healthy K-grouped producer paged as a stall"
+
+
+def test_stall_deadline_zero_disables_watchdog(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_STALL_S", "0")
+    obs.enable()
+    b = health.beacon("t/disabled")
+    assert b is health.NULL_BEACON  # off-switch, not a ValueError
+    b.pulse()
+    b.close()
+    assert health.watchdog().beacons() == []
+    # an explicit per-call deadline of 0 disables that beacon too
+    assert health.beacon("t/x", deadline_s=0) is health.NULL_BEACON
+    monkeypatch.setenv("BIGDL_TPU_STALL_S", "not-a-number")
+    assert health.default_stall_deadline() == 600.0  # parse fallback
+
+
+def test_watchdog_stall_recovers_and_rearms():
+    obs.enable()
+    b = health.beacon("t/loop", deadline_s=0.1)
+    try:
+        time.sleep(0.3)
+        assert b.stalled
+        b.pulse()  # progress resumes
+        assert not b.stalled
+        assert obs.registry().get("health/stall_recovered").value == 1.0
+        time.sleep(0.3)  # goes quiet again -> a SECOND stall fires
+        assert obs.registry().get("health/stall").value == 2.0
+    finally:
+        b.close()
+
+
+def test_watchdog_on_stall_callback():
+    obs.enable()
+    hits = []
+    b = health.beacon("t/cb", deadline_s=0.1,
+                      on_stall=lambda beacon, age: hits.append(
+                          (beacon.name, age)))
+    try:
+        time.sleep(0.3)
+        assert hits and hits[0][0] == "t/cb" and hits[0][1] > 0.1
+    finally:
+        b.close()
+
+
+def test_optimizer_run_registers_and_clears_step_beacon():
+    obs.enable()
+    _train(steps=2, stall_deadline_s=30.0)
+    # run finished: no beacon left registered, watchdog winds down
+    assert health.watchdog().beacons() == []
+    assert obs.registry().get("health/stall") is None
+
+
+# -------------------------------------------------------- anomaly detectors
+
+def test_series_monitor_spike_with_provenance():
+    m = health.SeriesMonitor("loss", window=16, min_points=4,
+                             spike_sigma=3.0)
+    evs = []
+    for i, v in enumerate([1.0, 0.98, 0.96, 0.94, 0.92, 0.9]):
+        evs += m.observe(v, i)
+    assert evs == []
+    evs = m.observe(100.0, 6)
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["kind"] == "health/loss_spike"
+    assert ev["step"] == 6 and ev["value"] == 100.0
+    assert ev["sigma"] >= 3.0
+
+
+def test_series_monitor_plateau_and_rearm():
+    m = health.SeriesMonitor("loss", plateau_window=5, plateau_rel=1e-3,
+                             min_points=1000)  # spikes off
+    evs = []
+    for i in range(20):
+        evs += m.observe(0.5, i)
+    kinds = [e["kind"] for e in evs]
+    assert kinds == ["health/plateau"]  # fires ONCE, not per step
+    assert evs[0]["best_step"] == 0 and evs[0]["step"] == 5
+    # a new best re-arms the detector
+    evs = m.observe(0.1, 30)
+    assert evs == []
+    evs = []
+    for i in range(31, 40):
+        evs += m.observe(0.1, i)
+    assert [e["kind"] for e in evs] == ["health/plateau"]
+
+
+def test_series_monitor_nan_streak_fires_once_at_threshold():
+    m = health.SeriesMonitor("loss", nan_streak=3)
+    evs = m.observe(0.5, 1)
+    evs += m.observe(float("nan"), 2)
+    evs += m.observe(float("inf"), 3)
+    assert evs == []
+    evs = m.observe(float("nan"), 4)
+    assert [e["kind"] for e in evs] == ["health/nan_streak"]
+    assert evs[0]["step"] == 4 and evs[0]["streak"] == 3
+    assert m.observe(float("nan"), 5) == []  # no re-fire mid-streak
+    m.observe(0.4, 6)  # finite value re-arms
+    for step in (7, 8):
+        assert m.observe(float("nan"), step) == []
+    assert [e["kind"] for e in m.observe(float("nan"), 9)] == \
+        ["health/nan_streak"]
+
+
+def test_training_nan_streak_event_from_skip_policy():
+    """The detector rides the losses the loop already syncs: a training
+    run whose data turns to NaN emits health/nan_streak with step
+    provenance and zero extra readbacks."""
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    obs.enable()
+    x = np.full((32, 16), np.nan, np.float32)
+    y = np.ones((32, 1), np.float32)
+    opt = LocalOptimizer(_mlp(), (x, y), nn.MSECriterion(),
+                         optim_method=SGD(learningrate=0.01),
+                         end_trigger=max_iteration(4), batch_size=8)
+    opt.set_nan_policy("skip")
+    opt.set_anomaly_detection(nan_streak=3)
+    opt.optimize()
+    c = obs.registry().get("health/nan_streak")
+    assert c is not None and c.value == 1.0
+    ev = [e for e in flight.recorder().events()
+          if e["kind"] == "health/nan_streak"]
+    assert ev and ev[0]["streak"] == 3
+
+
+def test_training_loss_spike_detected_in_superstep_vector():
+    """Superstep-vector aware: the host replay of the batched [K]
+    readback feeds the detector per microstep."""
+    m = health.SeriesMonitor("loss", window=32, min_points=4,
+                             spike_sigma=3.0)
+    # simulate two supersteps of K=4 resolved vectors
+    for i, v in enumerate([0.5, 0.49, 0.5, 0.51]):
+        m.observe(v, i + 1)
+    evs = []
+    for i, v in enumerate([0.5, 30.0, 0.49, 0.5]):
+        evs += m.observe(v, 5 + i)
+    assert [e["kind"] for e in evs] == ["health/loss_spike"]
+    assert evs[0]["step"] == 6
+
+
+# ------------------------------------------------- memory + profiler window
+
+def test_memory_telemetry_degrades_gracefully():
+    obs.enable()
+    ok = health.ensure_memory_telemetry()
+    live = obs.registry().get("mem/device_live_bytes")
+    if ok:
+        assert live is not None and live.value >= 0
+        assert obs.registry().get("mem/device_peak_bytes").value >= \
+            live.value * 0  # readable
+        assert health.sample_device_memory()["devices"] >= 1
+    else:
+        # backends without memory_stats register NOTHING (no dead rows)
+        assert live is None
+        assert health.sample_device_memory() is None
+
+
+def test_profiler_window_env_parse_and_ticks(monkeypatch, tmp_path):
+    obs.enable()
+    calls = []
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    w = health.profiler_window_from_env(
+        {"BIGDL_TPU_PROFILE": "2:4",
+         "BIGDL_TPU_PROFILE_DIR": str(tmp_path)})
+    assert w is not None and w.start_step == 2 and w.stop_step == 4
+    for step in range(6):
+        w.maybe_tick(step)
+    assert calls == [("start", str(tmp_path)), ("stop",)]
+    assert w.done and not w.active
+    names = [e.name for e in obs.get_tracer().events()]
+    assert "health/profile_start" in names
+    assert "health/profile_stop" in names
+    # malformed/unset specs never raise
+    assert health.profiler_window_from_env({}) is None
+    assert health.profiler_window_from_env(
+        {"BIGDL_TPU_PROFILE": "garbage"}) is None
+
+
+# ----------------------------------------------------------- crash bundles
+
+class _DetonateAt:
+    """End-trigger that raises at iteration n: a deterministic injected
+    mid-run step failure."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __call__(self, state):
+        if state.get("neval", 0) >= self.n:
+            raise RuntimeError("injected step failure")
+        return False
+
+
+def test_crash_bundle_on_injected_step_failure(tmp_path, monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_FLIGHT_DIR", str(tmp_path))
+    obs.enable()
+    steps = 40
+    with pytest.raises(RuntimeError, match="injected step failure"):
+        _train(steps=steps, end_trigger=_DetonateAt(steps))
+    bundles = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+    assert len(bundles) == 1
+    with open(tmp_path / bundles[0]) as f:
+        bundle = json.load(f)
+    # schema + error + context provenance
+    assert bundle["schema"] == flight.SCHEMA
+    assert bundle["error"]["type"] == "RuntimeError"
+    assert "injected step failure" in bundle["error"]["traceback"]
+    ctx = bundle["context"]
+    assert ctx["component"] == "optimizer"
+    assert ctx["neval"] == steps and ctx["seed"] == 42
+    # the ring holds the last >= 32 events with correct step/batch
+    # provenance (ISSUE acceptance)
+    ev_steps = [e for e in bundle["events"] if e["kind"] == "step"]
+    assert len(ev_steps) >= 32
+    nevals = [e["neval"] for e in ev_steps]
+    assert nevals == list(range(1, steps + 1))
+    assert all(e["epoch"] == 1 for e in ev_steps)
+    assert all(np.isfinite(e["loss"]) for e in ev_steps)
+    # metrics + span tail rode along
+    assert "optim/steps" in bundle["metrics"]
+    assert bundle["metrics"]["optim/steps"]["value"] == steps
+    assert any(sp["name"] == "step/dispatch" for sp in bundle["spans"])
+
+
+def test_crash_bundle_parseable_by_flight_report(tmp_path, monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_FLIGHT_DIR", str(tmp_path))
+    obs.enable()
+    with pytest.raises(RuntimeError):
+        _train(steps=6, end_trigger=_DetonateAt(6))
+    bundle = [f for f in os.listdir(tmp_path) if f.endswith(".json")][0]
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "flight_report.py"),
+         str(tmp_path / bundle), "--spans"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "RuntimeError: injected step failure" in out
+    assert "component=optimizer" in out
+    assert "optim/steps" in out
+    assert "traceback:" in out
+    # unreadable input is a clean nonzero, not a traceback
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "flight_report.py"),
+         str(tmp_path / "nope.json")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+
+
+def test_crash_bundle_from_nan_abort(tmp_path, monkeypatch):
+    """The nan_policy='error' abort is an unhandled failure too — the
+    bundle names FloatingPointError and the nan event precedes it."""
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    monkeypatch.setenv("BIGDL_TPU_FLIGHT_DIR", str(tmp_path))
+    obs.enable()
+    x = np.full((16, 16), np.nan, np.float32)
+    y = np.ones((16, 1), np.float32)
+    opt = LocalOptimizer(_mlp(), (x, y), nn.MSECriterion(),
+                         optim_method=SGD(learningrate=0.01),
+                         end_trigger=max_iteration(2), batch_size=8)
+    with pytest.raises(FloatingPointError):
+        opt.optimize()
+    bundle = [f for f in os.listdir(tmp_path) if f.endswith(".json")][0]
+    with open(tmp_path / bundle) as f:
+        doc = json.load(f)
+    assert doc["error"]["type"] == "FloatingPointError"
+    assert doc["context"]["nan_policy"] == "error"
+    assert any(e["kind"] == "nan" for e in doc["events"])
+
+
+def test_window_policy_flight_provenance_names_the_producing_step():
+    """Under window:K the resolved loss is up to K-1 dispatches old —
+    flight/anomaly events must attribute it to the step that PRODUCED
+    it, not the step that read it."""
+    from bigdl_tpu.utils import engine
+    steps = 6
+    obs.enable()
+    engine.set_seed(42)  # identical init/rng for both arms
+    _train(steps=steps)  # sync baseline: per-step ground-truth losses
+    truth = {e["neval"]: e["loss"] for e in flight.recorder().events()
+             if e["kind"] == "step"}
+    assert len(truth) == steps
+    flight.reset()
+    obs.reset()
+    engine.set_seed(42)
+    opt = _train(steps=steps, sync_policy="window:3")
+    lagged = [(e["neval"], e["loss"]) for e in flight.recorder().events()
+              if e["kind"] == "step"]
+    # K-1 tail losses drain after the loop (no flight record) — the
+    # observed ones must carry their ORIGINAL step numbers and values
+    assert [n for n, _ in lagged] == list(range(1, steps - 2 + 1))
+    for neval, loss in lagged:
+        assert loss == truth[neval], (neval, loss, truth[neval])
+    assert opt._resolved_step == steps - 2
+
+
+def test_crash_bundle_is_strict_json_despite_nan_events(tmp_path):
+    """A NaN post-mortem must be valid STRICT json — jq/JSON.parse on
+    the remote-fetched bundle is the documented workflow."""
+    obs.enable()
+    flight.record("nan", neval=3, loss=float("nan"))
+    flight.record("spike", value=float("inf"), floor=float("-inf"))
+    p = flight.dump_crash_bundle(
+        error=FloatingPointError("non-finite loss nan"),
+        path=str(tmp_path / "b.json"))
+    text = open(p).read()
+
+    def no_const(name):  # strict parsers reject NaN/Infinity tokens
+        raise AssertionError(f"bare {name} token in bundle")
+    doc = json.loads(text, parse_constant=no_const)
+    evs = {e["kind"]: e for e in doc["events"]}
+    assert evs["nan"]["loss"] == "NaN"
+    assert evs["spike"]["value"] == "Infinity"
+    assert evs["spike"]["floor"] == "-Infinity"
+
+
+def test_profiler_window_jumped_over_reports_skip(monkeypatch):
+    """Superstep ticks arrive at K-step stride: a window narrower than
+    the stride is reported (warning + health/profile_skipped), never
+    silently lost."""
+    obs.enable()
+    calls = []
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append("start"))
+    w = health.ProfilerWindow(2, 3, "/tmp/nope")
+    w.maybe_tick(0)
+    w.maybe_tick(4)  # jumped clean over [2, 3)
+    assert w.done and not w.active and calls == []
+    assert obs.registry().get("health/profile_skipped").value == 1.0
+    w.maybe_tick(8)  # done: no re-fire
+    assert obs.registry().get("health/profile_skipped").value == 1.0
+
+
+def test_flight_ring_is_bounded():
+    obs.enable()
+    rec = flight.FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("step", neval=i)
+    evs = rec.events()
+    assert len(evs) == 8
+    assert [e["neval"] for e in evs] == list(range(12, 20))
+    assert rec.total_recorded == 20
+
+
+# ------------------------------------------- per-request serving traces
+
+def test_serving_request_id_in_all_three_stage_spans():
+    from bigdl_tpu.serving import ServingEngine
+    obs.enable()
+    model = _mlp()
+    engine = ServingEngine(model, input_shape=(16,), max_batch=4,
+                           max_wait_ms=1.0, warmup=False)
+    with engine:
+        futs = [engine.submit(np.zeros(16, np.float32)) for _ in range(3)]
+        outs = [f.result(timeout=30.0) for f in futs]
+    assert all(o.shape == (1,) for o in outs)
+    rids = [f.rid for f in futs]
+    assert sorted(rids) == [0, 1, 2]  # minted at submit, in order
+    spans = obs.get_tracer().events()
+    by_name = {}
+    for sp in spans:
+        by_name.setdefault(sp.name, []).append(sp)
+    # every request id appears in all three stage spans
+    qw = by_name["serve/queue_wait"]
+    # overlapping retro waits each ride their own virtual lane —
+    # containment tooling (trace_report) must not fake-nest them
+    assert len({sp.tid for sp in qw}) == len(qw)
+    assert all(sp.tid < 0 for sp in qw)
+    qw_rids = {sp.args["rid"] for sp in qw}
+    asm_rids = {r for sp in by_name["serve/assemble"]
+                for r in sp.args["rids"]}
+    dsp_rids = {r for sp in by_name["serve/dispatch"]
+                for r in sp.args["rids"]}
+    for rid in rids:
+        assert rid in qw_rids and rid in asm_rids and rid in dsp_rids
+    # stage histograms observed and decomposable
+    for h in ("serve/queue_wait_ms", "serve/assemble_ms",
+              "serve/dispatch_ms"):
+        hist = obs.registry().get(h)
+        assert hist is not None and hist.count >= 1, h
+    # each future carries its trace with consistent ids
+    for f in futs:
+        tr = f.trace
+        assert tr is not None and tr["rid"] == f.rid
+        assert tr["queue_wait_ms"] >= 0.0
+        assert tr["dispatch_ms"] > 0.0
+        assert tr["version"] == f.version
+
+
+def test_serving_trace_attached_even_when_disabled():
+    """The trace dict is provenance, not telemetry: it rides the future
+    regardless of the observability flag (host floats, no spans)."""
+    from bigdl_tpu.serving import ServingEngine
+    assert not obs.enabled()
+    engine = ServingEngine(_mlp(), input_shape=(16,), max_batch=2,
+                           max_wait_ms=1.0, warmup=False)
+    with engine:
+        fut = engine.submit(np.zeros(16, np.float32))
+        fut.result(timeout=30.0)
+    assert fut.trace is not None and fut.trace["rid"] == fut.rid == 0
+    assert obs.get_tracer().events() == []
+
+
+# -------------------------------------------------- gauge set_fn hardening
+
+def test_raising_gauge_fn_does_not_break_exports():
+    reg = MetricsRegistry()
+    reg.gauge("t/good").set(1.5)
+
+    def boom():
+        raise RuntimeError("dead callback")
+    reg.gauge("t/bad").set_fn(boom)
+    reg.counter("t/count").inc(2)
+
+    snap = reg.snapshot()  # must not raise
+    assert snap["t/good"]["value"] == 1.5
+    assert np.isnan(snap["t/bad"]["value"])
+    assert snap["t/count"]["value"] == 2.0
+
+    from bigdl_tpu.observability.exporters import prometheus_text
+    text = prometheus_text(reg)  # must not raise either
+    assert "bigdl_t_good 1.5" in text
+    assert "bigdl_t_bad NaN" in text
+    # failures are counted in the default registry
+    errs = obs.registry().get("obs/gauge_fn_errors")
+    assert errs is not None and errs.value == 2.0  # snapshot + prom read
+
+
+# --------------------------------------------------- folded-stack report
+
+def test_trace_report_collapsed_output(tmp_path):
+    obs.enable()
+    for _ in range(2):
+        with obs.span("step"):
+            with obs.span("step/dispatch"):
+                time.sleep(0.002)
+            with obs.span("step/data_fetch"):
+                pass
+    trace = obs.write_chrome_trace(str(tmp_path / "t.json"))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "trace_report.py"),
+         trace, "--collapsed"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    lines = dict(l.rsplit(" ", 1) for l in proc.stdout.strip().splitlines())
+    assert "step;step/dispatch" in lines
+    assert "step;step/data_fetch" in lines
+    assert int(lines["step;step/dispatch"]) >= 4000  # 2 x 2ms in µs
+    # parent line carries SELF time only (children subtracted)
+    if "step" in lines:
+        assert int(lines["step"]) < int(lines["step;step/dispatch"])
+
+
+# -------------------------------------------------- disabled-mode overhead
+
+def test_disabled_mode_records_zero_new_events():
+    """The whole health layer compiles away when observability is off:
+    a full training run plus a health-API exercise leaves the tracer,
+    registry, flight ring and watchdog all empty."""
+    assert not obs.enabled()
+    opt = _train(steps=2, stall_deadline_s=5.0)
+    assert opt._step_beacon is health.NULL_BEACON
+    b = health.beacon("t/should_be_null", deadline_s=0.01)
+    assert b is health.NULL_BEACON
+    b.pulse()
+    b.close()
+    flight.record("step", neval=1)
+    health.emit("stall", component="nope")  # listeners-only when disabled
+    time.sleep(0.05)
+    assert obs.get_tracer().events() == []
+    assert obs.registry().names() == []
+    assert flight.recorder().events() == []
+    assert health.watchdog().beacons() == []
+    assert health.watchdog_threads_alive() == 0

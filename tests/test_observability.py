@@ -182,6 +182,7 @@ def test_prometheus_golden():
     from bigdl_tpu.observability.exporters import prometheus_text
     text = prometheus_text(reg)
     assert text == (
+        "# HELP bigdl_optim_step_time optim/step_time (s)\n"
         "# TYPE bigdl_optim_step_time summary\n"
         'bigdl_optim_step_time{quantile="0.5"} 0.25\n'
         'bigdl_optim_step_time{quantile="0.9"} 0.25\n'
@@ -190,8 +191,10 @@ def test_prometheus_golden():
         "bigdl_optim_step_time_count 4\n"
         "bigdl_optim_step_time_min 0.25\n"
         "bigdl_optim_step_time_max 0.25\n"
+        "# HELP bigdl_optim_steps optim/steps\n"
         "# TYPE bigdl_optim_steps counter\n"
         "bigdl_optim_steps 3.0\n"
+        "# HELP bigdl_optim_throughput optim/throughput (samples/s)\n"
         "# TYPE bigdl_optim_throughput gauge\n"
         "bigdl_optim_throughput 1.5\n")
 

@@ -25,12 +25,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # file -> function names whose bodies form the training hot path
 HOT_FUNCS = {
     "bigdl_tpu/optim/optimizer.py": {
-        "optimize", "_run_epoch_steps", "_run_epoch_supersteps",
+        "optimize", "_optimize_impl", "_run_epoch_steps",
+        "_run_epoch_supersteps",
         "_clamp_superstep", "_observe_loss", "_drain_pending_losses",
         "_stage_minibatch", "_stage_minibatch_host", "_stage_group",
         "_place_batch", "_place_group",
     },
     "bigdl_tpu/optim/staging.py": {"_run", "__next__"},
+    # health/flight hot paths: beacon pulses, anomaly observation and
+    # flight-ring appends run INSIDE the step loop when observability
+    # is on — none of them may touch a device value
+    "bigdl_tpu/observability/health.py": {"pulse", "observe",
+                                          "maybe_tick", "emit"},
+    "bigdl_tpu/observability/flight.py": {"record"},
     # forward-only loops: device-side metric/output accumulation means
     # the per-batch body must stay sync-free (one readback per epoch)
     "bigdl_tpu/optim/evaluator.py": {

@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Render a flight-recorder crash bundle as a human post-mortem.
+
+Reads the JSON bundle ``bigdl_tpu.observability.flight.dump_crash_bundle``
+writes on an unhandled training/serving failure and prints, in reading
+order: what died (error + context provenance), where it ran (env), what
+happened leading up to it (the event ring, newest last, with relative
+timestamps), what the metrics said, and the full traceback.
+
+Usage:
+    python tools/flight_report.py flight_1234_...json [--events N] [--spans]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_EXPECTED_SCHEMA_PREFIX = "bigdl_tpu.flight_bundle."
+
+
+def _fmt_fields(ev, skip=("t", "kind")):
+    parts = []
+    for k, v in ev.items():
+        if k in skip:
+            continue
+        if isinstance(v, float):
+            v = f"{v:.6g}"
+        parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
+def render(bundle: dict, max_events: int = 40, show_spans: bool = False):
+    lines = []
+    schema = bundle.get("schema", "<missing>")
+    lines.append(f"flight bundle  schema={schema}  "
+                 f"written_at={bundle.get('written_at_iso', '?')}  "
+                 f"pid={bundle.get('pid', '?')}")
+    if not str(schema).startswith(_EXPECTED_SCHEMA_PREFIX):
+        lines.append(f"  WARNING: unexpected schema (wanted "
+                     f"{_EXPECTED_SCHEMA_PREFIX}*)")
+
+    err = bundle.get("error")
+    if err:
+        lines.append(f"\nerror: {err.get('type')}: {err.get('message')}")
+    else:
+        lines.append("\nerror: none recorded (manual dump?)")
+
+    ctx = bundle.get("context") or {}
+    if ctx:
+        lines.append("context: " + _fmt_fields(ctx, skip=()))
+    env = bundle.get("env") or {}
+    if env:
+        lines.append("env: " + _fmt_fields(env, skip=()))
+
+    events = bundle.get("events") or []
+    t_end = events[-1].get("t", 0.0) if events else 0.0
+    shown = events[-max_events:]
+    lines.append(f"\nlast {len(shown)} of {len(events)} recorded events "
+                 "(newest last, seconds relative to the final event):")
+    for ev in shown:
+        dt = ev.get("t", t_end) - t_end
+        lines.append(f"  {dt:+9.3f}s  {ev.get('kind', '?'):<24} "
+                     f"{_fmt_fields(ev)}")
+
+    metrics = bundle.get("metrics") or {}
+    if metrics:
+        lines.append("\nmetrics at crash:")
+        for name in sorted(metrics):
+            m = metrics[name]
+            kind = m.get("type")
+            if kind == "histogram":
+                q = m.get("quantiles", {})
+                lines.append(
+                    f"  {name:<36} hist  count={m.get('count')} "
+                    f"mean={m.get('mean', 0):.6g} "
+                    f"p99={float(q.get('0.99', 0.0)):.6g}")
+            else:
+                lines.append(f"  {name:<36} {kind or '?':<5} "
+                             f"value={m.get('value', 0):.6g}")
+
+    if show_spans:
+        spans = bundle.get("spans") or []
+        lines.append(f"\nlast {len(spans)} finished spans:")
+        for sp in spans:
+            lines.append(f"  {sp.get('start_us', 0) / 1e3:>12.3f}ms  "
+                         f"{sp.get('name', '?'):<28} "
+                         f"dur={sp.get('dur_us', 0) / 1e3:.3f}ms")
+
+    if err and err.get("traceback"):
+        lines.append("\ntraceback:")
+        lines.append(err["traceback"].rstrip())
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="crash bundle JSON file")
+    ap.add_argument("--events", type=int, default=40,
+                    help="events to show from the tail of the ring")
+    ap.add_argument("--spans", action="store_true",
+                    help="also print the span tail")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.bundle) as f:
+            bundle = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"flight_report: cannot read bundle: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(bundle, dict):
+        print("flight_report: bundle is not a JSON object", file=sys.stderr)
+        return 1
+    print(render(bundle, args.events, args.spans))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

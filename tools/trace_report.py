@@ -12,8 +12,15 @@ Self-time is the number that answers "where does the step actually
 go": a ``step`` span's total includes dispatch/data_fetch children, but
 its self-time is only the host bookkeeping between them.
 
+``--collapsed`` instead emits folded-stack lines
+(``step;step/dispatch 312551`` — semicolon-joined ancestry, self-time
+in µs) — the input format of standard flamegraph tooling
+(flamegraph.pl, inferno, speedscope's "folded" importer), so a span
+dump renders as a flamegraph with no intermediate conversion.
+
 Usage:
     python tools/trace_report.py trace.json [--top N] [--prefix step/]
+    python tools/trace_report.py trace.json --collapsed > out.folded
 """
 from __future__ import annotations
 
@@ -64,6 +71,45 @@ def self_times(events):
     return agg
 
 
+def collapsed_stacks(events):
+    """Folded-stack aggregate ``{"a;a/b;a/b/c": self_us}``.
+
+    Same containment recovery as :func:`self_times`, but keyed by the
+    full open-ancestor path instead of the leaf name, and charging each
+    span's self-time (duration minus child cover) to its path — exactly
+    the semantics flamegraph tooling expects of a folded line."""
+    agg = defaultdict(float)
+    by_thread = defaultdict(list)
+    for pid, tid, ts, dur, name in events:
+        by_thread[(pid, tid)].append((ts, dur, name))
+    for evs in by_thread.values():
+        evs.sort(key=lambda e: (e[0], -e[1]))
+        stack = []  # (end_ts, name, path)
+        for ts, dur, name in evs:
+            while stack and stack[-1][0] <= ts:
+                stack.pop()
+            path = (stack[-1][2] + ";" + name) if stack else name
+            agg[path] += dur
+            if stack:
+                agg[stack[-1][2]] -= dur
+            stack.append((ts + dur, name, path))
+    return agg
+
+
+def collapsed(agg, prefix: str = ""):
+    """Render the folded aggregate as ``path self_us`` lines (sorted by
+    path for stable diffs; zero/negative self-times are dropped — a
+    parent fully covered by children contributes no samples)."""
+    lines = []
+    for path in sorted(agg):
+        if prefix and not path.startswith(prefix):
+            continue
+        us = int(round(agg[path]))
+        if us > 0:
+            lines.append(f"{path} {us}")
+    return "\n".join(lines)
+
+
 def report(agg, top: int = 20, prefix: str = ""):
     rows = [(name, c, tot, self_us)
             for name, (c, tot, self_us) in agg.items()
@@ -84,12 +130,18 @@ def main(argv=None):
                     help="rows to print (by self-time)")
     ap.add_argument("--prefix", default="",
                     help="only spans whose name starts with this")
+    ap.add_argument("--collapsed", action="store_true",
+                    help="emit folded-stack lines (flamegraph.pl input) "
+                         "instead of the table")
     args = ap.parse_args(argv)
     events = load_events(args.trace)
     if not events:
         print("no complete ('ph': 'X') events in trace", file=sys.stderr)
         return 1
-    print(report(self_times(events), args.top, args.prefix))
+    if args.collapsed:
+        print(collapsed(collapsed_stacks(events), args.prefix))
+    else:
+        print(report(self_times(events), args.top, args.prefix))
     return 0
 
 
