@@ -225,3 +225,84 @@ def dump_crash_bundle(error: Optional[BaseException] = None,
     except Exception:
         _LOG.exception("failed to write crash bundle")
         return None
+
+
+AGGREGATE_SCHEMA = "bigdl_tpu.flight_aggregate.v1"
+
+
+def aggregate_bundles(directory: Optional[str] = None,
+                      out: Optional[str] = None) -> Optional[str]:
+    """Merge every per-process crash bundle under ``directory`` (default
+    :func:`bundle_dir`) into ONE rank-0 post-mortem artifact and return
+    its path. In a multi-host failure each process dumps its own bundle
+    into the (shared) flight dir; the elastic restarter calls this on
+    process 0 before resuming, so the operator triages a single file —
+    bundles sorted by (process_index, written_at), the newest error per
+    process surfaced in a ``summary`` header. Never raises; returns
+    None when there is nothing to aggregate. Each aggregate covers only
+    bundles NEWER than the previous aggregate (and aggregates of
+    aggregates are skipped): repeated elastic restarts on a shared
+    flight dir each get a post-mortem of THEIR failure, not an
+    ever-growing re-embedding of every failure before it."""
+    try:
+        d = directory or bundle_dir()
+        if not os.path.isdir(d):
+            return None
+        last_agg = 0.0  # watermark: newest existing aggregate
+        for name in os.listdir(d):
+            if name.startswith("flight_aggregate") and \
+                    name.endswith(".json"):
+                try:
+                    last_agg = max(last_agg, float(
+                        name.rsplit("_", 1)[1].split(".")[0]) / 1000.0)
+                except (IndexError, ValueError):
+                    pass
+        bundles = []
+        for name in sorted(os.listdir(d)):
+            if not (name.startswith("flight_") and name.endswith(".json")) \
+                    or name.startswith("flight_aggregate"):
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    b = json.load(f)
+            except Exception:
+                continue  # half-written by a dying peer — skip, don't die
+            if b.get("written_at", 0) <= last_agg:
+                continue  # already folded into an earlier post-mortem
+            if b.get("schema", "").startswith("bigdl_tpu.flight_bundle"):
+                b["bundle_file"] = name
+                bundles.append(b)
+        if not bundles:
+            return None
+        bundles.sort(key=lambda b: (b.get("env", {}).get("process_index", 0),
+                                    b.get("written_at", 0)))
+        summary = []
+        for b in bundles:
+            err = b.get("error") or {}
+            summary.append({
+                "process_index": b.get("env", {}).get("process_index"),
+                "pid": b.get("pid"),
+                "bundle_file": b.get("bundle_file"),
+                "error_type": err.get("type"),
+                "error_message": err.get("message"),
+                "context": b.get("context", {}),
+            })
+        now = time.time()
+        agg = {"schema": AGGREGATE_SCHEMA, "written_at": now,
+               "written_at_iso": datetime.datetime.fromtimestamp(
+                   now, datetime.timezone.utc).isoformat(),
+               "n_bundles": len(bundles), "summary": summary,
+               "bundles": bundles}
+        if out is None:
+            out = os.path.join(d, f"flight_aggregate_{int(now * 1000)}.json")
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_json_safe(agg), f, indent=1, default=str,
+                      allow_nan=False)
+        os.replace(tmp, out)
+        _LOG.warning("aggregated %d crash bundles into %s",
+                     len(bundles), out)
+        return out
+    except Exception:
+        _LOG.exception("failed to aggregate crash bundles")
+        return None

@@ -93,6 +93,31 @@ def emit(kind: str, **fields) -> Dict:
     return event
 
 
+class listen:
+    """Scoped health-event listener: ``with listen(fn):`` registers
+    ``fn`` with :data:`listeners` for the block and ALWAYS unregisters
+    on exit, so a finished consumer's hook never outlives it. For
+    observing events fired by other components (the watchdog thread's
+    ``health/stall``, a peer's ``health/straggler``) in tests and
+    external supervisors; the in-process remediation policy gets its
+    signals directly (the beacon's stall callback, the event lists
+    ``SeriesMonitor.observe`` returns), not through listeners."""
+
+    def __init__(self, fn: Callable[[Dict], None]):
+        self._fn = fn
+
+    def __enter__(self):
+        listeners.append(self._fn)
+        return self._fn
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            listeners.remove(self._fn)
+        except ValueError:
+            pass  # reset() cleared the registry mid-scope
+        return False
+
+
 # ---------------------------------------------------------------- watchdog
 
 class Beacon:
@@ -102,7 +127,7 @@ class Beacon:
     is fine: a torn read is at worst one check interval of slack)."""
 
     __slots__ = ("name", "deadline_s", "on_stall", "_last_pulse",
-                 "_pulses", "_stalled")
+                 "_pulses", "_stalled", "_rearmed")
 
     def __init__(self, name: str, deadline_s: float,
                  on_stall: Optional[Callable[["Beacon", float], None]] = None):
@@ -114,15 +139,31 @@ class Beacon:
         self._last_pulse = time.monotonic()
         self._pulses = 0
         self._stalled = False
+        self._rearmed = False
 
     def pulse(self):
         """Record progress (hot path — cheap and lock-free)."""
         self._last_pulse = time.monotonic()
         self._pulses += 1
-        if self._stalled:
-            self._stalled = False
+        if self._stalled or self._rearmed:
+            # close the episode: every health/stall (including re-armed
+            # re-probes) pairs with exactly one stall_recovered
+            self._stalled = self._rearmed = False
             emit("stall_recovered", component=self.name,
                  pulses=self._pulses)
+
+    def rearm(self):
+        """Reset the stall latch WITHOUT claiming progress: no pulse is
+        counted, but the age clock restarts so the NEXT silent deadline
+        emits a fresh ``health/stall`` (and re-runs ``on_stall``). For
+        stall handlers that classified an episode as transient and must
+        be called again if it persists — a wedged component will never
+        pulse its own latch clear, and the monitor skips latched
+        beacons. The episode stays OPEN: real progress later still
+        emits the paired ``stall_recovered``."""
+        self._last_pulse = time.monotonic()
+        self._stalled = False
+        self._rearmed = True
 
     @property
     def age_s(self) -> float:
@@ -165,6 +206,9 @@ class _NullBeacon:
     stalled = False
 
     def pulse(self):
+        return None
+
+    def rearm(self):
         return None
 
     def close(self):
@@ -213,6 +257,13 @@ class Watchdog:
     def beacons(self) -> List[Beacon]:
         with self._lock:
             return list(self._beacons)
+
+    def poke(self):
+        """Wake the monitor thread so it recomputes its check interval
+        now — callers that TIGHTEN a live beacon's deadline (the step
+        loop dropping its startup compile grace) use this so detection
+        latency follows the new deadline, not the old poll cadence."""
+        self._wake.set()
 
     def reset(self):
         """Drop every beacon (tests); the monitor thread then exits on
@@ -298,8 +349,10 @@ class SeriesMonitor:
       look like this.
     * **Plateau**: no relative improvement of at least ``plateau_rel``
       over the best value for ``plateau_window`` steps fires
-      ``health/plateau`` once (re-armed by a new best) — the signal an
-      LR schedule or an early-stop policy wants.
+      ``health/plateau`` — recurring, once per FULL stale window (a
+      flat run keeps reporting every ``plateau_window`` steps; a new
+      best resets the clock) — the signal an LR schedule or an
+      early-stop/plateau-counting policy wants.
 
     Running mean/variance are maintained incrementally (O(1) per
     observation) over a bounded window, so a million-step run costs the
@@ -325,7 +378,7 @@ class SeriesMonitor:
         self._streak = 0
         self._best = math.inf
         self._best_step: Optional[int] = None
-        self._plateau_fired = False
+        self._plateau_step = None  # step of the last plateau event
 
     def observe(self, value, step: int) -> List[Dict]:
         """Feed one already-resolved host scalar; returns the health
@@ -354,14 +407,21 @@ class SeriesMonitor:
                 or value < self._best - abs(self._best) * self.plateau_rel):
             self._best = value
             self._best_step = step
-            self._plateau_fired = False
-        elif (self._best_step is not None and not self._plateau_fired
-                and step - self._best_step >= self.plateau_window):
-            self._plateau_fired = True
-            events.append(emit(
-                "plateau", monitor=self.name, step=step,
-                best=self._best, best_step=self._best_step,
-                stale_steps=step - self._best_step))
+            self._plateau_step = None
+        else:
+            # recurring, one event per FULL stale window (never per
+            # step): consumers that count plateaus — repeated LR cuts,
+            # RemediationPolicy.early_stop_plateaus — need a flat run to
+            # keep reporting, and a one-shot detector could never reach
+            # a count of 2 without an improvement in between
+            anchor = (self._plateau_step if self._plateau_step is not None
+                      else self._best_step)
+            if step - anchor >= self.plateau_window:
+                self._plateau_step = step
+                events.append(emit(
+                    "plateau", monitor=self.name, step=step,
+                    best=self._best, best_step=self._best_step,
+                    stale_steps=step - self._best_step))
         if n == self._vals.maxlen:
             old = self._vals[0]
             self._sum -= old

@@ -256,6 +256,19 @@ class Plateau(LearningRateSchedule):
                     self.cooldown_counter = self.cooldown
         return max(lr * self.multiplier, self.min_lr)
 
+    def force_reduction(self) -> float:
+        """Apply one factor reduction NOW, regardless of the patience
+        counter — the hook anomaly-driven control uses when the health
+        layer's ``health/plateau`` detector (which watches the per-step
+        loss the loop already syncs, not the per-epoch validation score
+        this schedule polls) fires first. Resets the patience window
+        and enters cooldown exactly as a patience-driven reduction
+        would; returns the new multiplier."""
+        self.multiplier *= self.factor
+        self.wait = 0
+        self.cooldown_counter = self.cooldown
+        return self.multiplier
+
 
 class EpochDecayWithWarmUp(LearningRateSchedule):
     """Linear warmup then epoch decay (SGD.scala:671)."""

@@ -26,6 +26,7 @@ Execution model (TPU-first):
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import queue
@@ -42,7 +43,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import observability as obs
 from ..observability import flight as _flight
 from ..observability import health as _health
-from .optim_method import OptimMethod, SGD
+from ..parallel.failure import (FaultPolicy, HeartbeatLost, TrainingHalted,
+                                PERMANENT, TRANSIENT, classify_failure,
+                                probe_mesh, _run_with_timeout)
+from .optim_method import OptimMethod, Plateau, SGD
 from .regularizer import regularizer_tree, regularization_loss
 from .trigger import Trigger, max_epoch as _max_epoch
 from ..dataset.dataset import AbstractDataSet, ShardedDataSet, DataSet
@@ -53,18 +57,74 @@ from ..utils import engine
 from ..utils.table import Table
 
 _tmap = jax.tree_util.tree_map
+_LOG = logging.getLogger(__name__)
+
+def _read_umask():
+    """The process umask, read WITHOUT the os.umask(0)/restore dance
+    when possible — that flip is process-wide, and another thread
+    creating a file inside the window (serving batcher, a lazy import
+    off a worker thread) would get world-writable modes. Linux exposes
+    it race-free in /proc; elsewhere fall back to the racy read once
+    here at import."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("Umask:"):
+                    return int(line.split()[1], 8)
+    except (OSError, ValueError, IndexError):
+        pass
+    um = os.umask(0)
+    os.umask(um)
+    return um
+
+
+# _atomic_pickle restores umask-based modes on its mkstemp tmps, which
+# are born 0600
+_UMASK = _read_umask()
 
 
 def _atomic_pickle(path, payload):
-    """tmp + fsync + rename: a crash mid-write (including OS crash/power
-    loss — hence the fsync before the rename) must never tear the
-    checkpoint the nan_policy='resume' path depends on."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(payload, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    """Crash-consistent write: unique tmp + fsync + atomic rename +
+    directory fsync. A kill at ANY point — mid-dump, post-dump
+    pre-rename, post-rename pre-dir-sync under power loss — leaves
+    either the previous intact file or the complete new one, never a
+    truncated 'latest' (the file every recovery path — nan resume,
+    remediation halt, elastic restart — trusts blindly). The tmp name
+    is unique per write (mkstemp), so a writer killed mid-dump can
+    never have its half-written tmp renamed over the target by a later
+    writer reusing the same tmp path, and concurrent writers (two
+    optimizers sharing a checkpoint dir) never interleave into one
+    file. Failed writes remove their tmp — no litter accumulates."""
+    import tempfile
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            # mkstemp creates 0600 and os.replace keeps the tmp's mode;
+            # a checkpoint must stay as readable as a plain open() would
+            # have made it (eval jobs / backup agents under another uid)
+            os.fchmod(f.fileno(), 0o666 & ~_UMASK)
+            pickle.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    # fsync the DIRECTORY: the rename itself must survive power loss,
+    # or recovery could see the pre-checkpoint directory state
+    try:
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # platforms without dir fsync keep file-level durability
 
 
 class _AsyncCheckpointWriter:
@@ -106,22 +166,44 @@ class _AsyncCheckpointWriter:
         if obs.enabled():
             obs.gauge("checkpoint/queue_depth").set(self._q.qsize())
 
-    def flush(self):
+    def flush(self, timeout=None):
         if self._thread is not None:
-            self._q.join()
+            if timeout is None:
+                self._q.join()
+            else:
+                deadline = time.monotonic() + timeout
+                while self._q.unfinished_tasks and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.05)
+                if self._q.unfinished_tasks:
+                    raise TimeoutError(
+                        f"{self._q.unfinished_tasks} async checkpoint "
+                        f"write(s) still pending after {timeout}s")
         if self._err is not None:
             err, self._err = self._err, None
             raise RuntimeError(
                 f"async checkpoint write failed: {err}") from err
 
-    def close(self):
+    def close(self, timeout=None):
         """Flush, then stop the writer thread (optimize() calls this so
-        no daemon thread outlives the run)."""
-        self.flush()
-        if self._thread is not None:
-            self._q.put(None)
-            self._thread.join(timeout=30)
-            self._thread = None
+        no daemon thread outlives the run). ``timeout`` bounds the whole
+        attempt for halt paths: a writer wedged on hung storage (dead
+        NFS mid-remediation) is ABANDONED to its daemon fate instead of
+        wedging the exit — the remediation checkpoint already landed
+        synchronously, and an elastic resume prefers the halt's own
+        checkpoint path over mtime, so a late-landing stale write
+        cannot be silently resumed."""
+        try:
+            self.flush(timeout)
+        finally:
+            if self._thread is not None:
+                try:
+                    self._q.put_nowait(None)
+                except queue.Full:
+                    pass  # wedged writer never drains: abandon it
+                self._thread.join(timeout=30 if timeout is None
+                                  else timeout)
+                self._thread = None
 
 
 class Metrics:
@@ -229,6 +311,92 @@ def _clip_grads(grads, clip_const=None, clip_norm=None):
     return grads
 
 
+class RemediationPolicy:
+    """Tier-1 observe→act configuration: what the optimizer DOES when
+    the health layer (PR 5) sees trouble, instead of only recording it.
+
+    * **Stall remediation** — when the step loop's watchdog beacon
+      stalls, the policy probes the mesh (``probe_mesh``, bounded by
+      ``probe_timeout_s``) to classify transient vs. dead. A dead mesh
+      — or any stall when ``halt_on_stall`` is set — checkpoints the
+      last resolved training state from the watchdog thread (the loop
+      itself is wedged), dumps a flight bundle, and requests a
+      :class:`~bigdl_tpu.parallel.failure.TrainingHalted` exit: the run
+      leaves artifacts instead of hanging forever. ``exit_process``
+      additionally ``os._exit(86)`` s after the artifacts land, for
+      loops wedged beyond rescue in a dead collective. The checkpoint's
+      device→host fetch is itself bounded by
+      ``halt_artifact_timeout_s`` (it has no deadline of its own, and a
+      dead mesh would otherwise wedge the watchdog thread doing the
+      remediating); on expiry the halt lands bundle-only.
+    * **Heartbeat membership** — with a ``heartbeat``
+      (:class:`~bigdl_tpu.parallel.failure.Heartbeat`), the loop beats
+      every ``heartbeat_every`` steps with ``heartbeat_timeout_s``; a
+      lost or stale exchange checkpoints-and-halts with the stale peer
+      ids recorded as ``lost_processes`` — the membership signal the
+      elastic restarter reshapes the mesh from.
+    * **Anomaly-driven control** — ``health/plateau`` events (from the
+      losses the sync policy already resolves — zero new readbacks)
+      optionally drive the LR: a :class:`Plateau` schedule gets
+      :meth:`~Plateau.force_reduction`, any other schedule a
+      ``plateau_factor`` multiplier (``health/lr_reduced`` event);
+      ``early_stop_plateaus`` ends the run cleanly after N plateaus,
+      and ``max_spikes`` checkpoint-and-halts a diverging run after N
+      ``health/loss_spike`` events.
+    * **Stragglers** — with a ``straggler_monitor``, per-step times are
+      recorded and a report runs every ``straggler_every`` steps;
+      persistent stragglers fire ``health/straggler`` (see
+      :class:`~bigdl_tpu.parallel.failure.StragglerMonitor`).
+
+    Stall/probe remediation needs observability enabled (the watchdog
+    is the trigger); heartbeat, anomaly control and stragglers work
+    either way.
+    """
+
+    def __init__(self, halt_on_stall: bool = False,
+                 probe_timeout_s: float = 30.0,
+                 exit_process: bool = False,
+                 halt_artifact_timeout_s: float = 120.0,
+                 heartbeat=None, heartbeat_every: int = 0,
+                 heartbeat_timeout_s: float = 60.0,
+                 plateau_lr: bool = False, plateau_factor: float = 0.1,
+                 min_lr_scale: float = 1e-4,
+                 early_stop_plateaus: Optional[int] = None,
+                 max_spikes: Optional[int] = None,
+                 straggler_monitor=None, straggler_every: int = 0):
+        if heartbeat is not None and heartbeat_every < 1:
+            raise ValueError("heartbeat needs heartbeat_every >= 1 "
+                             f"(got {heartbeat_every})")
+        if straggler_monitor is not None and straggler_every < 1:
+            raise ValueError("straggler_monitor needs straggler_every >= 1 "
+                             f"(got {straggler_every})")
+        self.halt_on_stall = halt_on_stall
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.exit_process = exit_process
+        self.halt_artifact_timeout_s = float(halt_artifact_timeout_s)
+        self.heartbeat = heartbeat
+        self.heartbeat_every = int(heartbeat_every)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.plateau_lr = plateau_lr
+        self.plateau_factor = float(plateau_factor)
+        self.min_lr_scale = float(min_lr_scale)
+        self.early_stop_plateaus = early_stop_plateaus
+        self.max_spikes = max_spikes
+        self.straggler_monitor = straggler_monitor
+        self.straggler_every = int(straggler_every)
+        # per-run bookkeeping (reset by Optimizer.optimize())
+        self.plateaus = 0
+        self.spikes = 0
+        self._last_beat_neval = 0
+        self._last_straggler_neval = 0
+
+    def reset_run_state(self):
+        self.plateaus = 0
+        self.spikes = 0
+        self._last_beat_neval = 0
+        self._last_straggler_neval = 0
+
+
 class BaseOptimizer:
     def __init__(self, model: Module, training_set, criterion: Criterion,
                  optim_method: Optional[OptimMethod] = None,
@@ -266,11 +434,22 @@ class BaseOptimizer:
         # stall watchdog deadline/callback, anomaly-detector config
         # (None disables; a dict overrides SeriesMonitor defaults)
         self.stall_deadline_s = None   # None -> BIGDL_TPU_STALL_S default
+        self.stall_startup_grace_s = None  # None -> max(deadline, default)
+        self._stall_grace_pending = False
         self.on_stall = None
         self.anomaly_config: Optional[dict] = {}
         self._step_beacon = _health.NULL_BEACON
         self._loss_monitor = None
         self._profiler = None
+        # self-healing (PR 6): Tier-1 observe→act policy, Tier-2
+        # dispatch retry budget, and the cross-thread halt/live-state
+        # channel the watchdog-thread remediation writes into
+        self.remediation: Optional[RemediationPolicy] = None
+        self.fault_policy: Optional[FaultPolicy] = None
+        self._halt_requested: Optional[TrainingHalted] = None
+        self._live_state = None        # (params, opt_state, mstate)
+        self._remediation_lr_scale = 1.0
+        self._remediating = False      # one stall remediation in flight
 
     # -- reference API surface ------------------------------------------
     def set_model(self, model):
@@ -460,19 +639,81 @@ class BaseOptimizer:
             return int(self.sync_policy.split(":", 1)[1])
         return None
 
-    def set_stall_deadline(self, seconds: float, on_stall=None):
+    def set_stall_deadline(self, seconds: float, on_stall=None,
+                           startup_grace_s=None):
         """Arm the stall watchdog for this optimizer's loops: the step
         loop and its batch stager pulse progress beacons, and a beacon
         quiet for ``seconds`` fires a structured ``health/stall`` event
         (plus ``on_stall(beacon, age_s)`` when given) instead of the run
         silently hanging — the remote-TPU 'no output' failure mode.
         Active only while observability is enabled; the default deadline
-        without this call is ``BIGDL_TPU_STALL_S`` (600s)."""
+        without this call is ``BIGDL_TPU_STALL_S`` (600s).
+
+        ``startup_grace_s``: the deadline in force until the FIRST
+        dispatch completes. The first step blocks for the whole XLA
+        compile — minutes on a real pod — which is silence a
+        steady-state deadline would misread as a stall (and, with
+        ``RemediationPolicy(halt_on_stall=True)``, kill a healthy run
+        before it trained a step). Defaults to
+        ``max(seconds, BIGDL_TPU_STALL_S)``; the step loop tightens the
+        beacon to ``seconds`` the moment the first step lands."""
         seconds = float(seconds)
         if seconds <= 0:
             raise ValueError(f"stall deadline must be > 0, got {seconds}")
+        if startup_grace_s is not None and float(startup_grace_s) < seconds:
+            raise ValueError(
+                f"startup_grace_s ({startup_grace_s}) must be >= the "
+                f"steady-state deadline ({seconds})")
         self.stall_deadline_s = seconds
+        self.stall_startup_grace_s = None if startup_grace_s is None \
+            else float(startup_grace_s)
         self.on_stall = on_stall
+        return self
+
+    def set_remediation(self, policy: Optional[RemediationPolicy]):
+        """Arm the Tier-1 observe→act loop (see
+        :class:`RemediationPolicy`): stalls and heartbeat loss
+        checkpoint-and-exit with a flight bundle instead of hanging,
+        plateau/spike anomalies optionally drive the LR schedule and
+        early-stop, straggler reports run on a cadence. ``None``
+        disarms."""
+        if policy is not None and not isinstance(policy, RemediationPolicy):
+            raise TypeError(f"expected RemediationPolicy, got {policy!r}")
+        self.remediation = policy
+        return self
+
+    def set_fault_policy(self, policy: Optional[FaultPolicy]):
+        """Arm Tier-2 dispatch retry (see
+        :class:`~bigdl_tpu.parallel.failure.FaultPolicy`): every
+        dispatch snapshots the resolved host-side training state first,
+        and a TRANSIENT device/collective failure replays the in-flight
+        step — under superstep fusion, the whole K-step group — from
+        that snapshot after an exponential backoff, so a dropped tunnel
+        packet costs one step's latency instead of the run. The replay
+        reuses the step's exact batches, lr vector and rng keys, so a
+        retried run is bitwise-identical to a fault-free one. Permanent
+        failures raise immediately (Tier 3 owns those). The per-
+        dispatch snapshot is a device→host fetch of params/opt-state —
+        meaningful overhead, so arm this for flaky transports, not by
+        default. ``None`` disarms.
+
+        SINGLE-CONTROLLER ONLY: the replay re-enters restore placement
+        and the compiled step's collectives on THIS process alone. In a
+        multi-controller run a failure one process sees and its peers
+        don't would have only that process replaying — collectives the
+        others never join, wedging the whole mesh until the watchdog
+        kills it. Multi-controller transients belong to Tier 1 + Tier 3
+        (heartbeat halt, checkpoint, elastic restart)."""
+        if policy is not None and not isinstance(policy, FaultPolicy):
+            raise TypeError(f"expected FaultPolicy, got {policy!r}")
+        if policy is not None and jax.process_count() > 1:
+            _LOG.warning(
+                "FaultPolicy replay is single-controller: in this "
+                "%d-process run a one-sided transient replay would "
+                "desynchronize the mesh's collectives — rely on "
+                "Tier 1 heartbeat remediation + elastic restart for "
+                "cross-process faults", jax.process_count())
+        self.fault_policy = policy
         return self
 
     def set_anomaly_detection(self, enabled: bool = True, **config):
@@ -494,12 +735,10 @@ class BaseOptimizer:
         return self
 
     def _latest_checkpoint(self):
-        if not self.checkpoint_path or not os.path.isdir(self.checkpoint_path):
-            return None
-        snaps = [os.path.join(self.checkpoint_path, f)
-                 for f in os.listdir(self.checkpoint_path)
-                 if f.startswith("checkpoint") and f.endswith(".bigdl")]
-        return max(snaps, key=os.path.getmtime) if snaps else None
+        # one trust anchor for "the latest checkpoint" across every
+        # recovery path: nan-resume here, elastic restart in the runner
+        from ..parallel.elastic import find_latest_checkpoint
+        return find_latest_checkpoint(self.checkpoint_path)
 
     # -- internals -------------------------------------------------------
     def _as_dataset(self, ds):
@@ -673,37 +912,70 @@ class BaseOptimizer:
             else:
                 self.metrics.add("nan_skips", 1.0)
 
-    def _checkpoint(self, params, opt_state, mstate, state):
-        tag = "" if self.checkpoint_overwrite else \
-            f"_e{state['epoch']}_i{state['neval']}"
+    def _checkpoint_payload(self, params, opt_state, mstate, state):
+        """Host snapshot of the full training state. The optimizer state
+        rides in CANONICAL (mesh-shape-agnostic) form — for ZeRO-1 the
+        flat sharded vectors are unflattened back to params-shaped trees
+        (``AllReduceParameter.state_to_canonical``) — so the same
+        checkpoint restores under any device count or parameter mode:
+        the contract elastic restart (Tier 3) depends on."""
+        return {
+            **self._host_step_state(params, opt_state, mstate),
+            # from the CALLER's state, not self.optim_method.state: the
+            # watchdog-thread halt path passes a snapshot taken next to
+            # its _live_state read, and re-reading the live dict here
+            # could pair step-N params with step-N+1 counters if the
+            # loop unwedges mid-halt (in the loop paths ``state`` IS
+            # optim_method.state, so this is the same dict)
+            "optim_host_state": dict(state),
+            "epoch": state["epoch"], "neval": state["neval"],
+        }
+
+    def _host_step_state(self, params, opt_state, mstate):
+        """Host copies of the in-step trees in the checkpoint's
+        CANONICAL (mesh-shape-agnostic) form — the single definition the
+        checkpoint payload and the Tier-2 replay snapshot share, and the
+        exact shape :meth:`_restore_step_state` parses."""
+        return {
+            "params": _tmap(np.asarray, self._params_for_checkpoint(params)),
+            "opt_state": self._opt_state_for_checkpoint(opt_state),
+            "model_state": self._to_host(mstate),
+        }
+
+    def _checkpoint(self, params, opt_state, mstate, state, tag=None,
+                    force_sync=False):
+        """Write one checkpoint; returns its path. ``tag`` overrides the
+        name suffix (remediation checkpoints are tagged so a post-mortem
+        can tell a scheduled snapshot from a halt artifact — both match
+        the ``checkpoint*.bigdl`` pattern every restore path globs).
+        ``force_sync`` bypasses the async writer: a halt must not race
+        its own exit."""
+        if tag is None:
+            tag = "" if self.checkpoint_overwrite else \
+                f"_e{state['epoch']}_i{state['neval']}"
         path = os.path.join(self.checkpoint_path, f"checkpoint{tag}.bigdl")
         # the device→host fetch is the only synchronous part; serialization
         # and file IO can ride the writer thread (async_write)
-        payload = {
-            "params": _tmap(np.asarray, self._params_for_checkpoint(params)),
-            "opt_state": self._to_host(opt_state),
-            "model_state": self._to_host(mstate),
-            "optim_host_state": dict(self.optim_method.state),
-            "epoch": state["epoch"], "neval": state["neval"],
-        }
-        with obs.span("step/checkpoint_submit",
-                      async_write=self.checkpoint_async):
-            if self.checkpoint_async:
+        payload = self._checkpoint_payload(params, opt_state, mstate, state)
+        async_write = self.checkpoint_async and not force_sync
+        with obs.span("step/checkpoint_submit", async_write=async_write):
+            if async_write:
                 self._ckpt_writer.submit(path, payload)
             else:
                 _atomic_pickle(path, payload)
         if obs.enabled():
             _flight.record("checkpoint", path=path, neval=state["neval"],
                            epoch=state["epoch"],
-                           async_write=self.checkpoint_async)
+                           async_write=async_write)
+        return path
 
     def wait_for_checkpoints(self):
         """Block until every async checkpoint write has landed (re-raising
         a writer failure). No-op for synchronous checkpoints."""
         self._ckpt_writer.flush()
 
-    def _close_checkpoints(self):
-        self._ckpt_writer.close()
+    def _close_checkpoints(self, timeout=None):
+        self._ckpt_writer.close(timeout=timeout)
 
     def load_checkpoint(self, path):
         """Resume training state from a snapshot (parity:
@@ -753,16 +1025,42 @@ class BaseOptimizer:
         backend supports them, and an unhandled failure (including the
         NaN-policy aborts) dumps a flight-recorder crash bundle before
         re-raising — ``tools/flight_report.py`` renders it."""
+        self._halt_requested = None
+        self._live_state = None
+        self._remediation_lr_scale = 1.0
+        self._remediating = False
+        if self.remediation is not None:
+            self.remediation.reset_run_state()
+        # with a remediation policy the stall callback is the Tier-1
+        # handler (which chains any user on_stall); without one the
+        # user callback rides the beacon directly as before
+        on_stall = self._stall_handler if self.remediation is not None \
+            else self.on_stall
+        # the beacon opens at the startup grace (first dispatch = whole
+        # XLA compile, legitimately silent for minutes) and is tightened
+        # to the steady-state deadline when the first step lands
+        deadline = self.stall_deadline_s \
+            if self.stall_deadline_s is not None \
+            else _health.default_stall_deadline()
+        grace = self.stall_startup_grace_s \
+            if self.stall_startup_grace_s is not None \
+            else max(deadline, _health.default_stall_deadline())
         self._step_beacon = _health.beacon(
-            "optim/step", deadline_s=self.stall_deadline_s,
-            on_stall=self.on_stall)
+            "optim/step", deadline_s=max(grace, deadline),
+            on_stall=on_stall)
+        self._stall_grace_pending = (
+            grace > deadline
+            and self._step_beacon is not _health.NULL_BEACON)
         self._profiler = _health.profiler_window_from_env()
         self._loss_monitor = None
+        if self.anomaly_config is not None and \
+                (obs.enabled() or self.remediation is not None):
+            # remediation's anomaly-driven control consumes the monitor's
+            # returned events, so it runs even with observability off
+            self._loss_monitor = _health.SeriesMonitor(
+                "loss", **self.anomaly_config)
         if obs.enabled():
             _health.ensure_memory_telemetry()
-            if self.anomaly_config is not None:
-                self._loss_monitor = _health.SeriesMonitor(
-                    "loss", **self.anomaly_config)
             st = self.optim_method.state
             _flight.record("train/start", epoch=st.get("epoch"),
                            neval=st.get("neval"), seed=engine.get_seed(),
@@ -771,6 +1069,8 @@ class BaseOptimizer:
                            sync_policy=self.sync_policy)
         try:
             return self._optimize_impl()
+        except TrainingHalted:
+            raise  # Tier-1 already landed its checkpoint + bundle
         except BaseException as e:
             if obs.enabled():
                 st = self.optim_method.state
@@ -786,9 +1086,21 @@ class BaseOptimizer:
         finally:
             self._step_beacon.close()
             self._step_beacon = _health.NULL_BEACON
+            self._live_state = None
             if self._profiler is not None:
                 self._profiler.close()
                 self._profiler = None
+            try:
+                # idempotent (the success path already closed it,
+                # UNBOUNDED — durability on a clean exit): a
+                # TrainingHalted/crash exit must not leak the async
+                # writer thread or let its queued stale writes keep
+                # landing under the ElasticRunner's NEXT attempt, and
+                # must not block forever on storage wedged badly enough
+                # to be part of why we're halting
+                self._close_checkpoints(timeout=30.0)
+            except Exception:
+                _LOG.exception("async checkpoint writer close failed")
 
     def _optimize_impl(self) -> Module:
         self.model.ensure_initialized()
@@ -868,6 +1180,333 @@ class BaseOptimizer:
         self._close_checkpoints()  # land async writes, stop the writer
         return self.model
 
+    # -- self-healing tiers ---------------------------------------------
+    def _dispatch_guarded(self, params, opt_state, mstate, *args):
+        """The dispatch path, wrapped by the Tier-2 FaultPolicy when
+        armed: snapshot the resolved host-side state BEFORE the call
+        (the compiled step donates its state buffers — after a failed
+        dispatch the device arrays may already be invalidated, so the
+        replay must re-place from host), then on a retryable failure
+        back off, restore, and replay the same step (or whole superstep
+        group: same batches, same lr vector, same rng keys — bitwise
+        the trajectory a fault-free run takes). Non-retryable failures
+        propagate untouched."""
+        fp = self.fault_policy
+        if fp is None:
+            return self._step_fn(params, opt_state, mstate, *args)
+        snap = self._host_step_state(params, opt_state, mstate)
+        if obs.enabled():
+            obs.counter("optim/fault_snapshots").inc()
+        while True:
+            try:
+                out = self._step_fn(params, opt_state, mstate, *args)
+                # async dispatch defers device/collective failures to
+                # the first readback, which happens at the loss sync far
+                # OUTSIDE this guard — resolve here so a transient
+                # surfaces where the retry can catch it (the armed path
+                # is already serialized by the per-dispatch snapshot)
+                jax.block_until_ready(out)  # sync-ok: Tier-2 fault guard
+                fp.record_success()
+                return out
+            except FloatingPointError:
+                raise  # NaN policy owns numeric failures, not the retry tier
+            except Exception as e:
+                cls = classify_failure(e)
+                if not fp.should_retry(cls):
+                    if obs.enabled():
+                        _health.emit("fault_exhausted", failure_class=cls,
+                                     error=f"{type(e).__name__}: {e}",
+                                     consecutive=fp.consecutive)
+                    raise
+                fp.record_failure()
+                delay = fp.backoff_s()
+                # mirrors into the registry as optim/fault_retries; the
+                # health/fault_retry counter rides the emit below
+                self.metrics.add("fault_retries", 1.0)
+                if obs.enabled():
+                    _health.emit("fault_retry", failure_class=cls,
+                                 error=f"{type(e).__name__}: {e}",
+                                 attempt=fp.consecutive,
+                                 backoff_s=round(delay, 3))
+                if delay > 0:
+                    fp.sleep(delay)
+                params, opt_state, mstate = self._restore_step_state(snap)
+
+    def _tighten_stall_deadline(self):
+        """Drop the beacon's startup compile grace down to the
+        steady-state stall deadline — called once the first dispatch
+        completes (one bool check per step after that)."""
+        if not self._stall_grace_pending:
+            return
+        self._stall_grace_pending = False
+        # pulse BEFORE lowering the deadline: the beacon's age still
+        # spans the whole compile, which would trip the tight deadline
+        # instantly; the completed first dispatch IS the progress signal
+        self._step_beacon.pulse()
+        self._step_beacon.deadline_s = self.stall_deadline_s
+        _health.watchdog().poke()  # recompute the check interval now
+
+    def _check_halt(self):
+        """Surface a halt the watchdog-thread remediation requested
+        while this loop was blocked (checked at every iteration top and
+        after every dispatch)."""
+        if self._halt_requested is not None:
+            ex, self._halt_requested = self._halt_requested, None
+            raise ex
+
+    def _try_halt_checkpoint(self, state, live):
+        """Drain queued async writes, then land the synchronous
+        remediation checkpoint from ``live`` ``(params, opt_state,
+        mstate)``. Best-effort: any failure logs and returns None — it
+        must not mask the halt."""
+        try:
+            self.wait_for_checkpoints()
+        except Exception:
+            _LOG.exception("async checkpoint drain failed during remediation")
+        if live is None:
+            return None
+        try:
+            p, o, m = live
+            return self._checkpoint(
+                p, o, m, state, force_sync=True,
+                tag=f"_remediation_e{state.get('epoch', 0)}"
+                    f"_i{state.get('neval', 0)}")
+        except Exception:
+            _LOG.exception(
+                "remediation checkpoint failed (halting anyway; "
+                "a wedged dispatch may have donated the live "
+                "buffers)")
+            return None
+
+    def _land_halt_checkpoint(self, state, live, timeout_s=None):
+        """Checkpoint step of the halt landing. ``timeout_s`` bounds the
+        attempt on a disposable daemon worker: the device→host fetch
+        inside has no deadline of its own, and on a DEAD mesh it blocks
+        forever — which must never wedge the single watchdog monitor
+        thread stall remediation runs on (``exit_process`` would never
+        fire and every other beacon would go unmonitored). On expiry
+        the worker is abandoned and the halt proceeds without a
+        checkpoint (the flight bundle and ``TrainingHalted`` are pure
+        host-side work and still land)."""
+        if not self.checkpoint_path:
+            return None
+        if timeout_s is None:
+            return self._try_halt_checkpoint(state, live)
+        res = _run_with_timeout(
+            lambda: self._try_halt_checkpoint(state, live), timeout_s)
+        if res.get("timeout"):
+            _LOG.error(
+                "remediation checkpoint did not land within %.1fs "
+                "(device fetch wedged on a dead mesh?); halting "
+                "without one", timeout_s)
+            return None
+        return res.get("value")
+
+    def _land_halt_artifacts(self, cause, state, live, error=None,
+                             failure_class=PERMANENT, lost_processes=(),
+                             ckpt_timeout_s=None, **extra):
+        """Shared Tier-1 artifact landing — the loop-side :meth:`_halt`
+        and the watchdog-thread :meth:`_stall_handler` must stay in
+        lockstep, so there is exactly one copy: drain in-flight async
+        checkpoint writes FIRST (a queued pre-halt write landing after
+        the remediation snapshot would out-mtime it and
+        ``find_latest_checkpoint`` would silently resume stale state),
+        land the synchronous remediation checkpoint when the ``live``
+        ``(params, opt_state, mstate)`` handles are available (bounded
+        by ``ckpt_timeout_s`` when the caller cannot afford to block —
+        see :meth:`_land_halt_checkpoint`), dump the flight bundle,
+        emit ``health/remediation``, and return the
+        :class:`TrainingHalted` for the caller to raise (step loop) or
+        queue (watchdog thread). Every artifact is best-effort — a
+        failure must not mask the halt."""
+        ckpt = self._land_halt_checkpoint(state, live,
+                                          timeout_s=ckpt_timeout_s)
+        bundle = _flight.dump_crash_bundle(error=error, context={
+            "component": "optimizer/remediation", "cause": cause,
+            "failure_class": failure_class,
+            "epoch": state.get("epoch"), "neval": state.get("neval"),
+            "checkpoint": ckpt,
+            "lost_processes": list(lost_processes), **extra})
+        _health.emit("remediation", cause=cause,
+                     failure_class=failure_class, checkpoint=ckpt,
+                     bundle=bundle, neval=state.get("neval"),
+                     lost_processes=list(lost_processes), **extra)
+        return TrainingHalted(
+            cause=cause, failure_class=failure_class, checkpoint_path=ckpt,
+            bundle_path=bundle, epoch=state.get("epoch"),
+            neval=state.get("neval"), lost_processes=lost_processes)
+
+    def _halt(self, cause, state, params, opt_state, mstate, error=None,
+              failure_class=PERMANENT, lost_processes=()):
+        """Tier-1 checkpoint-and-exit from the step loop itself: land
+        the halt artifacts and raise the :class:`TrainingHalted` they
+        describe. The checkpoint fetch is bounded just like the
+        watchdog path's: a heartbeat-loss halt is often remediating a
+        mesh with a DEAD peer, and an unbounded device→host fetch of
+        state sharded across it would wedge the run inside its own
+        remediation."""
+        pol = self.remediation
+        raise self._land_halt_artifacts(
+            cause, state, (params, opt_state, mstate), error=error,
+            failure_class=failure_class, lost_processes=lost_processes,
+            ckpt_timeout_s=pol.halt_artifact_timeout_s
+            if pol is not None else None) from error
+
+    def _stall_handler(self, beacon, age_s):
+        """Watchdog-fired stall remediation entry: run the user's
+        ``on_stall`` inline (cheap, PR-5 contract), then hand the
+        classify-and-land work to a disposable side thread — the probe
+        (``probe_timeout_s``) plus the bounded halt checkpoint
+        (``halt_artifact_timeout_s``) can block for minutes, and the
+        SINGLE watchdog monitor thread must keep checking every other
+        beacon (serving batcher, stager, heartbeat prober) meanwhile.
+        The beacon stays latched until the side thread's verdict
+        (re-arm or halt), so one episode spawns one remediation."""
+        if self.on_stall is not None:
+            try:
+                self.on_stall(beacon, age_s)
+            except Exception:
+                _LOG.exception("on_stall failed")
+        pol = self.remediation
+        if pol is None or self._halt_requested is not None \
+                or self._remediating:
+            return
+        self._remediating = True
+        threading.Thread(target=self._remediate_stall,
+                         args=(beacon, age_s),
+                         name="bigdl-stall-remediation",
+                         daemon=True).start()
+
+    def _remediate_stall(self, beacon, age_s):
+        """Side-thread body of stall remediation: probe the mesh to
+        classify the stall, and for a dead mesh (or ``halt_on_stall``)
+        land the halt artifacts — the step loop is the thing that
+        stopped, so it cannot save itself. The checkpoint comes from
+        ``_live_state`` (the handles of the last COMPLETED dispatch —
+        consistent by construction; best-effort if the wedged dispatch
+        already donated them), then the halt is queued for the loop to
+        raise if it ever unwedges; ``exit_process`` force-exits for
+        loops that never will."""
+        try:
+            pol = self.remediation
+            cls, err = TRANSIENT, None
+            mesh = getattr(self, "mesh", None)
+            if mesh is not None and pol.probe_timeout_s > 0:
+                res = probe_mesh(mesh, timeout_s=pol.probe_timeout_s)
+                if not res.ok:
+                    cls = PERMANENT
+                    err = RuntimeError(
+                        f"mesh probe failed after {age_s:.1f}s stall of "
+                        f"{beacon.name}: {res.error}")
+            if cls != PERMANENT and not pol.halt_on_stall:
+                # transient verdict: the watchdog already paged — but a
+                # wedged loop will never pulse the stall latch clear
+                # itself, and the monitor skips latched beacons, so
+                # re-arm the deadline clock: a mesh that dies LATER in
+                # the same stall episode gets probed (and halted) again
+                # instead of hanging the run with remediation armed
+                beacon.rearm()
+                return
+            # snapshot: if the loop unwedges mid-handler, a live state
+            # dict would shear (tag, payload and exception each reading
+            # a different neval)
+            state = dict(self.optim_method.state)
+            self._halt_requested = self._land_halt_artifacts(
+                "stall", state, self._live_state, error=err,
+                failure_class=cls, stalled_component=beacon.name,
+                age_s=round(age_s, 3),
+                ckpt_timeout_s=pol.halt_artifact_timeout_s)
+            if pol.exit_process:
+                os._exit(86)  # artifacts are on disk; the loop never is
+        except Exception:
+            _LOG.exception("stall remediation failed")
+        finally:
+            self._remediating = False
+
+    def _apply_anomaly_events(self, pol, state, events):
+        """Anomaly-driven control: act on the health events the loss
+        monitor fired for THIS iteration's resolved losses. Returns
+        True when the run should end cleanly (plateau early-stop)."""
+        for ev in events:
+            kind = ev.get("kind", "")
+            if kind == "health/plateau":
+                pol.plateaus += 1
+                if pol.plateau_lr:
+                    self._reduce_lr_for_plateau(pol, state)
+                if pol.early_stop_plateaus is not None and \
+                        pol.plateaus >= pol.early_stop_plateaus:
+                    _health.emit("early_stop", reason="plateau",
+                                 neval=state["neval"],
+                                 plateaus=pol.plateaus)
+                    return True
+            elif kind.endswith("_spike"):
+                pol.spikes += 1
+                if pol.max_spikes is not None and \
+                        pol.spikes >= pol.max_spikes:
+                    raise FloatingPointError(
+                        f"{pol.spikes} loss spikes "
+                        f"(RemediationPolicy.max_spikes="
+                        f"{pol.max_spikes}) — the run is diverging")
+        return False
+
+    def _reduce_lr_for_plateau(self, pol, state):
+        sched = getattr(self.optim_method, "learningrate_schedule", None)
+        if isinstance(sched, Plateau):
+            mult = sched.force_reduction()
+        else:
+            self._remediation_lr_scale = max(
+                self._remediation_lr_scale * pol.plateau_factor,
+                pol.min_lr_scale)
+            mult = self._remediation_lr_scale
+        _health.emit("lr_reduced", reason="plateau", neval=state["neval"],
+                     multiplier=mult,
+                     schedule=type(sched).__name__ if sched else None)
+
+    def _remediation_tick(self, state, params, opt_state, mstate,
+                          events, step_time_s=None):
+        """One per-iteration (per-superstep-group under fusion) pass of
+        the Tier-1 policy. Returns True when training should end
+        cleanly; raises via :meth:`_halt` on heartbeat loss or spike
+        overload. Runs host-side between dispatches — no readbacks
+        beyond what the sync policy already resolved."""
+        pol = self.remediation
+        if pol is None:
+            return False
+        try:
+            if events and self._apply_anomaly_events(pol, state, events):
+                return True
+        except FloatingPointError as e:
+            self._halt("loss_spikes", state, params, opt_state, mstate,
+                       error=e, failure_class=PERMANENT)
+        hb = pol.heartbeat
+        if hb is not None and \
+                state["neval"] - pol._last_beat_neval >= pol.heartbeat_every:
+            pol._last_beat_neval = state["neval"]
+            try:
+                stale = hb.beat(timeout_s=pol.heartbeat_timeout_s)
+            except HeartbeatLost as e:
+                self._halt("heartbeat_lost", state, params, opt_state,
+                           mstate, error=e, failure_class=PERMANENT)
+            if stale:
+                self._halt("heartbeat_stale", state, params, opt_state,
+                           mstate, failure_class=PERMANENT,
+                           error=HeartbeatLost(
+                               f"peers {stale} stopped advancing their "
+                               f"heartbeat counters"),
+                           lost_processes=stale)
+        sm = pol.straggler_monitor
+        if sm is not None:
+            if step_time_s is not None:
+                sm.record(step_time_s)
+            # distance-based cadence, not ``% == 0``: under superstep
+            # fusion neval advances by K and might never land on a
+            # multiple (the heartbeat check above has the same shape)
+            if state["neval"] - pol._last_straggler_neval >= \
+                    pol.straggler_every:
+                pol._last_straggler_neval = state["neval"]
+                sm.report()  # emits health/straggler on persistence
+        return False
+
     def _run_epoch_steps(self, batches, state, box):
         """One epoch of the pipelined step loop. ``batches`` yields
         device-resident (x, y) (already staged by the caller's stager);
@@ -882,6 +1521,7 @@ class BaseOptimizer:
         try:
             while True:
                 self._step_beacon.pulse()
+                self._check_halt()
                 with obs.span("step", neval=state["neval"]):
                     t0 = time.time()
                     with obs.span("step/data_fetch"):
@@ -890,12 +1530,19 @@ class BaseOptimizer:
                         except StopIteration:
                             return
                     t1 = time.time()
-                    lr = optim.current_lr()
+                    # *1.0 is bitwise-exact: the remediation scale only
+                    # changes lr after a plateau actually reduced it
+                    lr = optim.current_lr() * self._remediation_lr_scale
                     rng = engine.next_rng_key()
                     with obs.span("step/dispatch"):
-                        loss, params, opt_state, mstate = self._step_fn(
-                            params, opt_state, mstate, x, y,
-                            jnp.asarray(lr, jnp.float32), rng)
+                        loss, params, opt_state, mstate = \
+                            self._dispatch_guarded(
+                                params, opt_state, mstate, x, y,
+                                jnp.asarray(lr, jnp.float32), rng)
+                    # the last COMPLETED dispatch's handles: what the
+                    # watchdog-thread stall remediation checkpoints
+                    self._live_state = (params, opt_state, mstate)
+                    self._tighten_stall_deadline()
                     if obs.enabled():
                         obs.counter("engine/dispatches").inc()
                     with obs.span("step/loss_sync"):
@@ -913,9 +1560,9 @@ class BaseOptimizer:
                                            epoch=state["epoch"],
                                            loss=loss_val,
                                            policy=self.nan_policy)
-                            if self._loss_monitor is not None:
-                                self._loss_monitor.observe(
-                                    loss_val, self._resolved_step)
+                        if self._loss_monitor is not None:
+                            self._loss_monitor.observe(
+                                loss_val, self._resolved_step)
                         if self.nan_policy == "error":
                             raise FloatingPointError(
                                 f"non-finite loss {loss_val} at iteration "
@@ -960,15 +1607,19 @@ class BaseOptimizer:
                         state["loss"] = loss_val
                     state["neval"] += 1
                     state["epoch_finished"] = False
-                    if loss_val is not None and obs.enabled():
+                    health_events = []
+                    if loss_val is not None:
                         # provenance rides the already-resolved host
                         # float — no extra readback; under async/
                         # window:K the loss belongs to _resolved_step,
                         # up to K-1 before the current iteration
-                        _flight.record("step", neval=self._resolved_step,
-                                       epoch=state["epoch"], loss=loss_val)
+                        if obs.enabled():
+                            _flight.record("step",
+                                           neval=self._resolved_step,
+                                           epoch=state["epoch"],
+                                           loss=loss_val)
                         if self._loss_monitor is not None:
-                            self._loss_monitor.observe(
+                            health_events = self._loss_monitor.observe(
                                 loss_val, self._resolved_step)
                     if self._profiler is not None:
                         self._profiler.maybe_tick(state["neval"])
@@ -991,6 +1642,11 @@ class BaseOptimizer:
                                 "Throughput",
                                 self.batch_size / max(t2 - t0, 1e-9),
                                 state["neval"])
+                    if self._remediation_tick(state, params, opt_state,
+                                              mstate, health_events,
+                                              step_time_s=t2 - t1):
+                        box["done"] = True
+                        return
                     if self._fire_mid_epoch(state, params, opt_state, mstate):
                         pass
                     if self.end_trigger(state):
@@ -1042,6 +1698,7 @@ class BaseOptimizer:
         try:
             while True:
                 self._step_beacon.pulse()
+                self._check_halt()
                 t0 = time.time()
                 if pending is not None:
                     (k, xs, ys), pending = pending, None
@@ -1060,15 +1717,18 @@ class BaseOptimizer:
                     xs = _tmap(lambda a: a[:j], xs)
                     ys = _tmap(lambda a: a[:j], ys)
                     k = j
-                lrs = optim.current_lr_vector(k)
+                scale = self._remediation_lr_scale  # *1.0 is bitwise-exact
+                lrs = [l * scale for l in optim.current_lr_vector(k)]
                 rngs = engine.next_rng_keys(k)  # one dispatch, same stream
                 t1 = time.time()
                 with obs.span("step/superstep", neval=state["neval"], k=k):
                     with obs.span("step/dispatch"):
                         losses_dev, params, opt_state, mstate = \
-                            self._step_fn(params, opt_state, mstate, xs, ys,
-                                          jnp.asarray(lrs, jnp.float32),
-                                          rngs)
+                            self._dispatch_guarded(
+                                params, opt_state, mstate, xs, ys,
+                                jnp.asarray(lrs, jnp.float32), rngs)
+                    self._live_state = (params, opt_state, mstate)
+                    self._tighten_stall_deadline()
                     if obs.enabled():
                         obs.counter("engine/dispatches").inc()
                     with obs.span("step/loss_sync"):
@@ -1084,6 +1744,7 @@ class BaseOptimizer:
                     obs.gauge("optim/throughput", unit="samples/s").set(
                         k * self.batch_size / max(t2 - t0, 1e-9))
                 restored = False
+                health_events = []
                 for i, loss_val in enumerate(losses.tolist()):
                     if not np.isfinite(loss_val):
                         nan_streak += 1
@@ -1096,9 +1757,9 @@ class BaseOptimizer:
                                            loss=loss_val,
                                            policy=self.nan_policy,
                                            superstep_k=k, microstep=i)
-                            if self._loss_monitor is not None:
-                                self._loss_monitor.observe(loss_val,
-                                                           state["neval"])
+                        if self._loss_monitor is not None:
+                            self._loss_monitor.observe(loss_val,
+                                                       state["neval"])
                         if self.nan_policy == "error":
                             raise FloatingPointError(
                                 f"non-finite loss {loss_val} at iteration "
@@ -1145,9 +1806,9 @@ class BaseOptimizer:
                         _flight.record("step", neval=state["neval"],
                                        epoch=state["epoch"], loss=loss_val,
                                        superstep_k=k, microstep=i)
-                        if self._loss_monitor is not None:
-                            self._loss_monitor.observe(loss_val,
-                                                       state["neval"])
+                    if self._loss_monitor is not None:
+                        health_events.extend(self._loss_monitor.observe(
+                            loss_val, state["neval"]))
                     if self.train_summary is not None:
                         rec = self.train_summary.should_record
                         if rec("Loss", state):
@@ -1162,9 +1823,24 @@ class BaseOptimizer:
                                 k * self.batch_size / max(t2 - t0, 1e-9),
                                 state["neval"])
                 if restored:
+                    # the group's pre-NaN spike/plateau events describe
+                    # losses that really happened — the policy must see
+                    # them, or a diverging run that interleaves spikes
+                    # with NaN restores starves max_spikes forever and
+                    # loops checkpoint-restore indefinitely
+                    if self._remediation_tick(state, params, opt_state,
+                                              mstate, health_events,
+                                              step_time_s=t2 - t1):
+                        box["done"] = True
+                        return
                     continue
                 if self._profiler is not None:
                     self._profiler.maybe_tick(state["neval"])
+                if self._remediation_tick(state, params, opt_state, mstate,
+                                          health_events,
+                                          step_time_s=t2 - t1):
+                    box["done"] = True
+                    return
                 # checkpoint/validation/end triggers evaluate ONCE at the
                 # superstep boundary, where params and the iteration
                 # counter are consistent: clamping already aligned every
@@ -1202,6 +1878,12 @@ class BaseOptimizer:
     def _to_host(self, tree):
         """Fetch a tree to host numpy for checkpointing."""
         return _tmap(np.asarray, tree)
+
+    def _opt_state_for_checkpoint(self, opt_state):
+        """Host optimizer state in CANONICAL (mesh-shape-agnostic) form;
+        the local/replicated state already is — the ZeRO-1 override
+        unflattens its sharded vectors."""
+        return self._to_host(opt_state)
 
     def _prepare(self, params, opt_state, mstate):
         return params, opt_state, mstate
@@ -1294,7 +1976,18 @@ class DistriOptimizer(BaseOptimizer):
             from ..parallel.allreduce import AllReduceParameter
             self._arp = AllReduceParameter(self.optim_method, self.mesh,
                                            compress=self.compress)
-            flat_w, opt_state = self._arp.prepare(params)
+            # a loaded checkpoint's optimizer state is CANONICAL
+            # (params-shaped, mesh-agnostic): prepare() re-flattens and
+            # re-pads it against THIS mesh's shard boundaries, so the
+            # same snapshot restores under any device count — the
+            # elastic-restart contract. Without a loaded checkpoint the
+            # state passed in is a fresh init for the wrong (tree)
+            # layout; the sharded init replaces it.
+            resume = opt_state \
+                if getattr(self, "_resume_opt_state", None) is not None \
+                else None
+            flat_w, opt_state = self._arp.prepare(params,
+                                                  resume_state=resume)
             self._flat = self._arp.flat
             mstate = shard_params(mstate, self.mesh)
             return put_global(flat_w, self.mesh, P()), opt_state, mstate
@@ -1313,21 +2006,30 @@ class DistriOptimizer(BaseOptimizer):
             return self._flat.unflatten(jax.device_get(params))
         return params
 
+    def _opt_state_for_checkpoint(self, opt_state):
+        if self.parameter_mode == "zero1" and self._arp is not None:
+            # gather the sharded flat vectors, then unflatten to the
+            # canonical params-shaped form — the checkpoint carries no
+            # shard-boundary provenance (restores under any mesh shape)
+            return self._arp.state_to_canonical(self._to_host(opt_state))
+        return self._to_host(opt_state)
+
     def _restore_step_state(self, payload):
         from ..parallel.sharding import shard_params, put_global
         params = _tmap(jnp.asarray, payload["params"])
-        opt_state = _tmap(jnp.asarray, payload["opt_state"])
         mstate = shard_params(_tmap(jnp.asarray, payload["model_state"]),
                               self.mesh)
         if self.parameter_mode == "zero1" and self._arp is not None:
             # reuse the existing FlatParameter/AllReduceParameter — the
-            # compiled step closes over them; only re-place the data
+            # compiled step closes over them; only re-place the data.
+            # The payload's optimizer state is canonical (params-shaped;
+            # legacy flat vectors are re-padded too) — widen it back to
+            # THIS mesh's flat shard layout before placing.
             flat_w = put_global(self._flat.flatten(params), self.mesh, P())
-            opt_specs = self._arp.state_specs()
-            opt_state = jax.tree_util.tree_map(
-                lambda a, sp: put_global(a, self.mesh, sp),
-                opt_state, opt_specs)
+            opt_state = self._arp.place_canonical_state(
+                payload["opt_state"])
             return flat_w, opt_state, mstate
+        opt_state = _tmap(jnp.asarray, payload["opt_state"])
         return (shard_params(params, self.mesh),
                 shard_params(opt_state, self.mesh), mstate)
 

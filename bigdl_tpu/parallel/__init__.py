@@ -5,10 +5,14 @@ from .collective import (all_reduce_sum, all_reduce_mean, all_gather,
                          pmean)
 from .allreduce import AllReduceParameter, FP16CompressPolicy
 from .sharding import (replicated, data_sharding, shard_batch, shard_params,
-                       tp_linear_rules, transformer_tp_specs, fsdp_specs)
+                       tp_linear_rules, transformer_tp_specs, fsdp_specs,
+                       surviving_devices, mesh_after_loss)
 from .ring_attention import ring_attention
 from .failure import (probe_mesh, MeshProbeResult, Heartbeat, HeartbeatLost,
-                      StragglerMonitor)
+                      StragglerMonitor, TransientDeviceError, TrainingHalted,
+                      FaultPolicy, classify_failure, TRANSIENT, PERMANENT)
+from .elastic import (ElasticRunner, find_latest_checkpoint,
+                      data_parallel_factory)
 from .pipeline import gpipe, stack_stage_params, unstack_stage_params
 from .moe import moe_ffn, top1_routing
 from .ring_flash import ring_flash_attention, make_ring_flash_attention

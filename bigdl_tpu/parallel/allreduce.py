@@ -91,8 +91,16 @@ class AllReduceParameter:
         self.n = mesh.shape[axis]
         self.flat: Optional[FlatParameter] = None
 
-    def prepare(self, params):
-        """Build the flat view and the sharded optimizer state."""
+    def prepare(self, params, resume_state=None):
+        """Build the flat view and the sharded optimizer state.
+
+        ``resume_state``: a CANONICAL host optimizer-state tree (see
+        :meth:`state_to_canonical`) from a checkpoint — possibly written
+        under a *different* mesh shape. Vector state is re-flattened and
+        re-padded against THIS mesh's shard boundaries, so a checkpoint
+        saved under N-way ZeRO-1 restores bitwise onto N', including
+        after an elastic mesh reshape. ``None`` (fresh run) initializes
+        the per-slice state on device as before."""
         self.flat = FlatParameter(params, self.n)
         flat_w = self.flat.flatten(params)
         if obs.enabled():
@@ -107,6 +115,9 @@ class AllReduceParameter:
                 self.flat.padded_size * (gbytes + 4))
             obs.gauge("allreduce/n_shards").set(self.n)
 
+        if resume_state is not None:
+            return flat_w, self.place_canonical_state(resume_state)
+
         def init_slice(w_full):
             i = lax.axis_index(self.axis)
             sl = lax.dynamic_slice_in_dim(w_full, i * self.flat.shard_size,
@@ -118,6 +129,65 @@ class AllReduceParameter:
                          out_specs=self.state_specs(),
                          check_vma=False)
         return flat_w, init(flat_w)
+
+    def _slice_state_shapes(self):
+        """Shape witness for the PER-SLICE optimizer state: which outer
+        leaves are flat parameter vectors (ndim >= 1) vs replicated
+        scalars (step counters). The canonical<->sharded conversions
+        walk this structure with ``tree_map``, which flattens the other
+        tree UP TO this one's leaves — so a canonical tree may hold a
+        whole params-shaped subtree where the witness has one vector
+        leaf."""
+        return jax.eval_shape(
+            self.optim.init_state,
+            jax.ShapeDtypeStruct((self.flat.shard_size,), jnp.float32))
+
+    def state_to_canonical(self, gathered_state):
+        """Gathered host optimizer state (flat ``[padded]`` vectors per
+        THIS mesh's padding) -> the canonical mesh-shape-agnostic form:
+        each vector leaf unflattened into a params-shaped subtree,
+        scalars untouched. This is the form checkpoints store — it
+        carries no shard-boundary provenance, so any future mesh shape
+        (including LocalOptimizer's unsharded state) restores from it."""
+        def canon(shape_leaf, leaf):
+            if shape_leaf.ndim >= 1:
+                return jax.tree_util.tree_map(
+                    np.asarray, self.flat.unflatten(np.asarray(leaf)))
+            return np.asarray(leaf)
+        return jax.tree_util.tree_map(canon, self._slice_state_shapes(),
+                                      gathered_state)
+
+    def state_from_canonical(self, canonical):
+        """Canonical host state -> full flat vectors padded to THIS
+        mesh's boundaries (host-side; caller places them with
+        :meth:`state_specs`). Also accepts legacy flat-vector leaves
+        (pre-canonical checkpoints): they are trimmed to the true
+        parameter count and re-padded for the new shard count."""
+        def widen(shape_leaf, sub):
+            if shape_leaf.ndim >= 1:
+                if hasattr(sub, "ndim") and getattr(sub, "ndim", 0) >= 1:
+                    vec = jnp.asarray(np.asarray(sub).ravel()
+                                      [: self.flat.orig_size])
+                    return jnp.pad(
+                        vec, (0, self.flat.padded_size - vec.shape[0]))
+                return self.flat.flatten(sub)
+            return jnp.asarray(sub)
+        return jax.tree_util.tree_map(widen, self._slice_state_shapes(),
+                                      canonical)
+
+    def place_canonical_state(self, canonical):
+        """Canonical host state → device-placed state sharded for THIS
+        mesh: widen to the current shard boundaries
+        (:meth:`state_from_canonical`) and place each leaf per
+        :meth:`state_specs`. The single placement path both fresh
+        restores (``prepare(resume_state=...)``) and the optimizer's
+        mid-run restore (nan-resume, Tier-2 replay, elastic resume)
+        share — the two must never drift."""
+        from .sharding import put_global
+        full = self.state_from_canonical(canonical)
+        return jax.tree_util.tree_map(
+            lambda a, sp: put_global(a, self.mesh, sp),
+            full, self.state_specs())
 
     def state_specs(self):
         """Per-leaf PartitionSpecs for the sharded optimizer state: vector

@@ -34,6 +34,138 @@ from ..observability import health as _health
 
 _LOG = logging.getLogger("bigdl_tpu.parallel.failure")
 
+# ------------------------------------------------------ failure classes
+#: the failure taxonomy the remediation tiers branch on: TRANSIENT
+#: failures (a flaky collective, a dropped tunnel connection, a
+#: preempted RPC) are worth replaying in place (FaultPolicy, Tier 2);
+#: PERMANENT failures (a dead host, a wedged mesh) need checkpoint-and-
+#: exit followed by an elastic restart on a reshaped mesh (Tier 3,
+#: ``parallel/elastic.py``).
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+
+
+class TransientDeviceError(RuntimeError):
+    """A device/collective failure worth retrying in place: the chip is
+    believed alive, the dispatch just failed (dropped tunnel packet,
+    preempted RPC, flaky barrier). Raised by fault-injection harnesses
+    and recognized by :class:`FaultPolicy` (the trainer replays the
+    in-flight step group) and by the serving engine's one-shot batch
+    retry — one typed classification shared by both consumers."""
+
+
+#: substrings that mark a runtime error as transient. Drawn from the
+#: gRPC/absl status-code vocabulary jaxlib surfaces for connection-level
+#: failures (XlaRuntimeError stringifies the status) — deliberately NOT
+#: including RESOURCE_EXHAUSTED (OOM replays identically) or
+#: INVALID_ARGUMENT (a program bug replays identically).
+_TRANSIENT_MARKERS = (
+    "transient", "unavailable", "deadline_exceeded", "deadline exceeded",
+    "aborted", "cancelled", "connection reset", "connection refused",
+    "socket closed", "broken pipe", "temporarily", "preempt",
+    "too many pings", "keepalive", "network is unreachable",
+)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map an exception from the dispatch path onto the failure
+    taxonomy. Typed signals win: :class:`TransientDeviceError` is
+    transient by construction, :class:`HeartbeatLost` / a failed mesh
+    probe mean a peer is gone — permanent. Everything else falls back
+    to matching the runtime's status-code vocabulary in the message;
+    unknown errors classify PERMANENT (replaying a deterministic bug
+    burns the retry budget and then fails identically — the safe
+    default is to surface it)."""
+    if isinstance(exc, TransientDeviceError):
+        return TRANSIENT
+    if isinstance(exc, HeartbeatLost):
+        return PERMANENT
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return PERMANENT
+
+
+class FaultPolicy:
+    """Tier-2 retry/backoff budget for the training dispatch path.
+
+    Armed via ``Optimizer.set_fault_policy``: each dispatch first
+    snapshots the resolved host-side state, and a failure classified
+    into ``retry_classes`` (default: transient only) replays the
+    in-flight step (or whole superstep group) from that snapshot after
+    an exponential backoff — ``backoff_base_s * 2^k`` capped at
+    ``backoff_max_s``. ``max_restarts`` bounds CONSECUTIVE failed
+    attempts; any success resets the budget, so a long run tolerates
+    occasional flakes without accumulating toward an abort. Failures
+    outside ``retry_classes`` (permanent by default) raise immediately
+    — Tier 3 (checkpoint + elastic restart) owns those.
+
+    ``sleep`` is injectable so fault-injection tests run at full speed.
+    """
+
+    def __init__(self, max_restarts: int = 3, backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 30.0,
+                 retry_classes=(TRANSIENT,), sleep=time.sleep):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.retry_classes = tuple(retry_classes)
+        self.sleep = sleep
+        self.consecutive = 0   # failed attempts since the last success
+        self.total_retries = 0
+
+    def classify(self, exc: BaseException) -> str:
+        return classify_failure(exc)
+
+    def should_retry(self, failure_class: str) -> bool:
+        return (failure_class in self.retry_classes
+                and self.consecutive < self.max_restarts)
+
+    def backoff_s(self) -> float:
+        """Backoff before the NEXT attempt, from the consecutive-failure
+        count (first retry waits ``backoff_base_s``)."""
+        return min(self.backoff_base_s * (2.0 ** max(self.consecutive - 1, 0)),
+                   self.backoff_max_s)
+
+    def record_failure(self) -> None:
+        self.consecutive += 1
+        self.total_retries += 1
+
+    def record_success(self) -> None:
+        self.consecutive = 0
+
+
+class TrainingHalted(RuntimeError):
+    """Tier-1 remediation verdict: training stopped ITSELF — checkpoint
+    written (when a checkpoint path is set), flight bundle dumped —
+    instead of hanging in a dead collective or dying without artifacts.
+    Carries everything a supervisor (``parallel/elastic.ElasticRunner``
+    or an external launcher) needs to decide the restart: the cause,
+    the failure class, the remediation checkpoint and bundle paths, the
+    iteration provenance, and the lost peer processes when the
+    membership signal named them."""
+
+    def __init__(self, cause: str, failure_class: str = PERMANENT,
+                 checkpoint_path: Optional[str] = None,
+                 bundle_path: Optional[str] = None,
+                 epoch: Optional[int] = None, neval: Optional[int] = None,
+                 lost_processes=()):
+        self.cause = cause
+        self.failure_class = failure_class
+        self.checkpoint_path = checkpoint_path
+        self.bundle_path = bundle_path
+        self.epoch = epoch
+        self.neval = neval
+        self.lost_processes = list(lost_processes)
+        super().__init__(
+            f"training halted by remediation: cause={cause} "
+            f"class={failure_class} epoch={epoch} neval={neval} "
+            f"checkpoint={checkpoint_path} bundle={bundle_path}"
+            + (f" lost_processes={self.lost_processes}"
+               if self.lost_processes else ""))
+
 
 def _run_with_timeout(fn, timeout_s: float) -> Dict:
     """Run ``fn`` on a daemon watchdog thread. Returns {'value': ...} on
@@ -271,12 +403,22 @@ class Heartbeat:
 class StragglerMonitor:
     """Per-host step-time collection + straggler flagging (the metric Spark's
     speculation uses, over the jax.distributed channel instead of the Spark
-    driver)."""
+    driver).
 
-    def __init__(self, threshold: float = 1.5, window: int = 50):
+    A host flagged in ``persist_after`` CONSECUTIVE ``report()`` calls
+    fires a structured ``health/straggler`` event (host id, imbalance,
+    per-host means) so the remediation policy — which only sees health
+    events, never pulls reports — can act on it; a single slow report
+    (GC pause, one cold batch) never pages. Re-arms when the host drops
+    back under the threshold."""
+
+    def __init__(self, threshold: float = 1.5, window: int = 50,
+                 persist_after: int = 3):
         self.threshold = threshold
         self.window = window
+        self.persist_after = max(1, int(persist_after))
         self.times: List[float] = []
+        self._consecutive: Dict[int, int] = {}
 
     def record(self, step_time_s: float) -> None:
         self.times.append(float(step_time_s))
@@ -308,4 +450,19 @@ class StragglerMonitor:
                 "stragglers": stragglers}
 
     def report(self) -> Dict:
-        return self.analyze(self._gather_means(), self.threshold)
+        rep = self.analyze(self._gather_means(), self.threshold)
+        flagged = set(rep["stragglers"])
+        for pid in flagged:
+            self._consecutive[pid] = self._consecutive.get(pid, 0) + 1
+            if self._consecutive[pid] == self.persist_after:
+                _health.emit(
+                    "straggler", host=pid,
+                    consecutive_reports=self._consecutive[pid],
+                    mean_s=round(rep["per_host_mean_s"][pid], 6),
+                    median_s=round(rep["median_s"], 6),
+                    imbalance=round(rep["imbalance"], 3),
+                    threshold=self.threshold)
+        for pid in list(self._consecutive):
+            if pid not in flagged:
+                del self._consecutive[pid]  # re-arm: one clean report
+        return rep

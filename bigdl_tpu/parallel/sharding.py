@@ -6,6 +6,8 @@ through PartitionSpecs — XLA inserts the collectives.
 """
 from __future__ import annotations
 
+import logging
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -101,6 +103,72 @@ def shard_params(params, mesh: Mesh):
     """Replicate params across the mesh (multi-controller safe)."""
     return jax.tree_util.tree_map(
         lambda x: put_global(x, mesh, P()), params)
+
+
+def surviving_devices(mesh: Mesh, lost_processes=()):
+    """Devices of ``mesh`` NOT owned by the lost processes — the raw
+    material an elastic restart re-derives the mesh from. Order is
+    preserved (mesh iteration order), so the reshaped mesh keeps the
+    survivors' relative layout."""
+    lost = set(lost_processes)
+    return [d for d in mesh.devices.flat if d.process_index not in lost]
+
+
+def mesh_after_loss(mesh: Mesh, lost_processes=(), devices=None,
+                    axis: str = "data") -> Mesh:
+    """Re-derive a mesh after host loss: same axis names, the ``axis``
+    dimension shrunk to what the surviving devices support, every other
+    axis kept at its original size (tensor/sequence-parallel groups must
+    stay intact — only the data-parallel degree is elastic). Explicit
+    ``devices`` (e.g. a simulated-membership subset in the CPU fault
+    drill) override the ``lost_processes`` filter.
+
+    Model/seq groups stay WHOLE: a new mesh row is only ever one of the
+    ORIGINAL mesh's rows that survived intact — regrouping leftover
+    devices from different broken rows would be numerically fine after
+    resharding but silently turn every model-parallel collective into a
+    cross-host (DCN instead of ICI) hop. Survivors stranded in a broken
+    row are DROPPED and the drop is logged loudly so an operator sees
+    the capacity loss; no intact row surviving raises (a partial group
+    cannot run the program at all)."""
+    if devices is None:
+        devices = surviving_devices(mesh, lost_processes)
+    devices = list(devices)
+    if not devices:
+        raise ValueError("no surviving devices to build a mesh from")
+    axes = tuple(mesh.axis_names)
+    if axis not in axes:
+        raise ValueError(f"mesh has no {axis!r} axis (axes: {axes})")
+    ax_idx = axes.index(axis)
+    other = 1
+    for a in axes:
+        if a != axis:
+            other *= mesh.shape[a]
+    if other == 1:
+        # pure data-parallel: every survivor is a whole row
+        shape = tuple(len(devices) if a == axis else 1 for a in axes)
+        return Mesh(np.array(devices).reshape(shape), axes)
+    surv = set(devices)
+    rows = np.moveaxis(mesh.devices, ax_idx, 0).reshape(
+        mesh.shape[axis], other)
+    whole = [row for row in rows if all(d in surv for d in row)]
+    new_axis = len(whole)
+    if new_axis < 1:
+        raise ValueError(
+            f"{len(devices)} surviving devices leave no whole "
+            f"{axis!r} row of {other} devices intact (mesh axes {axes})")
+    if new_axis * other < len(devices):
+        logging.getLogger(__name__).warning(
+            "mesh_after_loss: dropping %d surviving devices stranded in "
+            "broken %r rows of %d (keeping %d of %d)",
+            len(devices) - new_axis * other, axis, other,
+            new_axis * other, len(devices))
+    arr = np.moveaxis(
+        np.array([d for row in whole for d in row]).reshape(
+            (new_axis,) + tuple(s for a, s in zip(axes, mesh.devices.shape)
+                                if a != axis)),
+        0, ax_idx)
+    return Mesh(arr, axes)
 
 
 def transformer_tp_specs(params, axis: str = "model"):

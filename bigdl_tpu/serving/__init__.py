@@ -29,3 +29,8 @@ from .batching import (QueueFull, DeadlineExceeded, EngineStopped,
                        ServeFuture, Request, assemble)
 from .registry import ModelRegistry, ModelVersion
 from .engine import ServingEngine, serving_threads_alive, THREAD_NAME
+# the transient-failure classification is SHARED with the trainer's
+# FaultPolicy (parallel/failure.py): a batch whose compiled forward
+# fails with a transient device error is re-dispatched once before its
+# futures fail (see docs/RESILIENCE.md)
+from ..parallel.failure import TransientDeviceError  # noqa: F401

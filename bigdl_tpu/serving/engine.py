@@ -41,6 +41,7 @@ from ..observability import health as _health
 from ..optim.predictor import bucket_for, pad_leading, shape_buckets, \
     shared_forward
 from ..optim.staging import place_host_value
+from ..parallel.failure import TRANSIENT, classify_failure
 from .batching import (DeadlineExceeded, EngineStopped, QueueFull, Request,
                        ServeFuture, assemble)
 from .registry import ModelRegistry
@@ -48,7 +49,8 @@ from .registry import ModelRegistry
 THREAD_NAME = "bigdl_tpu-serving-batcher"
 
 _STAT_KEYS = ("submitted", "completed", "rejected", "timeouts", "batches",
-              "batch_errors", "request_errors", "swaps")
+              "batch_errors", "request_errors", "swaps",
+              "transient_retries")
 
 
 class ServingEngine:
@@ -372,16 +374,41 @@ class ServingEngine:
         mv = self.registry.current()  # ONE version per batch — swap boundary
         sp = obs.span("serve/batch", bucket=bucket, n=n, version=mv.version)
         t_fwd_ns = time.perf_counter_ns()
+
+        def forward():
+            xd = place_host_value(pad_leading(x, bucket))
+            out = self._fwd(mv.params, mv.state, xd)
+            # sync-ok: serving result readback — the micro-batch
+            # is the pipeline unit; its clients are blocked on
+            # exactly this result
+            return np.asarray(out)
+
         try:
             with sp:
-                with obs.span("serve/dispatch", rids=rids, bucket=bucket,
-                              version=mv.version):
-                    xd = place_host_value(pad_leading(x, bucket))
-                    out = self._fwd(mv.params, mv.state, xd)
-                    # sync-ok: serving result readback — the micro-batch
-                    # is the pipeline unit; its clients are blocked on
-                    # exactly this result
-                    host = np.asarray(out)
+                try:
+                    with obs.span("serve/dispatch", rids=rids,
+                                  bucket=bucket, version=mv.version):
+                        host = forward()
+                except BaseException as e:  # noqa: BLE001 — maybe transient
+                    # one-shot replay of a TRANSIENT device failure (the
+                    # classification shared with the trainer's
+                    # FaultPolicy — parallel/failure.classify_failure):
+                    # a dropped tunnel packet should cost the batch one
+                    # re-dispatch, not fail every client in it. One
+                    # attempt only — a batcher that retries in a loop is
+                    # a batcher that head-of-line-blocks the queue.
+                    if classify_failure(e) != TRANSIENT \
+                            or self._stop.is_set():
+                        raise
+                    self._bump("transient_retries")
+                    if obs.enabled():
+                        obs.counter("serve/transient_retries").inc()
+                        _health.emit("serve_retry", bucket=bucket, n=n,
+                                     version=mv.version,
+                                     error=f"{type(e).__name__}: {e}")
+                    with obs.span("serve/retry_dispatch", rids=rids,
+                                  bucket=bucket, version=mv.version):
+                        host = forward()
         except BaseException as e:  # noqa: BLE001 — batch fails, batcher lives
             self._bump("batch_errors")
             if obs.enabled():

@@ -214,8 +214,13 @@ def test_series_monitor_plateau_and_rearm():
     for i in range(20):
         evs += m.observe(0.5, i)
     kinds = [e["kind"] for e in evs]
-    assert kinds == ["health/plateau"]  # fires ONCE, not per step
+    # recurring: once per FULL stale window (5, 10, 15) — never per
+    # step, but a flat run keeps reporting so plateau COUNTS (repeated
+    # LR cuts, early_stop_plateaus) can grow without an improvement
+    assert kinds == ["health/plateau"] * 3
     assert evs[0]["best_step"] == 0 and evs[0]["step"] == 5
+    assert [e["step"] for e in evs] == [5, 10, 15]
+    assert evs[-1]["stale_steps"] == 15
     # a new best re-arms the detector
     evs = m.observe(0.1, 30)
     assert evs == []

@@ -30,6 +30,14 @@ HOT_FUNCS = {
         "_clamp_superstep", "_observe_loss", "_drain_pending_losses",
         "_stage_minibatch", "_stage_minibatch_host", "_stage_group",
         "_place_batch", "_place_group",
+        # self-healing paths that run inside the step loop: the guarded
+        # dispatch (its host snapshot is the one deliberate per-dispatch
+        # fetch, taken only when a FaultPolicy is armed) and the Tier-1
+        # remediation tick (host-side control only — it may never add a
+        # readback beyond what the sync policy already resolved)
+        "_dispatch_guarded", "_host_step_state", "_check_halt",
+        "_remediation_tick", "_apply_anomaly_events",
+        "_tighten_stall_deadline",
     },
     "bigdl_tpu/optim/staging.py": {"_run", "__next__"},
     # health/flight hot paths: beacon pulses, anomaly observation and
