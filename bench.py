@@ -33,19 +33,15 @@ import time
 
 BASELINE_IMG_PER_SEC = 60.0  # MKL-DNN Xeon node, ResNet-50 train (SURVEY §6)
 
-# bf16 peak TFLOP/s per chip by device_kind substring (public specs).
-_PEAK_TFLOPS = [
-    ("v6", 918.0), ("v5p", 459.0), ("v5", 197.0), ("v4", 275.0),
-    ("v3", 123.0), ("v2", 46.0),
-]
-
 
 def _peak_flops(device_kind: str) -> float:
-    dk = device_kind.lower()
-    for sub, tf in _PEAK_TFLOPS:
-        if sub in dk:
-            return tf * 1e12
-    return 197.0e12  # assume v5e (the BASELINE target platform)
+    """Chip peak FLOP/s — the SAME table the runtime's live ``perf/mfu``
+    gauge uses (``observability/perf.py``), so the offline bench MFU and
+    the live gauge can never disagree about the hardware ceiling. Child
+    paths only (the parent never measures MFU); imported lazily so the
+    parent keeps its no-jax/no-package-import guarantee."""
+    from bigdl_tpu.observability.perf import peak_flops
+    return peak_flops(device_kind)
 
 
 # --------------------------------------------------------------------------
@@ -471,17 +467,28 @@ def _cache_tpu_lines(lines):
     existing = {}
     try:  # a corrupt cache resets rather than blocking the fresh write
         with open(_TPU_CACHE) as f:
-            existing = {l["metric"]: l for l in json.load(f)
-                        if isinstance(l, dict) and "metric" in l}
+            # sanitize entries already on disk too: a cache written by an
+            # older bench.py may carry serve-time fields baked in, and the
+            # merge must not keep re-persisting them next to clean writes
+            existing = {
+                l["metric"]: {k: v for k, v in l.items()
+                              if k not in ("cached", "cache_from",
+                                           "tunnel_error", "error")}
+                for l in json.load(f)
+                if isinstance(l, dict) and "metric" in l}
     except (OSError, ValueError):
         pass
     try:
         stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         for l in tpu:
             # strip serve-time provenance so a re-cached line can never
-            # carry a previous outage's context as its own
+            # carry a previous outage's context as its own ("error" too:
+            # BENCH_r05 showed a stale outage message riding a cached
+            # line — ANY error text on a line being cached describes a
+            # past serve, not the measurement)
             clean = {k: v for k, v in l.items()
-                     if k not in ("cached", "cache_from", "tunnel_error")}
+                     if k not in ("cached", "cache_from", "tunnel_error",
+                                  "error")}
             existing[l["metric"]] = dict(clean, measured_at=stamp)
         tmp = _TPU_CACHE + ".tmp"
         with open(tmp, "w") as f:

@@ -106,9 +106,20 @@ class Module:
             # LayerException parity (utils/LayerException.scala): errors
             # deep inside a model carry the failing layer's identity.
             # add_note keeps the original exception type/traceback intact.
+            note = f"Layer info: {self.name} ({type(self).__name__})"
             if hasattr(e, "add_note"):
-                e.add_note(f"Layer info: {self.name} "
-                           f"({type(self).__name__})")
+                e.add_note(note)
+            else:
+                # Python < 3.11: PEP-678 notes as a plain attribute —
+                # tracebacks won't render them, but programmatic readers
+                # (tests, error reporters) see the same __notes__ list
+                try:
+                    notes = getattr(e, "__notes__", None)
+                    if notes is None:
+                        notes = e.__notes__ = []
+                    notes.append(note)
+                except Exception:
+                    pass  # exotic exception without a writable __dict__
             raise
         if isinstance(out, tuple) and len(out) == 2 and isinstance(out[1], dict):
             return out

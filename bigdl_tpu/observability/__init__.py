@@ -24,6 +24,13 @@ reports into:
 * ``flight`` — a bounded ring of recent structured events dumped as a
   JSON crash bundle on unhandled failure; render post-mortems with
   ``tools/flight_report.py``.
+* ``perf`` — what the compiled programs COST: per-program XLA
+  cost/memory artifacts from every compile site (``compile/*``,
+  rendered by ``tools/xla_report.py``) and live MFU / step-phase
+  gauges (``perf/mfu``, ``perf/phase_*_frac``) derived from them.
+* ``cluster`` — per-process metric-snapshot files merged by rank 0
+  into one cluster view (step-time skew, straggler attribution joined
+  with heartbeat ages); render with ``tools/cluster_report.py``.
 
 Zero-overhead when disabled: ``span()`` returns a shared no-op context
 manager and call-sites guard metric writes with ``enabled()`` — the
@@ -48,6 +55,8 @@ from .exporters import (chrome_trace, write_chrome_trace, prometheus_text,
                         record_bench_line)
 from . import flight
 from . import health
+from . import perf
+from . import cluster
 
 if _os.environ.get("BIGDL_TPU_TRACE") == "1":
     enable()

@@ -155,6 +155,12 @@ def crash_bundle(error: Optional[BaseException] = None,
                "traceback": "".join(_traceback.format_exception(
                    type(error), error, error.__traceback__))}
     now = time.time()
+    try:  # compiled-program provenance (optional key — absent pre-PR-7
+        # bundles and degraded environments stay schema-valid)
+        from . import perf as _perf
+        programs = _perf.artifacts_snapshot()
+    except Exception:  # noqa: BLE001 — the post-mortem must still land
+        programs = []
     return {
         "schema": SCHEMA,
         "written_at": now,
@@ -166,6 +172,7 @@ def crash_bundle(error: Optional[BaseException] = None,
         "events": _recorder.events(),
         "metrics": _metrics.registry().snapshot(),
         "spans": _span_tail(),
+        "programs": programs,
         "env": _env_info(),
     }
 

@@ -29,7 +29,9 @@ class Evaluator:
             def fwd(params, state, x):
                 out, _ = model.apply(params, state, x, training=False)
                 return out
-            self._fwd = jax.jit(fwd)
+            self._fwd = obs.perf.instrument_jit(
+                jax.jit(fwd), name="eval/forward", kind="forward",
+                key_argnums=(2,))
         return self._fwd
 
     def _forward_stats_fn(self, methods):
@@ -47,7 +49,9 @@ class Evaluator:
             def fwd_stats(params, state, x, y):
                 out, _ = model.apply(params, state, x, training=False)
                 return tuple(m.device_stats(out, y) for m in methods)
-            self._fwd_stats = (key, jax.jit(fwd_stats))
+            self._fwd_stats = (key, obs.perf.instrument_jit(
+                jax.jit(fwd_stats), name="eval/forward_stats",
+                kind="forward", key_argnums=(2, 3)))
         return self._fwd_stats[1]
 
     @staticmethod

@@ -41,6 +41,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import observability as obs
+from ..observability import cluster as _cluster
 from ..observability import flight as _flight
 from ..observability import health as _health
 from ..parallel.failure import (FaultPolicy, HeartbeatLost, TrainingHalted,
@@ -441,6 +442,9 @@ class BaseOptimizer:
         self._step_beacon = _health.NULL_BEACON
         self._loss_monitor = None
         self._profiler = None
+        # cluster metric snapshots (BIGDL_TPU_METRIC_SNAP_S cadence;
+        # a zero interval makes every maybe_write a single comparison)
+        self._snap_writer = _cluster.MetricSnapshotWriter(every_s=0)
         # self-healing (PR 6): Tier-1 observe→act policy, Tier-2
         # dispatch retry budget, and the cross-thread halt/live-state
         # channel the watchdog-thread remediation writes into
@@ -802,9 +806,33 @@ class BaseOptimizer:
             return (loss, pick(new_params, params), pick(new_opt, opt_state),
                     pick(new_mstate, mstate))
 
+        fn = jax.jit(_scan_superstep(step), donate_argnums=(0, 1, 2)) \
+            if self.superstep > 1 else \
+            jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._instrument_step(fn)
+
+    def _instrument_step(self, jit_fn):
+        """Route the compiled step through the perf-introspection
+        wrapper: each distinct batch signature records a
+        CompiledArtifact (XLA FLOPs/bytes, memory footprint, compile
+        wall time, cache provenance) that the live ``perf/mfu`` gauge
+        and ``tools/xla_report.py`` read. Params/opt-state/model-state
+        shapes are fixed for the life of the step fn, so the signature
+        keys on the batch arguments alone (argnums 3, 4). Under
+        superstep fusion the per-program step count is read off the
+        ``[k, batch, ...]`` stack's leading dim at compile time — a
+        clamped j<K group compiles its OWN program and its artifact
+        must say j, not the configured K."""
         if self.superstep > 1:
-            return jax.jit(_scan_superstep(step), donate_argnums=(0, 1, 2))
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+            def steps_from_stack(args):
+                leaves = jax.tree_util.tree_leaves(args[3])
+                return leaves[0].shape[0] if leaves else 1
+            steps = steps_from_stack
+        else:
+            steps = 1
+        return obs.perf.instrument_jit(
+            jit_fn, name="optim/step", kind="train_step",
+            key_argnums=(3, 4), steps_per_program=steps)
 
     def _place_batch(self, x, y):
         from .staging import place_host_value
@@ -1061,6 +1089,9 @@ class BaseOptimizer:
                 "loss", **self.anomaly_config)
         if obs.enabled():
             _health.ensure_memory_telemetry()
+            # re-read the snapshot cadence per run (tests and launchers
+            # set BIGDL_TPU_METRIC_SNAP_S around individual runs)
+            self._snap_writer = _cluster.default_writer()
             st = self.optim_method.state
             _flight.record("train/start", epoch=st.get("epoch"),
                            neval=st.get("neval"), seed=engine.get_seed(),
@@ -1084,6 +1115,11 @@ class BaseOptimizer:
                     "nan_policy": self.nan_policy})
             raise
         finally:
+            if self._snap_writer.enabled and obs.enabled():
+                # terminal snapshot: the cluster merge must see this
+                # process's END state, not its last cadence tick
+                self._snap_writer.write(
+                    step=self.optim_method.state.get("neval"))
             self._step_beacon.close()
             self._step_beacon = _health.NULL_BEACON
             self._live_state = None
@@ -1534,7 +1570,8 @@ class BaseOptimizer:
                     # changes lr after a plateau actually reduced it
                     lr = optim.current_lr() * self._remediation_lr_scale
                     rng = engine.next_rng_key()
-                    with obs.span("step/dispatch"):
+                    dsp = obs.span("step/dispatch")
+                    with dsp:
                         loss, params, opt_state, mstate = \
                             self._dispatch_guarded(
                                 params, opt_state, mstate, x, y,
@@ -1629,6 +1666,21 @@ class BaseOptimizer:
                         obs.counter("optim/steps").inc()
                         obs.gauge("optim/throughput", unit="samples/s").set(
                             self.batch_size / max(t2 - t0, 1e-9))
+                        # live MFU + step-phase gauges: host floats the
+                        # loop already measured, zero new readbacks. A
+                        # dispatch that paid a compile measures XLA, not
+                        # the model — excluded, like bench warmup. The
+                        # wall is the FULL iteration (t0→t2): under
+                        # async/window:K the dispatch+resolve sliver
+                        # alone excludes the device time entirely.
+                        if not getattr(self._step_fn, "last_call_compiled",
+                                       True):
+                            obs.perf.note_step(
+                                getattr(self._step_fn, "last_artifact",
+                                        None),
+                                wall_s=t2 - t0, host_s=t1 - t0,
+                                dispatch_s=dsp.duration_s)
+                        self._snap_writer.maybe_write(step=state["neval"])
                     if self.train_summary is not None:
                         rec = self.train_summary.should_record
                         if loss_val is not None and rec("Loss", state):
@@ -1722,7 +1774,8 @@ class BaseOptimizer:
                 rngs = engine.next_rng_keys(k)  # one dispatch, same stream
                 t1 = time.time()
                 with obs.span("step/superstep", neval=state["neval"], k=k):
-                    with obs.span("step/dispatch"):
+                    dsp = obs.span("step/dispatch")
+                    with dsp:
                         losses_dev, params, opt_state, mstate = \
                             self._dispatch_guarded(
                                 params, opt_state, mstate, xs, ys,
@@ -1743,6 +1796,18 @@ class BaseOptimizer:
                     obs.counter("optim/steps").inc(k)
                     obs.gauge("optim/throughput", unit="samples/s").set(
                         k * self.batch_size / max(t2 - t0, 1e-9))
+                    # one artifact covers the whole K-step program (a
+                    # clamped j<K dispatch reads ITS program's artifact,
+                    # not the full-K one), so flops over the FULL
+                    # iteration wall IS the fused-dispatch MFU; compile
+                    # dispatches are excluded like bench warmup
+                    if not getattr(self._step_fn, "last_call_compiled",
+                                   True):
+                        obs.perf.note_step(
+                            getattr(self._step_fn, "last_artifact", None),
+                            wall_s=t2 - t0, host_s=t1 - t0,
+                            dispatch_s=dsp.duration_s)
+                    self._snap_writer.maybe_write(step=state["neval"])
                 restored = False
                 health_events = []
                 for i, loss_val in enumerate(losses.tolist()):
@@ -2106,7 +2171,8 @@ class DistriOptimizer(BaseOptimizer):
                           P(), P()),
                 out_specs=(P(), P(), opt_specs, mstate_specs),
                 check_vma=False)
-        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+        return self._instrument_step(
+            jax.jit(sharded, donate_argnums=(0, 1, 2)))
 
 
 class ParallelOptimizer(DistriOptimizer):

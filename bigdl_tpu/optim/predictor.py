@@ -135,7 +135,16 @@ class CompiledForward:
                         out, _ = model.apply(params, state, x,
                                              training=False)
                         return out
-                    self._jit = jax.jit(fwd)
+                    # every bucket shape (Predictor batches, serving
+                    # warmup/live buckets) records its own
+                    # CompiledArtifact — params/state are shape-stable,
+                    # so the signature key is the input alone
+                    model = model_ref()
+                    name = f"predict/forward/{type(model).__name__}" \
+                        if model is not None else "predict/forward"
+                    self._jit = obs.perf.instrument_jit(
+                        jax.jit(fwd), name=name, kind="forward",
+                        key_argnums=(2,))
         return self._jit
 
     def __call__(self, params, state, x):
@@ -143,13 +152,16 @@ class CompiledForward:
 
     def compiled_shape_count(self) -> int:
         """Distinct input shapes compiled so far (tests assert the
-        bucket discipline keeps this bounded)."""
+        bucket discipline keeps this bounded). Counts both the
+        instrumented AOT entries (observability on) and the inner jit
+        cache (observability off)."""
         if self._jit is None:
             return 0
+        n = self._jit.compiled_shape_count()
         try:
-            return int(self._jit._cache_size())
-        except AttributeError:  # older jax: no introspection, not fatal
-            return -1
+            return n + int(self._jit._jit._cache_size())
+        except AttributeError:  # older jax: no introspection
+            return n if n else -1
 
 
 _shared_forwards = weakref.WeakKeyDictionary()

@@ -44,6 +44,7 @@ from typing import Callable, List, Optional
 import jax
 
 from .. import observability as obs
+from ..observability import cluster as _cluster
 from ..observability import flight as _flight
 from ..observability import health as _health
 from .failure import TrainingHalted
@@ -150,6 +151,16 @@ class ElasticRunner:
                 resume_from = halt.checkpoint_path
                 if self.aggregate and jax.process_index() == 0:
                     _flight.aggregate_bundles()
+                    # merge the per-process metric snapshots too: the
+                    # snapshot files survive the restart, so successive
+                    # aggregates keep ONE timeline across mesh reshapes
+                    # (which attempt/cause each view belongs to rides in
+                    # its context)
+                    _cluster.write_aggregate(context={
+                        "elastic_attempt": attempt,
+                        "cause": halt.cause,
+                        "neval": halt.neval,
+                        "lost_processes": list(halt.lost_processes)})
                 survivors = list(self.membership(devices, halt))
                 # terminal halts re-raise BEFORE counting/announcing a
                 # restart — monitoring must not see an elastic_restart

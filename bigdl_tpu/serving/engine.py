@@ -36,6 +36,7 @@ import jax
 import numpy as np
 
 from .. import observability as obs
+from ..observability import cluster as _cluster
 from ..observability import flight as _flight
 from ..observability import health as _health
 from ..optim.predictor import bucket_for, pad_leading, shape_buckets, \
@@ -117,6 +118,9 @@ class ServingEngine:
         self._rids = itertools.count()
         self.stall_deadline_s = stall_deadline_s
         self._beacon = _health.NULL_BEACON
+        # serving processes join the cluster metric view too (same
+        # BIGDL_TPU_METRIC_SNAP_S cadence; no-op when unset)
+        self._snap_writer = _cluster.default_writer()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -278,6 +282,8 @@ class ServingEngine:
         try:
             while not self._stop.is_set():
                 self._beacon.pulse()
+                if obs.enabled():
+                    self._snap_writer.maybe_write()
                 batch = self._collect()
                 if batch:
                     self._dispatch(batch)

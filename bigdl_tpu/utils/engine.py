@@ -175,6 +175,20 @@ def compilation_cache_dir():
     return _state["compile_cache_dir"]
 
 
+def compilation_cache_stats() -> dict:
+    """One-call provenance snapshot of the persistent compile cache —
+    what the perf-introspection reports embed next to each program's
+    hit/miss deltas."""
+    from .. import observability as obs
+    reg = obs.registry()
+    return {
+        "dir": _state["compile_cache_dir"],
+        "entries": compilation_cache_entries(),
+        "hits": int(reg.counter("engine/compile_cache_hits").value),
+        "misses": int(reg.counter("engine/compile_cache_misses").value),
+    }
+
+
 def compilation_cache_entries() -> int:
     """Number of compiled executables in the persistent cache (0 when
     disabled) — exported as the ``engine/compile_cache_entries`` gauge."""

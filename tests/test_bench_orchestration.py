@@ -98,6 +98,67 @@ def test_cached_lines_provenance_on_reuse(bench_mod):
     assert "measured_at" in stored
 
 
+def test_contaminated_cache_never_reemits_stale_error(bench_mod,
+                                                      monkeypatch):
+    """The BENCH_r05 regression, end to end: a cache FILE contaminated
+    with serve-time fields (written by an older bench.py, or by hand)
+    must serve clean — the emitted ``cached: true`` line carries only
+    THIS run's outage text, never the baked-in one — and the next
+    re-cache scrubs the contamination off disk."""
+    b = bench_mod
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    json.dump([
+        {"metric": "resnet50_train_images_per_sec_per_chip", "value": 2436.9,
+         "unit": "images/sec/chip", "backend": "tpu", "measured_at": now,
+         # the contamination: a previous serve's provenance baked in
+         "cached": True, "cache_from": "2026-01-01T00:00:00Z",
+         "tunnel_error": "STALE OUTAGE TEXT", "error": "STALE ERROR"},
+    ], open(b._TPU_CACHE, "w"))
+
+    # this run's tunnel is down: every attempt times out, probe dead
+    monkeypatch.setattr(b, "_run_child",
+                        lambda which, env, timeout: (None, "timeout"))
+
+    def fake_alive(timeout=90.0, force=False):
+        b._TUNNEL_STATE.update(probed=True, alive=False)
+        return False
+
+    monkeypatch.setattr(b, "_tunnel_alive", fake_alive)
+    monkeypatch.setattr(b.time, "sleep", lambda s: None)
+    monkeypatch.setenv("BENCH_WAIT_S", "0")
+    lines = b._orchestrate("headline")
+    assert len(lines) == 1
+    line = lines[0]
+    assert line["cached"] is True and line["value"] == 2436.9
+    # the serve attaches THIS run's ladder text, not the stale one
+    assert "STALE" not in line.get("tunnel_error", "")
+    assert "timeout" in line["tunnel_error"]
+    assert "error" not in line
+    assert line["cache_from"] == now and "measured_at" not in line
+
+    # a later successful measurement merges against the contaminated
+    # file: the scrub must also clean the entries it does NOT overwrite
+    b._cache_tpu_lines([{"metric": "lenet_mnist_train_images_per_sec",
+                         "value": 5.0, "backend": "tpu"}])
+    stored = {l["metric"]: l for l in json.load(open(b._TPU_CACHE))}
+    resnet = stored["resnet50_train_images_per_sec_per_chip"]
+    for field in ("cached", "cache_from", "tunnel_error", "error"):
+        assert field not in resnet, (field, resnet)
+    assert resnet["measured_at"] == now
+
+
+def test_recache_strips_error_field(bench_mod):
+    """Re-caching a line that carries bench-child ``error`` text keeps
+    the measurement but drops the text (serve-time provenance)."""
+    b = bench_mod
+    b._cache_tpu_lines([{"metric": "resnet50_x", "value": 1.0,
+                         "backend": "tpu", "error": "transient init fail",
+                         "tunnel_error": "old ladder"}])
+    stored = json.load(open(b._TPU_CACHE))[0]
+    assert stored["value"] == 1.0
+    assert "error" not in stored and "tunnel_error" not in stored
+
+
 def test_corrupt_cache_resets_instead_of_blocking(bench_mod):
     b = bench_mod
     with open(b._TPU_CACHE, "w") as f:

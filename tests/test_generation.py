@@ -109,7 +109,13 @@ def test_moe_lm_generate_matches_naive():
 
 def test_lm_generate_eos_masking():
     """generate(eos_id=...): after a row emits eos, later positions are 0;
-    rows that never emit eos are unaffected (vs the eos-free output)."""
+    rows that never emit eos are unaffected (vs the eos-free output).
+
+    The eos is the first token row 0 generates, and each row is checked
+    against its OWN free-run behavior — greedy continuations differ
+    across jax/XLA versions (tie-breaks, fused-rounding), so the test
+    must not assume a particular token appears in one row but not the
+    other (the old deterministic-pick assert flaked per-environment)."""
     import jax.numpy as jnp
     from bigdl_tpu.models import TransformerLM
     model = TransformerLM(vocab_size=19, hidden_size=16, num_heads=2,
@@ -118,16 +124,21 @@ def test_lm_generate_eos_masking():
     prompt = jnp.asarray(np.random.RandomState(0).randint(1, 19, (2, 4)),
                          jnp.int32)
     free = np.asarray(model.generate(params, prompt, 8))
-    # deterministically pick an eos emitted by row 0 but never by row 1,
-    # so both the masking and the untouched-row checks are guaranteed
-    # non-vacuous (greedy output is fixed for this seed)
-    cands = [t for t in free[0, 4:] if t not in free[1, 4:]]
-    assert cands, (free[0], free[1])
-    eos = int(cands[0])
-    pos = int(np.where(free[0, 4:] == eos)[0][0]) + 4
+    eos = int(free[0, 4])  # row 0 emits it at its first generated slot
     out = np.asarray(model.generate(params, prompt, 8, eos_id=eos))
-    assert out[0, pos] == eos and (out[0, pos + 1:] == 0).all(), out[0]
-    assert np.array_equal(out[1], free[1])
+    masked_rows = 0
+    for r in range(free.shape[0]):
+        hits = np.where(free[r, 4:] == eos)[0]
+        if hits.size:  # this row emits eos: masked from first hit on
+            pos = int(hits[0]) + 4
+            assert out[r, pos] == eos, (r, out[r], free[r])
+            assert (out[r, pos + 1:] == 0).all(), (r, out[r])
+            # the prefix through eos is the free continuation unchanged
+            assert np.array_equal(out[r, :pos + 1], free[r, :pos + 1])
+            masked_rows += 1
+        else:  # never emits eos: identical to the free run
+            assert np.array_equal(out[r], free[r]), (r, out[r], free[r])
+    assert masked_rows >= 1  # row 0 guarantees non-vacuity
 
 
 def test_gqa_lm_generate_matches_naive():

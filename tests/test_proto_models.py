@@ -22,11 +22,13 @@ def _proto_roundtrip_forward(m, x, tmp_path, atol=1e-5):
     return m2
 
 
+@pytest.mark.slow
 def test_proto_inception_roundtrip(tmp_path):
     """FULL Inception-v1 (LRN + Concat heads) through bigdl.proto — the
     exact case the r3 verdict called out as unserializable. Structure +
-    exact params/state equality (forward-equality at full size is the
-    @slow variant below; the block-level forward check is default)."""
+    exact params/state equality. @slow since PR 7 (the full-size init
+    dominated tier-1's --durations at ~21-32s); the block-level forward
+    check below keeps default-tier coverage of the LRN + Concat case."""
     import jax
     from bigdl_tpu.loaders.bigdl_proto import save_bigdl, load_bigdl
     from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier

@@ -46,6 +46,14 @@ HOT_FUNCS = {
     "bigdl_tpu/observability/health.py": {"pulse", "observe",
                                           "maybe_tick", "emit"},
     "bigdl_tpu/observability/flight.py": {"record"},
+    # perf introspection hot hooks: the instrumented dispatch wrapper
+    # and the per-step MFU/phase math run inside the step loop — all
+    # host arithmetic on already-resolved floats, never a device touch
+    "bigdl_tpu/observability/perf.py": {"__call__", "_key", "note",
+                                        "note_step"},
+    # cluster snapshot cadence check runs per iteration (the write
+    # itself is host JSON on an elapsed cadence)
+    "bigdl_tpu/observability/cluster.py": {"maybe_write"},
     # forward-only loops: device-side metric/output accumulation means
     # the per-batch body must stay sync-free (one readback per epoch)
     "bigdl_tpu/optim/evaluator.py": {

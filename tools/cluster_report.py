@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Render the cluster metric view: per-host rows, skew, stragglers.
+
+Input: the per-process ``metrics_p*.json`` snapshot files the
+``BIGDL_TPU_METRIC_SNAP_S`` cadence writes into the flight dir (or an
+already-merged ``cluster_view_*.json``). The report answers the
+multihost question the span layer cannot: WHICH host is dragging the
+mesh, and is it slow or dying (straggler step time joined with its
+heartbeat age).
+
+Usage::
+
+    python tools/cluster_report.py [dir-or-view.json]
+    python tools/cluster_report.py --prom out.prom   # merged Prometheus
+    python tools/cluster_report.py --json            # merged JSON view
+
+Exit codes: 0 rendered, 2 nothing to merge.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _fmt(v, suffix="", na="-"):
+    if not isinstance(v, (int, float)):
+        return na
+    return f"{v:.4g}{suffix}"
+
+
+def render(view, out=sys.stdout):
+    w = out.write
+    w(f"# cluster view — {view['n_processes']} process(es)\n\n")
+    hdr = (f"{'proc':>4} {'step':>8} {'step_time':>10} {'throughput':>11} "
+           f"{'hb_age':>8} {'snap_age':>9}")
+    w(hdr + "\n" + "-" * len(hdr) + "\n")
+    for r in view.get("processes", []):
+        w(f"{r.get('process_index', 0):>4} "
+          f"{r.get('step') if r.get('step') is not None else '-':>8} "
+          f"{_fmt(r.get('step_time_mean_s'), 's'):>10} "
+          f"{_fmt(r.get('throughput'), '/s'):>11} "
+          f"{_fmt(r.get('heartbeat_age_s'), 's'):>8} "
+          f"{_fmt(r.get('snapshot_age_s'), 's'):>9}\n")
+    skew = view.get("step_time_skew")
+    w(f"\nstep-time skew (slowest/median): {_fmt(skew, 'x', na='n/a')}\n")
+    stragglers = view.get("stragglers", [])
+    if not stragglers:
+        w("stragglers: none\n")
+    else:
+        w(f"stragglers: {len(stragglers)}\n")
+        for s in stragglers:
+            verdict = "DYING (stale heartbeat)" if s.get("suspect_dead") \
+                else "slow"
+            w(f"  proc {s['process_index']}: "
+              f"{_fmt(s['step_time_mean_s'], 's')} "
+              f"({s['vs_median']}x median, hb age "
+              f"{_fmt(s.get('heartbeat_age_s'), 's', na='n/a')}) "
+              f"— {verdict}\n")
+    ctx = view.get("context")
+    if ctx:
+        w(f"context: {json.dumps(ctx, default=str)}\n")
+
+
+def _load_view(target):
+    from bigdl_tpu.observability import cluster
+    if target and os.path.isfile(target):
+        with open(target) as f:
+            doc = json.load(f)
+        if doc.get("schema") != cluster.CLUSTER_SCHEMA:
+            raise ValueError(f"not a cluster view: {target}")
+        return doc
+    return cluster.aggregate(target or None)
+
+
+def main(argv=None):
+    from bigdl_tpu.observability import cluster
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", nargs="?",
+                    help="snapshot dir or cluster_view_*.json "
+                    "(default: the flight dir)")
+    ap.add_argument("--prom", metavar="PATH",
+                    help="also write the merged Prometheus text here")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged view as JSON")
+    args = ap.parse_args(argv)
+    try:
+        view = _load_view(args.target)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"cluster_report: {e}", file=sys.stderr)
+        return 2
+    if view is None:
+        print("cluster_report: no metric snapshots found (set "
+              "BIGDL_TPU_METRIC_SNAP_S to enable the per-process "
+              "cadence)", file=sys.stderr)
+        return 2
+    if args.prom:
+        with open(args.prom, "w") as f:
+            f.write(cluster.prometheus_cluster_text(view))
+        print(f"cluster_report: wrote {args.prom}", file=sys.stderr)
+    if args.json:
+        json.dump(view, sys.stdout, indent=1, default=str)
+        print()
+    else:
+        render(view)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,9 +138,42 @@ def main():
     assert "optim/steps" in rows and "optim/step_time" in rows, rows
     assert "health/nan_streak" in rows or "optim/loss_syncs" in rows, rows
 
+    # -- phase 4: perf introspection round trip -------------------------
+    from bigdl_tpu.observability import cluster, perf
+    arts = perf.registry().artifacts()
+    assert any(a.name == "optim/step" for a in arts), \
+        f"no optim/step compiled artifact recorded: {arts}"
+    step_art = [a for a in arts if a.name == "optim/step"][-1]
+    if step_art.flops is not None:  # backend has cost analysis
+        mfu = obs.registry().get("perf/mfu")
+        assert mfu is not None and mfu.value > 0, \
+            "perf/mfu gauge missing despite a flops-bearing artifact"
+    dump = perf.dump_artifacts()
+    assert dump and os.path.exists(dump), "artifact dump failed"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "xla_report.py"),
+         dump], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "optim/step" in proc.stdout, proc.stdout
+
+    snap = cluster.MetricSnapshotWriter(every_s=1.0,
+                                        directory=_FLIGHT_DIR)
+    assert snap.write(step=STEPS), "metric snapshot write failed"
+    prom = os.path.join(_FLIGHT_DIR, "cluster.prom")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "cluster_report.py"),
+         _FLIGHT_DIR, "--prom", prom],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "cluster view" in proc.stdout, proc.stdout
+    with open(prom) as f:
+        assert "bigdl_cluster_processes" in f.read()
+
     print(f"obs_smoke: ok — {STEPS} healthy steps recorded, crash bundle "
           f"{os.path.basename(bundles[-1])} round-tripped through "
-          f"flight_report, metrics artifact has {len(rows)} rows "
+          f"flight_report, metrics artifact has {len(rows)} rows, "
+          f"{len(arts)} compiled artifact(s) round-tripped through "
+          f"xla_report + cluster_report "
           f"(device memory stats: "
           f"{'available' if mem_ok else 'not on this backend'})")
 

@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Perf-regression gate: current BENCH_METRICS.json vs a pinned baseline.
+
+The bench pipeline already writes every result line into
+``BENCH_METRICS.json`` (one ``bench/<metric>`` gauge per line). This
+gate holds those numbers against ``PERF_BASELINE.json`` with
+per-metric tolerance bands and exits nonzero on regression — CI's
+answer to "did this PR silently slow the hot path": a throughput
+metric may not drop, a latency metric may not rise, by more than its
+band.
+
+Baseline schema (``bigdl_tpu.perf_baseline.v1``)::
+
+    {"schema": ..., "tolerance_default": 0.15,
+     "metrics": {"bench/<name>": {"value": v, "unit": u,
+                                  "direction": "higher"|"lower",
+                                  "tolerance": 0.15}}}
+
+Direction is inferred at capture time: latency-shaped units/names
+(``ms``, ``*_p99``) are lower-better, rates (``.../s``, ``x``) are
+higher-better. Refresh the pin after an intentional perf change with
+``--update`` (then commit the file).
+
+Usage::
+
+    python tools/perf_gate.py                  # gate (make perf-gate)
+    python tools/perf_gate.py --update         # re-pin from current
+    python tools/perf_gate.py --tolerance 0.1  # tighten the default band
+
+Exit codes: 0 pass (or nothing to compare — a fresh checkout must not
+fail tier-1), 1 regression, 2 usage error. ``--strict`` turns the
+nothing-to-compare cases into failures.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_SCHEMA = "bigdl_tpu.perf_baseline.v1"
+DEFAULT_TOLERANCE = 0.15
+DEFAULT_CURRENT = os.path.join(_REPO, "BENCH_METRICS.json")
+DEFAULT_BASELINE = os.path.join(_REPO, "PERF_BASELINE.json")
+
+
+def infer_direction(name: str, unit: str) -> str:
+    """lower-better for latency-shaped metrics, higher-better for
+    rates/speedups/MFU."""
+    n, u = name.lower(), (unit or "").lower()
+    if u == "ms" or u.endswith("ms") or n.endswith("_ms") \
+            or "_p99" in n or "latency" in n or "wait" in n:
+        return "lower"
+    return "higher"
+
+
+def gated_metrics(lines):
+    """The {name: {value, unit, direction}} subset of a BENCH_METRICS
+    dump worth gating: real measurements (rates, latencies, speedups,
+    MFU), not provenance side-gauges (vs_baseline, cache counters,
+    superstep K) or failure markers."""
+    out = {}
+    for line in lines:
+        name = line.get("metric")
+        value = line.get("value")
+        unit = line.get("unit", "") or ""
+        if not name or not isinstance(value, (int, float)):
+            continue
+        if "bench_failed" in name or unit == "error":
+            continue
+        if not unit and not name.endswith("/mfu"):
+            continue  # unitless side-gauges are provenance, not perf
+        if value <= 0:
+            continue  # a zero measurement is a failed capture, not a pin
+        out[name] = {"value": float(value), "unit": unit,
+                     "direction": infer_direction(name, unit)}
+    return out
+
+
+def make_baseline(lines, tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    return {"schema": BASELINE_SCHEMA,
+            "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime()),
+            "tolerance_default": float(tolerance),
+            "metrics": gated_metrics(lines)}
+
+
+def compare(current: dict, baseline: dict,
+            tolerance: float = None):
+    """(regressions, improvements, notes) — each a list of strings.
+    ``current`` is {name: {value...}} (from :func:`gated_metrics`),
+    ``baseline`` the pinned document."""
+    tol_default = tolerance if tolerance is not None else \
+        float(baseline.get("tolerance_default", DEFAULT_TOLERANCE))
+    base = baseline.get("metrics", {})
+    regressions, improvements, notes = [], [], []
+    for name, cur in sorted(current.items()):
+        pin = base.get(name)
+        if pin is None:
+            notes.append(f"new metric (no pin): {name} = {cur['value']}")
+            continue
+        bv = pin.get("value")
+        if not isinstance(bv, (int, float)) or bv <= 0:
+            notes.append(f"unpinnable baseline for {name}: {bv!r}")
+            continue
+        tol = float(pin.get("tolerance", tol_default))
+        direction = pin.get("direction") or infer_direction(
+            name, pin.get("unit", ""))
+        cv = cur["value"]
+        rel = (cv - bv) / bv
+        worse = -rel if direction == "higher" else rel
+        line = (f"{name}: {bv:g} -> {cv:g} ({rel:+.1%}, "
+                f"{direction}-is-better, band {tol:.0%})")
+        if worse > tol:
+            regressions.append(line)
+        elif worse < -tol:
+            improvements.append(line)
+    for name in sorted(set(base) - set(current)):
+        notes.append(f"pinned metric missing from current run: {name}")
+    return regressions, improvements, notes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--current", default=DEFAULT_CURRENT,
+                    help="BENCH_METRICS.json path")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="PERF_BASELINE.json path")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="override the default relative band")
+    ap.add_argument("--update", action="store_true",
+                    help="re-pin the baseline from the current metrics")
+    ap.add_argument("--strict", action="store_true",
+                    help="missing files / empty comparisons fail instead "
+                    "of passing")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.current) as f:
+            lines = json.load(f)
+    except (OSError, ValueError) as e:
+        msg = f"perf_gate: cannot read current metrics {args.current}: {e}"
+        print(msg, file=sys.stderr)
+        return 1 if args.strict else 0
+    current = gated_metrics(lines if isinstance(lines, list) else [])
+
+    if args.update:
+        doc = make_baseline(lines, args.tolerance or DEFAULT_TOLERANCE)
+        tmp = args.baseline + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.baseline)
+        print(f"perf_gate: pinned {len(doc['metrics'])} metric(s) into "
+              f"{args.baseline}")
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        msg = f"perf_gate: no baseline ({e}) — run --update to pin one"
+        print(msg, file=sys.stderr)
+        return 1 if args.strict else 0
+    if baseline.get("schema") != BASELINE_SCHEMA:
+        print(f"perf_gate: {args.baseline} is not a "
+              f"{BASELINE_SCHEMA} document", file=sys.stderr)
+        return 2
+
+    regressions, improvements, notes = compare(current, baseline,
+                                               args.tolerance)
+    for n in notes:
+        print(f"perf_gate: note: {n}")
+    for i in improvements:
+        print(f"perf_gate: IMPROVED {i}")
+    if regressions:
+        print(f"perf_gate: FAIL — {len(regressions)} metric(s) regressed "
+              f"past their band:", file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        print("(intentional? re-pin with: python tools/perf_gate.py "
+              "--update)", file=sys.stderr)
+        return 1
+    compared = len(set(current) & set(baseline.get("metrics", {})))
+    if compared == 0 and args.strict:
+        print("perf_gate: FAIL — nothing to compare (--strict)",
+              file=sys.stderr)
+        return 1
+    print(f"perf_gate: ok — {compared} metric(s) within their bands, "
+          f"{len(improvements)} improved")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

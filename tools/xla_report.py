@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""Render a compiled-program artifact dump as a per-program cost table.
+
+Input: the JSON ``bigdl_tpu.observability.perf.dump_artifacts`` writes
+(``xla_programs_<pid>.json`` in the flight dir) — one entry per
+compiled XLA program with XLA's own cost/memory analysis, compile wall
+time and cache provenance. Output: a table ranked by FLOPs, the
+arithmetic-intensity column that says compute- vs memory-bound at a
+glance, and an HBM-headroom section holding each program's resident
+bytes (arguments + outputs + temporaries) against the
+``mem/device_peak_bytes`` gauge captured in the same dump.
+
+Usage::
+
+    python tools/xla_report.py [dump.json]       # default: newest dump
+                                                 # in the flight dir
+    python tools/xla_report.py --json            # re-emit merged JSON
+
+Exit codes: 0 rendered, 2 no/unreadable dump.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _find_default_dump():
+    from bigdl_tpu.observability import flight
+    d = flight.bundle_dir()
+    if not os.path.isdir(d):
+        return None
+    dumps = [os.path.join(d, f) for f in os.listdir(d)
+             if f.startswith("xla_programs_") and f.endswith(".json")]
+    return max(dumps, key=os.path.getmtime) if dumps else None
+
+
+def _fmt_num(v, unit=""):
+    if v is None:
+        return "-"
+    for div, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{suffix}{unit}"
+    return f"{v:.0f}{unit}"
+
+
+def load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != "bigdl_tpu.xla_programs.v1":
+        raise ValueError(f"not an xla_programs dump: {path}")
+    return doc
+
+
+def render(doc, out=sys.stdout):
+    programs = doc.get("programs", [])
+    w = out.write
+    w(f"# compiled programs — pid {doc.get('pid')} "
+      f"({len(programs)} programs)\n\n")
+    if not programs:
+        w("(no programs recorded — was observability enabled?)\n")
+        return
+    rows = []
+    for p in programs:
+        a = p.get("analysis", {})
+        flops = a.get("flops")
+        ba = a.get("bytes_accessed")
+        resident = None
+        keys = ("argument_bytes", "output_bytes", "temp_bytes")
+        if any(k in a for k in keys):
+            resident = sum(a.get(k, 0.0) for k in keys)
+        rows.append((p, flops, ba, resident))
+    rows.sort(key=lambda r: -(r[1] or 0))
+    hdr = (f"{'program':<34} {'kind':<10} {'K':>2} {'flops':>9} "
+           f"{'bytes':>9} {'fl/B':>6} {'temp':>9} {'resident':>9} "
+           f"{'compile':>8} {'cache':>9}")
+    w(hdr + "\n" + "-" * len(hdr) + "\n")
+    for p, flops, ba, resident in rows:
+        a = p.get("analysis", {})
+        intensity = (flops / ba) if flops and ba else None
+        cache = f"{p.get('cache_hits', 0)}h/{p.get('cache_misses', 0)}m"
+        name = p.get("name", "?")
+        if p.get("degraded"):
+            name += " (!)"
+        w(f"{name:<34.34} {p.get('kind', '?'):<10.10} "
+          f"{p.get('steps_per_program', 1):>2} "
+          f"{_fmt_num(flops):>9} {_fmt_num(ba, 'B'):>9} "
+          f"{intensity and f'{intensity:.1f}' or '-':>6} "
+          f"{_fmt_num(a.get('temp_bytes'), 'B'):>9} "
+          f"{_fmt_num(resident, 'B'):>9} "
+          f"{p.get('compile_seconds', 0):>7.2f}s {cache:>9}\n")
+    degraded = [p for p, *_ in rows if p.get("degraded")]
+    if degraded:
+        w(f"\n(!) {len(degraded)} program(s) degraded — backend lacks "
+          f"cost/memory analysis:\n")
+        for p in degraded:
+            w(f"    {p.get('name')}: {p.get('degraded')}\n")
+
+    # HBM headroom: the biggest program's working set vs the device
+    # peak the mem/* telemetry saw
+    mem = doc.get("metrics", {})
+    peak = (mem.get("mem/device_peak_bytes") or {}).get("value")
+    biggest = max((r for r in rows if r[3] is not None),
+                  key=lambda r: r[3], default=None)
+    w("\n## HBM headroom\n\n")
+    if biggest is None:
+        w("(no memory analysis available)\n")
+        return
+    p, _, _, resident = biggest
+    w(f"largest program: {p.get('name')} — resident "
+      f"{_fmt_num(resident, 'B')} "
+      f"(args {_fmt_num(p['analysis'].get('argument_bytes'), 'B')}, "
+      f"out {_fmt_num(p['analysis'].get('output_bytes'), 'B')}, "
+      f"temp {_fmt_num(p['analysis'].get('temp_bytes'), 'B')})\n")
+    if isinstance(peak, (int, float)) and peak > 0:
+        w(f"device peak observed: {_fmt_num(peak, 'B')} "
+          f"(mem/device_peak_bytes)\n")
+        w(f"headroom at peak: {_fmt_num(peak - resident, 'B')} "
+          f"({'OVERCOMMIT RISK' if resident > peak * 0.9 else 'ok'})\n")
+    else:
+        w("device peak: not captured (mem/* telemetry inactive — CPU "
+          "backend or observability off)\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", nargs="?", help="xla_programs_*.json path "
+                    "(default: newest in the flight dir)")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit the dump as JSON instead of the table")
+    args = ap.parse_args(argv)
+    path = args.dump or _find_default_dump()
+    if not path or not os.path.exists(path):
+        print("xla_report: no artifact dump found (run with observability "
+              "enabled and call perf.dump_artifacts())", file=sys.stderr)
+        return 2
+    try:
+        doc = load(path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"xla_report: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1)
+        print()
+    else:
+        render(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
