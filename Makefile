@@ -41,12 +41,18 @@ perf-gate:
 
 # End-to-end serving engine drive on CPU with LeNet: warmup-compiled
 # buckets, concurrent clients, result-vs-direct-forward check, clean
-# drain — seconds, not minutes (BENCH_METRICS_OUT='' keeps the smoke
-# from touching the committed bench evidence). Full measured run:
-# `python bench_serving.py` (16 clients, enforces the 3x acceptance).
+# drain — plus the LM continuous-batching smoke (DecodeScheduler vs
+# whole-request batching over a paged KV cache, leak gate included) —
+# seconds, not minutes (BENCH_METRICS_OUT='' keeps the smoke from
+# touching the committed bench evidence). Full measured runs:
+# `python bench_serving.py` (16 clients, enforces the 3x acceptance)
+# and `python bench_serving.py --lm` (enforces continuous > static on
+# tokens/s AND p99 TTFT).
 serve-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
 		python bench_serving.py --smoke
+	timeout -k 10 300 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
+		python bench_serving.py --lm --smoke
 
 # Health-layer drive: train a tiny model with the stall watchdog +
 # flight recorder on, inject a step failure, and assert the crash

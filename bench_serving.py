@@ -23,12 +23,27 @@ the ``serve/latency_ms`` histogram), rejected/timeout counts — and
 rides ``BENCH_METRICS.json`` with the training bench lines
 (``BENCH_METRICS_OUT`` overrides the path, '' disables).
 
+LM mode (``--lm``) benches AUTOREGRESSIVE serving instead: a
+mixed-length closed-loop decode load (heterogeneous prompt lengths and
+generation budgets) over the continuous-batching
+:class:`bigdl_tpu.serving.DecodeScheduler`, versus WHOLE-REQUEST
+batching (the same scheduler in ``admission="static"`` mode: a batch
+admits, runs every member's full generation, drains, then the next
+batch forms — the pre-iteration-level serving discipline). Identical
+compiled kernels, identical requests — the arms isolate the
+scheduling policy. Reports ``serve/tokens_per_s``, TTFT p50/p99 and
+TPOT per arm (from the per-request trace dicts), and the
+continuous-vs-static ratios the perf gate pins.
+
 Run:
   JAX_PLATFORMS=cpu python bench_serving.py            # 16 clients
   JAX_PLATFORMS=cpu python bench_serving.py --smoke    # make serve-smoke
+  JAX_PLATFORMS=cpu python bench_serving.py --lm       # LM decode bench
+  JAX_PLATFORMS=cpu python bench_serving.py --lm --smoke
 
 Env knobs: SERVE_CLIENTS, SERVE_REQUESTS (per client), SERVE_MAX_BATCH,
-SERVE_MAX_WAIT_MS, SERVE_DEADLINE_MS.
+SERVE_MAX_WAIT_MS, SERVE_DEADLINE_MS; LM mode: SERVE_LM_CLIENTS,
+SERVE_LM_REQUESTS, SERVE_LM_SLOTS.
 """
 from __future__ import annotations
 
@@ -184,10 +199,167 @@ def bench_serving(n_clients: int, n_requests: int, max_batch: int,
     return lines, st, bad, dropped
 
 
+def _build_lm_model():
+    from bigdl_tpu.models.transformer_lm import TransformerLM
+    model = TransformerLM(vocab_size=128, hidden_size=64, num_heads=4,
+                          filter_size=128, num_layers=2, max_len=512)
+    model.ensure_initialized()
+    return model
+
+
+def _lm_workload(n_clients, n_requests, max_seq_len, seed=0):
+    """Deterministic mixed-length request plan: client i's request j has
+    its own (prompt, max_new) — short chats next to long-context
+    queries, the mix whole-request batching serves worst."""
+    rng = np.random.RandomState(seed)
+    plan = []
+    for i in range(n_clients):
+        reqs = []
+        for _ in range(n_requests):
+            tp = int(rng.randint(4, 49))
+            mn = int(rng.randint(4, 33))
+            reqs.append((rng.randint(1, 128, size=tp).astype(np.int32), mn))
+        plan.append(reqs)
+    return plan
+
+
+def _run_lm_arm(model, plan, admission, max_slots):
+    """One closed-loop run over ``plan``; returns (tokens/s, ttft list,
+    tpot list, stats). A warmup pass first compiles every bucket/chunk
+    shape so the timed window measures scheduling, not XLA."""
+    from bigdl_tpu.serving import DecodeScheduler
+    sched = DecodeScheduler(
+        model, max_slots=max_slots, block_size=16,
+        max_seq_len=max(96, max(int(p.size) + mn + 2
+                                for reqs in plan for p, mn in reqs)),
+        prefill_chunk=16, admission=admission)
+    n_clients = len(plan)
+    total_tokens = [0] * n_clients
+    ttfts, tpots = [], []
+    lock = threading.Lock()
+    with sched:  # start() precompiles every dispatchable shape
+        def client(i):
+            for prompt, max_new in plan[i]:
+                fut = sched.submit(prompt, max_new)
+                out = fut.result(timeout=300)
+                with lock:
+                    total_tokens[i] += int(out.size)
+                    if fut.trace:
+                        if fut.trace.get("ttft_ms") is not None:
+                            ttfts.append(fut.trace["ttft_ms"])
+                        if fut.trace.get("tpot_ms"):
+                            tpots.append(fut.trace["tpot_ms"])
+        dt = _client_pool(n_clients, client)
+        sched.drain(timeout=60.0)
+        st = sched.stats()
+    return sum(total_tokens) / dt, ttfts, tpots, st
+
+
+def _pct(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.999999))]
+
+
+def bench_serving_lm(n_clients, n_requests, max_slots):
+    model = _build_lm_model()
+    plan = _lm_workload(n_clients, n_requests, 512)
+    total = n_clients * n_requests
+    # static (whole-request) first, then continuous — same model
+    # instance, each arm warms its own compiled shapes before timing
+    thr_s, ttft_s, tpot_s, st_s = _run_lm_arm(model, plan, "static",
+                                              max_slots)
+    thr_c, ttft_c, tpot_c, st_c = _run_lm_arm(model, plan, "continuous",
+                                              max_slots)
+    lines = [{
+        "metric": "serving_lm_tokens_per_s",
+        "value": round(thr_c, 1), "unit": "tok/s",
+        "clients": n_clients, "requests": total, "max_slots": max_slots,
+        "decode_steps": st_c["decode_steps"],
+        "backend": "cpu",
+    }, {
+        "metric": "serving_lm_ttft_p50_ms",
+        "value": round(_pct(ttft_c, 0.5), 2), "unit": "ms",
+        "clients": n_clients, "backend": "cpu",
+    }, {
+        "metric": "serving_lm_ttft_p99_ms",
+        "value": round(_pct(ttft_c, 0.99), 2), "unit": "ms",
+        "clients": n_clients, "backend": "cpu",
+    }, {
+        "metric": "serving_lm_tpot_ms",
+        "value": round(sum(tpot_c) / max(len(tpot_c), 1), 3),
+        "unit": "ms", "clients": n_clients, "backend": "cpu",
+    }, {
+        "metric": "serving_lm_static_tokens_per_s",
+        "value": round(thr_s, 1), "unit": "tok/s",
+        "clients": n_clients, "requests": total, "max_slots": max_slots,
+        "backend": "cpu",
+    }, {
+        "metric": "serving_lm_static_ttft_p99_ms",
+        "value": round(_pct(ttft_s, 0.99), 2), "unit": "ms",
+        "clients": n_clients, "backend": "cpu",
+    }, {
+        "metric": "serving_lm_cb_speedup",
+        "value": round(thr_c / max(thr_s, 1e-9), 2), "unit": "x",
+        "clients": n_clients, "backend": "cpu",
+    }, {
+        "metric": "serving_lm_ttft_p99_ratio",
+        "value": round(_pct(ttft_s, 0.99) / max(_pct(ttft_c, 0.99), 1e-9),
+                       2), "unit": "x",
+        "clients": n_clients, "backend": "cpu",
+    }]
+    return lines, st_c, st_s
+
+
+def main_lm(smoke: bool):
+    n_clients = int(os.environ.get("SERVE_LM_CLIENTS", 3 if smoke else 8))
+    n_requests = int(os.environ.get("SERVE_LM_REQUESTS", 2 if smoke else 4))
+    max_slots = int(os.environ.get("SERVE_LM_SLOTS", 4 if smoke else 8))
+    lines, st_c, st_s = bench_serving_lm(n_clients, n_requests, max_slots)
+    for line in lines:
+        print(json.dumps(line), flush=True)
+    _merge_metrics_dump(lines)
+    by_metric = {l["metric"]: l for l in lines}
+    failures = []
+    total = n_clients * n_requests
+    for name, st in (("continuous", st_c), ("static", st_s)):
+        if st["timeouts"]:
+            failures.append(f"{st['timeouts']} {name} requests timed out")
+        if st["kv"]["blocks_in_use"]:
+            failures.append(f"{name}: {st['kv']['blocks_in_use']} KV "
+                            "blocks leaked")
+    speedup = by_metric["serving_lm_cb_speedup"]["value"]
+    ttft_ratio = by_metric["serving_lm_ttft_p99_ratio"]["value"]
+    if not smoke:
+        # ISSUE 8 acceptance: continuous batching must beat whole-
+        # request batching on BOTH axes (the smoke run is a plumbing
+        # check on whatever loaded CI box runs it)
+        if speedup < 1.0:
+            failures.append(f"continuous tokens/s speedup {speedup}x < 1x")
+        if ttft_ratio < 1.0:
+            failures.append(f"continuous p99 TTFT ratio {ttft_ratio}x < 1x "
+                            "(static had better tail latency)")
+    if failures:
+        print("bench_serving --lm: FAIL — " + "; ".join(failures),
+              file=sys.stderr)
+        raise SystemExit(1)
+    print(f"bench_serving --lm: ok — "
+          f"{by_metric['serving_lm_tokens_per_s']['value']} tok/s "
+          f"continuous vs "
+          f"{by_metric['serving_lm_static_tokens_per_s']['value']} tok/s "
+          f"whole-request ({speedup}x), p99 TTFT "
+          f"{by_metric['serving_lm_ttft_p99_ms']['value']}ms vs "
+          f"{by_metric['serving_lm_static_ttft_p99_ms']['value']}ms "
+          f"({ttft_ratio}x better), TPOT "
+          f"{by_metric['serving_lm_tpot_ms']['value']}ms")
+
+
 def _merge_metrics_dump(lines):
     """Serving lines ride BENCH_METRICS.json next to the training bench
-    lines: keep whatever bench.py last wrote, replace stale serving_*
-    entries, append ours."""
+    lines: keep whatever bench.py last wrote, replace ONLY the stale
+    entries this run re-measures (a --lm run must not delete the
+    classic serving evidence, nor vice versa), append ours."""
     out = os.environ.get("BENCH_METRICS_OUT", "BENCH_METRICS.json")
     if not out:
         return
@@ -198,11 +370,12 @@ def _merge_metrics_dump(lines):
     for line in lines:
         obs.record_bench_line(line, reg)
     new = obs.metrics_dump(reg)
+    stale = {str(e.get("metric", "")) for e in new}
     old = []
     try:
         with open(out) as f:
             old = [e for e in json.load(f)
-                   if not str(e.get("metric", "")).startswith("bench/serving_")]
+                   if str(e.get("metric", "")) not in stale]
     except (OSError, ValueError):
         pass
     try:
@@ -214,6 +387,8 @@ def _merge_metrics_dump(lines):
 
 def main():
     smoke = "--smoke" in sys.argv
+    if "--lm" in sys.argv:
+        return main_lm(smoke)
     n_clients = int(os.environ.get("SERVE_CLIENTS", 4 if smoke else 16))
     n_requests = int(os.environ.get("SERVE_REQUESTS", 4 if smoke else 32))
     max_batch = int(os.environ.get("SERVE_MAX_BATCH", n_clients))

@@ -43,19 +43,28 @@ def _glorot(rng, shape):
 def rotary_embedding(x, positions, base: float = 10000.0):
     """Rotary position embedding (RoPE, rotate-half convention).
 
-    x: (..., T, D) with D even; positions: (T,) integer global positions.
+    x: (..., T, D) with D even; positions: (T,) integer global positions,
+    or (B, T) PER-ROW positions for x shaped (B, H, T, D) — the paged
+    decode layout, where every batch row sits at its own sequence depth.
     Rotation is absolute per position, so attention logits depend only on
     relative distance — the modern alternative to the reference's additive
     sinusoidal PE (``nn/TransformerOperation.scala`` getPositionEncode),
     and the form KV caches prefer (cache entries hold already-rotated K).
+    The per-row branch computes cos/sin from the identical ``pos * freqs``
+    products, so a given position's rotation is bitwise the same whether
+    it arrived via the shared or the per-row path.
     """
     d = x.shape[-1]
     if d % 2:
         raise ValueError(f"RoPE needs an even head dim, got {d}")
     half = d // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos, sin = jnp.cos(ang), jnp.sin(ang)          # (T, half)
+    if positions.ndim == 2:
+        ang = positions.astype(jnp.float32)[..., None] * freqs  # (B,T,half)
+        cos, sin = jnp.cos(ang)[:, None], jnp.sin(ang)[:, None]
+    else:
+        ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+        cos, sin = jnp.cos(ang), jnp.sin(ang)      # (T, half)
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
     return out.astype(x.dtype)
@@ -266,6 +275,74 @@ class Attention(Module):
             w = jax.nn.softmax(logits, axis=-1)
             o = jnp.einsum("bhst,bhtd->bhsd", w, v_cache)
         return self._merge(o, params), k_cache, v_cache
+
+    def decode_paged(self, params, x, k_pages, v_pages, block_tables,
+                     positions):
+        """Cached attention over a PAGED KV store with PER-ROW positions —
+        the continuous-batching decode primitive (serving/kv_cache.py,
+        serving/decode_scheduler.py). Where :meth:`decode_chunk` indexes
+        one dense (B, kvH, Tmax, D) cache at a single shared ``pos``,
+        this path lets every batch row sit at its own sequence depth in
+        fixed-size HBM blocks shared by the whole engine:
+
+        x: (B, S, H) tokens landing at positions
+        ``positions[b] .. positions[b]+S-1`` (S=1 is the decode step,
+        S=k+1 the speculative verify chunk);
+        k_pages/v_pages: (num_blocks, kvH, block_size, D) — the pooled
+        block storage; block_tables: (B, max_blocks) int32 mapping row
+        ``b``'s logical block ``i`` to a physical page (0 is the
+        engine's reserved null block — padded slots point every entry
+        there); positions: (B,) int32.
+
+        Writes the S new K/V entries through the table (scatter), then
+        attends over the gathered logical view with causal-within-chunk
+        + everything-before masking per row. The gathered view presents
+        logical positions 0..max_blocks*block_size-1 in order and masked
+        positions contribute exactly 0 after softmax (their logits are
+        -1e30 → exp underflows to +0.0), so the unmasked arithmetic is
+        bitwise-identical to :meth:`decode_chunk` over a dense cache —
+        the continuous-batching correctness gate rests on that.
+        Returns (out (B, S, H), k_pages, v_pages)."""
+        q, k_t, v_t = self.qkv(params, x)
+        B, S = x.shape[0], x.shape[1]
+        if self.rope:
+            p = positions[:, None] + jnp.arange(S)[None, :]     # (B, S)
+            q = rotary_embedding(q, p)
+            k_t = rotary_embedding(k_t, p)   # pages hold rotated K
+        bs = k_pages.shape[2]
+        pos_s = positions[:, None] + jnp.arange(S)[None, :]     # (B, S)
+        blk = jnp.take_along_axis(block_tables, pos_s // bs, axis=1)
+        off = pos_s % bs
+        # k_t (B, kvH, S, D) -> (B, S, kvH, D) rows scattered through the
+        # table; duplicate indices only ever occur between padded slots
+        # aimed at the null block (garbage either way)
+        k_pages = k_pages.at[blk, :, off, :].set(
+            jnp.moveaxis(k_t, 1, 2).astype(k_pages.dtype))
+        v_pages = v_pages.at[blk, :, off, :].set(
+            jnp.moveaxis(v_t, 1, 2).astype(v_pages.dtype))
+        # gather the logical view: (B, nblk, kvH, bs, D) -> (B, kvH, T, D)
+        kg = jnp.moveaxis(k_pages[block_tables], 2, 1)
+        vg = jnp.moveaxis(v_pages[block_tables], 2, 1)
+        t = block_tables.shape[1] * bs
+        kg = kg.reshape(B, kg.shape[1], t, -1)
+        vg = vg.reshape(B, vg.shape[1], t, -1)
+        d = q.shape[-1]
+        keep = (jnp.arange(t)[None, None, :] <= pos_s[:, :, None])  # (B,S,T)
+        groups = self.num_heads // self._kvh()
+        if groups > 1:
+            b, h, _, dd = q.shape
+            kvh = h // groups
+            qg = q.reshape(b, kvh, groups, S, dd)
+            logits = jnp.einsum("bkgsd,bktd->bkgst", qg, kg) / math.sqrt(d)
+            logits = jnp.where(keep[:, None, None], logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bkgst,bktd->bkgsd", w, vg).reshape(b, h, S, dd)
+        else:
+            logits = jnp.einsum("bhsd,bhtd->bhst", q, kg) / math.sqrt(d)
+            logits = jnp.where(keep[:, None], logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhst,bhtd->bhsd", w, vg)
+        return self._merge(o, params), k_pages, v_pages
 
     def _apply(self, params, state, x, training, rng):
         if isinstance(x, Table):
@@ -501,6 +578,17 @@ class TransformerBlock(Module):
             h_t = h_t + self.cross._merge(o, params["cross"])
         return self._ffn_sublayer(params, h_t), (k_cache, v_cache)
 
+    def decode_step_paged(self, params, h_t, k_pages, v_pages,
+                          block_tables, positions):
+        """The paged-cache analog of :meth:`decode_step` (LM blocks
+        only): h_t (B, S, H) lands at per-row positions
+        ``positions[b]..positions[b]+S-1`` through the block tables.
+        Returns (h (B, S, H), k_pages, v_pages)."""
+        n, _ = self.ln1.apply(params["ln1"], {}, h_t, False, None)
+        a, k_pages, v_pages = self.attn.decode_paged(
+            params["attn"], n, k_pages, v_pages, block_tables, positions)
+        return self._ffn_sublayer(params, h_t + a), k_pages, v_pages
+
 
 class Transformer(Module):
     """Transformer (nn/Transformer.scala). ``mode='lm'`` (decoder-only causal
@@ -721,6 +809,35 @@ class Transformer(Module):
         (nn/speculative.py)."""
         h, new_caches = self._decode_trunk(params, tokens, pos, caches)
         return h @ params["embed"].T, new_caches
+
+    def decode_paged(self, params, tokens, positions, pages, block_tables):
+        """S cached steps over a PAGED KV store with PER-ROW positions —
+        the continuous-batching decode step (serving/decode_scheduler.py).
+        tokens: (B, S) landing at positions
+        ``positions[b]..positions[b]+S-1``; positions: (B,) int32;
+        pages: per-block list of (k_pages, v_pages) each
+        (num_blocks, kvH, block_size, D); block_tables: (B, max_blocks)
+        int32 (see ``Attention.decode_paged``). Returns
+        (logits (B, S, V), pages). Row arithmetic is bitwise-identical
+        to :meth:`decode_chunk` over a dense cache at the same gemm
+        M-class (see serving/kv_cache.py docs for the M=1 caveat)."""
+        assert self.mode == "lm"
+        emb = jnp.take(params["embed"], tokens.astype(jnp.int32), axis=0)
+        h = emb * math.sqrt(self.hidden_size)
+        S = tokens.shape[1]
+        if getattr(self, "pos_encoding", "sinusoidal") != "rope":
+            pe = position_encoding(self.max_len, self.hidden_size,
+                                   emb.dtype)
+            pos_s = positions[:, None] + jnp.arange(S)[None, :]
+            h = h + jnp.take(pe, pos_s, axis=0)   # per-row PE rows
+        new_pages = []
+        for i, blk in enumerate(self.blocks):
+            h, kp, vp = blk.decode_step_paged(
+                params[f"block{i}"], h, pages[i][0], pages[i][1],
+                block_tables, positions)
+            new_pages.append((kp, vp))
+        h, _ = self.ln_f.apply(params["ln_f"], {}, h, False, None)
+        return h @ params["embed"].T, new_pages
 
     def generate(self, params, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None, top_k: int = 0,

@@ -14,12 +14,43 @@ from ..utils import engine
 from ..utils.table import Table
 
 
+def _stack_tree(items):
+    """[pytree, ...] (equal leaf shapes) -> one pytree of [K, ...]
+    device stacks — the evaluator/predictor superstep's group assembly,
+    run on the STAGER thread like the optimizer's (optim/staging.py)."""
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *items)
+
+
+def _tree_shape_key(item):
+    """Group key: leaf shapes+dtypes — a ragged epoch tail forms its own
+    (smaller) group instead of failing the stack."""
+    return tuple((tuple(l.shape), str(l.dtype))
+                 for l in jax.tree_util.tree_leaves(item))
+
+
 class Evaluator:
     def __init__(self, model, prefetch_depth: int = 2):
         self.model = model
         self.prefetch_depth = prefetch_depth
         self._fwd = None
         self._fwd_stats = None
+        self._superstep = 1
+
+    def set_superstep(self, k: int):
+        """Fuse K evaluation batches into ONE compiled dispatch — a
+        ``lax.scan`` forward with stacked per-method stats accumulation,
+        the forward-loop analog of ``Optimizer.set_superstep`` (ROADMAP
+        deferred item): per-batch dispatch envelope is paid once per K
+        batches, and the per-epoch readback stays ONE summed stats
+        vector. Applies to the device-stats path (every built-in
+        ValidationMethod); the host-metric fallback evaluates per batch
+        regardless. ``eval/dispatches`` counts compiled calls — the
+        K-fold drop is asserted in tests/test_superstep.py."""
+        if k < 1:
+            raise ValueError(f"superstep must be >= 1, got {k}")
+        self._superstep = int(k)
+        self._fwd_stats = None  # scan program differs — rebuild
+        return self
 
     def _forward_fn(self):
         if self._fwd is None:
@@ -37,7 +68,10 @@ class Evaluator:
     def _forward_stats_fn(self, methods):
         """Forward + per-method device stats in ONE jitted program, so
         the batch loop accumulates stats sums on device and never pulls
-        the (large) output tensor to host."""
+        the (large) output tensor to host. With ``set_superstep(K)`` the
+        program is a ``lax.scan`` over a [K, B, ...] batch stack whose
+        K per-batch stats vectors sum INSIDE the program — K batches,
+        one dispatch, still one number-vector out."""
         # key by the method OBJECTS (strong refs — an id()-keyed cache
         # could collide with a recycled address after the old list dies)
         key = tuple(methods)
@@ -46,11 +80,24 @@ class Evaluator:
             model = self.model
             engine.maybe_enable_compilation_cache()
 
-            def fwd_stats(params, state, x, y):
-                out, _ = model.apply(params, state, x, training=False)
-                return tuple(m.device_stats(out, y) for m in methods)
+            if self._superstep > 1:
+                def fwd_stats(params, state, xs, ys):
+                    def body(_, xy):
+                        x, y = xy
+                        out, _s = model.apply(params, state, x,
+                                              training=False)
+                        return None, tuple(m.device_stats(out, y)
+                                           for m in methods)
+                    _, stacked = jax.lax.scan(body, None, (xs, ys))
+                    return tuple(jnp.sum(s, axis=0) for s in stacked)
+                name = "eval/forward_stats_scan"
+            else:
+                def fwd_stats(params, state, x, y):
+                    out, _ = model.apply(params, state, x, training=False)
+                    return tuple(m.device_stats(out, y) for m in methods)
+                name = "eval/forward_stats"
             self._fwd_stats = (key, obs.perf.instrument_jit(
-                jax.jit(fwd_stats), name="eval/forward_stats",
+                jax.jit(fwd_stats), name=name,
                 kind="forward", key_argnums=(2, 3)))
         return self._fwd_stats[1]
 
@@ -85,12 +132,18 @@ class Evaluator:
         totals read back ONCE per epoch — the batch loop itself is
         sync-free (ROADMAP open item #4)."""
         fwd_stats = self._forward_stats_fn(methods)
+        k = self._superstep
         batched = ShardedDataSet(dataset, batch_size, drop_last=False)
         acc = None
         batches = staged(batched.data(train=False), self._stage_device,
-                         depth=self.prefetch_depth, name="eval_stager")
+                         depth=self.prefetch_depth, name="eval_stager",
+                         group=k,
+                         group_fn=_stack_tree if k > 1 else None,
+                         group_key=_tree_shape_key if k > 1 else None)
         try:
             for x, y in batches:
+                # superstep: (x, y) is a [j<=K, B, ...] device stack and
+                # this ONE dispatch scans all j batches
                 sp = obs.span("eval/batch")
                 with sp:
                     stats = fwd_stats(self.model.params, self.model.state,
@@ -98,6 +151,7 @@ class Evaluator:
                     acc = stats if acc is None else tuple(
                         a + s for a, s in zip(acc, stats))
                 if obs.enabled():
+                    obs.counter("eval/dispatches").inc()
                     obs.histogram("eval/batch_s", unit="s").observe(
                         sp.duration_s)
         finally:
@@ -128,6 +182,7 @@ class Evaluator:
                         results[i] = r if results[i] is None \
                             else results[i] + r
                 if obs.enabled():
+                    obs.counter("eval/dispatches").inc()
                     # one clock source: the histogram reads the span's own
                     # duration rather than timing the interval a second time
                     obs.histogram("eval/batch_s", unit="s").observe(

@@ -192,12 +192,44 @@ class Predictor:
         self.model = model
         self.batch_per_partition = batch_per_partition
         self.prefetch_depth = prefetch_depth
+        self._superstep = 1
+        self._scan_jit = None
+
+    def set_superstep(self, k: int):
+        """Fuse K prediction batches into ONE compiled ``lax.scan``
+        dispatch (the Evaluator's superstep, for the output path): the
+        stager stacks K same-shape staged batches to [K, B, ...], one
+        program runs all K forwards, and the per-batch outputs come back
+        as device-resident slices of the [K, B, ...] stack — the lagged
+        readback window in :meth:`predict` is unchanged.
+        ``predict/dispatches`` counts compiled calls (K-fold drop
+        asserted in tests/test_superstep.py)."""
+        if k < 1:
+            raise ValueError(f"superstep must be >= 1, got {k}")
+        self._superstep = int(k)
+        return self
 
     def _default_batch(self):
         return self.batch_per_partition * max(1, len(jax.devices()))
 
     def _forward_fn(self):
         return shared_forward(self.model)
+
+    def _scan_forward_fn(self):
+        if self._scan_jit is None:
+            model = self.model
+            engine.maybe_enable_compilation_cache()
+
+            def fwd_scan(params, state, xs):
+                def body(_, x):
+                    out, _s = model.apply(params, state, x, training=False)
+                    return None, out
+                return jax.lax.scan(body, None, xs)[1]
+            self._scan_jit = obs.perf.instrument_jit(
+                jax.jit(fwd_scan),
+                name=f"predict/forward_scan/{type(model).__name__}",
+                kind="forward", key_argnums=(2,))
+        return self._scan_jit
 
     def _iter_outputs(self, dataset, batch_size):
         """Yields DEVICE-resident per-batch ``(output, rows)`` pairs: the
@@ -223,15 +255,48 @@ class Predictor:
                 x = pad_leading(x, bucket_for(n, max_batch))
             return place_host_value(x), n
 
+        k = self._superstep
+
+        def _group(items):
+            # [(x, n), ...] -> ([K, B, ...] device stack, (n, ...)) on
+            # the stager thread (equal padded shapes via the group key)
+            from .evaluator import _stack_tree
+            return (_stack_tree([x for x, _ in items]),
+                    tuple(n for _, n in items))
+
+        def _gkey(item):
+            from .evaluator import _tree_shape_key
+            return _tree_shape_key(item[0])
+
         batched = ShardedDataSet(dataset, batch_size, drop_last=False)
         batches = staged(batched.data(train=False), _stage,
-                         depth=self.prefetch_depth, name="predict_stager")
+                         depth=self.prefetch_depth, name="predict_stager",
+                         group=k, group_fn=_group if k > 1 else None,
+                         group_key=_gkey if k > 1 else None)
+        scan_fwd = self._scan_forward_fn() if k > 1 else None
         try:
-            for x, n in batches:
+            for item in batches:
                 sp = obs.span("predict/batch")
+                if k > 1:
+                    xs, ns = item
+                    with sp:
+                        outs = scan_fwd(self.model.params,
+                                        self.model.state, xs)
+                    if obs.enabled():
+                        obs.counter("predict/dispatches").inc()
+                        obs.histogram("predict/batch_s", unit="s").observe(
+                            sp.duration_s)
+                    # device-resident slices of the [K, B, ...] stack —
+                    # the consumer's lagged-fetch window is unchanged
+                    for i, n in enumerate(ns):
+                        yield jax.tree_util.tree_map(lambda o, i=i: o[i],
+                                                     outs), n
+                    continue
+                x, n = item
                 with sp:
                     out = fwd(self.model.params, self.model.state, x)
                 if obs.enabled():
+                    obs.counter("predict/dispatches").inc()
                     obs.histogram("predict/batch_s", unit="s").observe(
                         sp.duration_s)
                 yield out, n

@@ -242,6 +242,23 @@ class MAE(ValidationMethod):
         return "MAE"
 
 
+def _device_pos_ranks(output, target):
+    """The sorted-scores rank formulation shared by the on-device
+    HitRatio/NDCG stats (ROADMAP: "a sorted-scores formulation could
+    move them on-device"): instead of the host path's per-positive
+    O(N) scan (``sum(out > p)``), sort the scores ONCE and read each
+    element's strictly-greater count off ``searchsorted`` —
+    O(N log N) total, fully traced, no data-dependent shapes. Returns
+    (pos_mask (N,), rank (N,) 1-based) matching the host arithmetic
+    exactly (strict > comparison, ties share a rank)."""
+    out = output.reshape(-1).astype(jnp.float32)
+    t = target.reshape(-1)
+    pos = t > 0.5
+    asc = jnp.sort(out)
+    n_greater = out.size - jnp.searchsorted(asc, out, side="right")
+    return pos, n_greater + 1
+
+
 class HitRatio(ValidationMethod):
     """optim/ValidationMethod.scala:279 — HR@k for recommendation: each row of
     output scores 1 positive + negNum negatives; target marks the positive."""
@@ -260,6 +277,17 @@ class HitRatio(ValidationMethod):
             hits += 1.0 if rank <= self.k else 0.0
             count += 1
         return AccuracyResult(int(hits), max(count, 1))
+
+    def device_stats(self, output, target):
+        pos, rank = _device_pos_ranks(output, target)
+        hits = jnp.sum(jnp.where(pos & (rank <= self.k), 1.0, 0.0))
+        return jnp.stack([hits, jnp.sum(pos.astype(jnp.float32))])
+
+    def result_from_stats(self, stats):
+        # count clamps at the AGGREGATE (the host path clamps per batch;
+        # they differ only for positive-free batches, which the ranking
+        # protocol — one positive per candidate list — never produces)
+        return AccuracyResult(int(stats[0]), max(int(stats[1]), 1))
 
     def __repr__(self):
         return f"HitRate@{self.k}"
@@ -284,6 +312,20 @@ class NDCG(ValidationMethod):
             count += 1
         r = LossResult(total, max(count, 1))
         return r
+
+    def device_stats(self, output, target):
+        pos, rank = _device_pos_ranks(output, target)
+        # f32 log vs the host's f64: the summed gain agrees to ~1e-6
+        # relative — the device path trades the last float digits for
+        # zero per-batch readbacks (see Evaluator._evaluate_device)
+        gain = jnp.where(rank <= self.k,
+                         jnp.log(2.0) / jnp.log(rank.astype(jnp.float32)
+                                                + 1.0), 0.0)
+        total = jnp.sum(jnp.where(pos, gain, 0.0))
+        return jnp.stack([total, jnp.sum(pos.astype(jnp.float32))])
+
+    def result_from_stats(self, stats):
+        return LossResult(float(stats[0]), max(int(stats[1]), 1))
 
     def __repr__(self):
         return f"NDCG@{self.k}"

@@ -19,6 +19,14 @@ design pays (the overhead 1805.08430 "RPC Considered Harmful" measures).
   background load + atomic activate; the engine snapshots the active
   version once per batch, so hot swap never mixes versions inside a
   response.
+* ``kv_cache`` — :class:`PagedKVCache`: fixed-size HBM blocks +
+  per-request block tables (vLLM's paged layout), the block ledger
+  exported as ``serve/kv_*`` gauges.
+* ``decode_scheduler`` — :class:`DecodeScheduler`: continuous batching
+  for autoregressive LM decode — requests join/leave the running batch
+  at decode-step boundaries over ONE compiled paged step; chunked
+  prefill admission, per-request version pinning for hot swap, optional
+  speculative fast path (docs/SERVING.md "Continuous batching").
 
 Metrics (`docs/OBSERVABILITY.md`): ``serve/queue_depth``,
 ``serve/batch_occupancy``, ``serve/latency_ms``, ``serve/rejected``,
@@ -29,6 +37,10 @@ from .batching import (QueueFull, DeadlineExceeded, EngineStopped,
                        ServeFuture, Request, assemble)
 from .registry import ModelRegistry, ModelVersion
 from .engine import ServingEngine, serving_threads_alive, THREAD_NAME
+from .kv_cache import KVCacheOOM, PagedKVCache, blocks_for_tokens
+from .decode_scheduler import (DecodeScheduler, LMRequest,
+                               decode_scheduler_threads_alive,
+                               prefill_schedule)
 # the transient-failure classification is SHARED with the trainer's
 # FaultPolicy (parallel/failure.py): a batch whose compiled forward
 # fails with a transient device error is re-dispatched once before its

@@ -1,0 +1,877 @@
+"""Continuous batching: iteration-level LM decode scheduling.
+
+The :class:`~.engine.ServingEngine` batches ONE-SHOT forwards — a
+request enters a micro-batch, the batch dispatches, every row resolves.
+Autoregressive decode breaks that shape: a request is not one forward
+but hundreds, and whole-request batching (cut a batch, run EVERY
+member's full generation, return together) makes short requests wait
+for the longest member while freed rows decode as padding. Continuous
+batching (Orca's iteration-level scheduling; the vLLM serving loop)
+reschedules at DECODE-STEP boundaries instead: requests join the
+running batch the step after they arrive, leave the step they finish,
+and the ONE compiled decode step stays hot the whole time — scheduling
+work onto fixed compiled shapes rather than reshaping per request, the
+same discipline the training side's superstep/bucket work rides.
+
+Shape discipline (why recompiles never happen mid-traffic):
+
+* the KV cache is PAGED (``kv_cache.PagedKVCache``) — fixed-size blocks
+  + per-request block tables, so heterogeneous sequence lengths share
+  one pooled allocation and the compiled step's cache operand never
+  changes shape;
+* active rows pad to POWER-OF-TWO buckets (``optim.predictor.
+  bucket_for`` — the serving engine's discipline) with a floor of 2:
+  XLA CPU lowers 1-row matmuls to a gemv kernel that differs from the
+  >=2-row gemm in the last ulp, and a bucket floor of 2 keeps every
+  step of every request in ONE gemm M-class — that is what makes a
+  request's tokens bitwise-identical whether it decodes alone or with
+  the batch reshuffling around it (the correctness gate in
+  tests/test_serving_lm.py);
+* prompts prefill in fixed CHUNKS (pow-2-bucketed tail) through the
+  same paged path, so a long prompt costs O(chunk * Tp) attention
+  scratch and a bounded set of compiled shapes.
+
+Hot swap: a request PINS the model version active at its admission and
+keeps it to completion — swap() takes effect for later admissions, and
+each dispatch serves exactly one version group, so no dispatch (and no
+request continuation) ever mixes versions. Speculative decoding
+(nn/speculative.py's draft-propose / chunk-verify pattern) rides the
+same paged step as an opt-in fast path whenever exactly one request is
+active — the regime where lockstep acceptance actually pays.
+
+Per-request telemetry rides the PR-5 rid machinery: ``serve/prefill``
+and ``serve/decode_step`` spans carry rids, and every future leaves
+with a trace dict ({rid, queue_wait_ms, prefill_ms, ttft_ms, tpot_ms,
+decode_steps, tokens, version}) plus the ``serve/ttft_ms`` /
+``serve/tpot_ms`` histograms and the tokens/s lines the LM bench
+(bench_serving.py --lm) reports. See docs/SERVING.md "Continuous
+batching".
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as obs
+from ..observability import cluster as _cluster
+from ..observability import flight as _flight
+from ..observability import health as _health
+from ..optim.predictor import bucket_for
+from .batching import (DeadlineExceeded, EngineStopped, QueueFull,
+                       ServeFuture)
+from .kv_cache import KVCacheOOM, PagedKVCache, blocks_for_tokens
+from .registry import ModelRegistry
+
+THREAD_NAME = "bigdl_tpu-serving-decode-scheduler"
+
+_STAT_KEYS = ("submitted", "completed", "rejected", "timeouts",
+              "decode_steps", "prefill_chunks", "tokens", "swaps",
+              "spec_rounds", "spec_accepted", "defrags")
+
+
+def _pow2_bucket(n: int, cap: int, floor: int = 2) -> int:
+    """Smallest power of two >= n, floored (gemm M-class — see module
+    docstring) and capped."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
+def prefill_schedule(prompt_len: int, chunk: int):
+    """The chunked-prefill plan for a prompt: [(start, real, padded)].
+    Full chunks run at ``chunk``; the tail pads to a power-of-two
+    bucket (floor 2), so the compiled prefill shapes are bounded to
+    {2, 4, ..., chunk}. Shared with the solo-decode oracle in
+    tests/test_serving_lm.py so both sides chunk identically."""
+    out = []
+    s = 0
+    while s < prompt_len:
+        real = min(chunk, prompt_len - s)
+        out.append((s, real, _pow2_bucket(real, chunk)))
+        s += real
+    return out
+
+
+def prefill_padded_end(prompt_len: int, chunk: int) -> int:
+    """Highest position (exclusive) the padded prefill writes — the
+    capacity the block reservation must cover."""
+    s, real, padded = prefill_schedule(prompt_len, chunk)[-1]
+    return s + padded
+
+
+class LMRequest:
+    """One in-flight generation: prompt, budget, and decode state."""
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "future", "rid",
+                 "deadline", "t_enqueue", "t_enqueue_ns", "t_admit_ns",
+                 "t_first_ns", "t_done_ns", "prefill_ms", "version",
+                 "model_version", "slot", "pos", "generated", "steps",
+                 "chunks", "pf_i")
+
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline_s, rid):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.future = ServeFuture()
+        self.future.rid = rid
+        self.rid = rid
+        self.t_enqueue = time.monotonic()
+        self.t_enqueue_ns = time.perf_counter_ns()
+        self.t_admit_ns = None
+        self.t_first_ns = None
+        self.t_done_ns = None
+        self.prefill_ms = 0.0
+        self.deadline = (self.t_enqueue + deadline_s
+                         if deadline_s is not None else None)
+        self.version = None        # pinned at admission
+        self.model_version = None  # the ModelVersion object (params ref)
+        self.slot = None
+        self.pos = 0               # next cache write position
+        self.generated = []
+        self.steps = 0             # decode dispatches this request rode
+        self.chunks = None         # prefill_schedule, set at admission
+        self.pf_i = 0              # next prefill chunk to run
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now or time.monotonic()) > self.deadline)
+
+
+class DecodeScheduler:
+    """Iteration-level LM serving over one decoder-only model.
+
+    Parameters
+    ----------
+    model : LM-mode ``nn.Transformer`` (``models.TransformerLM``).
+    max_slots : fixed slot capacity of the running batch (>= 2); active
+        rows pad to power-of-two buckets within it.
+    block_size / max_seq_len : paged-KV geometry — ``max_seq_len``
+        bounds prompt + generation per request (must be <= the model's
+        ``max_len``); blocks hold ``block_size`` positions each.
+    num_blocks : pooled block count (+1 reserved null block). Default
+        sizes the pool so every slot can hold a full ``max_seq_len``
+        sequence; shrink it to exercise admission backpressure.
+    prefill_chunk : chunked-prefill piece size (pow-2, >= 2).
+    draft_model : optional LM sharing the vocab — enables the greedy
+        speculative fast path when exactly one request is active.
+    admission : ``"continuous"`` (iteration-level — the point of this
+        class) or ``"static"`` (whole-request batching: a batch admits
+        only when the previous one fully drained — the bench baseline).
+    eos_id : default end-of-sequence id (per-request override at
+        ``submit``); greedy decoding only.
+    """
+
+    def __init__(self, model, *, max_slots: int = 8, block_size: int = 16,
+                 max_seq_len: int = 256, num_blocks: Optional[int] = None,
+                 prefill_chunk: int = 32, draft_model=None, spec_k: int = 4,
+                 max_queue: int = 256,
+                 default_deadline_ms: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 registry: Optional[ModelRegistry] = None,
+                 admission: str = "continuous",
+                 static_wait_ms: float = 4.0,
+                 stall_deadline_s: Optional[float] = None):
+        if model.mode != "lm":
+            raise ValueError("DecodeScheduler serves LM-mode models")
+        if max_slots < 2:
+            raise ValueError(f"max_slots must be >= 2 (the bucket floor "
+                             f"— see module docstring), got {max_slots}")
+        if prefill_chunk < 2 or (prefill_chunk & (prefill_chunk - 1)):
+            raise ValueError(f"prefill_chunk must be a power of two >= 2, "
+                             f"got {prefill_chunk}")
+        if max_seq_len > model.max_len:
+            raise ValueError(f"max_seq_len {max_seq_len} > model.max_len "
+                             f"{model.max_len}")
+        if admission not in ("continuous", "static"):
+            raise ValueError(f"admission must be 'continuous' or 'static', "
+                             f"got {admission!r}")
+        model.ensure_initialized()
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(max_seq_len)
+        self.prefill_chunk = int(prefill_chunk)
+        self.admission = admission
+        self.default_deadline_ms = default_deadline_ms
+        self.eos_id = eos_id
+        self.spec_k = int(spec_k)
+        mbs = blocks_for_tokens(max_seq_len, block_size)
+        if num_blocks is None:
+            num_blocks = self.max_slots * mbs + 1
+        self.kv = PagedKVCache(model, num_blocks=num_blocks,
+                               block_size=block_size,
+                               max_blocks_per_seq=mbs)
+        self.draft_model = draft_model
+        self.draft_kv = None
+        if draft_model is not None:
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError("draft and target must share a vocabulary")
+            draft_model.ensure_initialized()
+            self.draft_kv = PagedKVCache(draft_model, num_blocks=num_blocks,
+                                         block_size=block_size,
+                                         max_blocks_per_seq=mbs,
+                                         metric_prefix="serve/draft_kv")
+        self.registry = registry or ModelRegistry()
+        if self.registry.current() is None:
+            self.registry.publish(model.params, model.state, version="v0",
+                                  activate=True)
+        self._step_jit = self._build_step(model, "serve/decode_step")
+        self._draft_jit = (self._build_step(draft_model, "serve/draft_step")
+                           if draft_model is not None else None)
+        self.static_wait_ms = float(static_wait_ms)
+        self.max_queue = int(max_queue)
+        self._q: queue.Queue = queue.Queue(maxsize=self.max_queue)
+        self._defrag_wanted = threading.Event()
+        self._backlog: deque = deque()   # scheduler-local, arrival order
+        self._prefilling: deque = deque()  # admitted, prompt mid-prefill
+        self._active: list = []          # decoding LMRequests, slot order
+        self._free_slots = list(range(self.max_slots - 1, -1, -1))
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._stop = threading.Event()
+        self._pending = 0
+        self._cond = threading.Condition()
+        self._stats = dict.fromkeys(_STAT_KEYS, 0)
+        self._stats_lock = threading.Lock()
+        self._rids = itertools.count()
+        self.stall_deadline_s = stall_deadline_s
+        self._beacon = _health.NULL_BEACON
+        self._snap_writer = _cluster.default_writer()
+
+    @staticmethod
+    def _build_step(model, name):
+        """The ONE compiled paged decode step: argmax next-token choices
+        for every (row, chunk-position) plus the functionally-updated
+        pages. Params are arguments, so every model version shares the
+        executable; distinct (bucket, S) shapes compile once each."""
+
+        def step(params, pages, tokens, positions, tables):
+            logits, pages = model.decode_paged(params, tokens, positions,
+                                               pages, tables)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pages
+
+        return obs.perf.instrument_jit(jax.jit(step), name=name,
+                                       kind="forward",
+                                       key_argnums=(2, 3, 4))
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self, warmup: bool = True):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        if self._closed:
+            raise EngineStopped("scheduler was shut down; build a new one")
+        if warmup:
+            self.warmup()
+        self._beacon = _health.beacon("serving/decode_scheduler",
+                                      deadline_s=self.stall_deadline_s)
+        self._thread = threading.Thread(target=self._run, name=THREAD_NAME,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def warmup(self):
+        """Precompile EVERY shape the scheduler can dispatch — decode
+        buckets {2, 4, ..., max_slots}, prefill chunk shapes
+        {2, 4, ..., prefill_chunk}, and the speculative draft/verify
+        shapes — by driving the compiled step against the null block
+        table (writes land in the reserved garbage block). With the
+        persistent compile cache on, a restarted server warms from disk;
+        either way no live request ever pays an XLA compile."""
+        def shapes_upto(cap, lo=2):
+            out, b = [], lo
+            while b < cap:
+                out.append(b)
+                b <<= 1
+            out.append(cap)
+            return out
+
+        def drive(jit_fn, pages_of, B, S):
+            cache = pages_of
+            table = np.zeros((B, cache.max_blocks_per_seq), np.int32)
+            with obs.span("serve/warmup_decode", shape=(B, S)):
+                choices, pages = jit_fn(
+                    self.registry.current().params if cache is self.kv
+                    else self.draft_model.params,
+                    cache.pages(), jnp.zeros((B, S), jnp.int32),
+                    jnp.zeros((B,), jnp.int32), jnp.asarray(table))
+                cache.set_pages(pages)
+                # sync-ok: warmup precompile — runs before serving starts
+                jax.block_until_ready(choices)
+
+        for b in shapes_upto(self.max_slots):
+            drive(self._step_jit, self.kv, b, 1)
+        for s in shapes_upto(self.prefill_chunk):
+            drive(self._step_jit, self.kv, 1, s)
+        if self.draft_model is not None:
+            drive(self._draft_jit, self.draft_kv, 1, 1)
+            for s in shapes_upto(self.prefill_chunk):
+                drive(self._draft_jit, self.draft_kv, 1, s)
+            drive(self._step_jit, self.kv, 1, self.spec_k + 1)
+        return self
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            return self._cond.wait_for(lambda: self._pending == 0, timeout)
+
+    def shutdown(self, drain: bool = True, timeout: float = 60.0):
+        """Graceful by default: stop admitting, serve everything already
+        queued/active to completion, join. ``drain=False`` abandons all
+        in-flight work with typed :class:`EngineStopped` failures. Either
+        way every KV block returns to the free list before this returns
+        (``serve/kv_blocks_in_use`` drains to zero — the leak gate)."""
+        with self._cond:
+            self._closed = True
+        if not drain:
+            self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                import logging
+                # the drain is overrunning its budget: hard-stop the
+                # loop and give it one short grace to exit at the next
+                # step boundary — the cleanup below mutates scheduler-
+                # owned state and MUST NOT race a live loop
+                logging.getLogger(__name__).warning(
+                    "decode scheduler did not join within %.0fs — "
+                    "hard-stopping", timeout)
+                self._stop.set()
+                t.join(10.0)
+                if t.is_alive():
+                    # wedged inside a dispatch: leave its state alone
+                    # (freeing live requests' blocks under a running
+                    # loop would let a later admission alias their
+                    # pages); the stall watchdog owns this failure mode
+                    logging.getLogger(__name__).error(
+                        "decode scheduler wedged — skipping state "
+                        "cleanup; clients fail via the stall watchdog")
+                    self._beacon.close()
+                    return
+        self._beacon.close()
+        # hard stop (or a dead scheduler): fail whatever is left, free
+        # its blocks — a client must never hang and a block never leak
+        leftovers = list(self._active) + list(self._prefilling)
+        self._active.clear()
+        self._prefilling.clear()
+        while True:
+            try:
+                leftovers.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        leftovers.extend(self._backlog)
+        self._backlog.clear()
+        for r in leftovers:
+            self._release(r)
+            if not r.future.done():
+                try:
+                    r.future.set_exception(EngineStopped(
+                        "scheduler shut down before completion"))
+                except Exception:
+                    pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)
+        return False
+
+    # -- client surface --------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: int,
+               deadline_ms: Optional[float] = None,
+               eos_id="default") -> ServeFuture:
+        """Enqueue ONE generation request: ``prompt_ids`` (1-D int) →
+        future resolving to the GENERATED ids (np.int32, prompt
+        excluded; greedy). Raises :class:`QueueFull` / typed rejection
+        on over-budget requests; a deadline that expires mid-generation
+        fails the future with :class:`DeadlineExceeded` whose
+        ``partial`` attribute carries the tokens generated so far."""
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must be non-empty")
+        spec_over = (self.spec_k + 1) if self.draft_model is not None else 0
+        worst = max(prefill_padded_end(prompt.size, self.prefill_chunk),
+                    prompt.size + max_new_tokens + spec_over)
+        if worst > self.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new_tokens} "
+                f"(+ padding/speculation headroom) needs {worst} positions "
+                f"> max_seq_len {self.max_seq_len}")
+        ms = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        eid = self.eos_id if eos_id == "default" else eos_id
+        req = LMRequest(prompt, max_new_tokens, eid,
+                        ms / 1000.0 if ms is not None else None,
+                        next(self._rids))
+        try:
+            with self._cond:
+                if self._closed:
+                    raise EngineStopped("scheduler is shutting down")
+                self._q.put_nowait(req)
+                self._pending += 1
+        except queue.Full:
+            self._bump("rejected")
+            if obs.enabled():
+                obs.counter("serve/rejected").inc()
+            raise QueueFull(
+                f"request queue at capacity ({self.max_queue}) — shed or "
+                "retry with backoff")
+        req.future.add_done_callback(lambda f: self._on_done(f))
+        self._bump("submitted")
+        return req.future
+
+    def generate(self, prompt_ids, max_new_tokens: int,
+                 timeout: Optional[float] = None, **kw) -> np.ndarray:
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        if self._thread is None:
+            raise RuntimeError("scheduler not started — call start() or "
+                               "use it as a context manager")
+        return self.submit(prompt_ids, max_new_tokens, **kw).result(timeout)
+
+    def swap(self, params, state=None, version: Optional[str] = None) -> str:
+        """Hot swap: load + activate a new version. In-flight requests
+        keep the version they pinned at admission to their last token
+        (dispatches are cut per version group — no program ever sees two
+        param sets); admissions after this call serve the new version."""
+        v = self.registry.publish(params, state, version=version,
+                                  activate=False)
+        self.registry.activate(v)
+        self._bump("swaps")
+        if obs.enabled():
+            obs.instant("serve/swap", version=v)
+        return v
+
+    def defrag(self) -> int:
+        """Request a block-pool defrag at the next step boundary (safe:
+        the scheduler thread runs it between dispatches). Synchronous
+        when called before start() or after shutdown."""
+        if self._thread is None or not self._thread.is_alive():
+            n = self.kv.defrag()
+            if self.draft_kv is not None:
+                n += self.draft_kv.defrag()
+            if n:
+                self._bump("defrags")
+            return n
+        self._defrag_wanted.set()
+        return -1  # deferred; watch serve/kv_defrag_moves
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            out = dict(self._stats)
+        out["pending"] = self._pending
+        out["queue_depth"] = self._q.qsize() + len(self._backlog)
+        out["active"] = len(self._active)
+        out["prefilling"] = len(self._prefilling)
+        out["active_version"] = self.registry.active_version
+        out["kv"] = self.kv.stats()
+        return out
+
+    # -- scheduler loop --------------------------------------------------
+
+    def _run(self):
+        try:
+            self._loop()
+        except BaseException as e:  # noqa: BLE001 — post-mortem, then die
+            if obs.enabled():
+                _flight.dump_crash_bundle(error=e, context={
+                    "component": "serving/decode_scheduler",
+                    "stats": {k: v for k, v in self.stats().items()
+                              if k != "kv"}})
+            raise
+
+    def _loop(self):
+        """The iteration-level loop: every pass is one step boundary —
+        drain arrivals, admit into free slots, advance ONE prefill
+        chunk, ONE decode dispatch per active version group, evict
+        finished/expired rows. Prefill is interleaved chunk-at-a-time
+        so a joining long prompt never head-of-line-blocks the running
+        batch for more than one chunk's forward. Nothing in here blocks
+        on the device except the per-step token readbacks."""
+        while not self._stop.is_set():
+            self._beacon.pulse()
+            if obs.enabled():
+                self._snap_writer.maybe_write()
+            self._drain_arrivals()
+            self._admit()
+            stepped = self._advance_prefill()
+            stepped |= self._step_all()
+            self._evict_expired()
+            if self._defrag_wanted.is_set():
+                self._defrag_wanted.clear()
+                n = self.kv.defrag()
+                if self.draft_kv is not None:
+                    n += self.draft_kv.defrag()
+                if n:
+                    self._bump("defrags")
+            if self._closed and not self._active and not self._prefilling \
+                    and not self._backlog and self._q.empty():
+                break
+            if not stepped:
+                # idle (or static mode waiting out its fill window):
+                # block briefly on the queue so arrival→admission
+                # latency stays low without a spin
+                try:
+                    self._backlog.append(self._q.get(
+                        timeout=0.002 if self._backlog else 0.02))
+                    self._pull_pending()
+                except queue.Empty:
+                    pass
+
+    def _pull_pending(self):
+        while True:
+            try:
+                self._backlog.append(self._q.get_nowait())
+            except queue.Empty:
+                return
+
+    def _drain_arrivals(self):
+        self._pull_pending()
+
+    def _admit(self):
+        """Admit backlog head-of-line into free slots at this step
+        boundary. A request is admitted only when its WORST-CASE block
+        need is reservable, so no later step can OOM mid-flight; static
+        mode additionally waits for the running batch to fully drain
+        (whole-request batching — the bench baseline). FIFO order is
+        kept even when a smaller later request would fit (no starvation
+        of large requests)."""
+        if self.admission == "static":
+            if self._active or self._prefilling:
+                return
+            if self._backlog and len(self._backlog) < self.max_slots \
+                    and not self._closed:
+                # whole-request batching needs a fill window (the
+                # ServingEngine's max_wait_ms analog): wait briefly for
+                # the batch to fill rather than running a batch of one
+                oldest = self._backlog[0].t_enqueue
+                if (time.monotonic() - oldest) * 1000.0 < \
+                        self.static_wait_ms:
+                    return
+        while self._backlog and self._free_slots:
+            req = self._backlog[0]
+            if req.future.cancelled():
+                self._backlog.popleft()
+                self._finish(req, cancel=True)
+                continue
+            if req.expired():
+                self._backlog.popleft()
+                self._expire(req)
+                continue
+            spec_over = (self.spec_k + 1) if self.draft_model is not None \
+                else 0
+            worst = max(
+                prefill_padded_end(req.prompt.size, self.prefill_chunk),
+                req.prompt.size + req.max_new_tokens + spec_over)
+            try:
+                self.kv.ensure_capacity(req.rid, worst)
+                if self.draft_kv is not None:
+                    try:
+                        self.draft_kv.ensure_capacity(req.rid, worst)
+                    except KVCacheOOM:
+                        self.kv.free(req.rid)
+                        raise
+            except KVCacheOOM:
+                # backpressure: leave it queued — eviction will free
+                # blocks and the next boundary retries
+                break
+            self._backlog.popleft()
+            req.slot = self._free_slots.pop()
+            mv = self.registry.current()
+            req.version = mv.version
+            req.model_version = mv
+            req.t_admit_ns = time.perf_counter_ns()
+            req.chunks = prefill_schedule(req.prompt.size,
+                                          self.prefill_chunk)
+            req.pf_i = 0
+            if not req.future.set_running_or_notify_cancel():
+                self._finish(req, cancel=True)
+                continue
+            self._prefilling.append(req)
+
+    def _advance_prefill(self) -> bool:
+        """ONE prefill chunk for the head admitted-but-prefilling
+        request (FIFO), interleaved with the running batch's decode
+        steps — a joining 100k-token prompt stalls active generations
+        by at most one chunk's forward per step boundary, not its whole
+        prefill. The LAST chunk's final real row is the first generated
+        token (TTFT stamps there). Returns True when it did work."""
+        if not self._prefilling:
+            return False
+        req = self._prefilling[0]
+        mv = req.model_version
+        t0 = time.perf_counter_ns()
+        s, real, padded = req.chunks[req.pf_i]
+        last = req.pf_i == len(req.chunks) - 1
+        toks = np.zeros((1, padded), np.int32)
+        toks[0, :real] = req.prompt[s:s + real]
+        with obs.span("serve/prefill", rid=req.rid, chunk=req.pf_i,
+                      of=len(req.chunks), version=req.version):
+            table = self.kv.block_table(req.rid)[None]
+            choices, pages = self._step_jit(
+                mv.params, self.kv.pages(), jnp.asarray(toks),
+                jnp.asarray([s], jnp.int32), jnp.asarray(table))
+            self.kv.set_pages(pages)
+            if self.draft_kv is not None:
+                dtable = self.draft_kv.block_table(req.rid)[None]
+                _, dpages = self._draft_jit(
+                    self._draft_params(), self.draft_kv.pages(),
+                    jnp.asarray(toks), jnp.asarray([s], jnp.int32),
+                    jnp.asarray(dtable))
+                self.draft_kv.set_pages(dpages)
+            first_tok = None
+            if last:
+                # sync-ok: the first generated token — the client's
+                # TTFT — is exactly this readback
+                first_tok = int(np.asarray(choices)[0, real - 1])
+        self._bump("prefill_chunks")
+        req.pf_i += 1
+        req.prefill_ms += (time.perf_counter_ns() - t0) / 1e6
+        if not last:
+            return True
+        self._prefilling.popleft()
+        req.pos = int(req.prompt.size)
+        req.t_first_ns = time.perf_counter_ns()
+        self._bump("tokens")
+        if obs.enabled():
+            obs.histogram("serve/prefill_ms", unit="ms").observe(
+                req.prefill_ms)
+            obs.histogram("serve/ttft_ms", unit="ms").observe(
+                (req.t_first_ns - req.t_enqueue_ns) / 1e6)
+            obs.counter("serve/lm_tokens").inc()
+        self._active.append(req)
+        self._emit(req, first_tok)
+        return True
+
+    def _draft_params(self):
+        return self.draft_model.params
+
+    def _emit(self, req, token) -> bool:
+        """Append one generated token; returns True when the request is
+        DONE (eos or budget) and has been finished+released."""
+        req.generated.append(int(token))
+        done = (req.eos_id is not None and int(token) == req.eos_id) \
+            or len(req.generated) >= req.max_new_tokens
+        if done:
+            self._finish(req)
+        return done
+
+    def _step_all(self) -> bool:
+        """One decode dispatch per active version group (admission
+        order). Each dispatch pads its rows to a power-of-two bucket
+        (floor 2) of the FIXED slot capacity; padded slots carry the
+        null block table, so their writes land in garbage space."""
+        if not self._active:
+            return False
+        groups = {}
+        for r in self._active:
+            groups.setdefault(r.version, []).append(r)
+        for version, rows in list(groups.items()):
+            if (self.draft_model is not None and len(self._active) == 1
+                    and len(rows) == 1 and not self._prefilling):
+                # truly alone: a multi-token spec burst must not delay
+                # a joining request's interleaved prefill chunks
+                self._spec_round(rows[0])
+            else:
+                self._step_group(version, rows)
+        return True
+
+    def _step_group(self, version, rows):
+        n = len(rows)
+        bucket = bucket_for(max(n, 2), self.max_slots)
+        tokens = np.zeros((bucket, 1), np.int32)
+        positions = np.zeros((bucket,), np.int32)
+        tables = np.zeros((bucket, self.kv.max_blocks_per_seq), np.int32)
+        for i, r in enumerate(rows):
+            tokens[i, 0] = r.generated[-1]
+            positions[i] = r.pos
+            tables[i] = self.kv.block_table(r.rid)
+        mv = rows[0].model_version
+        rids = [r.rid for r in rows]
+        with obs.span("serve/decode_step", rids=rids, bucket=bucket,
+                      version=version):
+            choices, pages = self._step_jit(
+                mv.params, self.kv.pages(), jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(tables))
+            # sync-ok: the per-step token readback — EOS detection and
+            # per-client streaming both need the ids on host; this is
+            # the one deliberate sync of the decode loop
+            toks = np.asarray(choices)[:, 0]
+        self.kv.set_pages(pages)
+        self._bump("decode_steps")
+        self._bump("tokens", n)
+        for i, r in enumerate(rows):
+            r.pos += 1
+            r.steps += 1
+            self._emit(r, toks[i])
+        if obs.enabled():
+            obs.counter("serve/decode_steps").inc()
+            obs.counter("serve/lm_tokens").inc(n)
+            obs.histogram("serve/decode_occupancy").observe(n / bucket)
+            obs.gauge("serve/active_slots").set(len(self._active))
+
+    def _spec_round(self, req):
+        """Greedy speculative fast path (single active request): the
+        draft proposes ``spec_k`` tokens one paged step at a time, the
+        target verifies all of them (+1 bonus) in ONE chunked paged
+        forward, and the longest matching prefix is emitted — exactly
+        nn/speculative.py's schedule, host-driven so the request can
+        still leave (and others join) at every round boundary. Output-
+        preserving: the emitted tokens are the target's own greedy
+        choices (the correctness gate covers this path too)."""
+        k = self.spec_k
+        last = req.generated[-1]
+        pos0 = req.pos
+        dmv = self._draft_params()
+        dtable = self.draft_kv.block_table(req.rid)[None]
+        drafts = []
+        tok = last
+        with obs.span("serve/spec_round", rid=req.rid, k=k,
+                      version=req.version):
+            # k+1 draft steps: the extra step writes d_k's K/V so a
+            # fully-accepted round leaves no cache hole (speculative.py)
+            for i in range(k + 1):
+                choices, dpages = self._draft_jit(
+                    dmv, self.draft_kv.pages(),
+                    jnp.asarray([[tok]], np.int32),
+                    jnp.asarray([pos0 + i], np.int32), jnp.asarray(dtable))
+                self.draft_kv.set_pages(dpages)
+                # sync-ok: draft proposals drive the verify chunk's
+                # token ids — the round is host-driven by design
+                tok = int(np.asarray(choices)[0, 0])
+                if i < k:
+                    drafts.append(tok)
+            chunk = np.asarray([[last] + drafts], np.int32)   # (1, k+1)
+            table = self.kv.block_table(req.rid)[None]
+            choices, pages = self._step_jit(
+                req.model_version.params, self.kv.pages(),
+                jnp.asarray(chunk), jnp.asarray([pos0], np.int32),
+                jnp.asarray(table))
+            self.kv.set_pages(pages)
+            # sync-ok: verify readback — acceptance happens on host
+            target = np.asarray(choices)[0]                    # (k+1,)
+        j = 0
+        while j < k and drafts[j] == int(target[j]):
+            j += 1
+        emitted = drafts[:j] + [int(target[j])]
+        req.pos = pos0 + j + 1
+        req.steps += 1
+        self._bump("decode_steps")
+        self._bump("spec_rounds")
+        self._bump("spec_accepted", j)
+        self._bump("tokens", len(emitted))
+        if obs.enabled():
+            obs.counter("serve/spec_rounds").inc()
+            obs.counter("serve/spec_accepted").inc(j)
+            obs.counter("serve/lm_tokens").inc(len(emitted))
+        for t in emitted:
+            if self._emit(req, t):
+                break
+
+    # -- eviction / completion -------------------------------------------
+
+    def _evict_expired(self):
+        now = time.monotonic()
+        for r in list(self._active):
+            if r.expired(now):
+                self._expire(r)
+        for r in list(self._prefilling):
+            if r.expired(now):
+                self._expire(r)
+        for r in list(self._backlog):
+            if r.expired(now):
+                self._backlog.remove(r)
+                self._expire(r)
+
+    def _expire(self, req):
+        self._bump("timeouts")
+        if obs.enabled():
+            obs.counter("serve/timeouts").inc()
+        exc = DeadlineExceeded(
+            f"deadline passed after {len(req.generated)} of "
+            f"{req.max_new_tokens} tokens")
+        # the tokens generated before eviction are real (and bitwise
+        # equal to a solo decode's prefix) — hand them to the client
+        exc.partial = np.asarray(req.generated, np.int32)
+        self._release(req)
+        try:
+            req.future.set_exception(exc)
+        except Exception:
+            pass
+
+    def _finish(self, req, cancel: bool = False):
+        req.t_done_ns = time.perf_counter_ns()
+        self._release(req)
+        if cancel:
+            return
+        out = np.asarray(req.generated, np.int32)
+        n = out.size
+        tpot = ((req.t_done_ns - req.t_first_ns) / 1e6 / (n - 1)
+                if (req.t_first_ns and n > 1) else 0.0)
+        req.future.version = req.version
+        req.future.trace = {
+            "rid": req.rid,
+            "queue_wait_ms": ((req.t_admit_ns or req.t_enqueue_ns)
+                              - req.t_enqueue_ns) / 1e6,
+            "prefill_ms": round(req.prefill_ms, 3),
+            "ttft_ms": ((req.t_first_ns - req.t_enqueue_ns) / 1e6
+                        if req.t_first_ns else None),
+            "tpot_ms": round(tpot, 3),
+            "decode_steps": req.steps,
+            "tokens": n,
+            "version": req.version,
+        }
+        self._bump("completed")
+        if obs.enabled():
+            obs.counter("serve/lm_completed").inc()
+            if tpot:
+                obs.histogram("serve/tpot_ms", unit="ms").observe(tpot)
+            _flight.record("serve/lm_done", rid=req.rid, tokens=n,
+                           steps=req.steps, version=req.version)
+        try:
+            req.future.set_result(out)
+        except Exception:
+            pass
+
+    def _release(self, req):
+        """Return every engine resource a request holds: its slot and
+        its KV blocks (both caches). Safe to call twice."""
+        if req in self._active:
+            self._active.remove(req)
+        if req in self._prefilling:
+            self._prefilling.remove(req)
+        if req.slot is not None:
+            self._free_slots.append(req.slot)
+            req.slot = None
+        self.kv.free(req.rid)
+        if self.draft_kv is not None:
+            self.draft_kv.free(req.rid)
+        req.model_version = None
+
+    # -- internals -------------------------------------------------------
+
+    def _on_done(self, future):
+        with self._cond:
+            self._pending -= 1
+            self._cond.notify_all()
+
+    def _bump(self, key: str, n: int = 1):
+        with self._stats_lock:
+            self._stats[key] += n
+
+
+def decode_scheduler_threads_alive() -> int:
+    """Live scheduler threads (tests assert 0 after shutdown)."""
+    return sum(1 for t in threading.enumerate()
+               if t.name == THREAD_NAME and t.is_alive())

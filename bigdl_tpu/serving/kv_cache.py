@@ -1,0 +1,264 @@
+"""Paged KV cache: fixed-size HBM blocks + per-request block tables.
+
+Dense per-request KV caches fragment HBM under heterogeneous sequence
+lengths: a (B, kvH, Tmax, D) cache reserves Tmax positions for every
+row, so a 32-token request pins the same memory as a 2048-token one and
+the batch dimension must be rebuilt (recompile + realloc) whenever the
+request mix changes. The paged layout (vLLM's PagedAttention scheme)
+pools ALL cache memory into ``num_blocks`` fixed-size blocks of
+``block_size`` token positions each, per layer:
+
+    k_pages, v_pages : (num_blocks, kvH, block_size, D)
+
+and gives each request a BLOCK TABLE — logical block ``i`` of its
+sequence lives at physical page ``table[i]``. Requests allocate blocks
+one at a time as they grow and return them on completion/eviction, so
+the only unusable memory is the tail of each request's last block
+(< block_size tokens): internal fragmentation is bounded and external
+fragmentation is zero by construction. The attention side
+(``nn.Attention.decode_paged``) scatters new K/V through the table and
+attends over the gathered logical view.
+
+Block 0 is the reserved NULL block: unallocated table entries and the
+padded slots of a partially-filled decode bucket all point there, so a
+padded row's writes land in garbage space that no real row ever reads.
+
+Accounting is exported live (``serve/kv_*`` gauges/counters — see
+docs/OBSERVABILITY.md) and the block ledger is the engine's admission
+authority: a request is only admitted when its worst-case block need
+(prompt + max_new_tokens + speculative overshoot) fits the free list,
+so a decode step can never fail mid-flight on cache exhaustion.
+
+GEMM M-class note (the continuous-batching bitwise gate): XLA CPU
+lowers total-row-count-1 matmuls to a gemv kernel whose accumulation
+differs in the last ulp from the gemm used for >= 2 rows; all >= 2-row
+shapes agree bitwise row-for-row (measured, tests/test_serving_lm.py).
+The decode scheduler therefore never dispatches a 1-row program — the
+active-row bucket floor is 2 — which is what makes a request's tokens
+bitwise-identical whether it decodes alone or mid-swarm.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as obs
+
+
+class KVCacheOOM(RuntimeError):
+    """The free list cannot cover a requested allocation. Typed so the
+    scheduler's admission control can defer (keep the request queued)
+    rather than fail it."""
+
+
+def blocks_for_tokens(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` positions (ceil division)."""
+    return -(-int(tokens) // int(block_size))
+
+
+class PagedKVCache:
+    """Pooled block storage + the host-side block ledger for one model.
+
+    Pages are functional jax arrays: the compiled decode step takes the
+    current pages as inputs and returns updated ones; the scheduler
+    stores the new handles back via :meth:`set_pages`. The ledger
+    (free list, per-owner block lists) is plain host state guarded by a
+    lock — allocation never touches the device.
+    """
+
+    def __init__(self, model, *, num_blocks: int, block_size: int = 16,
+                 max_blocks_per_seq: int, dtype=jnp.float32,
+                 metric_prefix: str = "serve/kv"):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks must be >= 2 (block 0 is the "
+                             f"reserved null block), got {num_blocks}")
+        if block_size < 2 or (block_size & (block_size - 1)):
+            # power of two keeps the prompt-bucket math exact (prompt
+            # buckets are pow2 >= block_size, so padded prefill always
+            # fills whole blocks) and the //, % in the scatter cheap
+            raise ValueError(f"block_size must be a power of two >= 2, "
+                             f"got {block_size}")
+        if max_blocks_per_seq < 1:
+            raise ValueError("max_blocks_per_seq must be >= 1")
+        attn = model.blocks[0].attn
+        # the gauge/counter namespace — a second cache in one engine
+        # (the speculative draft's) must not overwrite the target's
+        # ledger telemetry
+        self.metric_prefix = metric_prefix
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.max_seq_len = self.max_blocks_per_seq * self.block_size
+        kvh = attn._kvh()
+        d = model.hidden_size // attn.num_heads
+        self._pages = [
+            (jnp.zeros((num_blocks, kvh, block_size, d), dtype),
+             jnp.zeros((num_blocks, kvh, block_size, d), dtype))
+            for _ in model.blocks]
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._owned: Dict[object, List[int]] = {}
+        self._high_water = 0
+        self._lock = threading.Lock()
+        self._set_gauges()
+
+    # -- device pages ----------------------------------------------------
+
+    def pages(self):
+        """The per-layer [(k_pages, v_pages), ...] pytree the compiled
+        decode step reads AND replaces (functional update)."""
+        return self._pages
+
+    def set_pages(self, new_pages):
+        self._pages = new_pages
+
+    # -- ledger ----------------------------------------------------------
+
+    def blocks_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def blocks_in_use(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._owned.values())
+
+    def owned(self, owner) -> int:
+        """Blocks currently held by ``owner`` (0 when unknown)."""
+        with self._lock:
+            return len(self._owned.get(owner, ()))
+
+    def can_allocate(self, n_blocks: int) -> bool:
+        with self._lock:
+            return n_blocks <= len(self._free)
+
+    def ensure_capacity(self, owner, upto_tokens: int):
+        """Grow ``owner``'s allocation so positions ``0..upto_tokens-1``
+        fit. Raises :class:`KVCacheOOM` (allocating NOTHING) when the
+        free list can't cover the growth, and ``ValueError`` past the
+        table width — admission control checks both up front."""
+        need = blocks_for_tokens(upto_tokens, self.block_size)
+        if need > self.max_blocks_per_seq:
+            raise ValueError(
+                f"{upto_tokens} tokens need {need} blocks > "
+                f"max_blocks_per_seq {self.max_blocks_per_seq} "
+                f"(max_seq_len {self.max_seq_len})")
+        with self._lock:
+            have = self._owned.setdefault(owner, [])
+            grow = need - len(have)
+            if grow <= 0:
+                return
+            if grow > len(self._free):
+                if not have:    # don't leave an empty ledger entry behind
+                    self._owned.pop(owner, None)
+                raise KVCacheOOM(
+                    f"need {grow} blocks, {len(self._free)} free "
+                    f"(in use {sum(len(b) for b in self._owned.values())}"
+                    f"/{self.num_blocks - 1})")
+            for _ in range(grow):
+                have.append(self._free.pop())
+            in_use = sum(len(b) for b in self._owned.values())
+            self._high_water = max(self._high_water, in_use)
+        if obs.enabled():
+            obs.counter(f"{self.metric_prefix}_allocs").inc(grow)
+        self._set_gauges()
+
+    def free(self, owner) -> int:
+        """Return every block ``owner`` holds to the free list (the
+        completion/eviction path). Returns the count freed; unknown
+        owners free 0 (idempotent — double-eviction is a no-op)."""
+        with self._lock:
+            blocks = self._owned.pop(owner, [])
+            # LIFO reuse keeps the hot end of the pool dense
+            self._free.extend(reversed(blocks))
+        if blocks and obs.enabled():
+            obs.counter(f"{self.metric_prefix}_frees").inc(len(blocks))
+        self._set_gauges()
+        return len(blocks)
+
+    def block_table(self, owner) -> np.ndarray:
+        """``owner``'s (max_blocks_per_seq,) int32 physical-block table,
+        null-block(0)-padded past its allocation."""
+        out = np.zeros((self.max_blocks_per_seq,), np.int32)
+        with self._lock:
+            blocks = self._owned.get(owner, ())
+            out[:len(blocks)] = blocks
+        return out
+
+    def null_table(self) -> np.ndarray:
+        """The all-null table a padded decode slot carries: every write
+        lands in the reserved garbage block."""
+        return np.zeros((self.max_blocks_per_seq,), np.int32)
+
+    # -- defrag ----------------------------------------------------------
+
+    def frag_blocks(self) -> int:
+        """Address-space spread: the number of free holes below the
+        highest allocated physical id — 0 when the allocation is
+        perfectly packed at the low end of the pool (ids are 1-based;
+        packed = ids 1..n). After enough churn the live blocks scatter
+        across the pool; :meth:`defrag` repacks them."""
+        with self._lock:
+            ids = [b for blocks in self._owned.values() for b in blocks]
+            if not ids:
+                return 0
+            return max(ids) - len(ids)
+
+    def defrag(self) -> int:
+        """Repack live blocks into the lowest physical ids: device-copy
+        each out-of-place block's K/V pages down and rewrite the owning
+        tables. Returns the number of blocks moved (``serve/kv_defrag_
+        moves``). Run at a step boundary — tables handed to an in-flight
+        dispatch must not be rewritten under it."""
+        with self._lock:
+            live = sorted(b for blocks in self._owned.values()
+                          for b in blocks)
+            n = len(live)
+            targets = set(range(1, n + 1))
+            moves = []          # (src, dst) pairs
+            free_targets = sorted(targets - set(live))
+            for src in sorted(b for b in live if b > n):
+                moves.append((src, free_targets.pop(0)))
+            if not moves:
+                return 0
+            remap = dict(moves)
+            srcs = jnp.asarray([s for s, _ in moves], jnp.int32)
+            dsts = jnp.asarray([d for _, d in moves], jnp.int32)
+            self._pages = [
+                (k.at[dsts].set(k[srcs]), v.at[dsts].set(v[srcs]))
+                for k, v in self._pages]
+            for blocks in self._owned.values():
+                for i, b in enumerate(blocks):
+                    blocks[i] = remap.get(b, b)
+            self._free = list(range(self.num_blocks - 1, n, -1))
+        if obs.enabled():
+            obs.counter(f"{self.metric_prefix}_defrag_moves").inc(len(moves))
+        self._set_gauges()
+        return len(moves)
+
+    # -- telemetry -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            in_use = sum(len(b) for b in self._owned.values())
+            return {
+                "blocks_total": self.num_blocks - 1,  # null excluded
+                "blocks_in_use": in_use,
+                "blocks_free": len(self._free),
+                "owners": len(self._owned),
+                "high_water": self._high_water,
+                "block_size": self.block_size,
+                "max_blocks_per_seq": self.max_blocks_per_seq,
+            }
+
+    def _set_gauges(self):
+        if not obs.enabled():
+            return
+        s = self.stats()
+        pre = self.metric_prefix
+        obs.gauge(f"{pre}_blocks_total").set(s["blocks_total"])
+        obs.gauge(f"{pre}_blocks_in_use").set(s["blocks_in_use"])
+        obs.gauge(f"{pre}_blocks_free").set(s["blocks_free"])
+        obs.gauge(f"{pre}_high_water").set(s["high_water"])
+        obs.gauge(f"{pre}_frag_blocks").set(self.frag_blocks())

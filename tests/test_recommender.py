@@ -103,3 +103,64 @@ def test_textclassifier_rnn_variants():
                         encoder_output_dim=16)
     m2.evaluate()
     assert m2.forward(x).shape == (3, 5)
+
+
+# ---------------------------------------------------------------------------
+# on-device HitRatio/NDCG (ISSUE 8 satellite — ROADMAP deferred item)
+# ---------------------------------------------------------------------------
+
+def test_hitratio_ndcg_device_stats_match_host():
+    """The sorted-scores device formulation reproduces the host path's
+    rank arithmetic: integer-exact hits, NDCG to f32 tolerance — over
+    candidate lists with known ranks, ties included."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    for trial in range(5):
+        scores = rng.randn(8, 10).astype(np.float32)
+        if trial == 3:  # exercise ties: strict > must agree on shared ranks
+            scores[:, 1] = scores[:, 0]
+        target = np.zeros((8, 10), np.float32)
+        target[np.arange(8), rng.randint(0, 10, size=8)] = 1
+        for k in (1, 3, 10):
+            hr, nd = HitRatio(k=k), NDCG(k=k)
+            assert hr.supports_device_stats() and nd.supports_device_stats()
+            h_host, n_host = hr(scores, target), nd(scores, target)
+            h_dev = hr.result_from_stats(np.asarray(
+                hr.device_stats(jnp.asarray(scores), jnp.asarray(target))))
+            n_dev = nd.result_from_stats(np.asarray(
+                nd.device_stats(jnp.asarray(scores), jnp.asarray(target))))
+            assert h_dev == h_host, (trial, k)
+            assert abs(n_dev.result()[0] - n_host.result()[0]) < 1e-5
+            assert n_dev.result()[1] == n_host.result()[1]
+
+
+def test_evaluator_rank_metrics_go_device_side():
+    """HitRatio/NDCG now ride the device-accumulation path: ONE stats
+    readback per evaluation (the last per-batch numpy fallback is gone)
+    and the results match the host path batch-for-batch."""
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu.dataset import DataSet as DS
+    from bigdl_tpu.optim.evaluator import Evaluator
+    obs.enable()
+    try:
+        rng = np.random.RandomState(1)
+        xs = rng.randn(60, 6).astype(np.float32)
+        ys = np.zeros((60, 1), np.float32)
+        ys[rng.rand(60) > 0.8] = 1     # sparse positives across batches
+        ys[:3] = 1                     # every early batch has one
+        m = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 1),
+                          nn.Sigmoid())
+        m.ensure_initialized()
+        ds = DS.from_arrays(xs, ys)
+        ev = Evaluator(m)
+        reg = obs.registry()
+        r0 = reg.get("eval/metric_readbacks").value \
+            if "eval/metric_readbacks" in reg.names() else 0.0
+        got = ev.evaluate(ds, [HitRatio(k=3), NDCG(k=3)], batch_size=20)
+        readbacks = reg.get("eval/metric_readbacks").value - r0
+        assert readbacks == 1          # device path: one readback/epoch
+        want = ev._evaluate_host(ds, [HitRatio(k=3), NDCG(k=3)], 20)
+        assert got[0] == want[0]
+        assert abs(got[1].result()[0] - want[1].result()[0]) < 1e-5
+    finally:
+        obs.disable()

@@ -67,6 +67,22 @@ HOT_FUNCS = {
         "_batcher", "_collect", "_dispatch", "submit", "warmup",
     },
     "bigdl_tpu/serving/batching.py": {"assemble"},
+    # continuous-batching decode loop: a stray sync between decode steps
+    # stalls EVERY active generation, not one request — the deliberate
+    # ones are the per-step token readback (EOS detection), the
+    # first-token readback in prefill, the spec round's draft/verify
+    # readbacks, and the warmup precompile block
+    "bigdl_tpu/serving/decode_scheduler.py": {
+        "_loop", "_admit", "_advance_prefill", "_step_all", "_step_group",
+        "_spec_round", "_evict_expired", "_emit", "_finish", "_release",
+        "submit", "warmup",
+    },
+    # block ledger: admission-control bookkeeping runs between decode
+    # steps and must stay pure host state (device pages are functional
+    # handles — only defrag, a rare explicit operation, touches them)
+    "bigdl_tpu/serving/kv_cache.py": {
+        "ensure_capacity", "free", "block_table", "can_allocate",
+    },
 }
 
 SYNC = re.compile(r"(?<![\w.])float\(|\.block_until_ready\(")
