@@ -42,17 +42,23 @@ perf-gate:
 # End-to-end serving engine drive on CPU with LeNet: warmup-compiled
 # buckets, concurrent clients, result-vs-direct-forward check, clean
 # drain — plus the LM continuous-batching smoke (DecodeScheduler vs
-# whole-request batching over a paged KV cache, leak gate included) —
-# seconds, not minutes (BENCH_METRICS_OUT='' keeps the smoke from
-# touching the committed bench evidence). Full measured runs:
-# `python bench_serving.py` (16 clients, enforces the 3x acceptance)
-# and `python bench_serving.py --lm` (enforces continuous > static on
-# tokens/s AND p99 TTFT).
+# whole-request batching over a paged KV cache, leak gate included)
+# and the router smoke (2 emulated replicas behind weighted-fair
+# priority classes, open-loop mixed-deadline load, lost-request
+# accounting) — seconds, not minutes (BENCH_METRICS_OUT='' keeps the
+# smoke from touching the committed bench evidence). Full measured
+# runs: `python bench_serving.py` (16 clients, enforces the 3x
+# acceptance), `python bench_serving.py --lm` (enforces continuous >
+# static on tokens/s AND p99 TTFT), and `python bench_serving.py
+# --router` (enforces tight-p99 < single-queue, goodput >= 1.5x, zero
+# tight misses at the pinned overload point).
 serve-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
 		python bench_serving.py --smoke
 	timeout -k 10 300 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
 		python bench_serving.py --lm --smoke
+	timeout -k 10 300 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
+		python bench_serving.py --router --smoke
 
 # Health-layer drive: train a tiny model with the stall watchdog +
 # flight recorder on, inject a step failure, and assert the crash

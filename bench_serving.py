@@ -35,15 +35,41 @@ scheduling policy. Reports ``serve/tokens_per_s``, TTFT p50/p99 and
 TPOT per arm (from the per-request trace dicts), and the
 continuous-vs-static ratios the perf gate pins.
 
+Router mode (``--router``) benches the SLO story (ISSUE 10): a mixed
+deadline-class load — tight-deadline interactive clients next to
+loose-deadline bulk clients — over TWO arms at the same offered load:
+
+* **single-queue baseline** — ONE ServingEngine, every client FIFO
+  through its queue: tight requests wait behind bulk ones exactly when
+  load is high (the regime the router exists for).
+* **router** — 2 engine replicas behind
+  :class:`bigdl_tpu.serving.Router` with weighted-fair priority classes
+  (tight 8 : bulk 1), deadline-aware least-loaded placement and
+  fail-fast doomed admission. Replica queues are kept SHALLOW so
+  backpressure lands in the router where class priority can act
+  (docs/SERVING.md "Router").
+
+Reports per-class p50/p99 latency, deadline misses, and GOODPUT
+(requests answered WITHIN their deadline per second); the acceptance
+ratios the perf gate pins are tight-class p99 (baseline/router, > 1 =
+router better), total goodput (router/single-replica, the >= 1.5x
+claim), and zero tight-class misses through the router at the pinned
+load point.
+
 Run:
   JAX_PLATFORMS=cpu python bench_serving.py            # 16 clients
   JAX_PLATFORMS=cpu python bench_serving.py --smoke    # make serve-smoke
   JAX_PLATFORMS=cpu python bench_serving.py --lm       # LM decode bench
   JAX_PLATFORMS=cpu python bench_serving.py --lm --smoke
+  JAX_PLATFORMS=cpu python bench_serving.py --router   # SLO router bench
+  JAX_PLATFORMS=cpu python bench_serving.py --router --smoke
 
 Env knobs: SERVE_CLIENTS, SERVE_REQUESTS (per client), SERVE_MAX_BATCH,
 SERVE_MAX_WAIT_MS, SERVE_DEADLINE_MS; LM mode: SERVE_LM_CLIENTS,
-SERVE_LM_REQUESTS, SERVE_LM_SLOTS.
+SERVE_LM_REQUESTS, SERVE_LM_SLOTS; router mode: SERVE_RT_TIGHT_RPS /
+SERVE_RT_BULK_RPS (offered load), SERVE_RT_SECONDS (generation
+window), SERVE_RT_TIGHT_MS / SERVE_RT_BULK_MS (deadline tiers),
+SERVE_RT_REPLICAS.
 """
 from __future__ import annotations
 
@@ -355,6 +381,270 @@ def main_lm(smoke: bool):
           f"{by_metric['serving_lm_tpot_ms']['value']}ms")
 
 
+def _run_router_arm(model, submit, tight_rps, bulk_rps, duration_s,
+                    tight_ms, bulk_ms, n_gen=4):
+    """One OPEN-LOOP mixed-class run: fixed-rate generators offer
+    ``tight_rps`` + ``bulk_rps`` requests/s for ``duration_s``
+    regardless of how the server keeps up — the load a population of
+    independent users actually presents ("the same offered load" to
+    every arm). ``submit(x, klass, deadline_ms)`` abstracts over the
+    single engine (klass ignored) and the router.
+
+    Outcomes are recorded via done-callbacks (latency = submit →
+    outcome, misses included — an all-miss class must report its true
+    tail, not an empty histogram); admission rejections (QueueFull /
+    fail-fast doomed) count as misses at ~0 latency. GOODPUT counts
+    only completions inside their own deadline. Returns (latency lists
+    per class, miss counts per class, goodput req/s, wall seconds)."""
+    from bigdl_tpu.serving import DeadlineExceeded, QueueFull
+    rng = np.random.RandomState(0)
+    samples = rng.randn(16, 784).astype(np.float32)
+    lats = {"tight": [], "bulk": []}
+    misses = {"tight": 0, "bulk": 0}
+    good = [0]
+    lock = threading.Lock()
+    futures = []
+
+    def on_done(fut, klass, deadline, t0):
+        ms = (time.perf_counter() - t0) * 1000.0
+        ok = fut.exception() is None
+        with lock:
+            lats[klass].append(ms)
+            if ok and ms <= deadline:
+                good[0] += 1
+            else:
+                misses[klass] += 1
+
+    attempts = {"tight": 0, "bulk": 0}
+
+    def generator(i):
+        klass = "tight" if i < n_gen else "bulk"
+        rate = (tight_rps if klass == "tight" else bulk_rps) / n_gen
+        deadline = tight_ms if klass == "tight" else bulk_ms
+        period = 1.0 / rate
+        t_end = time.perf_counter() + duration_s
+        t_next = time.perf_counter()
+        k = 0
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                break
+            if now < t_next:
+                time.sleep(t_next - now)
+            t_next += period
+            t0 = time.perf_counter()
+            with lock:
+                attempts[klass] += 1
+            try:
+                fut = submit(samples[k % 16], klass, deadline)
+            except (DeadlineExceeded, QueueFull):
+                with lock:   # shed at admission — a miss in ~µs
+                    lats[klass].append(0.0)
+                    misses[klass] += 1
+                continue
+            finally:
+                k += 1
+            fut.add_done_callback(
+                lambda f, kl=klass, d=deadline, t=t0: on_done(f, kl, d, t))
+            with lock:
+                futures.append(fut)
+
+    # cyclic-GC pauses are tens of ms on this box — a visible fraction
+    # of a tight SLO. Refcounting still frees the per-request garbage;
+    # the cycle collector just runs after the timed window instead of
+    # in the middle of it (standard latency-bench hygiene).
+    import gc
+    gc.collect()
+    gc.disable()
+    try:
+        dt = _client_pool(2 * n_gen, generator)
+        # drain: every admitted request resolves (deadline expiry inside
+        # the engines bounds this — nothing waits forever)
+        for fut in futures:
+            try:
+                fut.exception(timeout=bulk_ms / 1000.0 + 60.0)
+            except Exception:
+                pass
+    finally:
+        gc.enable()
+        gc.collect()
+    lost = sum(attempts.values()) - len(lats["tight"]) - len(lats["bulk"])
+    return lats, misses, good[0] / dt, {"attempts": dict(attempts),
+                                        "lost": lost, "wall_s": dt}
+
+
+def _build_router_model():
+    """A meatier forward than LeNet (per-batch ~8ms on the 1-core dev
+    box): the SLO bench needs service times in the tens of ms so
+    deadline tiers separate cleanly from scheduler jitter."""
+    from bigdl_tpu.nn import Linear, ReLU, Sequential
+    m = Sequential(Linear(784, 1024), ReLU(), Linear(1024, 1024), ReLU(),
+                   Linear(1024, 10))
+    m.ensure_initialized()
+    return m
+
+
+def bench_serving_router(tight_rps, bulk_rps, duration_s, tight_ms,
+                         bulk_ms, n_replicas, max_batch, max_wait_ms):
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu.serving import PriorityClass, Router, ServingEngine
+
+    obs.enable()
+    model = _build_router_model()
+
+    # -- arm 1: single-queue baseline (ONE replica, FIFO, no classes).
+    # Under overload the bounded queue pins at capacity, so FIFO wait
+    # sits at max_queue/drain-rate — structurally past the tight tier —
+    # and admission sheds both classes indiscriminately: the two
+    # deadline-blind failure modes the router exists to prevent.
+    single = ServingEngine(model, input_shape=(784,), max_batch=max_batch,
+                           max_wait_ms=max_wait_ms, max_queue=512,
+                           name="single")
+    with single:
+        lat_s, miss_s, goodput_s, acct_s = _run_router_arm(
+            model, lambda x, k, d: single.submit(x, deadline_ms=d),
+            tight_rps, bulk_rps, duration_s, tight_ms, bulk_ms)
+        st_s = single.stats()
+
+    # -- arm 2: router over N replicas with weighted-fair classes ------
+    # replica queues stay SHALLOW (max_batch) so backpressure lands in
+    # the router, where class weights and deadlines can act on it
+    replicas = [ServingEngine(model, input_shape=(784,),
+                              max_batch=max_batch,
+                              max_wait_ms=max_wait_ms,
+                              max_queue=max_batch, name=f"r{i}")
+                for i in range(n_replicas)]
+    # bulk depth_limit=2: keep replicas pipelined on bulk without
+    # letting the bulk backlog stuff the replica FIFOs ahead of tight
+    # arrivals — the head-of-line control that bounds tight latency
+    router = Router(replicas, classes=[
+        PriorityClass("tight", weight=8, max_queue=2048),
+        PriorityClass("bulk", weight=1, max_queue=4096, depth_limit=2),
+    ], fail_fast_factor=0.0)  # measure real misses, don't shed at admission
+    with router:
+        lat_r, miss_r, goodput_r, acct_r = _run_router_arm(
+            model, lambda x, k, d: router.submit(x, klass=k, deadline_ms=d),
+            tight_rps, bulk_rps, duration_s, tight_ms, bulk_ms)
+        st_r = router.stats()
+
+    tight_p99_s = _pct(lat_s["tight"], 0.99)
+    tight_p99_r = _pct(lat_r["tight"], 0.99)
+    lines = [{
+        "metric": "serving_router_goodput_req_per_s",
+        "value": round(goodput_r, 1), "unit": "req/s",
+        "replicas": n_replicas, "tight_rps": tight_rps,
+        "bulk_rps": bulk_rps, "duration_s": duration_s,
+        "tight_deadline_ms": tight_ms,
+        "bulk_deadline_ms": bulk_ms, "max_batch": max_batch,
+        "tight_misses": miss_r["tight"], "bulk_misses": miss_r["bulk"],
+        "failovers": st_r["failovers"], "lost": acct_r["lost"],
+        "backend": "cpu",
+    }, {
+        "metric": "serving_single_goodput_req_per_s",
+        "value": round(goodput_s, 1), "unit": "req/s",
+        "tight_rps": tight_rps, "bulk_rps": bulk_rps,
+        "tight_misses": miss_s["tight"], "bulk_misses": miss_s["bulk"],
+        "lost": acct_s["lost"], "backend": "cpu",
+    }, {
+        "metric": "serving_router_goodput_ratio",
+        "value": round(goodput_r / max(goodput_s, 1e-9), 2), "unit": "x",
+        "replicas": n_replicas, "backend": "cpu",
+    }, {
+        "metric": "serving_router_tight_p99_ms",
+        "value": round(tight_p99_r, 2), "unit": "ms",
+        "tight_p50_ms": round(_pct(lat_r["tight"], 0.5), 2),
+        "bulk_p99_ms": round(_pct(lat_r["bulk"], 0.99), 2),
+        "backend": "cpu",
+    }, {
+        "metric": "serving_single_tight_p99_ms",
+        "value": round(tight_p99_s, 2), "unit": "ms",
+        "tight_p50_ms": round(_pct(lat_s["tight"], 0.5), 2),
+        "bulk_p99_ms": round(_pct(lat_s["bulk"], 0.99), 2),
+        "backend": "cpu",
+    }, {
+        "metric": "serving_router_tight_p99_ratio",
+        "value": round(tight_p99_s / max(tight_p99_r, 1e-9), 2),
+        "unit": "x", "backend": "cpu",
+    }, {
+        "metric": "serving_router_tight_misses",
+        "value": miss_r["tight"], "unit": "requests",
+        "offered": acct_r["attempts"]["tight"], "backend": "cpu",
+    }, {
+        # the gate-compatible form of "zero tight misses": the perf
+        # gate skips zero-valued pins (a 0 reads as a failed capture),
+        # so pin the in-deadline fraction at 1.0 with a tiny band
+        "metric": "serving_router_tight_hit_rate",
+        "value": round(1.0 - miss_r["tight"]
+                       / max(acct_r["attempts"]["tight"], 1), 4),
+        "unit": "frac", "backend": "cpu",
+    }]
+    return lines, st_s, st_r, miss_r, (acct_s, acct_r)
+
+
+def main_router(smoke: bool):
+    # The pinned load point is OPEN-LOOP OVERLOAD (1-core dev box,
+    # ~8ms per-batch forward, one-queue capacity ~950 req/s): 700
+    # tight + 500 bulk offered req/s exceed one queue's capacity, so
+    # the single FIFO's wait pins at max_queue/drain (~400-700ms) and
+    # the 250ms tight tier becomes unmeetable by a wide margin — while
+    # the router serves the whole tight rate stably (p99 ~35ms quiet,
+    # ~150ms under heavy box contention; the tier is sized for the
+    # noisy case) and sheds only bulk. Deadline economics, not a
+    # knife-edge: it holds wherever offered load > one queue's
+    # capacity, which is the regime a router exists for.
+    tight_rps = float(os.environ.get("SERVE_RT_TIGHT_RPS",
+                                     60.0 if smoke else 700.0))
+    bulk_rps = float(os.environ.get("SERVE_RT_BULK_RPS",
+                                    40.0 if smoke else 500.0))
+    duration_s = float(os.environ.get("SERVE_RT_SECONDS",
+                                      1.5 if smoke else 10.0))
+    tight_ms = float(os.environ.get("SERVE_RT_TIGHT_MS", 1000.0 if smoke
+                                    else 250.0))
+    bulk_ms = float(os.environ.get("SERVE_RT_BULK_MS", 30000.0))
+    n_replicas = int(os.environ.get("SERVE_RT_REPLICAS", 2))
+    max_batch = int(os.environ.get("SERVE_MAX_BATCH", 8))
+    max_wait_ms = float(os.environ.get("SERVE_MAX_WAIT_MS", 2.0))
+    lines, st_s, st_r, miss_r, (acct_s, acct_r) = bench_serving_router(
+        tight_rps, bulk_rps, duration_s, tight_ms, bulk_ms, n_replicas,
+        max_batch, max_wait_ms)
+    for line in lines:
+        print(json.dumps(line), flush=True)
+    _merge_metrics_dump(lines)
+    by_metric = {l["metric"]: l for l in lines}
+    failures = []
+    if acct_r["lost"] or acct_s["lost"]:
+        failures.append(f"lost requests (no outcome): router "
+                        f"{acct_r['lost']}, single {acct_s['lost']}")
+    goodput_ratio = by_metric["serving_router_goodput_ratio"]["value"]
+    p99_ratio = by_metric["serving_router_tight_p99_ratio"]["value"]
+    if not smoke:
+        # ISSUE 10 acceptance at the pinned load point (the smoke run is
+        # a plumbing check on whatever loaded CI box runs it)
+        if miss_r["tight"]:
+            failures.append(f"{miss_r['tight']} tight-class deadline "
+                            "misses through the router (want 0)")
+        if goodput_ratio < 1.5:
+            failures.append(f"router goodput {goodput_ratio}x single "
+                            "replica < 1.5x acceptance")
+        if p99_ratio < 1.0:
+            failures.append(f"tight-class p99 ratio {p99_ratio}x < 1x "
+                            "(single queue beat the router)")
+    if failures:
+        print("bench_serving --router: FAIL — " + "; ".join(failures),
+              file=sys.stderr)
+        raise SystemExit(1)
+    print(f"bench_serving --router: ok — goodput "
+          f"{by_metric['serving_router_goodput_req_per_s']['value']} req/s "
+          f"over {n_replicas} replicas vs "
+          f"{by_metric['serving_single_goodput_req_per_s']['value']} req/s "
+          f"single queue ({goodput_ratio}x) at "
+          f"{tight_rps + bulk_rps:.0f} offered req/s, tight p99 "
+          f"{by_metric['serving_router_tight_p99_ms']['value']}ms vs "
+          f"{by_metric['serving_single_tight_p99_ms']['value']}ms "
+          f"({p99_ratio}x better), tight misses {miss_r['tight']} of "
+          f"{acct_r['attempts']['tight']}")
+
+
 def _merge_metrics_dump(lines):
     """Serving lines ride BENCH_METRICS.json next to the training bench
     lines: keep whatever bench.py last wrote, replace ONLY the stale
@@ -389,6 +679,8 @@ def main():
     smoke = "--smoke" in sys.argv
     if "--lm" in sys.argv:
         return main_lm(smoke)
+    if "--router" in sys.argv:
+        return main_router(smoke)
     n_clients = int(os.environ.get("SERVE_CLIENTS", 4 if smoke else 16))
     n_requests = int(os.environ.get("SERVE_REQUESTS", 4 if smoke else 32))
     max_batch = int(os.environ.get("SERVE_MAX_BATCH", n_clients))

@@ -6,7 +6,10 @@ from .collective import (all_reduce_sum, all_reduce_mean, all_gather,
 from .allreduce import AllReduceParameter, FP16CompressPolicy
 from .sharding import (replicated, data_sharding, shard_batch, shard_params,
                        tp_linear_rules, transformer_tp_specs, fsdp_specs,
-                       surviving_devices, mesh_after_loss)
+                       surviving_devices, mesh_after_loss,
+                       serving_batch_spec, serving_param_specs,
+                       place_with_specs, batch_shard_count,
+                       SERVING_BATCH_AXES)
 from .ring_attention import ring_attention
 from .failure import (probe_mesh, MeshProbeResult, Heartbeat, HeartbeatLost,
                       StragglerMonitor, TransientDeviceError, TrainingHalted,

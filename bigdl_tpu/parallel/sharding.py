@@ -236,6 +236,72 @@ def fsdp_specs(params, mesh: Mesh, axis: str = "data",
     return jax.tree_util.tree_map(spec, params)
 
 
+#: mesh axes a serving batch shards over when present — Levanter's
+#: ``P(("replica", "data"))`` idiom: one physical mesh carries both the
+#: replica-parallel degree (whole engine replicas) and the data-parallel
+#: degree (rows within a replica's dispatch), and the request batch
+#: splits its leading dim across BOTH.
+SERVING_BATCH_AXES = ("replica", "data")
+
+
+def serving_batch_spec(mesh: Mesh, axes=SERVING_BATCH_AXES) -> P:
+    """PartitionSpec for a serving micro-batch's leading dim on ``mesh``:
+    sharded jointly over whichever of ``axes`` the mesh actually has
+    (``P(("replica", "data"))`` on a replica×data mesh, ``P("data")`` on
+    a data-only mesh), replicated when the mesh has neither (a pure
+    tensor-parallel mesh serves the whole batch on every shard — the
+    parallelism is inside the layers)."""
+    present = tuple(a for a in axes if a in mesh.axis_names)
+    return P(present) if present else P()
+
+
+def batch_shard_count(mesh: Mesh, spec: P) -> int:
+    """How many ways ``spec`` splits the leading batch dim on ``mesh`` —
+    the serving engine's bucket floor: every padded bucket must be a
+    multiple of this so the shards divide evenly."""
+    if not spec or spec[0] is None:
+        return 1
+    first = spec[0]
+    axes = first if isinstance(first, tuple) else (first,)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return n
+
+
+def serving_param_specs(params, mesh: Mesh, placement,
+                        model_axis: str = "model",
+                        data_axis: str = "data"):
+    """Resolve a serving-engine param placement into a PartitionSpec
+    tree: ``"tp"`` → :func:`transformer_tp_specs` (Megatron-style —
+    models that don't fit one chip serve over the ``model`` axis),
+    ``"fsdp"`` → :func:`fsdp_specs` over ``data_axis`` (big leaves at
+    1/N memory, all-gathered just-in-time), ``"replicated"``/None →
+    every leaf replicated, a callable → ``placement(params)``, anything
+    else is taken as an explicit spec tree."""
+    if placement is None or placement == "replicated":
+        return jax.tree_util.tree_map(lambda _: P(), params)
+    if callable(placement):
+        return placement(params)
+    if placement == "tp":
+        return transformer_tp_specs(params, axis=model_axis)
+    if placement == "fsdp":
+        return fsdp_specs(params, mesh, axis=data_axis)
+    return placement
+
+
+def place_with_specs(tree, mesh: Mesh, specs):
+    """Device-put a params/state pytree onto ``mesh`` leaf-by-leaf with
+    the matching PartitionSpec tree (multi-controller safe via
+    :func:`put_global`) — the sharded-load half of a serving hot swap:
+    the registry runs this on the PUBLISHING thread, so traffic keeps
+    flowing on the active version while the new one lands sharded."""
+    if tree is None:
+        return None
+    return jax.tree_util.tree_map(
+        lambda x, s: put_global(x, mesh, s), tree, specs)
+
+
 def tp_linear_rules(axis: str = "model"):
     """PartitionSpecs for a column→row parallel Linear pair (Megatron-style):
     first Linear's (out, in) weight column-sharded, second row-sharded;

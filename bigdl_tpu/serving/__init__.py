@@ -25,8 +25,18 @@ design pays (the overhead 1805.08430 "RPC Considered Harmful" measures).
 * ``decode_scheduler`` — :class:`DecodeScheduler`: continuous batching
   for autoregressive LM decode — requests join/leave the running batch
   at decode-step boundaries over ONE compiled paged step; chunked
-  prefill admission, per-request version pinning for hot swap, optional
-  speculative fast path (docs/SERVING.md "Continuous batching").
+  prefill admission, per-request version pinning for hot swap,
+  per-request temperature/top-p sampling under seeded key streams,
+  optional speculative fast path (docs/SERVING.md "Continuous
+  batching").
+* ``router`` — :class:`Router`: N engine replicas behind SLO-aware
+  dispatch — priority-class weighted-fair queues, deadline-aware
+  placement (tight deadlines to the least-loaded replica,
+  deadline-doomed requests fail fast at admission), per-replica stall
+  drain + failover + rejoin, fleet-wide hot swap. Both engines also
+  take ``mesh=`` + ``placement=`` (TP / FSDP PartitionSpecs from
+  ``parallel.sharding``) so a single replica can span a mesh — the
+  model-parallel axis — while the router scales the replica axis.
 
 Metrics (`docs/OBSERVABILITY.md`): ``serve/queue_depth``,
 ``serve/batch_occupancy``, ``serve/latency_ms``, ``serve/rejected``,
@@ -41,6 +51,7 @@ from .kv_cache import KVCacheOOM, PagedKVCache, blocks_for_tokens
 from .decode_scheduler import (DecodeScheduler, LMRequest,
                                decode_scheduler_threads_alive,
                                prefill_schedule)
+from .router import PriorityClass, Router, router_threads_alive
 # the transient-failure classification is SHARED with the trainer's
 # FaultPolicy (parallel/failure.py): a batch whose compiled forward
 # fails with a transient device error is re-dispatched once before its

@@ -115,12 +115,17 @@ class LMRequest:
                  "deadline", "t_enqueue", "t_enqueue_ns", "t_admit_ns",
                  "t_first_ns", "t_done_ns", "prefill_ms", "version",
                  "model_version", "slot", "pos", "generated", "steps",
-                 "chunks", "pf_i")
+                 "chunks", "pf_i", "temperature", "top_p", "seed")
 
-    def __init__(self, prompt, max_new_tokens, eos_id, deadline_s, rid):
+    def __init__(self, prompt, max_new_tokens, eos_id, deadline_s, rid,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 seed: int = 0):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.seed = int(seed) & 0xFFFFFFFF
         self.future = ServeFuture()
         self.future.rid = rid
         self.rid = rid
@@ -167,7 +172,25 @@ class DecodeScheduler:
         class) or ``"static"`` (whole-request batching: a batch admits
         only when the previous one fully drained — the bench baseline).
     eos_id : default end-of-sequence id (per-request override at
-        ``submit``); greedy decoding only.
+        ``submit``).
+    sampling_seed : base of the per-request sampling key stream
+        (``engine.next_rng_keys``-style: one deterministic stream, one
+        seed per request derived from it, so a request's samples are
+        reproducible and independent of who shares its batch). Requests
+        default to greedy; ``submit(..., temperature=, top_p=, seed=)``
+        opts into sampling per request.
+    mesh / placement : model-parallel serving — a
+        ``jax.sharding.Mesh`` plus a param-placement policy (``"tp"`` /
+        ``"fsdp"`` / spec tree / callable, see
+        ``parallel.sharding.serving_param_specs``). Published versions
+        device-put SHARDED onto the mesh, the paged KV pool lives on the
+        mesh (kvH split over the ``model`` axis when it divides), and
+        the same compiled paged step dispatches over it with
+        XLA-inserted collectives. Speculative decoding is single-device
+        only (``draft_model`` + ``mesh`` raises).
+    name : replica name — per-replica watchdog beacon
+        (``serving/decode_scheduler[<name>]``) for Router health
+        integration.
     """
 
     def __init__(self, model, *, max_slots: int = 8, block_size: int = 16,
@@ -179,7 +202,10 @@ class DecodeScheduler:
                  registry: Optional[ModelRegistry] = None,
                  admission: str = "continuous",
                  static_wait_ms: float = 4.0,
-                 stall_deadline_s: Optional[float] = None):
+                 stall_deadline_s: Optional[float] = None,
+                 sampling_seed: int = 0,
+                 mesh=None, placement=None,
+                 name: Optional[str] = None):
         if model.mode != "lm":
             raise ValueError("DecodeScheduler serves LM-mode models")
         if max_slots < 2:
@@ -194,6 +220,9 @@ class DecodeScheduler:
         if admission not in ("continuous", "static"):
             raise ValueError(f"admission must be 'continuous' or 'static', "
                              f"got {admission!r}")
+        if mesh is not None and draft_model is not None:
+            raise ValueError("speculative decoding is single-device only — "
+                             "drop draft_model or the mesh")
         model.ensure_initialized()
         self.model = model
         self.max_slots = int(max_slots)
@@ -202,13 +231,44 @@ class DecodeScheduler:
         self.admission = admission
         self.default_deadline_ms = default_deadline_ms
         self.eos_id = eos_id
+        self.sampling_seed = int(sampling_seed)
         self.spec_k = int(spec_k)
+        self.name = name
+        self.beacon_name = ("serving/decode_scheduler" if name is None
+                            else f"serving/decode_scheduler[{name}]")
+        self.mesh = mesh
+        page_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel import sharding as _sh
+            if registry is not None and placement is not None:
+                raise ValueError(
+                    "placement= is applied by the registry the scheduler "
+                    "builds — with an explicit registry= it would be "
+                    "silently ignored; construct the registry with "
+                    "mesh/param_specs yourself, or drop one argument")
+            self._op_sharding = NamedSharding(mesh, P())
+            kvh = model.blocks[0].attn._kvh()
+            if "model" in mesh.axis_names and mesh.shape["model"] > 1 \
+                    and kvh % mesh.shape["model"] == 0:
+                # pooled K/V pages split over KV heads: the decode-path
+                # HBM lever under tensor parallelism — each shard holds
+                # kvH/tp heads of every block
+                page_sharding = NamedSharding(mesh, P(None, "model"))
+            else:
+                page_sharding = self._op_sharding
+            if registry is None:
+                registry = ModelRegistry(
+                    mesh=mesh,
+                    param_specs=_sh.serving_param_specs(
+                        model.params, mesh, placement))
         mbs = blocks_for_tokens(max_seq_len, block_size)
         if num_blocks is None:
             num_blocks = self.max_slots * mbs + 1
         self.kv = PagedKVCache(model, num_blocks=num_blocks,
                                block_size=block_size,
-                               max_blocks_per_seq=mbs)
+                               max_blocks_per_seq=mbs,
+                               sharding=page_sharding)
         self.draft_model = draft_model
         self.draft_kv = None
         if draft_model is not None:
@@ -223,6 +283,7 @@ class DecodeScheduler:
         if self.registry.current() is None:
             self.registry.publish(model.params, model.state, version="v0",
                                   activate=True)
+        self._greedy_args = {}  # bucket -> device-resident greedy triple
         self._step_jit = self._build_step(model, "serve/decode_step")
         self._draft_jit = (self._build_step(draft_model, "serve/draft_step")
                            if draft_model is not None else None)
@@ -248,19 +309,95 @@ class DecodeScheduler:
 
     @staticmethod
     def _build_step(model, name):
-        """The ONE compiled paged decode step: argmax next-token choices
-        for every (row, chunk-position) plus the functionally-updated
-        pages. Params are arguments, so every model version shares the
-        executable; distinct (bucket, S) shapes compile once each."""
+        """The ONE compiled paged decode step: next-token choices for
+        every (row, chunk-position) plus the functionally-updated pages.
+        Params are arguments, so every model version shares the
+        executable; distinct (bucket, S) shapes compile once each.
 
-        def step(params, pages, tokens, positions, tables):
+        Token choice is per-row: greedy argmax when ``temps[b] <= 0``
+        (bitwise the pre-sampling behavior — the correctness gate),
+        temperature + top-p (nucleus) sampling otherwise. Sampling keys
+        derive IN-PROGRAM from ``fold_in(PRNGKey(seeds[b]), position)``
+        — a function of the request's seed and the absolute position
+        only, so a sampled request draws the same tokens whether it
+        decodes alone or mid-swarm (batch-mix independence, same
+        contract the gemm M-class floor gives greedy). The whole
+        sampling branch sits under ``lax.cond``: an all-greedy dispatch
+        (the common case) never pays the sort."""
+
+        def sample(logits, positions, seeds, temps, top_ps):
+            B, S, V = logits.shape
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            def sampled():
+                base = jax.vmap(jax.random.PRNGKey)(
+                    seeds.astype(jnp.uint32))
+                pos = positions[:, None] + jnp.arange(S)[None, :]
+                keys = jax.vmap(lambda k, ps: jax.vmap(
+                    lambda p: jax.random.fold_in(k, p))(ps))(base, pos)
+                t = jnp.maximum(temps, 1e-6)[:, None, None]
+                scaled = logits / t
+                order = jnp.argsort(-scaled, axis=-1)
+                srt = jnp.take_along_axis(scaled, order, axis=-1)
+                probs = jax.nn.softmax(srt, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # nucleus: keep while the mass BEFORE a token is < p
+                # (the top-1 token always survives)
+                keep = (cum - probs) < top_ps[:, None, None]
+                masked = jnp.where(keep, srt, -jnp.inf)
+                pick = jax.vmap(jax.random.categorical)(
+                    keys.reshape(B * S, -1), masked.reshape(B * S, V))
+                tok = jnp.take_along_axis(order.reshape(B * S, V),
+                                          pick[:, None], axis=-1)[:, 0]
+                tok = tok.reshape(B, S).astype(jnp.int32)
+                # per-row: greedy rows of a mixed batch stay greedy
+                return jnp.where(temps[:, None] > 0.0, tok, greedy)
+
+            return jax.lax.cond(jnp.any(temps > 0.0), sampled,
+                                lambda: greedy)
+
+        def step(params, pages, tokens, positions, tables, seeds, temps,
+                 top_ps):
             logits, pages = model.decode_paged(params, tokens, positions,
                                                pages, tables)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pages
+            return sample(logits, positions, seeds, temps, top_ps), pages
 
         return obs.perf.instrument_jit(jax.jit(step), name=name,
                                        kind="forward",
                                        key_argnums=(2, 3, 4))
+
+    def _put(self, a):
+        """Operand placement for one dispatch: replicated onto the mesh
+        when serving model-parallel (params/pages carry the sharded
+        placement; XLA inserts the collectives), plain transfer
+        otherwise."""
+        if self.mesh is not None:
+            return jax.device_put(np.asarray(a), self._op_sharding)
+        return jnp.asarray(a)
+
+    def _sampling_args(self, rows, bucket):
+        """(seeds, temps, top_ps) operands for one dispatch — padded
+        slots are greedy (temp 0), so they never pay sampling work.
+        The all-greedy triple (the default workload, and every padded
+        warmup/draft/spec dispatch) is constant per bucket and cached
+        device-resident, so the hot decode loop adds no per-step
+        transfers until a request actually opts into sampling."""
+        if all(r.temperature <= 0.0 for r in rows):
+            cached = self._greedy_args.get(bucket)
+            if cached is None:
+                cached = (self._put(np.zeros((bucket,), np.uint32)),
+                          self._put(np.zeros((bucket,), np.float32)),
+                          self._put(np.ones((bucket,), np.float32)))
+                self._greedy_args[bucket] = cached
+            return cached
+        seeds = np.zeros((bucket,), np.uint32)
+        temps = np.zeros((bucket,), np.float32)
+        top_ps = np.ones((bucket,), np.float32)
+        for i, r in enumerate(rows):
+            seeds[i] = r.seed
+            temps[i] = r.temperature
+            top_ps[i] = r.top_p
+        return self._put(seeds), self._put(temps), self._put(top_ps)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -271,7 +408,7 @@ class DecodeScheduler:
             raise EngineStopped("scheduler was shut down; build a new one")
         if warmup:
             self.warmup()
-        self._beacon = _health.beacon("serving/decode_scheduler",
+        self._beacon = _health.beacon(self.beacon_name,
                                       deadline_s=self.stall_deadline_s)
         self._thread = threading.Thread(target=self._run, name=THREAD_NAME,
                                         daemon=True)
@@ -301,8 +438,9 @@ class DecodeScheduler:
                 choices, pages = jit_fn(
                     self.registry.current().params if cache is self.kv
                     else self.draft_model.params,
-                    cache.pages(), jnp.zeros((B, S), jnp.int32),
-                    jnp.zeros((B,), jnp.int32), jnp.asarray(table))
+                    cache.pages(), self._put(np.zeros((B, S), np.int32)),
+                    self._put(np.zeros((B,), np.int32)), self._put(table),
+                    *self._sampling_args((), B))
                 cache.set_pages(pages)
                 # sync-ok: warmup precompile — runs before serving starts
                 jax.block_until_ready(choices)
@@ -389,15 +527,28 @@ class DecodeScheduler:
 
     def submit(self, prompt_ids, max_new_tokens: int,
                deadline_ms: Optional[float] = None,
-               eos_id="default") -> ServeFuture:
+               eos_id="default", temperature: float = 0.0,
+               top_p: float = 1.0,
+               seed: Optional[int] = None) -> ServeFuture:
         """Enqueue ONE generation request: ``prompt_ids`` (1-D int) →
         future resolving to the GENERATED ids (np.int32, prompt
-        excluded; greedy). Raises :class:`QueueFull` / typed rejection
+        excluded). Raises :class:`QueueFull` / typed rejection
         on over-budget requests; a deadline that expires mid-generation
         fails the future with :class:`DeadlineExceeded` whose
-        ``partial`` attribute carries the tokens generated so far."""
+        ``partial`` attribute carries the tokens generated so far.
+
+        ``temperature=0`` (default) decodes greedy — bitwise the
+        pre-sampling behavior. ``temperature>0`` samples with top-p
+        ``top_p`` under a per-request key stream: ``seed`` pins the
+        stream explicitly (same seed ⇒ same tokens, regardless of
+        batch mix); when None, the seed derives deterministically from
+        the scheduler's ``sampling_seed`` and this request's rid."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must be non-empty")
@@ -412,9 +563,17 @@ class DecodeScheduler:
         ms = deadline_ms if deadline_ms is not None \
             else self.default_deadline_ms
         eid = self.eos_id if eos_id == "default" else eos_id
+        rid = next(self._rids)
+        if seed is None:
+            # next_rng_keys-style stream: a splitmix-flavored fold of
+            # (base, rid) — deterministic per request, decorrelated
+            # across requests, zero device work
+            seed = ((self.sampling_seed * 0x9E3779B9 + rid * 0x85EBCA6B
+                     + 0xC2B2AE35) & 0xFFFFFFFF)
         req = LMRequest(prompt, max_new_tokens, eid,
                         ms / 1000.0 if ms is not None else None,
-                        next(self._rids))
+                        rid, temperature=temperature, top_p=top_p,
+                        seed=seed)
         try:
             with self._cond:
                 if self._closed:
@@ -444,7 +603,12 @@ class DecodeScheduler:
         """Hot swap: load + activate a new version. In-flight requests
         keep the version they pinned at admission to their last token
         (dispatches are cut per version group — no program ever sees two
-        param sets); admissions after this call serve the new version."""
+        param sets); admissions after this call serve the new version.
+        ``state=None`` inherits the active version's state (a
+        params-only swap must not change the compiled step's pytree)."""
+        if state is None:
+            cur = self.registry.current()
+            state = cur.state if cur is not None else self.model.state
         v = self.registry.publish(params, state, version=version,
                                   activate=False)
         self.registry.activate(v)
@@ -620,15 +784,16 @@ class DecodeScheduler:
                       of=len(req.chunks), version=req.version):
             table = self.kv.block_table(req.rid)[None]
             choices, pages = self._step_jit(
-                mv.params, self.kv.pages(), jnp.asarray(toks),
-                jnp.asarray([s], jnp.int32), jnp.asarray(table))
+                mv.params, self.kv.pages(), self._put(toks),
+                self._put(np.asarray([s], np.int32)), self._put(table),
+                *self._sampling_args([req], 1))
             self.kv.set_pages(pages)
             if self.draft_kv is not None:
                 dtable = self.draft_kv.block_table(req.rid)[None]
                 _, dpages = self._draft_jit(
                     self._draft_params(), self.draft_kv.pages(),
-                    jnp.asarray(toks), jnp.asarray([s], jnp.int32),
-                    jnp.asarray(dtable))
+                    self._put(toks), self._put(np.asarray([s], np.int32)),
+                    self._put(dtable), *self._sampling_args((), 1))
                 self.draft_kv.set_pages(dpages)
             first_tok = None
             if last:
@@ -679,9 +844,12 @@ class DecodeScheduler:
             groups.setdefault(r.version, []).append(r)
         for version, rows in list(groups.items()):
             if (self.draft_model is not None and len(self._active) == 1
-                    and len(rows) == 1 and not self._prefilling):
-                # truly alone: a multi-token spec burst must not delay
-                # a joining request's interleaved prefill chunks
+                    and len(rows) == 1 and not self._prefilling
+                    and rows[0].temperature <= 0.0):
+                # truly alone (and greedy — the draft-propose/verify
+                # acceptance rule is argmax-match): a multi-token spec
+                # burst must not delay a joining request's interleaved
+                # prefill chunks
                 self._spec_round(rows[0])
             else:
                 self._step_group(version, rows)
@@ -702,8 +870,9 @@ class DecodeScheduler:
         with obs.span("serve/decode_step", rids=rids, bucket=bucket,
                       version=version):
             choices, pages = self._step_jit(
-                mv.params, self.kv.pages(), jnp.asarray(tokens),
-                jnp.asarray(positions), jnp.asarray(tables))
+                mv.params, self.kv.pages(), self._put(tokens),
+                self._put(positions), self._put(tables),
+                *self._sampling_args(rows, bucket))
             # sync-ok: the per-step token readback — EOS detection and
             # per-client streaming both need the ids on host; this is
             # the one deliberate sync of the decode loop
@@ -745,7 +914,8 @@ class DecodeScheduler:
                 choices, dpages = self._draft_jit(
                     dmv, self.draft_kv.pages(),
                     jnp.asarray([[tok]], np.int32),
-                    jnp.asarray([pos0 + i], np.int32), jnp.asarray(dtable))
+                    jnp.asarray([pos0 + i], np.int32), jnp.asarray(dtable),
+                    *self._sampling_args((), 1))
                 self.draft_kv.set_pages(dpages)
                 # sync-ok: draft proposals drive the verify chunk's
                 # token ids — the round is host-driven by design
@@ -757,7 +927,7 @@ class DecodeScheduler:
             choices, pages = self._step_jit(
                 req.model_version.params, self.kv.pages(),
                 jnp.asarray(chunk), jnp.asarray([pos0], np.int32),
-                jnp.asarray(table))
+                jnp.asarray(table), *self._sampling_args((), 1))
             self.kv.set_pages(pages)
             # sync-ok: verify readback — acceptance happens on host
             target = np.asarray(choices)[0]                    # (k+1,)

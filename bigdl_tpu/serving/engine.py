@@ -76,6 +76,18 @@ class ServingEngine:
     stall_deadline_s : watchdog deadline for the batcher's progress
         beacon (None = the ``BIGDL_TPU_STALL_S`` default; active only
         while observability is enabled).
+    mesh / placement / batch_spec : model-parallel serving. ``mesh`` is
+        a ``jax.sharding.Mesh`` the engine dispatches over; ``placement``
+        is the param PartitionSpec policy (``"tp"`` /
+        ``"fsdp"`` / ``"replicated"`` / a spec tree / a callable —
+        ``parallel.sharding.serving_param_specs``) the registry uses for
+        every sharded publish; the padded batch device-puts with
+        ``batch_spec`` (default ``P(("replica", "data"))`` restricted to
+        the axes the mesh has — ``serving_batch_spec``). Buckets then
+        floor at the batch-shard count so every shard gets whole rows.
+    name : replica name — distinguishes this engine's watchdog beacon
+        (``serving/batcher[<name>]``) and metrics provenance when N
+        replicas serve behind a :class:`~.router.Router`.
     """
 
     def __init__(self, model, *, input_shape: Optional[Sequence[int]] = None,
@@ -84,7 +96,9 @@ class ServingEngine:
                  default_deadline_ms: Optional[float] = None,
                  registry: Optional[ModelRegistry] = None,
                  warmup: bool = True,
-                 stall_deadline_s: Optional[float] = None):
+                 stall_deadline_s: Optional[float] = None,
+                 mesh=None, placement=None, batch_spec=None,
+                 name: Optional[str] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue < 1:
@@ -100,6 +114,37 @@ class ServingEngine:
         self.default_deadline_ms = default_deadline_ms
         self._warmup_on_start = warmup
         self._fwd = shared_forward(model)
+        self.name = name
+        self.beacon_name = ("serving/batcher" if name is None
+                            else f"serving/batcher[{name}]")
+        self.mesh = mesh
+        self._batch_sharding = None
+        self._bucket_floor = 1
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from ..parallel import sharding as _sh
+            if registry is not None and placement is not None:
+                raise ValueError(
+                    "placement= is applied by the registry the engine "
+                    "builds — with an explicit registry= it would be "
+                    "silently ignored; construct the registry with "
+                    "mesh/param_specs yourself, or drop one argument")
+            spec = (batch_spec if batch_spec is not None
+                    else _sh.serving_batch_spec(mesh))
+            self._batch_sharding = NamedSharding(mesh, spec)
+            self._bucket_floor = _sh.batch_shard_count(mesh, spec)
+            if self._bucket_floor > self.max_batch \
+                    or self.max_batch % self._bucket_floor:
+                raise ValueError(
+                    f"max_batch {self.max_batch} must be a multiple of the "
+                    f"batch shard count {self._bucket_floor} (mesh "
+                    f"{dict(mesh.shape)}, batch spec {spec}) so every "
+                    "bucket splits into whole per-shard rows")
+            if registry is None:
+                registry = ModelRegistry(
+                    mesh=mesh,
+                    param_specs=_sh.serving_param_specs(
+                        model.params, mesh, placement))
         self.registry = registry or ModelRegistry()
         if self.registry.current() is None:
             self.registry.publish(model.params, model.state, version="v0",
@@ -137,7 +182,7 @@ class ServingEngine:
         # the batcher registers with the stall watchdog: it pulses per
         # collect cycle (bounded 50ms idle poll), so silence means a
         # wedged dispatch — every queued client is stuck behind it
-        self._beacon = _health.beacon("serving/batcher",
+        self._beacon = _health.beacon(self.beacon_name,
                                       deadline_s=self.stall_deadline_s)
         self._thread = threading.Thread(
             target=self._batcher, name=THREAD_NAME, daemon=True)
@@ -153,9 +198,9 @@ class ServingEngine:
         if self.input_shape is None:
             raise ValueError("warmup needs input_shape")
         mv = self.registry.current()
-        for b in shape_buckets(self.max_batch):
+        for b in self._buckets():
             with obs.span("serve/warmup", bucket=b):
-                x = place_host_value(
+                x = self._place_batch(
                     np.zeros((b,) + self.input_shape, self.input_dtype))
                 # sync-ok: warmup precompile — runs before serving starts
                 jax.block_until_ready(self._fwd(mv.params, mv.state, x))
@@ -259,7 +304,15 @@ class ServingEngine:
         """Hot swap: device-load new params (on THIS thread — traffic
         keeps flowing) and atomically activate. The old version finishes
         the batches already cut against it; no response mixes versions.
-        Returns the new version id (rollback = ``registry.activate(old)``)."""
+        Returns the new version id (rollback = ``registry.activate(old)``).
+
+        ``state=None`` (a params-only swap) INHERITS the active
+        version's state — the compiled forward's state pytree must not
+        change shape under it, and carrying running stats across a
+        weight refresh is the sensible default."""
+        if state is None:
+            cur = self.registry.current()
+            state = cur.state if cur is not None else self.model.state
         v = self.registry.publish(params, state, version=version,
                                   activate=False)
         self.registry.activate(v)
@@ -376,13 +429,13 @@ class ServingEngine:
                 qh.observe((t_cut_ns - r.t_enqueue_ns) / 1e6)
             obs.histogram("serve/assemble_ms", unit="ms").observe(
                 assemble_ms)
-        bucket = bucket_for(n, self.max_batch)
+        bucket = self._bucket_for(n)
         mv = self.registry.current()  # ONE version per batch — swap boundary
         sp = obs.span("serve/batch", bucket=bucket, n=n, version=mv.version)
         t_fwd_ns = time.perf_counter_ns()
 
         def forward():
-            xd = place_host_value(pad_leading(x, bucket))
+            xd = self._place_batch(pad_leading(x, bucket))
             out = self._fwd(mv.params, mv.state, xd)
             # sync-ok: serving result readback — the micro-batch
             # is the pipeline unit; its clients are blocked on
@@ -457,6 +510,37 @@ class ServingEngine:
                            dispatch_ms=round(dispatch_ms, 3))
 
     # -- internals -------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        """Padded batch size for ``n`` live rows: power-of-two bucket,
+        rounded UP to a multiple of the mesh batch-shard count (every
+        shard must get whole rows — a mesh with a non-power-of-two data
+        degree, e.g. after an elastic reshape to 3 hosts, still gets
+        divisible buckets; ``max_batch`` itself is validated divisible
+        at construction, so the cap is always reachable)."""
+        b = max(bucket_for(n, self.max_batch), self._bucket_floor)
+        f = self._bucket_floor
+        if b % f:
+            b = min(self.max_batch, -(-b // f) * f)
+        return b
+
+    def _buckets(self):
+        """The reachable bucket set — ``shape_buckets`` mapped through
+        the shard-divisibility rounding (what warmup precompiles)."""
+        out = []
+        for b in shape_buckets(self.max_batch):
+            rb = self._bucket_for(b)
+            if rb not in out:
+                out.append(rb)
+        return tuple(out)
+
+    def _place_batch(self, x):
+        """Host batch → device: the mesh path shards the leading dim
+        with the engine's batch spec (``P(("replica", "data"))``-style);
+        the single-device path keeps the staged device_put."""
+        if self._batch_sharding is not None:
+            return jax.device_put(x, self._batch_sharding)
+        return place_host_value(x)
 
     def _on_done(self, future, t_enqueue):
         # latency covers SERVED requests only — rejections resolve in µs

@@ -42,6 +42,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -71,7 +72,8 @@ class PagedKVCache:
 
     def __init__(self, model, *, num_blocks: int, block_size: int = 16,
                  max_blocks_per_seq: int, dtype=jnp.float32,
-                 metric_prefix: str = "serve/kv"):
+                 metric_prefix: str = "serve/kv",
+                 sharding=None):
         if num_blocks < 2:
             raise ValueError(f"num_blocks must be >= 2 (block 0 is the "
                              f"reserved null block), got {num_blocks}")
@@ -94,10 +96,15 @@ class PagedKVCache:
         self.max_seq_len = self.max_blocks_per_seq * self.block_size
         kvh = attn._kvh()
         d = model.hidden_size // attn.num_heads
-        self._pages = [
-            (jnp.zeros((num_blocks, kvh, block_size, d), dtype),
-             jnp.zeros((num_blocks, kvh, block_size, d), dtype))
-            for _ in model.blocks]
+
+        def _zeros():
+            z = jnp.zeros((num_blocks, kvh, block_size, d), dtype)
+            # mesh-sharded serving: the pooled pages live on the mesh
+            # (kvH split over the model axis when it divides — the
+            # decode-path HBM lever under tensor parallelism); the
+            # compiled step's functional update keeps the placement
+            return z if sharding is None else jax.device_put(z, sharding)
+        self._pages = [(_zeros(), _zeros()) for _ in model.blocks]
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._owned: Dict[object, List[int]] = {}
         self._high_water = 0

@@ -8,6 +8,16 @@ they drain, and no batch ever mixes versions. ``publish()`` does the
 expensive part (host→device placement of the new params) on the CALLER's
 thread — the batcher keeps dispatching against the active version while
 the new one loads — and ``activate()`` is a pointer write under a lock.
+
+Mesh placement (r10): a registry constructed with a ``Mesh`` + param
+PartitionSpecs does the SHARDED load in ``publish()`` — every leaf
+lands on the mesh with its spec (TP column/row shards, FSDP 1/N
+slices), on the publishing thread, so a model that doesn't fit one
+chip hot-swaps exactly like a single-device one: load sharded in the
+background, ``activate()`` flips the pointer, the next dispatch serves
+the new placement atomically. ``param_specs`` may be a spec tree or a
+callable ``params -> spec tree`` (re-resolved per publish, so versions
+with fresh leaf structure still place correctly).
 """
 from __future__ import annotations
 
@@ -45,23 +55,49 @@ class ModelRegistry:
 
     Old versions stay resident until :meth:`retire` — instant rollback is
     ``activate(previous)``. Retiring the active version is refused (it
-    may be mid-batch)."""
+    may be mid-batch).
 
-    def __init__(self):
+    With ``mesh`` + ``param_specs`` given, every publish is a SHARDED
+    load: leaves land on the mesh per their PartitionSpec
+    (``parallel.sharding.place_with_specs``); ``state_specs`` defaults
+    to fully replicated. Swap semantics are unchanged — the placement
+    cost rides the publishing thread, activation stays a pointer write."""
+
+    def __init__(self, mesh=None, param_specs=None, state_specs=None):
         self._versions: Dict[str, ModelVersion] = {}
         self._order: List[str] = []
         self._active: Optional[str] = None
         self._counter = 0
         self._used: set = set()  # every id EVER published — retire must
         self._lock = threading.Lock()  # not let an id be re-minted
+        self.mesh = mesh
+        self._param_specs = param_specs
+        self._state_specs = state_specs
+
+    def _place_tree(self, tree, specs):
+        """Mesh-aware placement of one pytree: sharded when the registry
+        has a mesh (specs resolved per publish when callable, replicated
+        when no specs were given), plain device load otherwise."""
+        if tree is None:
+            return None
+        if self.mesh is None:
+            return _place(tree)
+        from ..parallel.sharding import place_with_specs
+        from jax.sharding import PartitionSpec as P
+        specs = specs(tree) if callable(specs) else specs
+        if specs is None:
+            specs = jax.tree_util.tree_map(lambda _: P(), tree)
+        return place_with_specs(tree, self.mesh, specs)
 
     def publish(self, params, state=None, version: Optional[str] = None,
                 activate: bool = False) -> str:
         """Load a new version (device placement happens HERE, on the
-        calling thread — the background-load half of a hot swap) and
-        optionally activate it. Returns the version id (auto-assigned
-        ``v<n>`` when not given)."""
-        placed = ModelVersion("", _place(params), _place(state))
+        calling thread — the background-load half of a hot swap; sharded
+        onto the registry's mesh when it has one) and optionally
+        activate it. Returns the version id (auto-assigned ``v<n>`` when
+        not given)."""
+        placed = ModelVersion("", self._place_tree(params, self._param_specs),
+                              self._place_tree(state, self._state_specs))
         with self._lock:
             if version is None:
                 # skip ids ever taken (explicit publishes AND retired

@@ -441,6 +441,97 @@ def test_static_admission_is_whole_request_batching():
     assert st["kv"]["blocks_in_use"] == 0
 
 
+# ---------------------------------------------------------------------------
+# sampling (ISSUE 10 satellite): temperature / top-p with seeded streams
+# ---------------------------------------------------------------------------
+
+
+def _one(m, prompt, max_new=10, **kw):
+    with _sched(m) as sched:
+        return np.asarray(sched.submit(prompt, max_new, **kw)
+                          .result(timeout=120))
+
+
+def test_sampling_default_and_temp0_stay_greedy_bitwise():
+    """temperature=0 (the default, and explicitly with a seed set) is
+    BITWISE the greedy path — the pre-sampling correctness gate."""
+    m = shared_model()
+    p = np.random.RandomState(20).randint(1, V, size=7).astype(np.int32)
+    want = solo_oracle(m, m.params, p, 10)
+    assert np.array_equal(_one(m, p), want)
+    assert np.array_equal(_one(m, p, temperature=0.0, seed=99), want)
+
+
+def test_sampling_seeded_reproducible_and_batch_mix_independent():
+    """Same seed ⇒ same tokens — alone or sharing the batch with other
+    traffic (keys derive from (seed, position) only, the sampling
+    analog of the gemm M-class floor)."""
+    m = shared_model()
+    rng = np.random.RandomState(21)
+    p = rng.randint(1, V, size=6).astype(np.int32)
+    kw = dict(temperature=0.9, top_p=0.9, seed=123)
+    solo1 = _one(m, p, **kw)
+    solo2 = _one(m, p, **kw)
+    assert np.array_equal(solo1, solo2), "same seed must reproduce"
+    with _sched(m) as sched:
+        others = [sched.submit(rng.randint(1, V, size=5).astype(np.int32),
+                               8) for _ in range(2)]
+        fut = sched.submit(p, 10, **kw)
+        mixed = np.asarray(fut.result(timeout=120))
+        for f in others:
+            f.result(timeout=120)
+    assert np.array_equal(mixed, solo1), \
+        "sampled tokens must not depend on batch mix"
+    diff_seed = _one(m, p, temperature=0.9, top_p=0.9, seed=124)
+    assert not np.array_equal(solo1, diff_seed) or solo1.size < 3
+
+
+def test_sampling_top_p_collapse_is_greedy():
+    """top_p → 0 keeps only the top-1 token: sampling must reduce to
+    the greedy choice exactly."""
+    m = shared_model()
+    p = np.random.RandomState(22).randint(1, V, size=5).astype(np.int32)
+    want = solo_oracle(m, m.params, p, 8)
+    got = _one(m, p, max_new=8, temperature=0.8, top_p=1e-6, seed=7)
+    assert np.array_equal(got, want)
+
+
+def test_sampling_validation_and_greedy_rows_unaffected():
+    m = shared_model()
+    p = np.asarray([1, 2, 3], np.int32)
+    with _sched(m) as sched:
+        with pytest.raises(ValueError, match="temperature"):
+            sched.submit(p, 4, temperature=-0.1)
+        with pytest.raises(ValueError, match="top_p"):
+            sched.submit(p, 4, top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            sched.submit(p, 4, top_p=1.5)
+        # a greedy request decoding NEXT TO a sampling request stays
+        # bitwise greedy (per-row where() on the choice)
+        g = sched.submit(p, 8)
+        s = sched.submit(p, 8, temperature=1.2, top_p=0.8, seed=5)
+        greedy_out = np.asarray(g.result(timeout=120))
+        s.result(timeout=120)
+    assert np.array_equal(greedy_out, solo_oracle(m, m.params, p, 8))
+
+
+def test_sampling_skips_speculative_fast_path():
+    """The draft-propose/verify acceptance rule is argmax-match —
+    a sampling request must ride the normal bucketed step even when it
+    is alone with a draft model armed."""
+    m = shared_model()
+    draft = _model(num_layers=1, pos_encoding="rope", num_kv_heads=2)
+    p = np.asarray([3, 1, 4, 1, 5], np.int32)
+    kw = dict(temperature=0.9, top_p=0.9, seed=31)
+    want = _one(m, p, max_new=8, **kw)
+    with _sched(m, draft_model=draft) as sched:
+        out = np.asarray(sched.submit(p, 8, **kw).result(timeout=120))
+        st = sched.stats()
+    assert st["spec_rounds"] == 0, "sampling must not take the spec path"
+    assert np.array_equal(out, want), \
+        "tokens identical with or without a draft model armed"
+
+
 def test_concurrent_submitters():
     """Thread-safety of submit(): many client threads, every result
     bitwise (the closed-loop bench shape at test scale)."""

@@ -62,9 +62,12 @@ HOT_FUNCS = {
     "bigdl_tpu/optim/predictor.py": {"_iter_outputs", "predict", "_stage"},
     # serving batcher hot loop: a stray sync between dispatches stalls
     # every queued client, not just one training step (the readback in
-    # _dispatch and the warmup block are the two deliberate ones)
+    # _dispatch and the warmup block are the two deliberate ones);
+    # _place_batch/_bucket_for are the mesh dispatch path — the padded
+    # batch shards onto the mesh with a transfer, never a block
     "bigdl_tpu/serving/engine.py": {
         "_batcher", "_collect", "_dispatch", "submit", "warmup",
+        "_place_batch", "_bucket_for",
     },
     "bigdl_tpu/serving/batching.py": {"assemble"},
     # continuous-batching decode loop: a stray sync between decode steps
@@ -75,7 +78,7 @@ HOT_FUNCS = {
     "bigdl_tpu/serving/decode_scheduler.py": {
         "_loop", "_admit", "_advance_prefill", "_step_all", "_step_group",
         "_spec_round", "_evict_expired", "_emit", "_finish", "_release",
-        "submit", "warmup",
+        "submit", "warmup", "_put", "_sampling_args",
     },
     # block ledger: admission-control bookkeeping runs between decode
     # steps and must stay pure host state (device pages are functional
@@ -83,6 +86,18 @@ HOT_FUNCS = {
     "bigdl_tpu/serving/kv_cache.py": {
         "ensure_capacity", "free", "block_table", "can_allocate",
     },
+    # router hot loop: pure host routing — a sync here would stall
+    # EVERY class queue; the replicas' own batcher threads do the
+    # device work. _on_inner_done runs on replica threads between
+    # their dispatches and must stay host-only too.
+    "bigdl_tpu/serving/router.py": {
+        "_route_loop", "_drr_round", "_dispatch_one", "_on_inner_done",
+        "_failover", "_drain_replica", "submit",
+    },
+    # mesh dispatch path: the sharded version load (publish, on the
+    # swapping caller's thread) issues device transfers but must never
+    # BLOCK on one — traffic flows on the active version meanwhile
+    "bigdl_tpu/serving/registry.py": {"publish", "_place_tree"},
 }
 
 SYNC = re.compile(r"(?<![\w.])float\(|\.block_until_ready\(")
