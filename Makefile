@@ -17,7 +17,7 @@ NPROC ?= 4
 SHELL := /bin/bash
 
 .PHONY: test test-slow test-serial test-examples tier1 check-no-sync \
-	serve-smoke obs-smoke fault-smoke perf-gate
+	serve-smoke obs-smoke fault-smoke perf-gate kernels-smoke
 test:
 	$(PYTEST) tests/ -q -n $(NPROC) --dist loadfile
 
@@ -26,11 +26,19 @@ test:
 # the sync-point lint so an un-annotated float()/block_until_ready in the
 # hot loop fails before the 15-minute suite starts, and on the serving
 # smoke so a broken engine fails in seconds, not mid-suite.
-tier1: check-no-sync perf-gate serve-smoke obs-smoke fault-smoke
+tier1: check-no-sync perf-gate kernels-smoke serve-smoke obs-smoke fault-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 check-no-sync:
 	python tools/check_no_sync.py
+
+# Every hand-written Pallas kernel through the interpreter against its
+# oracle (flash attention fwd+bwd, fused conv+BN epilogue, the paged
+# decode-attention kernel) with dispatch spies asserting the env-gated
+# seams actually route — seconds, so a broken kernel fails before the
+# 15-minute suite starts.
+kernels-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/kernels_smoke.py
 
 # Perf-regression gate: current BENCH_METRICS.json vs the pinned
 # PERF_BASELINE.json, per-metric tolerance bands (docs/OBSERVABILITY.md

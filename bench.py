@@ -472,8 +472,9 @@ def _cache_tpu_lines(lines):
             # merge must not keep re-persisting them next to clean writes
             existing = {
                 l["metric"]: {k: v for k, v in l.items()
-                              if k not in ("cached", "cache_from",
-                                           "tunnel_error", "error")}
+                              if k not in ("cached", "stale_cache",
+                                           "cache_from", "tunnel_error",
+                                           "error")}
                 for l in json.load(f)
                 if isinstance(l, dict) and "metric" in l}
     except (OSError, ValueError):
@@ -487,8 +488,8 @@ def _cache_tpu_lines(lines):
             # line — ANY error text on a line being cached describes a
             # past serve, not the measurement)
             clean = {k: v for k, v in l.items()
-                     if k not in ("cached", "cache_from", "tunnel_error",
-                                  "error")}
+                     if k not in ("cached", "stale_cache", "cache_from",
+                                  "tunnel_error", "error")}
             existing[l["metric"]] = dict(clean, measured_at=stamp)
         tmp = _TPU_CACHE + ".tmp"
         with open(tmp, "w") as f:
@@ -532,17 +533,20 @@ def _cached_tpu_lines(which, max_age_days: float = 14.0):
         if age is not None and age > max_age_days * 86400:
             continue
         # provenance on reuse: the measurement time moves to `cache_from`
-        # (a served line must never look freshly measured), and any error
+        # (a served line must never look freshly measured), any error
         # text a previous serve attached is dropped — it described THAT
         # run's outage, not this one (BENCH_r05 re-emitted a stale
-        # tunnel_error verbatim)
+        # tunnel_error verbatim) — and the line is EXPLICITLY flagged
+        # `stale_cache: true`: a round file holding one of these is a
+        # re-served old measurement, never a fresh round (BENCH_r03's
+        # number rode r04/r05 as if re-measured; ROADMAP direction 1)
         line = dict(l)
         line.pop("tunnel_error", None)
         line.pop("error", None)
         ts = line.pop("measured_at", None)
         if ts:
             line["cache_from"] = ts
-        out.append(dict(line, cached=True))
+        out.append(dict(line, cached=True, stale_cache=True))
     return out
 
 
@@ -677,6 +681,15 @@ def _orchestrate(which: str):
         break
     cached = _cached_tpu_lines(which)
     if cached:
+        # LOUD: a cached serve must never read like a fresh measurement.
+        # Every line below carries stale_cache: true + cache_from, and
+        # the warning names the measurement date so a human scanning the
+        # round log sees the re-serve immediately.
+        ages = sorted({l.get("cache_from", "?") for l in cached})
+        print(f"bench: WARNING — tunnel down for config {which!r}; "
+              f"re-serving {len(cached)} CACHED measurement(s) from "
+              f"{', '.join(ages)} marked stale_cache: true. This is NOT "
+              f"a fresh round.", file=sys.stderr, flush=True)
         return [dict(l, tunnel_error="; ".join(errors)[-200:])
                 for l in cached]
     if degraded is not None:

@@ -249,36 +249,64 @@ def _lm_workload(n_clients, n_requests, max_seq_len, seed=0):
     return plan
 
 
-def _run_lm_arm(model, plan, admission, max_slots):
+def _paged_attn_env(value):
+    """Pin the paged-attention dispatch mode for one arm (the knob is
+    read at trace time, so it must be set around scheduler build +
+    warmup). ``None`` restores the ambient default."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        old = os.environ.get("BIGDL_TPU_PAGED_ATTN")
+        if value is None:
+            os.environ.pop("BIGDL_TPU_PAGED_ATTN", None)
+        else:
+            os.environ["BIGDL_TPU_PAGED_ATTN"] = value
+        try:
+            yield
+        finally:
+            if old is None:
+                os.environ.pop("BIGDL_TPU_PAGED_ATTN", None)
+            else:
+                os.environ["BIGDL_TPU_PAGED_ATTN"] = old
+    return ctx()
+
+
+def _run_lm_arm(model, plan, admission, max_slots, paged_attn="off"):
     """One closed-loop run over ``plan``; returns (tokens/s, ttft list,
-    tpot list, stats). A warmup pass first compiles every bucket/chunk
-    shape so the timed window measures scheduling, not XLA."""
+    tpot list, stats, outputs keyed (client, request)). A warmup pass
+    first compiles every bucket/chunk shape so the timed window
+    measures scheduling, not XLA. ``paged_attn`` pins the attention
+    path for the arm (the kernel A/B lever)."""
     from bigdl_tpu.serving import DecodeScheduler
-    sched = DecodeScheduler(
-        model, max_slots=max_slots, block_size=16,
-        max_seq_len=max(96, max(int(p.size) + mn + 2
-                                for reqs in plan for p, mn in reqs)),
-        prefill_chunk=16, admission=admission)
-    n_clients = len(plan)
-    total_tokens = [0] * n_clients
-    ttfts, tpots = [], []
-    lock = threading.Lock()
-    with sched:  # start() precompiles every dispatchable shape
-        def client(i):
-            for prompt, max_new in plan[i]:
-                fut = sched.submit(prompt, max_new)
-                out = fut.result(timeout=300)
-                with lock:
-                    total_tokens[i] += int(out.size)
-                    if fut.trace:
-                        if fut.trace.get("ttft_ms") is not None:
-                            ttfts.append(fut.trace["ttft_ms"])
-                        if fut.trace.get("tpot_ms"):
-                            tpots.append(fut.trace["tpot_ms"])
-        dt = _client_pool(n_clients, client)
-        sched.drain(timeout=60.0)
-        st = sched.stats()
-    return sum(total_tokens) / dt, ttfts, tpots, st
+    with _paged_attn_env(paged_attn):
+        sched = DecodeScheduler(
+            model, max_slots=max_slots, block_size=16,
+            max_seq_len=max(96, max(int(p.size) + mn + 2
+                                    for reqs in plan for p, mn in reqs)),
+            prefill_chunk=16, admission=admission)
+        n_clients = len(plan)
+        total_tokens = [0] * n_clients
+        ttfts, tpots = [], []
+        outputs = {}
+        lock = threading.Lock()
+        with sched:  # start() precompiles every dispatchable shape
+            def client(i):
+                for j, (prompt, max_new) in enumerate(plan[i]):
+                    fut = sched.submit(prompt, max_new)
+                    out = fut.result(timeout=300)
+                    with lock:
+                        total_tokens[i] += int(out.size)
+                        outputs[(i, j)] = np.asarray(out)
+                        if fut.trace:
+                            if fut.trace.get("ttft_ms") is not None:
+                                ttfts.append(fut.trace["ttft_ms"])
+                            if fut.trace.get("tpot_ms"):
+                                tpots.append(fut.trace["tpot_ms"])
+            dt = _client_pool(n_clients, client)
+            sched.drain(timeout=60.0)
+            st = sched.stats()
+    return sum(total_tokens) / dt, ttfts, tpots, st, outputs
 
 
 def _pct(xs, q):
@@ -293,11 +321,40 @@ def bench_serving_lm(n_clients, n_requests, max_slots):
     plan = _lm_workload(n_clients, n_requests, 512)
     total = n_clients * n_requests
     # static (whole-request) first, then continuous — same model
-    # instance, each arm warms its own compiled shapes before timing
-    thr_s, ttft_s, tpot_s, st_s = _run_lm_arm(model, plan, "static",
-                                              max_slots)
-    thr_c, ttft_c, tpot_c, st_c = _run_lm_arm(model, plan, "continuous",
-                                              max_slots)
+    # instance, each arm warms its own compiled shapes before timing.
+    # Both baseline arms PIN the dense attention path so the kernel A/B
+    # below isolates the attention implementation, not the backend's
+    # auto policy.
+    thr_s, ttft_s, tpot_s, st_s, _ = _run_lm_arm(model, plan, "static",
+                                                 max_slots)
+    thr_c, ttft_c, tpot_c, st_c, out_c = _run_lm_arm(model, plan,
+                                                     "continuous",
+                                                     max_slots)
+    # kernel A/B arm (ISSUE 11): continuous batching with the Pallas
+    # paged-attention kernel — compiled on TPU-class backends, the
+    # interpreter on CPU (functionally the same kernel; interpret-mode
+    # tokens/s is a CORRECTNESS number, not a perf claim — the HBM win
+    # only exists where there is HBM, which is why kernel_mode rides
+    # the line). Tokens must match the dense arm bitwise.
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    kernel_mode = "on" if backend in ("tpu", "axon") else "interpret"
+    # trace-count spy (same discipline as the tests and kernels_smoke):
+    # a kernel failure degrades loudly to the dense path mid-arm, and a
+    # dense-path number published as kernel_mode 'on' would be exactly
+    # the silent-provenance failure the stale_cache work closes — the
+    # arm must PROVE the Pallas path built its programs
+    from bigdl_tpu.kernels import paged_attention as _pk
+    traces0 = _pk.trace_count()
+    thr_k, ttft_k, tpot_k, st_k, out_k = _run_lm_arm(
+        model, plan, "continuous", max_slots, paged_attn=kernel_mode)
+    kernel_traced = _pk.trace_count() > traces0
+    match = (len(out_c) == len(out_k)
+             and all(np.array_equal(out_c[key], out_k[key])
+                     for key in out_c))
     lines = [{
         "metric": "serving_lm_tokens_per_s",
         "value": round(thr_c, 1), "unit": "tok/s",
@@ -334,22 +391,43 @@ def bench_serving_lm(n_clients, n_requests, max_slots):
         "value": round(_pct(ttft_s, 0.99) / max(_pct(ttft_c, 0.99), 1e-9),
                        2), "unit": "x",
         "clients": n_clients, "backend": "cpu",
+    }, {
+        "metric": "serving_lm_kernel_tokens_per_s",
+        "value": round(thr_k, 1), "unit": "tok/s",
+        "clients": n_clients, "requests": total, "max_slots": max_slots,
+        "decode_steps": st_k["decode_steps"],
+        "kernel_mode": kernel_mode, "kernel_traced": kernel_traced,
+        "backend": backend,
+    }, {
+        "metric": "serving_lm_kernel_vs_dense",
+        "value": round(thr_k / max(thr_c, 1e-9), 2), "unit": "x",
+        "kernel_mode": kernel_mode, "clients": n_clients,
+        "backend": backend,
+    }, {
+        # the bench-level bitwise gate: every request's kernel-arm
+        # tokens equal its dense-arm tokens (1.0 or the run fails)
+        "metric": "serving_lm_kernel_token_match",
+        "value": 1.0 if match else 0.0, "unit": "frac",
+        "requests": total, "kernel_mode": kernel_mode,
+        "backend": backend,
     }]
-    return lines, st_c, st_s
+    return lines, st_c, st_s, st_k
 
 
 def main_lm(smoke: bool):
     n_clients = int(os.environ.get("SERVE_LM_CLIENTS", 3 if smoke else 8))
     n_requests = int(os.environ.get("SERVE_LM_REQUESTS", 2 if smoke else 4))
     max_slots = int(os.environ.get("SERVE_LM_SLOTS", 4 if smoke else 8))
-    lines, st_c, st_s = bench_serving_lm(n_clients, n_requests, max_slots)
+    lines, st_c, st_s, st_k = bench_serving_lm(n_clients, n_requests,
+                                               max_slots)
     for line in lines:
         print(json.dumps(line), flush=True)
     _merge_metrics_dump(lines)
     by_metric = {l["metric"]: l for l in lines}
     failures = []
     total = n_clients * n_requests
-    for name, st in (("continuous", st_c), ("static", st_s)):
+    for name, st in (("continuous", st_c), ("static", st_s),
+                     ("kernel", st_k)):
         if st["timeouts"]:
             failures.append(f"{st['timeouts']} {name} requests timed out")
         if st["kv"]["blocks_in_use"]:
@@ -357,6 +435,16 @@ def main_lm(smoke: bool):
                             "blocks leaked")
     speedup = by_metric["serving_lm_cb_speedup"]["value"]
     ttft_ratio = by_metric["serving_lm_ttft_p99_ratio"]["value"]
+    # the kernel arm's gates hold at EVERY scale, smoke included: the
+    # tokens must match the dense arm bitwise AND the Pallas path must
+    # actually have served them (a silent dense fallback published as
+    # kernel numbers is a provenance lie, not a measurement)
+    if by_metric["serving_lm_kernel_token_match"]["value"] != 1.0:
+        failures.append("kernel-arm tokens diverged from the dense arm "
+                        "(serving_lm_kernel_token_match < 1.0)")
+    if not by_metric["serving_lm_kernel_tokens_per_s"]["kernel_traced"]:
+        failures.append("kernel arm never traced the Pallas path — its "
+                        "numbers are dense-path numbers (fallback?)")
     if not smoke:
         # ISSUE 8 acceptance: continuous batching must beat whole-
         # request batching on BOTH axes (the smoke run is a plumbing
@@ -370,6 +458,7 @@ def main_lm(smoke: bool):
         print("bench_serving --lm: FAIL — " + "; ".join(failures),
               file=sys.stderr)
         raise SystemExit(1)
+    km = by_metric["serving_lm_kernel_tokens_per_s"]
     print(f"bench_serving --lm: ok — "
           f"{by_metric['serving_lm_tokens_per_s']['value']} tok/s "
           f"continuous vs "
@@ -378,7 +467,9 @@ def main_lm(smoke: bool):
           f"{by_metric['serving_lm_ttft_p99_ms']['value']}ms vs "
           f"{by_metric['serving_lm_static_ttft_p99_ms']['value']}ms "
           f"({ttft_ratio}x better), TPOT "
-          f"{by_metric['serving_lm_tpot_ms']['value']}ms")
+          f"{by_metric['serving_lm_tpot_ms']['value']}ms; kernel arm "
+          f"({km['kernel_mode']}) {km['value']} tok/s, tokens bitwise "
+          f"== dense")
 
 
 def _run_router_arm(model, submit, tight_rps, bulk_rps, duration_s,

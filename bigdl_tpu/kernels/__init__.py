@@ -6,5 +6,6 @@ Those live here, each with an interpret-mode path so the CPU test suite
 exercises the same kernel code the TPU runs.
 """
 from .flash_attention import flash_attention_fused
+from .paged_attention import paged_decode_attention
 
-__all__ = ["flash_attention_fused"]
+__all__ = ["flash_attention_fused", "paged_decode_attention"]

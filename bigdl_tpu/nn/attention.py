@@ -295,16 +295,28 @@ class Attention(Module):
         there); positions: (B,) int32.
 
         Writes the S new K/V entries through the table (scatter), then
-        attends over the gathered logical view with causal-within-chunk
-        + everything-before masking per row. The gathered view presents
-        logical positions 0..max_blocks*block_size-1 in order and masked
-        positions contribute exactly 0 after softmax (their logits are
-        -1e30 → exp underflows to +0.0), so the unmasked arithmetic is
-        bitwise-identical to :meth:`decode_chunk` over a dense cache —
-        the continuous-batching correctness gate rests on that.
+        attends. Two implementations of the attention itself, one
+        dispatch policy (``parallel.flash.paged_attention``, gated by
+        ``BIGDL_TPU_PAGED_ATTN``):
+
+        * the DENSE path (:meth:`_paged_gather_attend` — the fallback
+          and the oracle) gathers the logical (B, kvH, T, D) view
+          through the tables and einsums over it. The gathered view
+          presents logical positions 0..max_blocks*block_size-1 in
+          order and masked positions contribute exactly 0 after softmax
+          (their logits are -1e30 → exp underflows to +0.0), so the
+          unmasked arithmetic is bitwise-identical to
+          :meth:`decode_chunk` over a dense cache — the
+          continuous-batching correctness gate rests on that;
+        * the Pallas KERNEL (``kernels/paged_attention.py``) streams
+          the row's physical blocks through VMEM via scalar-prefetched
+          tables — no gathered view, no O(T) HBM round-trip. Its
+          online-softmax output matches the dense path to ulps (greedy
+          argmax absorbs the difference — the kernel-on serving gate).
+
         Returns (out (B, S, H), k_pages, v_pages)."""
         q, k_t, v_t = self.qkv(params, x)
-        B, S = x.shape[0], x.shape[1]
+        S = x.shape[1]
         if self.rope:
             p = positions[:, None] + jnp.arange(S)[None, :]     # (B, S)
             q = rotary_embedding(q, p)
@@ -320,6 +332,21 @@ class Attention(Module):
             jnp.moveaxis(k_t, 1, 2).astype(k_pages.dtype))
         v_pages = v_pages.at[blk, :, off, :].set(
             jnp.moveaxis(v_t, 1, 2).astype(v_pages.dtype))
+        from ..parallel.flash import paged_attention
+        o = paged_attention(
+            q, k_pages, v_pages, block_tables, positions,
+            lambda: self._paged_gather_attend(q, k_pages, v_pages,
+                                              block_tables, pos_s))
+        return self._merge(o, params), k_pages, v_pages
+
+    def _paged_gather_attend(self, q, k_pages, v_pages, block_tables,
+                             pos_s):
+        """The dense paged-attention path: gather the logical
+        (B, kvH, T, D) view through the block tables, einsum over it.
+        Fallback and ORACLE for the Pallas paged kernel — every kernel
+        change must keep this path bitwise-stable."""
+        B, S = pos_s.shape
+        bs = k_pages.shape[2]
         # gather the logical view: (B, nblk, kvH, bs, D) -> (B, kvH, T, D)
         kg = jnp.moveaxis(k_pages[block_tables], 2, 1)
         vg = jnp.moveaxis(v_pages[block_tables], 2, 1)
@@ -336,13 +363,12 @@ class Attention(Module):
             logits = jnp.einsum("bkgsd,bktd->bkgst", qg, kg) / math.sqrt(d)
             logits = jnp.where(keep[:, None, None], logits, -1e30)
             w = jax.nn.softmax(logits, axis=-1)
-            o = jnp.einsum("bkgst,bktd->bkgsd", w, vg).reshape(b, h, S, dd)
-        else:
-            logits = jnp.einsum("bhsd,bhtd->bhst", q, kg) / math.sqrt(d)
-            logits = jnp.where(keep[:, None], logits, -1e30)
-            w = jax.nn.softmax(logits, axis=-1)
-            o = jnp.einsum("bhst,bhtd->bhsd", w, vg)
-        return self._merge(o, params), k_pages, v_pages
+            return jnp.einsum("bkgst,bktd->bkgsd", w,
+                              vg).reshape(b, h, S, dd)
+        logits = jnp.einsum("bhsd,bhtd->bhst", q, kg) / math.sqrt(d)
+        logits = jnp.where(keep[:, None], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhst,bhtd->bhsd", w, vg)
 
     def _apply(self, params, state, x, training, rng):
         if isinstance(x, Table):
@@ -583,7 +609,9 @@ class TransformerBlock(Module):
         """The paged-cache analog of :meth:`decode_step` (LM blocks
         only): h_t (B, S, H) lands at per-row positions
         ``positions[b]..positions[b]+S-1`` through the block tables.
-        Returns (h (B, S, H), k_pages, v_pages)."""
+        Attention dispatch (dense gather vs the Pallas paged kernel)
+        happens inside :meth:`Attention.decode_paged` — this wrapper is
+        path-agnostic. Returns (h (B, S, H), k_pages, v_pages)."""
         n, _ = self.ln1.apply(params["ln1"], {}, h_t, False, None)
         a, k_pages, v_pages = self.attn.decode_paged(
             params["attn"], n, k_pages, v_pages, block_tables, positions)

@@ -1980,7 +1980,12 @@ class DistriOptimizer(BaseOptimizer):
     def __init__(self, model, training_set, criterion, optim_method=None,
                  end_trigger=None, batch_size: int = 32, mesh=None,
                  parameter_mode: str = "replicated",
-                 compress: str = "none"):
+                 compress: str = "none", wire_dtype: str = "none"):
+        """``compress`` / ``wire_dtype``: ZeRO-1 gradient-wire knobs
+        (``parallel.allreduce`` module docstring) — ``compress`` is the
+        legacy wire-dtype psum, ``wire_dtype`` the fp32-master-
+        accumulation all_to_all wire. Both off by default; mutually
+        exclusive."""
         super().__init__(model, training_set, criterion, optim_method,
                          end_trigger, batch_size)
         from ..parallel.mesh import get_default_mesh
@@ -1989,6 +1994,7 @@ class DistriOptimizer(BaseOptimizer):
             raise ValueError("DistriOptimizer mesh needs a 'data' axis")
         self.parameter_mode = parameter_mode
         self.compress = compress
+        self.wire_dtype = wire_dtype
         self._arp = None
         self._flat = None
 
@@ -2039,8 +2045,9 @@ class DistriOptimizer(BaseOptimizer):
         self._check_split_agreement()
         if self.parameter_mode == "zero1":
             from ..parallel.allreduce import AllReduceParameter
-            self._arp = AllReduceParameter(self.optim_method, self.mesh,
-                                           compress=self.compress)
+            self._arp = AllReduceParameter(
+                self.optim_method, self.mesh, compress=self.compress,
+                wire_dtype=getattr(self, "wire_dtype", "none"))
             # a loaded checkpoint's optimizer state is CANONICAL
             # (params-shaped, mesh-agnostic): prepare() re-flattens and
             # re-pads it against THIS mesh's shard boundaries, so the

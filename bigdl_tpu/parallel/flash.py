@@ -1,18 +1,25 @@
 """Flash attention dispatch: custom Pallas kernel on TPU, einsum elsewhere.
 
-The kernel itself lives in ``bigdl_tpu.kernels.flash_attention`` (hand-written
-Pallas forward + backward, O(T) memory). This module is only the dispatcher:
+The kernels themselves live in ``bigdl_tpu.kernels`` (hand-written
+Pallas; ``flash_attention`` for training/prefill, ``paged_attention``
+for the serving tier's paged decode). This module is only the
+dispatcher:
 
 * TPU-class backends ("tpu", and the axon PJRT plugin's "axon") run the
-  compiled kernel;
-* ``BIGDL_TPU_FLASH=interpret`` forces the same kernel through the Pallas
-  interpreter (how the CPU test suite exercises the kernel code);
-* ``BIGDL_TPU_FLASH=off`` or any non-TPU backend falls back to the reference
-  einsum path in ``nn.attention`` — and the fallback is LOGGED, never silent,
-  so a TPU run that degrades to O(T^2) attention is visible.
+  compiled kernels;
+* ``BIGDL_TPU_FLASH=interpret`` / ``BIGDL_TPU_PAGED_ATTN=interpret``
+  force the same kernels through the Pallas interpreter (how the CPU
+  test suite exercises the kernel code);
+* ``BIGDL_TPU_FLASH=off`` / ``BIGDL_TPU_PAGED_ATTN=off`` or any non-TPU
+  backend falls back to the reference einsum / dense-gather paths in
+  ``nn.attention`` — and the fallback is LOGGED, never silent, so a TPU
+  run that degrades to O(T^2) attention (or to the O(T) paged-gather
+  round-trip) is visible.
 """
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import logging
 import os
 
@@ -136,3 +143,118 @@ def flash_chunk_attention(q, k, v, q_offset: int, kv_len: int = None):
     return _dispatch("chunk attention", kernel,
                      lambda: _einsum_chunk_fallback(q, k, v, q_offset,
                                                     kv_len))
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention (serving tier)
+# ---------------------------------------------------------------------------
+
+# Trace-time serving context: the DecodeScheduler's compiled step sets
+# (mesh, kv-head shard axis) around its trace so the dispatch below can
+# shard_map the kernel per kv-head group under TP serving. A contextvar
+# (not a model attribute) keeps shared model objects placement-free —
+# two schedulers serving the same model at different placements never
+# see each other's mesh.
+_PAGED_CTX = contextvars.ContextVar("bigdl_tpu_paged_ctx",
+                                    default=(None, None))
+
+
+@contextlib.contextmanager
+def paged_serving_context(mesh=None, shard_axis=None):
+    """Trace-time context: set by the serving step around its
+    ``decode_paged`` trace. ``shard_axis``: mesh axis the KV pages'
+    kv-head dim is sharded over (None = pages replicated)."""
+    tok = _PAGED_CTX.set((mesh, shard_axis))
+    try:
+        yield
+    finally:
+        _PAGED_CTX.reset(tok)
+
+
+def paged_mode() -> str:
+    """Resolved paged-decode dispatch mode: 'pallas' | 'interpret' |
+    'dense'. Same policy shape as :func:`flash_mode`, gated by its own
+    env knob (``BIGDL_TPU_PAGED_ATTN`` = auto/on/off/interpret) so the
+    serving kernel can be A/B'd independently of the training kernels.
+    The dense gather path stays the fallback AND the oracle."""
+    mode = os.environ.get("BIGDL_TPU_PAGED_ATTN", "auto")
+    if mode == "off":
+        return "dense"
+    if mode == "interpret":
+        return "interpret"
+    if mode == "on":
+        return "pallas"
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    return "pallas" if backend in ("tpu", "axon") else "dense"
+
+
+def _paged_obs(counter: str):
+    """Trace-time dispatch accounting: one bump per program BUILT on
+    each path (execution never re-enters Python, so per-program is the
+    honest unit — serve/decode_steps counts the dispatches riding it)."""
+    from .. import observability as obs
+    if obs.enabled():
+        obs.counter(f"kernels/{counter}").inc()
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, positions,
+                    dense_fn):
+    """The serving tier's paged-decode attention seam.
+
+    q: (B, nH, S, D); k_pages/v_pages: (num_blocks, kvH, block_size, D)
+    ALREADY holding this chunk's scattered K/V; block_tables:
+    (B, max_blocks) int32; positions: (B,) int32. ``dense_fn()`` is the
+    caller's gathered-view einsum — the fallback and the oracle.
+
+    Under a :func:`paged_serving_context` mesh the kernel runs inside
+    ``shard_map`` per kv-head group: attention is head-local, so a
+    kvH-sharded page pool needs no cross-shard communication — each
+    shard streams its own heads' blocks. Pages replicated on the mesh
+    (FSDP placement, or kvH not divisible by the axis) shard_map with
+    replicated specs instead; any kernel failure falls back to the
+    dense path with a logged warning, never silently."""
+    mode = paged_mode()
+    if mode == "dense":
+        if os.environ.get("BIGDL_TPU_PAGED_ATTN") != "off":
+            try:
+                backend = jax.default_backend()
+            except Exception:
+                backend = "cpu"
+            _warn_once(("paged attention", "backend", backend),
+                       "paged attention: non-TPU backend %r uses the dense "
+                       "gather path (set BIGDL_TPU_PAGED_ATTN=interpret to "
+                       "run the Pallas kernel in interpreter mode)", backend)
+        _paged_obs("paged_attn_dense_programs")
+        return dense_fn()
+    interpret = mode == "interpret"
+    mesh, axis = _PAGED_CTX.get()
+    try:
+        from ..kernels.paged_attention import paged_decode_attention
+        if mesh is None:
+            out = paged_decode_attention(q, k_pages, v_pages, block_tables,
+                                         positions, interpret=interpret)
+        else:
+            from jax.sharding import PartitionSpec as P
+            from ..utils.compat import shard_map
+            head = P(None, axis) if axis else P()
+
+            def body(q, kp, vp, tbl, pos):
+                return paged_decode_attention(
+                    q, kp, vp, tbl, pos, interpret=interpret,
+                    vma={axis} if axis else None)
+
+            out = shard_map(body, mesh=mesh,
+                            in_specs=(head, head, head, P(), P()),
+                            out_specs=head, check_vma=False)(
+                q, k_pages, v_pages, block_tables, positions)
+        _paged_obs("paged_attn_programs")
+        return out
+    except Exception as e:
+        _warn_once(("paged attention", "kernel", mode),
+                   "Pallas paged-attention kernel failed (%s); falling "
+                   "back to the dense gather path", e)
+        _paged_obs("paged_attn_fallbacks")
+        return dense_fn()

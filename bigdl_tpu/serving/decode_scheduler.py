@@ -237,6 +237,7 @@ class DecodeScheduler:
         self.beacon_name = ("serving/decode_scheduler" if name is None
                             else f"serving/decode_scheduler[{name}]")
         self.mesh = mesh
+        self._page_axis = None   # mesh axis the pages' kvH dim splits over
         page_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -255,6 +256,7 @@ class DecodeScheduler:
                 # HBM lever under tensor parallelism — each shard holds
                 # kvH/tp heads of every block
                 page_sharding = NamedSharding(mesh, P(None, "model"))
+                self._page_axis = "model"
             else:
                 page_sharding = self._op_sharding
             if registry is None:
@@ -307,12 +309,19 @@ class DecodeScheduler:
         self._beacon = _health.NULL_BEACON
         self._snap_writer = _cluster.default_writer()
 
-    @staticmethod
-    def _build_step(model, name):
+    def _build_step(self, model, name):
         """The ONE compiled paged decode step: next-token choices for
         every (row, chunk-position) plus the functionally-updated pages.
         Params are arguments, so every model version shares the
         executable; distinct (bucket, S) shapes compile once each.
+
+        The trace runs under ``parallel.flash.paged_serving_context``
+        carrying this scheduler's (mesh, kv-head shard axis), so the
+        Pallas paged-attention kernel — when ``BIGDL_TPU_PAGED_ATTN``
+        enables it — dispatches shard_map'd per kv-head group under TP
+        placement and plain everywhere else. The draft model is
+        single-device by construction (mesh+draft refused), so its step
+        traces with no mesh.
 
         Token choice is per-row: greedy argmax when ``temps[b] <= 0``
         (bitwise the pre-sampling behavior — the correctness gate),
@@ -356,10 +365,15 @@ class DecodeScheduler:
             return jax.lax.cond(jnp.any(temps > 0.0), sampled,
                                 lambda: greedy)
 
+        mesh = self.mesh if model is self.model else None
+        axis = self._page_axis if model is self.model else None
+        from ..parallel import flash as _flash
+
         def step(params, pages, tokens, positions, tables, seeds, temps,
                  top_ps):
-            logits, pages = model.decode_paged(params, tokens, positions,
-                                               pages, tables)
+            with _flash.paged_serving_context(mesh=mesh, shard_axis=axis):
+                logits, pages = model.decode_paged(
+                    params, tokens, positions, pages, tables)
             return sample(logits, positions, seeds, temps, top_ps), pages
 
         return obs.perf.instrument_jit(jax.jit(step), name=name,

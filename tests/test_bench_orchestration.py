@@ -85,6 +85,7 @@ def test_cached_lines_provenance_on_reuse(bench_mod):
     assert len(got) == 1
     line = got[0]
     assert line["cached"] is True
+    assert line["stale_cache"] is True
     assert line["cache_from"] == now
     assert "measured_at" not in line
     assert "tunnel_error" not in line and "error" not in line
@@ -94,7 +95,7 @@ def test_cached_lines_provenance_on_reuse(bench_mod):
                              tunnel_error="current outage")])
     stored = json.load(open(b._TPU_CACHE))[0]
     assert "tunnel_error" not in stored and "cached" not in stored
-    assert "cache_from" not in stored
+    assert "cache_from" not in stored and "stale_cache" not in stored
     assert "measured_at" in stored
 
 
@@ -248,6 +249,49 @@ def test_wait_ladder_budget_zero_serves_cache(bench_mod, monkeypatch):
     monkeypatch.setenv("BENCH_WAIT_S", "0")
     lines = b._orchestrate("headline")
     assert lines[0]["cached"] and lines[0]["value"] == 7.0
+
+
+def test_cached_serve_marks_stale_and_warns_loudly(bench_mod, monkeypatch,
+                                                   capsys):
+    """ROADMAP direction 1, named explicitly: a tunnel outage must never
+    silently re-issue the cached r03 number as a new round. Every served
+    line carries ``stale_cache: true`` + ``cache_from``, a loud warning
+    names the measurement date, and the metrics dump built from those
+    lines carries the mark too."""
+    b = bench_mod
+    b._cache_tpu_lines([{
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 4.49, "unit": "images/sec/chip", "backend": "tpu"}])
+    measured_at = json.load(open(b._TPU_CACHE))[0]["measured_at"]
+
+    monkeypatch.setattr(b, "_run_child",
+                        lambda which, env, timeout: (None, "timeout"))
+
+    def fake_alive(timeout=90.0, force=False):
+        b._TUNNEL_STATE.update(probed=True, alive=False)
+        return False
+
+    monkeypatch.setattr(b, "_tunnel_alive", fake_alive)
+    monkeypatch.setattr(b.time, "sleep", lambda s: None)
+    monkeypatch.setenv("BENCH_WAIT_S", "0")
+    lines = b._orchestrate("headline")
+    assert len(lines) == 1
+    line = lines[0]
+    # the explicit mark: a round file holding this line is visibly a
+    # re-serve, never a fresh measurement
+    assert line["stale_cache"] is True and line["cached"] is True
+    assert line["cache_from"] == measured_at
+    err = capsys.readouterr().err
+    assert "WARNING" in err and "stale_cache" in err
+    assert measured_at in err and "NOT a fresh round" in err
+
+    # the BENCH_METRICS dump carries the mark as a sibling gauge
+    from bigdl_tpu import observability as obs
+    reg = obs.MetricsRegistry()
+    obs.record_bench_line(line, reg)
+    by = {l["metric"]: l for l in obs.metrics_dump(reg)}
+    assert by["bench/resnet50_train_images_per_sec_per_chip"
+              "/stale_cache"]["value"] == 1.0
 
 
 def test_metrics_dump_written_from_lines(bench_mod, tmp_path, monkeypatch):

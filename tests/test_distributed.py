@@ -81,6 +81,89 @@ def test_zero1_bf16_compression():
     assert np.isfinite(opt.optim_method.state["loss"])
 
 
+def test_wire_dtype_fp32_master_accumulation_oracle():
+    """The ulp-equivalence harness for the wire_dtype knob: the sharded
+    all_to_all wire (compressed slices, owner sums in f32) must compute
+    EXACTLY bf16-round → f32 sum over shards → /n → f32 update. The
+    oracle runs the same math unsharded; SGD is elementwise, so the
+    slice-wise sharded update and the full-vector oracle agree bitwise
+    when the wire math does."""
+    from bigdl_tpu.parallel.allreduce import AllReduceParameter
+    from bigdl_tpu.utils.compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    mesh = data_parallel_mesh(8)
+    n = 8
+    rng = np.random.RandomState(5)
+    params = {"w": jnp.asarray(rng.randn(37, 5).astype(np.float32)),
+              "b": jnp.asarray(rng.randn(11).astype(np.float32))}
+    arp = AllReduceParameter(SGD(learningrate=0.1), mesh,
+                             wire_dtype="bf16")
+    flat_w, opt_state = arp.prepare(params)
+    per_dev = rng.randn(n, arp.flat.padded_size).astype(np.float32)
+
+    def step(g_local, w_full, st):
+        return arp.update(g_local[0], w_full, st, 0.1)
+
+    new_full, _ = shard_map(
+        step, mesh=mesh,
+        in_specs=(P("data"), P(), arp.state_specs()),
+        out_specs=(P(), arp.state_specs()), check_vma=False)(
+        jnp.asarray(per_dev), flat_w, opt_state)
+
+    # oracle: round the wire once, accumulate in f32, update in f32
+    g_wire = jnp.asarray(per_dev).astype(jnp.bfloat16)
+    g_mean = jnp.sum(g_wire.astype(jnp.float32), axis=0) / n
+    want = flat_w - 0.1 * g_mean
+    assert np.array_equal(np.asarray(new_full), np.asarray(want)), \
+        np.abs(np.asarray(new_full) - np.asarray(want)).max()
+    # and the rounding is REAL (the knob is not a no-op): an f32-wire
+    # oracle differs
+    f32_mean = jnp.sum(jnp.asarray(per_dev), axis=0) / n
+    assert not np.array_equal(np.asarray(new_full),
+                              np.asarray(flat_w - 0.1 * f32_mean))
+
+
+def test_wire_dtype_trains_and_halves_gradient_wire_bytes():
+    """End to end: wire_dtype='bf16' trains (close to the f32-wire run)
+    and the per-dispatch byte accounting shows the gradient leg at HALF
+    the f32 wire — the FP16CompressedTensor claim, measured."""
+    from bigdl_tpu import observability as obs
+    obs.enable()
+    try:
+        mesh = data_parallel_mesh(8)
+        model, opt = _train(DistriOptimizer, mesh=mesh, iters=6,
+                            parameter_mode="zero1", wire_dtype="bf16")
+        assert np.isfinite(opt.optim_method.state["loss"])
+        reg = obs.registry()
+        wire = reg.get("collective/grad_wire_traced_bytes").value
+        padded = reg.get("allreduce/param_elems")  # gauge exists
+        assert padded is not None
+        assert wire > 0 and wire % 2 == 0
+        # bytes_per_step gauge prices the bf16 gradient leg + f32 gather
+        per_step = reg.get("allreduce/bytes_per_step").value
+        n_elems = opt._arp.flat.padded_size
+        assert per_step == n_elems * (2 + 4)
+        # the traced wire is exactly 2 bytes/elem per traced step — half
+        # the 4 bytes/elem an f32 psum_scatter ships
+        assert wire % (2 * n_elems) == 0
+    finally:
+        obs.disable()
+    m_f32, _ = _train(DistriOptimizer, mesh=data_parallel_mesh(8), iters=6,
+                      parameter_mode="zero1")
+    for a, b in zip(jax.tree_util.tree_leaves(m_f32.params),
+                    jax.tree_util.tree_leaves(model.params)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=2e-2)
+
+
+def test_wire_dtype_and_compress_mutually_exclusive():
+    from bigdl_tpu.parallel.allreduce import AllReduceParameter
+    mesh = data_parallel_mesh(8)
+    with pytest.raises(ValueError, match="wire_dtype"):
+        AllReduceParameter(SGD(), mesh, compress="bf16", wire_dtype="bf16")
+    with pytest.raises(ValueError, match="wire_dtype"):
+        AllReduceParameter(SGD(), mesh, wire_dtype="int8")
+
+
 def test_ring_attention_matches_full():
     mesh = make_mesh((8,), ("seq",))
     B, H, T, D = 2, 4, 64, 16

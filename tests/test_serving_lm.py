@@ -88,6 +88,36 @@ def _sched(model, **kw):
     return DecodeScheduler(model, **cfg)
 
 
+@pytest.fixture(params=["dense", "kernel"])
+def paged_path(request, monkeypatch):
+    """The ISSUE 11 kernel-on/kernel-off matrix: 'kernel' routes
+    Attention.decode_paged through the Pallas paged-attention kernel
+    (interpret mode on CPU — the identical kernel the TPU compiles);
+    'dense' keeps the gathered-view einsum. The solo oracle always
+    decodes DENSE (decode_chunk), so the kernel arm asserts the hard
+    claim: kernel tokens are bitwise the dense tokens."""
+    if request.param == "kernel":
+        monkeypatch.setenv("BIGDL_TPU_PAGED_ATTN", "interpret")
+    else:
+        monkeypatch.delenv("BIGDL_TPU_PAGED_ATTN", raising=False)
+    return request.param
+
+
+def _spy_guard(paged_path):
+    """Returns a closure asserting the Pallas path actually built the
+    programs that served the traffic (trace-count spy)."""
+    from bigdl_tpu.kernels import paged_attention as pk
+    before = pk.trace_count()
+
+    def check():
+        if paged_path == "kernel":
+            assert pk.trace_count() > before, \
+                "kernel arm served traffic without tracing the Pallas path"
+        else:
+            assert pk.trace_count() == before
+    return check
+
+
 # ---------------------------------------------------------------------------
 # paged attention vs dense decode_chunk
 # ---------------------------------------------------------------------------
@@ -131,14 +161,17 @@ def test_prefill_schedule():
 # the correctness gate
 # ---------------------------------------------------------------------------
 
-def test_continuous_batching_bitwise_solo_oracle():
+def test_continuous_batching_bitwise_solo_oracle(paged_path):
     """Mixed-length requests joining mid-flight and finishing early:
-    every request's tokens are bitwise-identical to its solo decode."""
+    every request's tokens are bitwise-identical to its solo decode —
+    through the dense gather AND through the Pallas paged kernel
+    (chunked prefill and mid-flight joins ride the same matrix)."""
     m = shared_model()
     rng = np.random.RandomState(7)
     prompts = [rng.randint(1, V, size=n).astype(np.int32)
                for n in (3, 11, 7, 18, 5, 25)]
     maxnews = [6, 12, 4, 9, 15, 5]
+    spy = _spy_guard(paged_path)
     with _sched(m) as sched:
         futs = []
         for i, (pr, mn) in enumerate(zip(prompts, maxnews)):
@@ -147,6 +180,7 @@ def test_continuous_batching_bitwise_solo_oracle():
                 time.sleep(0.03)   # stagger arrivals → mid-flight joins
         results = [f.result(timeout=120) for f in futs]
         st = sched.stats()
+    spy()
     assert st["completed"] == len(prompts)
     for i, (pr, mn) in enumerate(zip(prompts, maxnews)):
         want = solo_oracle(m, m.params, pr, mn)
@@ -216,17 +250,20 @@ def test_hot_swap_never_mixes_versions():
     assert np.array_equal(new, solo_oracle(m, m2.params, pr_new, 8))
 
 
-def test_speculative_fast_path_bitwise_and_fewer_rounds():
+def test_speculative_fast_path_bitwise_and_fewer_rounds(paged_path):
     """Greedy speculative decoding inside the scheduler is output-
     preserving; with the target as its own draft, acceptance is total
-    and verify rounds collapse ~(k+1)-fold."""
+    and verify rounds collapse ~(k+1)-fold. The kernel arm drives the
+    S=k+1 verify-chunk shape through the Pallas path too."""
     m = _model()   # sinusoidal/MHA variant exercises the other PE path
     rng = np.random.RandomState(4)
     pr = rng.randint(1, V, size=9).astype(np.int32)
     want = solo_oracle(m, m.params, pr, 12)
+    spy = _spy_guard(paged_path)
     with _sched(m, draft_model=m, spec_k=3) as sched:
         got = sched.submit(pr, 12).result(timeout=120)
         st = sched.stats()
+    spy()
     assert np.array_equal(got, want)
     assert st["spec_rounds"] > 0
     assert st["spec_accepted"] >= st["spec_rounds"]  # perfect draft
@@ -281,13 +318,15 @@ def test_kv_ledger_alloc_free_oom():
     assert blocks_for_tokens(1, 4) == 1 and blocks_for_tokens(9, 4) == 3
 
 
-def test_kv_defrag_repacks_and_preserves_decode():
+def test_kv_defrag_repacks_and_preserves_decode(paged_path):
     """Churn scatters live blocks across the pool; defrag repacks them
     to the low end (frag -> 0) and the moved pages still decode
-    bitwise."""
+    bitwise — on both attention paths (the kernel arm reads the moved
+    pages through rewritten tables: defrag-then-decode)."""
     m = shared_model()
     rng = np.random.RandomState(6)
     pr = rng.randint(1, V, size=5).astype(np.int32)
+    spy = _spy_guard(paged_path)
     with _sched(m, num_blocks=4 * 24 + 1) as sched:
         # churn: waves of short requests fragment the id space
         for _ in range(3):
@@ -302,6 +341,7 @@ def test_kv_defrag_repacks_and_preserves_decode():
         sched.defrag()     # deferred to the next step boundary
         out = f_live.result(timeout=120)
         st = sched.stats()
+    spy()
     assert np.array_equal(out, solo_oracle(m, m.params, pr, 30))
     assert st["defrags"] >= 0 and sched.kv.frag_blocks() <= frag_before
     assert st["kv"]["blocks_in_use"] == 0

@@ -98,6 +98,14 @@ HOT_FUNCS = {
     # swapping caller's thread) issues device transfers but must never
     # BLOCK on one — traffic flows on the active version meanwhile
     "bigdl_tpu/serving/registry.py": {"publish", "_place_tree"},
+    # paged-attention dispatch seam (ISSUE 11): trace-time code on the
+    # decode hot path — mode resolution, the shard_map wrapper and the
+    # kernel builder run inside the compiled step's trace and must
+    # never touch a device value (a sync here would serialize every
+    # warmup/first-shape compile behind a readback)
+    "bigdl_tpu/parallel/flash.py": {"paged_attention", "paged_mode"},
+    "bigdl_tpu/kernels/paged_attention.py": {"paged_decode_attention"},
+    "bigdl_tpu/nn/attention.py": {"decode_paged", "_paged_gather_attend"},
 }
 
 SYNC = re.compile(r"(?<![\w.])float\(|\.block_until_ready\(")
