@@ -50,14 +50,17 @@ perf-gate:
 # End-to-end serving engine drive on CPU with LeNet: warmup-compiled
 # buckets, concurrent clients, result-vs-direct-forward check, clean
 # drain — plus the LM continuous-batching smoke (DecodeScheduler vs
-# whole-request batching over a paged KV cache, leak gate included)
-# and the router smoke (2 emulated replicas behind weighted-fair
+# whole-request batching over a paged KV cache, leak gate included,
+# plus the shared-system-prompt PREFIX leg: the cache must actually
+# hit, and the warm arm's TTFTs carry hit provenance) and the router
+# smoke (2 emulated replicas behind weighted-fair
 # priority classes, open-loop mixed-deadline load, lost-request
 # accounting) — seconds, not minutes (BENCH_METRICS_OUT='' keeps the
 # smoke from touching the committed bench evidence). Full measured
 # runs: `python bench_serving.py` (16 clients, enforces the 3x
 # acceptance), `python bench_serving.py --lm` (enforces continuous >
-# static on tokens/s AND p99 TTFT), and `python bench_serving.py
+# static on tokens/s AND p99 TTFT, prefix hit rate >= 0.9 and
+# warm/cold TTFT < 0.5 on the shared-prefix arm), and `python bench_serving.py
 # --router` (enforces tight-p99 < single-queue, goodput >= 1.5x, zero
 # tight misses at the pinned overload point).
 serve-smoke:

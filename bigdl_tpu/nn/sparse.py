@@ -24,6 +24,33 @@ import numpy as np
 from .module import Module
 from .linear import Linear, LookupTable
 from ..utils.table import Table
+# the Parallax-style (indices, values) gradient exchange lives with the
+# other collectives; re-exported here because it is the sparse-compute
+# side of the same story (DistriOptimizer's per-layer path selection
+# feeds it from embedding layers — docs/DISTRIBUTED.md)
+from ..parallel.allreduce import sparse_embedding_grad_allreduce  # noqa: F401,E501
+
+
+def embedding_grad_rows(dense_grad, ids):
+    """Extract the ``(B, H)`` per-id gradient rows a shard's LOCAL dense
+    embedding gradient carries, ready for the Parallax ``(indices,
+    values)`` exchange (:func:`sparse_embedding_grad_allreduce`).
+
+    ``dense_grad`` is the ``(vocab, H)`` gradient autodiff produced on
+    THIS shard — nonzero only at the rows ``ids`` touched, and row
+    ``ids[i]`` already SUMS every local contribution for that id. A
+    duplicated id must therefore ship exactly once: occurrences after
+    the first are masked to zero via a scatter-min first-occurrence
+    index (O(B + vocab) — a pairwise id compare would materialize a
+    (B, B) intermediate, ~1 GB at a 32k-token shard batch)."""
+    ids = ids.astype(jnp.int32)
+    b = ids.shape[0]
+    iota = jnp.arange(b, dtype=jnp.int32)
+    first = jnp.full((dense_grad.shape[0],), b,
+                     jnp.int32).at[ids].min(iota)
+    keep = first[ids] == iota
+    rows = jnp.take(dense_grad, ids, axis=0)
+    return rows * keep[:, None].astype(rows.dtype)
 
 
 class SparseTensor:

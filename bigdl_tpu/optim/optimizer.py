@@ -1980,21 +1980,40 @@ class DistriOptimizer(BaseOptimizer):
     def __init__(self, model, training_set, criterion, optim_method=None,
                  end_trigger=None, batch_size: int = 32, mesh=None,
                  parameter_mode: str = "replicated",
-                 compress: str = "none", wire_dtype: str = "none"):
+                 compress: str = "none", wire_dtype: str = "none",
+                 sparse_embedding: bool = False):
         """``compress`` / ``wire_dtype``: ZeRO-1 gradient-wire knobs
         (``parallel.allreduce`` module docstring) — ``compress`` is the
         legacy wire-dtype psum, ``wire_dtype`` the fp32-master-
         accumulation all_to_all wire. Both off by default; mutually
-        exclusive."""
+        exclusive.
+
+        ``sparse_embedding``: per-layer gradient-wire path selection
+        (the Parallax exchange — ``nn.sparse.
+        sparse_embedding_grad_allreduce``, docs/DISTRIBUTED.md). The
+        step is built as an explicit shard_map whose per-layer exchange
+        picks, AT TRACE TIME from the static shapes, the cheaper wire
+        for each gradient leaf: the model's leading embedding layer
+        ships its touched ``(indices, value rows)`` when ``B_local *
+        (H+1) < vocab * H`` elements, every other leaf (and an
+        embedding whose batch would not win) rides the dense ``pmean``.
+        Replicated parameter mode only — ZeRO-1's flat-vector wire has
+        no per-layer seam."""
         super().__init__(model, training_set, criterion, optim_method,
                          end_trigger, batch_size)
         from ..parallel.mesh import get_default_mesh
         self.mesh = mesh or get_default_mesh()
         if "data" not in self.mesh.axis_names:
             raise ValueError("DistriOptimizer mesh needs a 'data' axis")
+        if sparse_embedding and parameter_mode != "replicated":
+            raise ValueError(
+                "sparse_embedding selects per-LAYER gradient wires — "
+                "ZeRO-1 ships one flat vector and has no per-layer "
+                "seam; use parameter_mode='replicated'")
         self.parameter_mode = parameter_mode
         self.compress = compress
         self.wire_dtype = wire_dtype
+        self.sparse_embedding = bool(sparse_embedding)
         self._arp = None
         self._flat = None
 
@@ -2105,8 +2124,146 @@ class DistriOptimizer(BaseOptimizer):
         return (shard_params(params, self.mesh),
                 shard_params(opt_state, self.mesh), mstate)
 
+    def _sparse_embedding_path(self):
+        """Locate the embedding layer whose ids are the model input:
+        the model itself, or the first child of a leading Sequential.
+        Returns ``(param_path, vocab_size)`` — the gradient leaf at
+        ``param_path`` is the one whose wire the per-layer selection
+        may route sparse (its row ids are ``clip(input - 1, ...)``,
+        the LookupTable's 1-based convention)."""
+        from ..nn.linear import LookupTable
+        m = self.model
+        emb, path = None, None
+        if isinstance(m, LookupTable):
+            emb, path = m, ("weight",)
+        else:
+            mods = getattr(m, "modules", None)
+            if mods and isinstance(mods[0], LookupTable):
+                emb, path = mods[0], ("0", "weight")
+        if emb is None:
+            raise ValueError(
+                "sparse_embedding=True needs the model input to BE the "
+                "embedding ids: a LookupTable model, or a Sequential "
+                "whose first child is a LookupTable — got "
+                f"{type(m).__name__}")
+        if emb.w_regularizer is not None:
+            # weight decay's gradient is DENSE (lambda*w on every vocab
+            # row); the (indices, values) exchange ships only the rows
+            # this batch touched, so a regularized embedding would
+            # silently train different weights than the dense wire
+            raise ValueError(
+                "sparse_embedding=True cannot ride a w_regularizer'd "
+                "embedding: the regularizer gradient is dense over the "
+                "whole vocab, which the sparse (indices, values) "
+                "exchange cannot carry — drop the regularizer or the "
+                "sparse wire")
+        return path, emb.n_index
+
+    def _build_sparse_step(self):
+        """The per-layer gradient-wire path (sparse_embedding=True):
+        an EXPLICIT shard_map data-parallel step — unlike the default
+        replicated path (where XLA's sharding propagation inserts one
+        implicit psum over all grads), each gradient leaf here picks
+        its own wire at trace time. The embedding leaf ships
+        ``(indices, value rows)`` via the Parallax exchange when that
+        is fewer elements than its dense gradient; everything else
+        rides ``pmean``. Trace-time byte counters
+        (``collective/sparse_grad_wire_traced_bytes`` vs
+        ``collective/grad_dense_traced_bytes``) make the win
+        auditable per dispatch."""
+        from ..utils.compat import shard_map
+        from ..nn.sparse import embedding_grad_rows
+        from ..parallel.allreduce import sparse_embedding_grad_allreduce
+        model, criterion = self.model, self.criterion
+        reg_tree = regularizer_tree(model)
+        clip_const, clip_norm = self.clip_const, self.clip_norm
+        optim = self.optim_method
+        frozen_mask = _frozen_mask(model)
+        mesh = self.mesh
+        path, vocab = self._sparse_embedding_path()
+        superstep_k = self.superstep
+
+        def loss_fn(params, mstate, x, y, rng):
+            out, new_state = model.apply(params, mstate, x, training=True,
+                                         rng=rng)
+            loss = criterion._forward(out, y)
+            if reg_tree:
+                loss = loss + regularization_loss(reg_tree, params)
+            return loss, new_state
+
+        def exchange(grads, x):
+            ids = jnp.clip(x.reshape(-1).astype(jnp.int32) - 1, 0,
+                           vocab - 1)
+            picked = {"sparse": 0}
+
+            def walk(tree, p=()):
+                if isinstance(tree, dict):
+                    return {k: walk(v, p + (k,)) for k, v in tree.items()}
+                g = tree
+                if p == path:
+                    sparse_elems = ids.shape[0] * (g.shape[-1] + 1)
+                    dense_elems = int(np.prod(g.shape))
+                    if sparse_elems < dense_elems:
+                        picked["sparse"] += 1
+                        rows = embedding_grad_rows(g, ids)
+                        return sparse_embedding_grad_allreduce(
+                            ids, rows, vocab_size=vocab, axis="data",
+                            traced_steps=superstep_k)
+                if obs.enabled():
+                    # trace-time: bytes this leaf ships on the dense wire
+                    obs.counter("collective/grad_dense_traced_bytes",
+                                unit="B").inc(
+                        float(g.size * g.dtype.itemsize) * superstep_k)
+                return jax.lax.pmean(g, "data")
+
+            out = walk(grads)
+            if obs.enabled():
+                obs.gauge("collective/sparse_layers_selected").set(
+                    picked["sparse"])
+            return out
+
+        def local_step(params, opt_state, mstate, x, y, lr, rng):
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            (loss, new_mstate), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mstate, x, y, rng)
+            grads = exchange(grads, x)
+            grads = _clip_grads(grads, clip_const, clip_norm)
+            if frozen_mask is not None:
+                grads = _tmap(lambda g, m: g * m, grads, frozen_mask)
+            new_params, new_opt = optim.update(grads, params, opt_state,
+                                               lr)
+            if frozen_mask is not None:
+                new_params = _tmap(
+                    lambda n, o, m: jnp.where(m > 0, n, o),
+                    new_params, params, frozen_mask)
+            loss = jax.lax.pmean(loss, "data")
+            new_mstate = _tmap(lambda t: jax.lax.pmean(t, "data"),
+                               new_mstate)
+            # same post-pmean NaN guard as the other distributed paths
+            ok = jnp.isfinite(loss)
+            pick = lambda new, old: _tmap(
+                lambda a, b: jnp.where(ok, a, b), new, old)
+            return (loss, pick(new_params, params),
+                    pick(new_opt, opt_state), pick(new_mstate, mstate))
+
+        if superstep_k > 1:
+            sharded = shard_map(
+                _scan_superstep(local_step), mesh=mesh,
+                in_specs=(P(), P(), P(), P(None, "data"),
+                          P(None, "data"), P(), P()),
+                out_specs=(P(), P(), P(), P()), check_vma=False)
+        else:
+            sharded = shard_map(
+                local_step, mesh=mesh,
+                in_specs=(P(), P(), P(), P("data"), P("data"), P(), P()),
+                out_specs=(P(), P(), P(), P()), check_vma=False)
+        return self._instrument_step(
+            jax.jit(sharded, donate_argnums=(0, 1, 2)))
+
     def _build_step(self):
         if self.parameter_mode != "zero1":
+            if self.sparse_embedding:
+                return self._build_sparse_step()
             return super()._build_step()
 
         from ..utils.compat import shard_map

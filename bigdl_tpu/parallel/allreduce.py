@@ -285,7 +285,8 @@ class AllReduceParameter:
 
 
 def sparse_embedding_grad_allreduce(ids, row_grads, vocab_size: int,
-                                    axis: str, mean: bool = True):
+                                    axis: str, mean: bool = True,
+                                    traced_steps: int = 1):
     """Sparsity-aware embedding-gradient aggregation (Parallax,
     arXiv:1808.02621 — PAPERS.md): data-parallel shards exchange the
     (token ids, gradient rows) pairs instead of the dense (vocab, H)
@@ -303,7 +304,18 @@ def sparse_embedding_grad_allreduce(ids, row_grads, vocab_size: int,
     (vocab_size, H) gradient, identical on every device — the same
     result a dense ``psum`` of per-device scatter-adds would give.
     ``mean=True`` divides by the axis size (matching grad-mean data
-    parallelism)."""
+    parallelism). ``traced_steps``: executions of this traced body per
+    dispatch (K under a superstep scan), keeping the trace-time byte
+    counter an honest per-dispatch wire total — the same convention as
+    :meth:`AllReduceParameter.update`."""
+    if obs.enabled():
+        # trace-time accounting: bytes each device sends on this
+        # exchange — the (indices, values) legs of the two all_gathers
+        obs.counter("collective/sparse_grad_wire_traced_bytes",
+                    unit="B").inc(
+            float(ids.size * 4
+                  + row_grads.size * row_grads.dtype.itemsize)
+            * traced_steps)
     all_ids = lax.all_gather(ids.astype(jnp.int32), axis, tiled=True)
     all_rows = lax.all_gather(row_grads, axis, tiled=True)
     dense = jnp.zeros((vocab_size, row_grads.shape[-1]),

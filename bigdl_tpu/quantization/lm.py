@@ -20,6 +20,14 @@ nn/quantized/) covers Linear/Conv inference; BigDL 0.x has no
 transformer decode to quantize. PTQ for Linear/Conv lives in
 ``quantization/quantize.py``; this module is the LM-specific weight-only
 variant.
+
+Serving-tier integration (docs/SERVING.md "Quantized replicas"):
+because the quantized params are a drop-in pytree,
+``ModelRegistry.publish(quantize_lm_params(params), ...)`` already
+serves int8 through the whole stack (continuous batching, paged KV,
+prefix cache, router). The remaining ROADMAP direction-4 work is the
+declared publish transform and the quantized-vs-f32 replica A/B behind
+the Router — not new kernels.
 """
 from __future__ import annotations
 

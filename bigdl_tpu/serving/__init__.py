@@ -20,8 +20,15 @@ design pays (the overhead 1805.08430 "RPC Considered Harmful" measures).
   version once per batch, so hot swap never mixes versions inside a
   response.
 * ``kv_cache`` — :class:`PagedKVCache`: fixed-size HBM blocks +
-  per-request block tables (vLLM's paged layout), the block ledger
-  exported as ``serve/kv_*`` gauges.
+  per-request block tables (vLLM's paged layout) with per-block
+  REFERENCE COUNTS and copy-on-write forks, the block ledger exported
+  as ``serve/kv_*`` gauges.
+* ``prefix_cache`` — :class:`PrefixCache`: content-addressed index
+  over the block ledger (rolling chain digests of (tokens, model
+  version) at block granularity) — shared prompt prefixes are stored
+  once and their prefill skipped at admission; LRU eviction over
+  unreferenced entries, prefix-affinity probes for the router
+  (docs/SERVING.md "Prefix cache").
 * ``decode_scheduler`` — :class:`DecodeScheduler`: continuous batching
   for autoregressive LM decode — requests join/leave the running batch
   at decode-step boundaries over ONE compiled paged step; chunked
@@ -48,6 +55,7 @@ from .batching import (QueueFull, DeadlineExceeded, EngineStopped,
 from .registry import ModelRegistry, ModelVersion
 from .engine import ServingEngine, serving_threads_alive, THREAD_NAME
 from .kv_cache import KVCacheOOM, PagedKVCache, blocks_for_tokens
+from .prefix_cache import PrefixCache, chain_keys
 from .decode_scheduler import (DecodeScheduler, LMRequest,
                                decode_scheduler_threads_alive,
                                prefill_schedule)

@@ -29,7 +29,14 @@ Shape discipline (why recompiles never happen mid-traffic):
   tests/test_serving_lm.py);
 * prompts prefill in fixed CHUNKS (pow-2-bucketed tail) through the
   same paged path, so a long prompt costs O(chunk * Tp) attention
-  scratch and a bounded set of compiled shapes.
+  scratch and a bounded set of compiled shapes;
+* shared prompt PREFIXES are served from the content-addressed prefix
+  cache (``prefix_cache.PrefixCache`` over the refcounted block
+  ledger): admission adopts the longest cached block-aligned prefix
+  and skips its prefill chunks — the thousand-identical-system-prompts
+  workload pays ONE prefill and stores the pages once, with
+  copy-on-write forks guarding the shared pages (docs/SERVING.md
+  "Prefix cache").
 
 Hot swap: a request PINS the model version active at its admission and
 keeps it to completion — swap() takes effect for later admissions, and
@@ -68,13 +75,16 @@ from ..optim.predictor import bucket_for
 from .batching import (DeadlineExceeded, EngineStopped, QueueFull,
                        ServeFuture)
 from .kv_cache import KVCacheOOM, PagedKVCache, blocks_for_tokens
+from .prefix_cache import PrefixCache
 from .registry import ModelRegistry
 
 THREAD_NAME = "bigdl_tpu-serving-decode-scheduler"
 
 _STAT_KEYS = ("submitted", "completed", "rejected", "timeouts",
               "decode_steps", "prefill_chunks", "tokens", "swaps",
-              "spec_rounds", "spec_accepted", "defrags")
+              "spec_rounds", "spec_accepted", "defrags",
+              "prefix_hits", "prefix_misses", "prefix_reused_tokens",
+              "prefix_cow_forks")
 
 
 def _pow2_bucket(n: int, cap: int, floor: int = 2) -> int:
@@ -115,7 +125,8 @@ class LMRequest:
                  "deadline", "t_enqueue", "t_enqueue_ns", "t_admit_ns",
                  "t_first_ns", "t_done_ns", "prefill_ms", "version",
                  "model_version", "slot", "pos", "generated", "steps",
-                 "chunks", "pf_i", "temperature", "top_p", "seed")
+                 "chunks", "pf_i", "temperature", "top_p", "seed",
+                 "hit_tokens", "adopted_n")
 
     def __init__(self, prompt, max_new_tokens, eos_id, deadline_s, rid,
                  temperature: float = 0.0, top_p: float = 1.0,
@@ -145,6 +156,8 @@ class LMRequest:
         self.steps = 0             # decode dispatches this request rode
         self.chunks = None         # prefill_schedule, set at admission
         self.pf_i = 0              # next prefill chunk to run
+        self.hit_tokens = 0        # prefix-cache hit length (tokens)
+        self.adopted_n = 0         # shared blocks adopted at admission
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -173,6 +186,23 @@ class DecodeScheduler:
         only when the previous one fully drained — the bench baseline).
     eos_id : default end-of-sequence id (per-request override at
         ``submit``).
+    prefix_cache : content-addressed KV block sharing
+        (``prefix_cache.PrefixCache``, on by default). Admission looks
+        up the longest cached block-aligned prefix of each prompt,
+        ADOPTS those blocks (refcount +1, zero copies) and skips their
+        prefill chunks entirely — on a hit, TTFT collapses to the tail
+        chunk + the first decode step. Completed prefills register
+        their full prompt blocks for future hits; under block pressure
+        admission reclaims unreferenced entries LRU-first. Reuse is
+        keyed on (tokens, model version), so a hot swap never crosses
+        versions. Hits align to ``max(prefill_chunk, block_size)`` —
+        the warm suffix then re-runs EXACTLY the cold schedule's
+        remaining chunks (same shapes, same inputs), which is what
+        keeps warm tokens bitwise-identical to a cold solo decode. A
+        fully-cached aligned prompt re-runs only its LAST chunk for the
+        first-token logits; that chunk's writes into shared pages take
+        copy-on-write forks (reserved at admission — no mid-flight
+        OOM).
     sampling_seed : base of the per-request sampling key stream
         (``engine.next_rng_keys``-style: one deterministic stream, one
         seed per request derived from it, so a request's samples are
@@ -204,6 +234,8 @@ class DecodeScheduler:
                  static_wait_ms: float = 4.0,
                  stall_deadline_s: Optional[float] = None,
                  sampling_seed: int = 0,
+                 prefix_cache: bool = True,
+                 prefix_cache_entries: Optional[int] = None,
                  mesh=None, placement=None,
                  name: Optional[str] = None):
         if model.mode != "lm":
@@ -271,6 +303,14 @@ class DecodeScheduler:
                                block_size=block_size,
                                max_blocks_per_seq=mbs,
                                sharding=page_sharding)
+        # prefix reuse aligns to max(chunk, block): hits leave the cold
+        # schedule's remaining chunks intact (same compiled shapes, same
+        # inputs — the bitwise contract; both are powers of two, so the
+        # smaller always divides the larger)
+        self.hit_align = max(self.prefill_chunk, int(block_size))
+        self.prefix = (PrefixCache(self.kv,
+                                   max_entries=prefix_cache_entries)
+                       if prefix_cache else None)
         self.draft_model = draft_model
         self.draft_kv = None
         if draft_model is not None:
@@ -529,6 +569,11 @@ class DecodeScheduler:
                         "scheduler shut down before completion"))
                 except Exception:
                     pass
+        # every owner is gone — drop the prefix cache's pins so the
+        # shared pages return too (the kv_blocks_in_use -> 0 leak gate
+        # holds on every shutdown path, sharing included)
+        if self.prefix is not None:
+            self.prefix.clear()
 
     def __enter__(self):
         return self.start()
@@ -654,7 +699,25 @@ class DecodeScheduler:
         out["prefilling"] = len(self._prefilling)
         out["active_version"] = self.registry.active_version
         out["kv"] = self.kv.stats()
+        out["prefix"] = (self.prefix.stats() if self.prefix is not None
+                         else None)
         return out
+
+    def cached_prefix_tokens(self, prompt_ids) -> int:
+        """Router-affinity probe: how many leading tokens of this
+        prompt admission would actually REUSE from this replica's
+        prefix cache under the active version — the raw resident chain
+        aligned down to ``hit_align``, so the router never steers a
+        request toward a fragment admission will discard. Pure host
+        work (a digest walk) — safe to call from router dispatch
+        threads; 0 with the cache disabled."""
+        if self.prefix is None:
+            return 0
+        mv = self.registry.current()
+        if mv is None:
+            return 0
+        t = self.prefix.peek(prompt_ids, mv.version)
+        return t - t % self.hit_align
 
     # -- scheduler loop --------------------------------------------------
 
@@ -752,31 +815,134 @@ class DecodeScheduler:
             worst = max(
                 prefill_padded_end(req.prompt.size, self.prefill_chunk),
                 req.prompt.size + req.max_new_tokens + spec_over)
+            mv = self.registry.current()
+            cold = prefill_schedule(req.prompt.size, self.prefill_chunk)
+            plan, adopted, fork_idxs = self._prefix_plan(req, mv.version,
+                                                         cold)
+            forked = []
             try:
-                self.kv.ensure_capacity(req.rid, worst)
-                if self.draft_kv is not None:
-                    try:
+                # worst-case PRIVATE need: total blocks minus the shared
+                # prefix it adopts, plus the copy-on-write pages its
+                # warm plan must fork
+                need = (blocks_for_tokens(worst, self.kv.block_size)
+                        - len(adopted) + len(fork_idxs))
+                if adopted:
+                    self.kv.adopt(req.rid, adopted)
+                try:
+                    if self.prefix is not None \
+                            and not self.kv.can_allocate(need):
+                        # block pressure: reclaim unreferenced prefix
+                        # entries (LRU, leaf-first) before deferring —
+                        # the blocks just adopted are pinned (refcount
+                        # >= 2) and cannot be taken back out from under
+                        # this request
+                        self.prefix.evict(need - self.kv.blocks_free())
+                    if not self.kv.can_allocate(need):
+                        raise KVCacheOOM(
+                            f"need {need} private blocks, "
+                            f"{self.kv.blocks_free()} free")
+                    self.kv.ensure_capacity(req.rid, worst)
+                    if self.draft_kv is not None:
                         self.draft_kv.ensure_capacity(req.rid, worst)
-                    except KVCacheOOM:
-                        self.kv.free(req.rid)
-                        raise
+                    if fork_idxs:
+                        # copy-on-write EAGERLY, inside the same
+                        # admission transaction that checked the free
+                        # list: a later admission may consume every
+                        # free block, and a fork deferred to prefill
+                        # time would then OOM mid-flight (the invariant
+                        # this whole block exists to uphold)
+                        forked = self.kv.fork_blocks(req.rid, fork_idxs)
+                except KVCacheOOM:
+                    # undo the adoption and any partial growth — a
+                    # deferred request must leave the ledger untouched
+                    self.kv.free(req.rid)
+                    raise
             except KVCacheOOM:
                 # backpressure: leave it queued — eviction will free
                 # blocks and the next boundary retries
                 break
             self._backlog.popleft()
             req.slot = self._free_slots.pop()
-            mv = self.registry.current()
             req.version = mv.version
             req.model_version = mv
             req.t_admit_ns = time.perf_counter_ns()
-            req.chunks = prefill_schedule(req.prompt.size,
-                                          self.prefill_chunk)
+            req.chunks = plan
             req.pf_i = 0
+            if self.prefix is not None:
+                self._bump("prefix_hits" if req.hit_tokens
+                           else "prefix_misses")
+                # honest savings accounting: tokens the warm plan does
+                # NOT prefill — in the rerun-last-chunk case the tail
+                # chunk's tokens are re-computed, so they don't count
+                reused = int(req.prompt.size) - sum(c[1] for c in plan)
+                if reused:
+                    self._bump("prefix_reused_tokens", reused)
+                if forked:
+                    self._bump("prefix_cow_forks", len(forked))
+                if obs.enabled():
+                    if req.hit_tokens:
+                        obs.counter("serve/prefix_hits").inc()
+                    else:
+                        obs.counter("serve/prefix_misses").inc()
+                    if reused:
+                        obs.counter("serve/prefix_reused_tokens").inc(
+                            reused)
+                    if forked:
+                        obs.counter("serve/prefix_cow_forks").inc(
+                            len(forked))
             if not req.future.set_running_or_notify_cancel():
                 self._finish(req, cancel=True)
                 continue
             self._prefilling.append(req)
+
+    def _prefix_plan(self, req, version, cold):
+        """Prefill-skip admission: returns ``(chunks_to_run,
+        adopted_blocks, cow_fork_idxs)``. A miss (or a disabled cache)
+        runs the full cold schedule. A hit adopts the longest cached
+        ``hit_align``-aligned prefix and keeps only the cold schedule's
+        chunks at/after it — identical shapes over identical inputs, so
+        warm tokens stay bitwise the cold solo decode's. A FULLY cached
+        aligned prompt keeps just its last chunk (the first-token
+        logits must still be computed); the adopted blocks that chunk
+        overwrites are returned as ``cow_fork_idxs`` for the admission
+        transaction to fork EAGERLY — deferring the fork to prefill
+        time would let an interleaved admission drain the free list and
+        OOM it mid-flight."""
+        req.hit_tokens = 0
+        req.adopted_n = 0
+        if self.prefix is None:
+            return cold, [], []
+        bs = self.kv.block_size
+        chain = self.prefix.lookup(req.prompt, version)
+        h = min(len(chain) * bs, int(req.prompt.size))
+        h -= h % self.hit_align
+        if h <= 0:
+            return cold, [], []
+        adopted = chain[:h // bs]
+        plan = [c for c in cold if c[0] >= h] or [cold[-1]]
+        fork_idxs = []
+        s0, _, padded0 = plan[0]
+        if s0 < h:
+            # rerun-last-chunk case: adopted blocks the chunk overwrites
+            fork_idxs = list(range(
+                s0 // bs, min(len(adopted), -(-(s0 + padded0) // bs))))
+        req.hit_tokens = h
+        req.adopted_n = len(adopted)
+        return plan, adopted, fork_idxs
+
+    def _register_prefix(self, req):
+        """Prefill done: register every FULL prompt block for future
+        hits (content-addressed; the tail partial block — still
+        receiving this request's decode writes — is never shared).
+        Blocks already indexed (the adopted prefix, or a concurrent
+        twin that registered first) are refreshed, not re-inserted, so
+        a shared system prompt stays resident ONCE."""
+        if self.prefix is None:
+            return
+        nfull = int(req.prompt.size) // self.kv.block_size
+        if nfull:
+            self.prefix.insert(req.prompt, req.version,
+                               self.kv.owner_blocks(req.rid)[:nfull])
 
     def _advance_prefill(self) -> bool:
         """ONE prefill chunk for the head admitted-but-prefilling
@@ -792,6 +958,10 @@ class DecodeScheduler:
         t0 = time.perf_counter_ns()
         s, real, padded = req.chunks[req.pf_i]
         last = req.pf_i == len(req.chunks) - 1
+        # write-safety invariant: every block this chunk touches is
+        # PRIVATE — warm suffix chunks start past the adopted prefix,
+        # and the rerun-last-chunk case's shared blocks were forked
+        # copy-on-write inside the admission transaction (_admit)
         toks = np.zeros((1, padded), np.int32)
         toks[0, :real] = req.prompt[s:s + real]
         with obs.span("serve/prefill", rid=req.rid, chunk=req.pf_i,
@@ -820,6 +990,7 @@ class DecodeScheduler:
         if not last:
             return True
         self._prefilling.popleft()
+        self._register_prefix(req)
         req.pos = int(req.prompt.size)
         req.t_first_ns = time.perf_counter_ns()
         self._bump("tokens")
@@ -859,11 +1030,18 @@ class DecodeScheduler:
         for version, rows in list(groups.items()):
             if (self.draft_model is not None and len(self._active) == 1
                     and len(rows) == 1 and not self._prefilling
-                    and rows[0].temperature <= 0.0):
+                    and rows[0].temperature <= 0.0
+                    and rows[0].hit_tokens == 0):
                 # truly alone (and greedy — the draft-propose/verify
                 # acceptance rule is argmax-match): a multi-token spec
                 # burst must not delay a joining request's interleaved
-                # prefill chunks
+                # prefill chunks. PREFIX-HIT requests skip the draft
+                # model's prefill along with the target's, so the draft
+                # KV over the adopted region is garbage — its proposals
+                # would be noise and every spec round a net loss; hit
+                # requests ride the normal bucketed step instead
+                # (tokens identical either way — spec is
+                # output-preserving).
                 self._spec_round(rows[0])
             else:
                 self._step_group(version, rows)
@@ -1015,6 +1193,7 @@ class DecodeScheduler:
             "decode_steps": req.steps,
             "tokens": n,
             "version": req.version,
+            "prefix_hit_tokens": req.hit_tokens,
         }
         self._bump("completed")
         if obs.enabled():

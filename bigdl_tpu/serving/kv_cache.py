@@ -23,11 +23,26 @@ Block 0 is the reserved NULL block: unallocated table entries and the
 padded slots of a partially-filled decode bucket all point there, so a
 padded row's writes land in garbage space that no real row ever reads.
 
+Block SHARING (ISSUE 12): every allocated block carries a reference
+count. A block referenced once is private (its owner may write it); a
+block referenced more than once — adopted into several requests' tables
+by the prefix cache (``prefix_cache.PrefixCache``), or pinned by the
+cache itself — is READ-ONLY: the ledger's copy-on-write primitive
+(:meth:`fork_blocks`) gives an owner a private device copy before its
+first divergent write. ``free``/``defrag``/eviction are all
+refcount-aware — a physical page returns to the free list only when its
+LAST referent lets go, and defrag moves a shared page ONCE, rewriting
+every owner's table plus the prefix-cache index (remap listeners). That
+is what stores a shared 4k-token system prompt once per replica instead
+of once per request.
+
 Accounting is exported live (``serve/kv_*`` gauges/counters — see
 docs/OBSERVABILITY.md) and the block ledger is the engine's admission
 authority: a request is only admitted when its worst-case block need
-(prompt + max_new_tokens + speculative overshoot) fits the free list,
-so a decode step can never fail mid-flight on cache exhaustion.
+(prompt + max_new_tokens + speculative overshoot, MINUS the blocks a
+prefix hit adopts, PLUS the copy-on-write forks its warm plan will
+take) fits the free list, so a decode step can never fail mid-flight on
+cache exhaustion.
 
 GEMM M-class note (the continuous-batching bitwise gate): XLA CPU
 lowers total-row-count-1 matmuls to a gemv kernel whose accumulation
@@ -40,7 +55,7 @@ bitwise-identical whether it decodes alone or mid-swarm.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -66,8 +81,13 @@ class PagedKVCache:
     Pages are functional jax arrays: the compiled decode step takes the
     current pages as inputs and returns updated ones; the scheduler
     stores the new handles back via :meth:`set_pages`. The ledger
-    (free list, per-owner block lists) is plain host state guarded by a
-    lock — allocation never touches the device.
+    (free list, per-owner block lists, per-block refcounts) is plain
+    host state guarded by a lock — allocation never touches the device.
+
+    Sharing contract: a block with refcount 1 belongs to exactly one
+    referent and may be written; refcount >= 2 means the page is shared
+    (prefix-cache entries and/or several owners' tables point at it)
+    and is read-only — callers must :meth:`fork_blocks` before writing.
     """
 
     def __init__(self, model, *, num_blocks: int, block_size: int = 16,
@@ -107,8 +127,10 @@ class PagedKVCache:
         self._pages = [(_zeros(), _zeros()) for _ in model.blocks]
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._owned: Dict[object, List[int]] = {}
+        self._refs: Dict[int, int] = {}   # physical id -> reference count
         self._high_water = 0
         self._lock = threading.Lock()
+        self._remap_listeners: List[Callable[[dict], None]] = []
         self._set_gauges()
 
     # -- device pages ----------------------------------------------------
@@ -128,13 +150,26 @@ class PagedKVCache:
             return len(self._free)
 
     def blocks_in_use(self) -> int:
+        """UNIQUE physical blocks with at least one referent — a block
+        shared by ten tables (and/or the prefix cache) counts once:
+        that is the stored-once-per-replica accounting."""
         with self._lock:
-            return sum(len(b) for b in self._owned.values())
+            return self.num_blocks - 1 - len(self._free)
+
+    def shared_blocks(self) -> int:
+        """Blocks with refcount >= 2 (prefix-cache sharing in effect)."""
+        with self._lock:
+            return sum(1 for r in self._refs.values() if r >= 2)
 
     def owned(self, owner) -> int:
-        """Blocks currently held by ``owner`` (0 when unknown)."""
+        """Blocks currently in ``owner``'s table (0 when unknown)."""
         with self._lock:
             return len(self._owned.get(owner, ()))
+
+    def block_refs(self, block: int) -> int:
+        """Current refcount of a physical block (0 = free/unknown)."""
+        with self._lock:
+            return self._refs.get(int(block), 0)
 
     def can_allocate(self, n_blocks: int) -> bool:
         with self._lock:
@@ -144,7 +179,9 @@ class PagedKVCache:
         """Grow ``owner``'s allocation so positions ``0..upto_tokens-1``
         fit. Raises :class:`KVCacheOOM` (allocating NOTHING) when the
         free list can't cover the growth, and ``ValueError`` past the
-        table width — admission control checks both up front."""
+        table width — admission control checks both up front. Blocks an
+        owner ADOPTED from the prefix cache count toward its capacity:
+        only the private tail is newly allocated."""
         need = blocks_for_tokens(upto_tokens, self.block_size)
         if need > self.max_blocks_per_seq:
             raise ValueError(
@@ -159,30 +196,143 @@ class PagedKVCache:
             if grow > len(self._free):
                 if not have:    # don't leave an empty ledger entry behind
                     self._owned.pop(owner, None)
+                in_use = self.num_blocks - 1 - len(self._free)
                 raise KVCacheOOM(
                     f"need {grow} blocks, {len(self._free)} free "
-                    f"(in use {sum(len(b) for b in self._owned.values())}"
-                    f"/{self.num_blocks - 1})")
+                    f"(in use {in_use}/{self.num_blocks - 1})")
             for _ in range(grow):
-                have.append(self._free.pop())
-            in_use = sum(len(b) for b in self._owned.values())
+                b = self._free.pop()
+                self._refs[b] = 1
+                have.append(b)
+            in_use = self.num_blocks - 1 - len(self._free)
             self._high_water = max(self._high_water, in_use)
         if obs.enabled():
             obs.counter(f"{self.metric_prefix}_allocs").inc(grow)
         self._set_gauges()
 
+    def adopt(self, owner, blocks: Sequence[int]):
+        """Prefix-cache hit: append already-resident SHARED blocks to
+        ``owner``'s table (refcount +1 each — the pages are not copied,
+        that is the point). The adopted prefix must land before any
+        private growth: adoption is refused once the owner holds
+        blocks."""
+        blocks = [int(b) for b in blocks]
+        with self._lock:
+            have = self._owned.setdefault(owner, [])
+            if have:
+                raise ValueError(
+                    f"adopt() must precede private allocation — owner "
+                    f"{owner!r} already holds {len(have)} blocks")
+            for b in blocks:
+                if self._refs.get(b, 0) < 1:
+                    raise ValueError(f"block {b} is not live — a prefix "
+                                     "entry outlived its page")
+                self._refs[b] += 1
+            have.extend(blocks)
+        self._set_gauges()
+
+    def retain(self, blocks: Sequence[int]):
+        """Ownerless references (the prefix cache pinning its entries'
+        pages): refcount +1 each, no table."""
+        with self._lock:
+            for b in blocks:
+                b = int(b)
+                if self._refs.get(b, 0) < 1:
+                    raise ValueError(f"cannot retain free block {b}")
+                self._refs[b] += 1
+        self._set_gauges()
+
+    def release(self, blocks: Sequence[int]) -> int:
+        """Drop ownerless references. A release past refcount zero is
+        REFUSED (raises ``ValueError``) — the double-free would hand one
+        physical page to two future owners. Returns how many blocks hit
+        refcount 0 and went back to the free list."""
+        freed = 0
+        with self._lock:
+            for b in blocks:
+                b = int(b)
+                r = self._refs.get(b, 0)
+                if r < 1:
+                    raise ValueError(
+                        f"double-free refused: block {b} has no live "
+                        "references")
+                if r == 1:
+                    del self._refs[b]
+                    self._free.append(b)
+                    freed += 1
+                else:
+                    self._refs[b] = r - 1
+        if freed and obs.enabled():
+            obs.counter(f"{self.metric_prefix}_frees").inc(freed)
+        self._set_gauges()
+        return freed
+
     def free(self, owner) -> int:
-        """Return every block ``owner`` holds to the free list (the
-        completion/eviction path). Returns the count freed; unknown
-        owners free 0 (idempotent — double-eviction is a no-op)."""
+        """Drop every reference ``owner``'s table holds (the completion/
+        eviction path). Private blocks return to the free list; shared
+        blocks just lose one referent and live on (the prefix cache or
+        another request still reads them). Returns the number of table
+        entries released; unknown owners free 0 (idempotent —
+        double-eviction is a no-op)."""
+        returned = 0
         with self._lock:
             blocks = self._owned.pop(owner, [])
             # LIFO reuse keeps the hot end of the pool dense
-            self._free.extend(reversed(blocks))
-        if blocks and obs.enabled():
-            obs.counter(f"{self.metric_prefix}_frees").inc(len(blocks))
+            for b in reversed(blocks):
+                r = self._refs.get(b, 0)
+                if r <= 1:
+                    self._refs.pop(b, None)
+                    self._free.append(b)
+                    returned += 1
+                else:
+                    self._refs[b] = r - 1
+        if returned and obs.enabled():
+            obs.counter(f"{self.metric_prefix}_frees").inc(returned)
         self._set_gauges()
         return len(blocks)
+
+    def fork_blocks(self, owner, idxs: Sequence[int]) -> List[int]:
+        """COPY-ON-WRITE: replace the given logical indices of
+        ``owner``'s table with private copies wherever the current
+        physical block is shared (refcount >= 2). One device dispatch
+        per layer copies all forked pages at once. Already-private
+        indices are left alone. Returns the logical indices actually
+        forked. Raises :class:`KVCacheOOM` when the free list cannot
+        cover the forks — admission control reserves fork headroom
+        up front precisely so this never fires mid-flight."""
+        moves = []                     # (src_physical, dst_physical)
+        forked: List[int] = []
+        with self._lock:
+            have = self._owned.get(owner)
+            if have is None:
+                raise ValueError(f"unknown owner {owner!r}")
+            want = [i for i in idxs
+                    if i < len(have) and self._refs.get(have[i], 0) >= 2]
+            if not want:
+                return []
+            if len(want) > len(self._free):
+                raise KVCacheOOM(
+                    f"copy-on-write fork needs {len(want)} blocks, "
+                    f"{len(self._free)} free")
+            for i in want:
+                src = have[i]
+                dst = self._free.pop()
+                self._refs[dst] = 1
+                self._refs[src] -= 1
+                have[i] = dst
+                moves.append((src, dst))
+                forked.append(i)
+            srcs = jnp.asarray([s for s, _ in moves], jnp.int32)
+            dsts = jnp.asarray([d for _, d in moves], jnp.int32)
+            self._pages = [
+                (k.at[dsts].set(k[srcs]), v.at[dsts].set(v[srcs]))
+                for k, v in self._pages]
+            in_use = self.num_blocks - 1 - len(self._free)
+            self._high_water = max(self._high_water, in_use)
+        if obs.enabled():
+            obs.counter(f"{self.metric_prefix}_cow_forks").inc(len(moves))
+        self._set_gauges()
+        return forked
 
     def block_table(self, owner) -> np.ndarray:
         """``owner``'s (max_blocks_per_seq,) int32 physical-block table,
@@ -193,12 +343,32 @@ class PagedKVCache:
             out[:len(blocks)] = blocks
         return out
 
+    def owner_blocks(self, owner) -> List[int]:
+        """``owner``'s physical block list (a copy)."""
+        with self._lock:
+            return list(self._owned.get(owner, ()))
+
     def null_table(self) -> np.ndarray:
         """The all-null table a padded decode slot carries: every write
         lands in the reserved garbage block."""
         return np.zeros((self.max_blocks_per_seq,), np.int32)
 
     # -- defrag ----------------------------------------------------------
+
+    def add_remap_listener(self, fn: Callable[[dict], None]):
+        """Register a ``{old_physical: new_physical}`` callback fired
+        by :meth:`defrag` right AFTER the table rewrite, on the
+        defragging thread but OUTSIDE the ledger lock (listeners take
+        their own locks and may query refcounts — nesting both orders
+        would deadlock). There is therefore a window where owner
+        tables are rewritten and a listener's index is not yet: defrag
+        runs at a decode-step boundary on the scheduler thread, which
+        is also the only thread that consumes listener-held block ids,
+        so nothing can adopt through a stale mapping — a listener that
+        serves OTHER threads by block id must tolerate staleness. The
+        prefix cache re-keys its entry->block index through this, so
+        sharing survives a repack."""
+        self._remap_listeners.append(fn)
 
     def frag_blocks(self) -> int:
         """Address-space spread: the number of free holes below the
@@ -207,7 +377,7 @@ class PagedKVCache:
         packed = ids 1..n). After enough churn the live blocks scatter
         across the pool; :meth:`defrag` repacks them."""
         with self._lock:
-            ids = [b for blocks in self._owned.values() for b in blocks]
+            ids = list(self._refs)
             if not ids:
                 return 0
             return max(ids) - len(ids)
@@ -215,12 +385,13 @@ class PagedKVCache:
     def defrag(self) -> int:
         """Repack live blocks into the lowest physical ids: device-copy
         each out-of-place block's K/V pages down and rewrite the owning
-        tables. Returns the number of blocks moved (``serve/kv_defrag_
-        moves``). Run at a step boundary — tables handed to an in-flight
-        dispatch must not be rewritten under it."""
+        tables — a SHARED page moves once and every owner's table plus
+        the prefix-cache index (remap listeners) follows it, refcount
+        untouched. Returns the number of blocks moved (``serve/kv_
+        defrag_moves``). Run at a step boundary — tables handed to an
+        in-flight dispatch must not be rewritten under it."""
         with self._lock:
-            live = sorted(b for blocks in self._owned.values()
-                          for b in blocks)
+            live = sorted(self._refs)
             n = len(live)
             targets = set(range(1, n + 1))
             moves = []          # (src, dst) pairs
@@ -238,7 +409,16 @@ class PagedKVCache:
             for blocks in self._owned.values():
                 for i, b in enumerate(blocks):
                     blocks[i] = remap.get(b, b)
+            self._refs = {remap.get(b, b): r
+                          for b, r in self._refs.items()}
             self._free = list(range(self.num_blocks - 1, n, -1))
+        # outside the ledger lock (listeners take their own locks — the
+        # prefix cache also queries refcounts, and nesting the two
+        # orders both ways would deadlock); defrag runs at a step
+        # boundary on the scheduler thread, so nothing adopts through
+        # the index between the table rewrite and this re-key
+        for fn in self._remap_listeners:
+            fn(remap)
         if obs.enabled():
             obs.counter(f"{self.metric_prefix}_defrag_moves").inc(len(moves))
         self._set_gauges()
@@ -248,11 +428,13 @@ class PagedKVCache:
 
     def stats(self) -> dict:
         with self._lock:
-            in_use = sum(len(b) for b in self._owned.values())
+            in_use = self.num_blocks - 1 - len(self._free)
             return {
                 "blocks_total": self.num_blocks - 1,  # null excluded
                 "blocks_in_use": in_use,
                 "blocks_free": len(self._free),
+                "shared_blocks": sum(1 for r in self._refs.values()
+                                     if r >= 2),
                 "owners": len(self._owned),
                 "high_water": self._high_water,
                 "block_size": self.block_size,
@@ -267,5 +449,6 @@ class PagedKVCache:
         obs.gauge(f"{pre}_blocks_total").set(s["blocks_total"])
         obs.gauge(f"{pre}_blocks_in_use").set(s["blocks_in_use"])
         obs.gauge(f"{pre}_blocks_free").set(s["blocks_free"])
+        obs.gauge(f"{pre}_shared_blocks").set(s["shared_blocks"])
         obs.gauge(f"{pre}_high_water").set(s["high_water"])
         obs.gauge(f"{pre}_frag_blocks").set(self.frag_blocks())

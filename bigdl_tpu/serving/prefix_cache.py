@@ -1,0 +1,285 @@
+"""Content-addressed prefix index over the paged KV block pool.
+
+Production LM traffic is dominated by shared prefixes — system prompts,
+few-shot templates, multi-turn histories — yet a scheduler without this
+module re-prefills every prompt from position 0 and stores its KV
+blocks privately: a thousand requests carrying the same 4k-token system
+prompt pay a thousand identical prefills and pin a thousand copies of
+the same pages. This module is the serving analog of what the
+data-parallel papers did for training (Parallax's locality-aware
+exchange, arXiv:1808.02621): route work to where the state already
+lives instead of re-materializing it.
+
+Index structure — a CHAIN of content-addressed entries at KV-block
+granularity. Block ``i`` of a prompt is keyed by a rolling digest::
+
+    key_i = blake2b(key_{i-1} || tokens[i*bs:(i+1)*bs] || model_version)
+
+so the key commits to the ENTIRE token history, not just the local
+chunk (two prompts sharing chunk 3 but differing in chunk 1 never
+collide), and to the model version (a hot swap invalidates reuse
+without touching the index — old entries simply stop matching and age
+out). Each entry pins ONE physical block in the
+:class:`~.kv_cache.PagedKVCache` ledger (refcount +1 held by the
+cache). A lookup walks the chain until the first absent entry: the
+surviving prefix is exactly the longest cached block-aligned prefix.
+
+Lifecycle:
+
+* **insert** — after a request's prefill completes, the scheduler
+  registers every FULL prompt block (partial tail blocks are never
+  shared: their pages still receive that request's decode writes).
+  Existing keys are refreshed (LRU touch), new keys retain the owner's
+  physical block — from that moment the page is shared and read-only.
+* **hit** — a later admission adopts the matched blocks into its own
+  table (refcount +1 each, zero page copies) and skips their prefill
+  chunks entirely.
+* **evict** — under block pressure the scheduler reclaims cache-only
+  pages: LEAF-FIRST LRU over entries whose block has no live adopter
+  (refcount == 1, the cache's own pin). Interior entries with present
+  children are skipped — evicting mid-chain would strand descendants
+  unreachable while their pages stay pinned.
+* **defrag** — the cache registers a remap listener with the ledger, so
+  a repack that moves a shared page updates the index in the same
+  critical section as the owners' tables.
+
+Thread-safety: one lock; the scheduler thread mutates, router threads
+only :meth:`peek` (prefix-affinity probes — no LRU touch, no metrics).
+
+Metrics (``serve/prefix_*`` — docs/OBSERVABILITY.md): ``hits``/
+``misses``/``evictions``/``cow_forks`` counters and
+``entries``/``shared_blocks``/``reused_tokens`` gauges/counters are
+maintained by this class and the scheduler's admission path.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import observability as obs
+from .kv_cache import PagedKVCache
+
+
+def chain_keys(token_ids, block_size: int, version: str,
+               max_blocks: Optional[int] = None) -> List[bytes]:
+    """The rolling content digests for every FULL ``block_size`` chunk
+    of ``token_ids`` under ``version`` — ``keys[i]`` commits to tokens
+    ``[0, (i+1)*block_size)`` and the model version."""
+    toks = np.asarray(token_ids, np.int32).reshape(-1)
+    n = toks.size // block_size
+    if max_blocks is not None:
+        n = min(n, max_blocks)
+    keys: List[bytes] = []
+    prev = version.encode() + b"\x00" + str(block_size).encode()
+    for i in range(n):
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(toks[i * block_size:(i + 1) * block_size].tobytes())
+        prev = h.digest()
+        keys.append(prev)
+    return keys
+
+
+class _Entry:
+    __slots__ = ("key", "parent", "block", "depth", "children")
+
+    def __init__(self, key: bytes, parent: Optional[bytes], block: int,
+                 depth: int):
+        self.key = key
+        self.parent = parent
+        self.block = block
+        self.depth = depth          # chain position (0 = first block)
+        self.children = 0           # PRESENT child entries
+
+
+class PrefixCache:
+    """Content-addressed block sharing over one :class:`PagedKVCache`.
+
+    Parameters
+    ----------
+    kv : the block ledger whose pages this index pins (refcounts).
+    max_entries : optional cap on resident entries — insert evicts
+        least-recently-used unreferenced entries past it. ``None``
+        bounds the cache only by the block pool itself (eviction then
+        happens on admission pressure via :meth:`evict`).
+    metric_prefix : the ``serve/prefix`` namespace.
+    """
+
+    def __init__(self, kv: PagedKVCache, *,
+                 max_entries: Optional[int] = None,
+                 metric_prefix: str = "serve/prefix"):
+        self.kv = kv
+        self.block_size = kv.block_size
+        self.max_entries = max_entries
+        self.metric_prefix = metric_prefix
+        self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._evictions = 0
+        kv.add_remap_listener(self._on_remap)
+
+    # -- lookup ----------------------------------------------------------
+
+    def _walk(self, token_ids, version: str, touch: bool) -> List[int]:
+        """Digest the chain INCREMENTALLY, stopping at the first absent
+        entry — a probe that misses at the root costs one blake2b, not
+        one per prompt block (the router fans N of these out per
+        dispatch, and misses dominate on every replica but the
+        holder)."""
+        toks = np.asarray(token_ids, np.int32).reshape(-1)
+        n = toks.size // self.block_size
+        prev = version.encode() + b"\x00" + str(self.block_size).encode()
+        blocks: List[int] = []
+        with self._lock:
+            for i in range(n):
+                h = hashlib.blake2b(prev, digest_size=16)
+                h.update(toks[i * self.block_size:
+                              (i + 1) * self.block_size].tobytes())
+                prev = h.digest()
+                e = self._entries.get(prev)
+                if e is None:
+                    break
+                if touch:
+                    self._entries.move_to_end(prev)
+                blocks.append(e.block)
+        return blocks
+
+    def lookup(self, token_ids, version: str) -> List[int]:
+        """Longest cached chain for this prompt: the physical block ids
+        of every consecutive present entry from the root (possibly
+        empty). Touches the matched entries (LRU recency) — this is the
+        admission path."""
+        return self._walk(token_ids, version, touch=True)
+
+    def peek(self, token_ids, version: str) -> int:
+        """Router-affinity probe: cached prefix length in TOKENS for
+        this prompt, without touching recency or metrics."""
+        return len(self._walk(token_ids, version, touch=False)) \
+            * self.block_size
+
+    # -- insert ----------------------------------------------------------
+
+    def insert(self, token_ids, version: str,
+               owner_blocks: Sequence[int]) -> int:
+        """Register a prefilled prompt's FULL blocks: ``owner_blocks``
+        are the owner's physical ids for chain positions 0..len-1 (the
+        scheduler passes its table's head). Entries already present are
+        refreshed; new entries retain the owner's page (it becomes
+        shared and read-only). Returns the number of NEW entries."""
+        keys = chain_keys(token_ids, self.block_size, version,
+                          max_blocks=len(owner_blocks))
+        new = 0
+        with self._lock:
+            parent: Optional[bytes] = None
+            for i, k in enumerate(keys):
+                e = self._entries.get(k)
+                if e is not None:
+                    self._entries.move_to_end(k)
+                    parent = k
+                    continue
+                # chains register root-first, so the parent entry must
+                # be RESIDENT by the time its child inserts — an orphan
+                # would be unreachable by the lookup walk while still
+                # pinning its page
+                assert parent is None or parent in self._entries
+                self.kv.retain([owner_blocks[i]])
+                e = _Entry(k, parent, int(owner_blocks[i]), i)
+                self._entries[k] = e
+                if parent is not None:
+                    self._entries[parent].children += 1
+                parent = k
+                new += 1
+            over = (len(self._entries) - self.max_entries
+                    if self.max_entries is not None else 0)
+        if over > 0:
+            self.evict(over)
+        if new:
+            self._set_gauges()
+        return new
+
+    # -- evict -----------------------------------------------------------
+
+    def evict(self, n_blocks: int) -> int:
+        """Reclaim up to ``n_blocks`` pages from UNREFERENCED entries
+        (block refcount 1 — only the cache pins it), least recently
+        used first, leaves before parents. Entries some live request
+        still adopts (refcount >= 2) are never touched. Returns the
+        number of pages actually returned to the free list."""
+        freed = 0
+        # batched passes: each pass sweeps the LRU order ONCE and takes
+        # every currently-eligible leaf (a per-victim restart would be
+        # O(freed x entries) on the admission hot path); freeing a leaf
+        # can make its parent eligible, so passes repeat until the
+        # budget is met or a sweep finds nothing — bounded by the
+        # longest chain, not by the entry count
+        while freed < n_blocks:
+            victims = []
+            with self._lock:
+                for e in self._entries.values():   # OrderedDict = LRU order
+                    if freed + len(victims) >= n_blocks:
+                        break
+                    if e.children == 0 and self.kv.block_refs(e.block) == 1:
+                        victims.append(e)
+                for e in victims:
+                    del self._entries[e.key]
+                    if e.parent is not None:
+                        p = self._entries.get(e.parent)
+                        if p is not None:
+                            p.children -= 1
+            if not victims:
+                break
+            self.kv.release([e.block for e in victims])
+            freed += len(victims)
+            self._evictions += len(victims)
+            if obs.enabled():
+                obs.counter(f"{self.metric_prefix}_evictions").inc(
+                    len(victims))
+        if freed:
+            self._set_gauges()
+        return freed
+
+    def clear(self) -> int:
+        """Release every entry's page (shutdown: the leak gate demands
+        ``kv_blocks_in_use`` drain to zero once the last owner freed).
+        Returns the entry count dropped."""
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for e in entries:
+            self.kv.release([e.block])
+        self._set_gauges()
+        return len(entries)
+
+    # -- internals -------------------------------------------------------
+
+    def _on_remap(self, remap: dict):
+        """Ledger defrag moved pages: follow them (called right after
+        the table rewrite, outside the ledger lock — index-only work)."""
+        with self._lock:
+            for e in self._entries.values():
+                e.block = remap.get(e.block, e.block)
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = len(self._entries)
+            depth = max((e.depth + 1 for e in self._entries.values()),
+                        default=0)
+        return {
+            "entries": n,
+            "max_chain_blocks": depth,
+            "evictions": self._evictions,
+            "shared_blocks": self.kv.shared_blocks(),
+        }
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def _set_gauges(self):
+        if not obs.enabled():
+            return
+        pre = self.metric_prefix
+        obs.gauge(f"{pre}_entries").set(len(self))
+        obs.gauge(f"{pre}_shared_blocks").set(self.kv.shared_blocks())

@@ -30,6 +30,13 @@ TPU-native upgrade of that tier:
   onto the survivors — requests complete on survivors, none are lost.
   The replica rejoins on ``health/stall_recovered``. ``EngineStopped``
   from a replica mid-flight takes the same failover path.
+* **Prefix-affinity dispatch** — KV-cache-aware routing (ISSUE 12):
+  for scheduler replicas with a prefix cache, dispatch probes each
+  healthy replica's cached-prefix summary for the prompt and prefers
+  the one already holding the longest prefix (its admission skips that
+  prefill entirely); a holder deeper than the least-loaded replica by
+  more than ``affinity_slack`` in-flight requests is bypassed, so
+  affinity never starves the WFQ/deadline machinery.
 * **Hot swap across the fleet** — :meth:`Router.swap` publishes the
   new version to every replica (each load sharded per that replica's
   mesh placement, on this thread) and activates per replica
@@ -64,7 +71,7 @@ THREAD_NAME = "bigdl_tpu-serving-router"
 
 _STAT_KEYS = ("submitted", "completed", "rejected", "doomed", "dispatches",
               "failovers", "drains", "rejoins", "deadline_misses",
-              "replica_full")
+              "replica_full", "affinity_hits", "affinity_bypassed")
 
 
 def _metric_cls(name: str) -> str:
@@ -198,6 +205,21 @@ class Router:
     manage_replicas : ``start()``/``shutdown()`` cascade to the
         replicas (the common ownership); False when the caller runs
         their lifecycle.
+    prefix_affinity : KV-cache-aware placement (on by default; a no-op
+        unless a replica exposes ``cached_prefix_tokens`` — i.e. a
+        :class:`~.decode_scheduler.DecodeScheduler` with its prefix
+        cache enabled). Dispatch probes each healthy replica's
+        prefix-cache summary for the prompt and prefers the replica
+        already holding the LONGEST cached prefix: the hit skips that
+        prefix's prefill there, where any other placement re-pays it.
+        Affinity is bounded by ``affinity_slack``: a cache-holder whose
+        in-flight depth exceeds the least-loaded healthy replica's by
+        more than the slack is bypassed (counted), so affinity never
+        starves the deadline/least-loaded machinery — a hot prefix
+        cannot capsize one replica while others idle.
+    affinity_slack : max extra in-flight requests a prefix-affine
+        replica may carry over the least-loaded one before affinity
+        yields to load balance.
     """
 
     def __init__(self, replicas: Sequence, *,
@@ -206,6 +228,8 @@ class Router:
                  fail_fast_factor: float = 0.5,
                  manage_replicas: bool = True,
                  name: str = "router",
+                 prefix_affinity: bool = True,
+                 affinity_slack: int = 4,
                  stall_deadline_s: Optional[float] = None):
         if not replicas:
             raise ValueError("need at least one replica")
@@ -228,6 +252,13 @@ class Router:
         self.max_failovers = int(max_failovers)
         self.fail_fast_factor = float(fail_fast_factor)
         self.manage_replicas = bool(manage_replicas)
+        self.prefix_affinity = bool(prefix_affinity)
+        self.affinity_slack = int(affinity_slack)
+        # capability probe once: affinity costs nothing on fleets whose
+        # engines expose no prefix summary (plain ServingEngines)
+        self._any_prefix = any(
+            callable(getattr(r.engine, "cached_prefix_tokens", None))
+            for r in self._replicas)
         self.name = name
         self.beacon_name = f"serving/router[{name}]"
         self.stall_deadline_s = stall_deadline_s
@@ -448,6 +479,13 @@ class Router:
                 r.name: {"healthy": r.healthy,
                          "inflight": len(r.inflight)}
                 for r in self._replicas}
+        # per-replica prefix summary (the affinity signal, surfaced
+        # next to the load signal): resident entry/shared-block counts
+        # from each scheduler's prefix cache
+        for r in self._replicas:
+            pc = getattr(r.engine, "prefix", None)
+            if pc is not None:
+                out["replicas"][r.name]["prefix"] = pc.stats()
         return out
 
     def healthy_replicas(self) -> List[str]:
@@ -551,6 +589,9 @@ class Router:
             self._rr += 1
             order = healthy[self._rr % len(healthy):] \
                 + healthy[:self._rr % len(healthy)]
+        aff = self._affinity_pick(req, healthy)
+        if aff is not None:
+            order = [aff] + [r for r in order if r is not aff]
         rem = req.remaining_ms(now)
         for rep in order:
             try:
@@ -602,6 +643,43 @@ class Router:
         with self._lock:
             cq.q.appendleft(req)
         return False
+
+    def _affinity_pick(self, req: _RouterRequest,
+                       healthy: List[_Replica]) -> Optional[_Replica]:
+        """Prefix-affinity placement: the healthy replica whose prefix
+        cache reports the LONGEST resident prefix for this prompt (each
+        replica's ``cached_prefix_tokens`` probe — a host-side digest
+        walk, no device work), or None when nothing is cached, only one
+        candidate exists, or the cache-holder is more than
+        ``affinity_slack`` in-flight requests deeper than the
+        least-loaded replica (affinity yields to load — the
+        starvation guard)."""
+        if not self.prefix_affinity or not self._any_prefix \
+                or len(healthy) < 2:
+            return None
+        best, best_tokens = None, 0
+        for rep in healthy:
+            probe = getattr(rep.engine, "cached_prefix_tokens", None)
+            if not callable(probe):
+                continue
+            try:
+                n = int(probe(req.payload))
+            except Exception:
+                continue   # malformed payload for this engine — no bias
+            if n > best_tokens:
+                best, best_tokens = rep, n
+        if best is None:
+            return None
+        min_load = min(len(r.inflight) for r in healthy)
+        if len(best.inflight) - min_load > self.affinity_slack:
+            self._bump("affinity_bypassed")
+            if obs.enabled():
+                obs.counter("serve/router_affinity_bypassed").inc()
+            return None
+        self._bump("affinity_hits")
+        if obs.enabled():
+            obs.counter("serve/router_affinity_hits").inc()
+        return best
 
     def _on_inner_done(self, req: _RouterRequest, rep: _Replica, inner,
                        epoch: int = 0):

@@ -1,0 +1,48 @@
+"""Shared LM-serving test helpers: the solo-decode oracle and the
+sharing-aware KV leak gate, imported by test_serving_lm.py and
+test_prefix_cache.py so both suites enforce ONE correctness bar (a
+chunking or leak-gate change that lands in only one copy would make
+the two files silently gate different things)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.serving import prefill_schedule
+
+
+def solo_oracle(model, params, prompt, max_new, chunk=8, maxlen=256,
+                eos_id=None):
+    """The same request decoded ALONE through dense ``decode_chunk``
+    (greedy), duplicated to 2 rows (the scheduler's gemm M-class) with
+    the scheduler's own prefill chunking."""
+    prompt = np.asarray(prompt, np.int32)
+    caches = model.init_cache(2, maxlen, jnp.float32)
+    step = jax.jit(lambda toks, pos, c: model.decode_chunk(
+        params, toks, pos, c))
+    tok = None
+    for s, real, padded in prefill_schedule(prompt.size, chunk):
+        toks = np.zeros((2, padded), np.int32)
+        toks[:, :real] = prompt[s:s + real]
+        lg, caches = step(jnp.asarray(toks), jnp.int32(s), caches)
+        if s + real == prompt.size:
+            tok = int(np.asarray(lg)[0, real - 1].argmax())
+    out = [tok]
+    pos = int(prompt.size)
+    while len(out) < max_new and (eos_id is None or out[-1] != eos_id):
+        lg, caches = step(jnp.asarray([[tok], [tok]], np.int32),
+                          jnp.int32(pos), caches)
+        tok = int(np.asarray(lg)[0, 0].argmax())
+        out.append(tok)
+        pos += 1
+    return np.asarray(out, np.int32)
+
+
+def no_leaked_blocks(st):
+    """The sharing-aware leak gate: mid-run, every resident page is
+    pinned by the prefix cache (registered prefixes waiting for their
+    next hit) — no block survives the request that owned it. After
+    shutdown the cache is cleared too and this reduces to the old
+    ``blocks_in_use == 0``."""
+    cache_resident = (st.get("prefix") or {}).get("entries", 0)
+    assert st["kv"]["blocks_in_use"] == cache_resident

@@ -1,0 +1,438 @@
+"""Prefix-aware KV reuse (ISSUE 12): content-addressed block sharing.
+
+Two gate families:
+
+* **Ledger invariants** — per-block refcounts: double-free refused,
+  adoption pins pages, copy-on-write forks leave the shared original
+  intact, defrag moves a shared page ONCE and every referent (owner
+  tables + prefix-cache index) follows it, eviction reclaims only
+  refcount-0 (cache-only) entries leaf-first, and
+  ``kv_blocks_in_use`` drains to zero at every shutdown path.
+* **The bitwise matrix** — tokens produced through any mix of prefix
+  hits, CoW forks, defrag-then-decode, eviction-under-sharing,
+  speculative decoding and the Pallas paged-attention kernel are
+  BITWISE identical to a cold solo decode (the house correctness bar):
+  a warm hit adopts blocks whose pages were written by the SAME chunk
+  shapes over the SAME inputs the cold schedule would use, and the
+  warm suffix re-runs exactly the cold schedule's remaining chunks.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import observability as obs
+from bigdl_tpu.models.transformer_lm import TransformerLM
+from serving_helpers import no_leaked_blocks, solo_oracle as _oracle
+from bigdl_tpu.serving import (DecodeScheduler, KVCacheOOM, PagedKVCache,
+                               PrefixCache, chain_keys,
+                               decode_scheduler_threads_alive,
+                               prefill_schedule)
+
+V, H, LAYERS = 48, 32, 2
+MAXLEN = 256
+CHUNK = 8
+BS = 4          # block_size; hit_align = max(CHUNK, BS) = 8
+
+
+def _model(**kw):
+    cfg = dict(vocab_size=V, hidden_size=H, num_heads=4, filter_size=64,
+               num_layers=LAYERS, max_len=MAXLEN)
+    cfg.update(kw)
+    m = TransformerLM(**cfg)
+    m.ensure_initialized()
+    return m
+
+
+_shared = {}
+
+
+def shared_model():
+    if "m" not in _shared:
+        _shared["m"] = _model(pos_encoding="rope", num_kv_heads=2)
+    return _shared["m"]
+
+
+def solo_oracle(model, params, prompt, max_new, chunk=CHUNK, eos_id=None):
+    return _oracle(model, params, prompt, max_new, chunk=chunk,
+                   maxlen=MAXLEN, eos_id=eos_id)
+
+
+def _sched(model, **kw):
+    cfg = dict(max_slots=4, block_size=BS, max_seq_len=96,
+               prefill_chunk=CHUNK)
+    cfg.update(kw)
+    return DecodeScheduler(model, **cfg)
+
+
+def _no_leaked_blocks(st):
+    no_leaked_blocks(st)
+
+
+@pytest.fixture(params=["dense", "kernel"])
+def paged_path(request, monkeypatch):
+    if request.param == "kernel":
+        monkeypatch.setenv("BIGDL_TPU_PAGED_ATTN", "interpret")
+    else:
+        monkeypatch.delenv("BIGDL_TPU_PAGED_ATTN", raising=False)
+    return request.param
+
+
+# ---------------------------------------------------------------------------
+# ledger invariants: refcounts, CoW, defrag-under-sharing, eviction
+# ---------------------------------------------------------------------------
+
+def test_refcount_adopt_release_and_double_free_refused():
+    m = shared_model()
+    kv = PagedKVCache(m, num_blocks=9, block_size=4, max_blocks_per_seq=4)
+    kv.ensure_capacity("a", 12)                       # 3 private blocks
+    blocks = kv.owner_blocks("a")
+    assert [kv.block_refs(b) for b in blocks] == [1, 1, 1]
+    kv.retain(blocks[:2])                             # cache pins 2
+    assert [kv.block_refs(b) for b in blocks] == [2, 2, 1]
+    kv.adopt("b", blocks[:2])                         # a hit adopts them
+    assert kv.block_refs(blocks[0]) == 3
+    # shared pages count ONCE: a(3) + b shares 2 of them
+    assert kv.blocks_in_use() == 3 and kv.shared_blocks() == 2
+    assert kv.free("a") == 3          # drops a's refs; only block 3 frees
+    assert kv.blocks_in_use() == 2 and kv.blocks_free() == 6
+    assert kv.free("b") == 2          # cache still pins both
+    assert kv.blocks_in_use() == 2
+    assert kv.release(blocks[:2]) == 2                # now they free
+    with pytest.raises(ValueError, match="double-free"):
+        kv.release(blocks[:1])
+    with pytest.raises(ValueError):
+        kv.retain(blocks[:1])          # can't pin a free page
+    with pytest.raises(ValueError):
+        kv.adopt("c", blocks[:1])      # can't adopt a free page
+    assert kv.blocks_in_use() == 0 and kv.free("a") == 0  # idempotent
+    # adoption must precede private growth (the table layout contract)
+    kv.ensure_capacity("d", 4)
+    with pytest.raises(ValueError, match="adopt"):
+        kv.adopt("d", kv.owner_blocks("d"))
+
+
+def test_cow_fork_copies_pages_and_leaves_original():
+    m = shared_model()
+    kv = PagedKVCache(m, num_blocks=8, block_size=4, max_blocks_per_seq=4)
+    kv.ensure_capacity("a", 8)                        # blocks [1, 2]
+    b0, b1 = kv.owner_blocks("a")
+    # stamp recognizable values into a's pages
+    k0, v0 = kv.pages()[0]
+    kv.set_pages([(k.at[b0].set(7.0).at[b1].set(9.0), v)
+                  for k, v in kv.pages()])
+    kv.retain([b0, b1])                               # now shared
+    forked = kv.fork_blocks("a", [0, 1, 3])           # 3 is out of range
+    assert forked == [0, 1]
+    n0, n1 = kv.owner_blocks("a")
+    assert {n0, n1}.isdisjoint({b0, b1})
+    k, _ = kv.pages()[0]
+    assert float(k[n0].reshape(-1)[0]) == 7.0         # pages copied
+    assert float(k[b1].reshape(-1)[0]) == 9.0         # original intact
+    assert kv.block_refs(b0) == 1 and kv.block_refs(n0) == 1
+    assert kv.fork_blocks("a", [0, 1]) == []          # already private
+    # fork respects the free list: pool of 7, 4 in use -> 3 free; a
+    # second owner adopting + forking past that must raise typed
+    kv.adopt("b", [b0, b1])
+    kv.ensure_capacity("b", 16)  # grows b to 4 blocks (2 adopted + 2)
+    assert kv.blocks_free() == 1
+    with pytest.raises(KVCacheOOM):
+        kv.fork_blocks("b", [0, 1])
+
+
+def test_defrag_preserves_sharing_and_remaps_index():
+    m = shared_model()
+    kv = PagedKVCache(m, num_blocks=20, block_size=4, max_blocks_per_seq=5)
+    seen = []
+    kv.add_remap_listener(seen.append)
+    kv.ensure_capacity("hole", 12)
+    kv.ensure_capacity("a", 12)
+    shared = kv.owner_blocks("a")[:2]
+    kv.retain(shared)
+    kv.adopt("b", shared)
+    kv.free("hole")                   # holes below a's ids
+    assert kv.frag_blocks() > 0
+    moved = kv.defrag()
+    assert moved > 0 and kv.frag_blocks() == 0 and seen
+    remap = seen[0]
+    new_shared = [remap.get(b, b) for b in shared]
+    # BOTH owners' tables follow the moved page — still the same page
+    assert kv.owner_blocks("a")[:2] == new_shared
+    assert kv.owner_blocks("b") == new_shared
+    assert [kv.block_refs(b) for b in new_shared] == [3, 3]
+    assert kv.shared_blocks() == 2
+
+
+def test_prefix_cache_insert_lookup_evict_leaf_first():
+    m = shared_model()
+    kv = PagedKVCache(m, num_blocks=32, block_size=4, max_blocks_per_seq=8)
+    pc = PrefixCache(kv)
+    toks = np.arange(1, 17, dtype=np.int32)           # 4 full blocks
+    kv.ensure_capacity("a", 16)
+    blocks = kv.owner_blocks("a")
+    assert pc.insert(toks, "v0", blocks) == 4
+    assert pc.insert(toks, "v0", blocks) == 0         # refresh, not dup
+    assert pc.lookup(toks, "v0") == blocks
+    assert pc.lookup(toks, "v1") == []                # version-keyed
+    assert pc.peek(toks, "v0") == 16
+    assert pc.peek(toks[:10], "v0") == 8              # partial chain
+    assert len(chain_keys(toks, 4, "v0")) == 4
+    # divergent chain shares only the common prefix
+    toks2 = toks.copy()
+    toks2[9] = 44
+    assert pc.peek(toks2, "v0") == 8
+    # owner still holds every block: nothing is evictable
+    assert pc.evict(99) == 0 and len(pc) == 4
+    kv.free("a")
+    # now cache-only (refcount 1): evict reclaims LEAF-first
+    assert pc.evict(1) == 1
+    assert pc.peek(toks, "v0") == 12                  # chain shrank at tail
+    assert pc.evict(99) == 3 and len(pc) == 0
+    assert kv.blocks_in_use() == 0
+    # stats surface
+    s = pc.stats()
+    assert s["evictions"] == 4 and s["entries"] == 0
+
+
+def test_prefix_cache_interior_entry_pinned_by_descendant():
+    """An interior entry whose child is still adopted must not be
+    evicted even when its own block is unreferenced — the chain walk
+    would strand the descendant unreachable while its page stays
+    pinned."""
+    m = shared_model()
+    kv = PagedKVCache(m, num_blocks=16, block_size=4, max_blocks_per_seq=4)
+    pc = PrefixCache(kv)
+    toks = np.arange(1, 13, dtype=np.int32)           # 3 blocks
+    kv.ensure_capacity("a", 12)
+    pc.insert(toks, "v0", kv.owner_blocks("a"))
+    tail = kv.owner_blocks("a")[2]
+    kv.free("a")
+    kv.retain([tail])                 # a live adopter of the TAIL only
+    assert pc.evict(99) == 0          # parents have children; tail adopted
+    assert len(pc) == 3
+    kv.release([tail])
+    assert pc.evict(99) == 3
+
+
+# ---------------------------------------------------------------------------
+# the bitwise matrix
+# ---------------------------------------------------------------------------
+
+def _prefix_plus(rng, prefix, n_extra):
+    return np.concatenate([prefix,
+                           rng.randint(1, V, size=n_extra).astype(np.int32)])
+
+
+def test_warm_hit_bitwise_and_skips_prefill(paged_path):
+    """The core gate: a request whose prompt extends a registered
+    prefix adopts the cached blocks, skips their prefill chunks, and
+    still emits BITWISE the cold solo decode's tokens — dense and
+    Pallas-kernel paths both."""
+    m = shared_model()
+    rng = np.random.RandomState(20)
+    prefix = rng.randint(1, V, size=16).astype(np.int32)   # 2 chunks
+    p1 = _prefix_plus(rng, prefix, 5)
+    p2 = _prefix_plus(rng, prefix, 3)
+    with _sched(m) as sched:
+        r1 = sched.submit(p1, 6).result(timeout=120)
+        chunks_cold = sched.stats()["prefill_chunks"]
+        r2 = sched.submit(p2, 6).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(r1, solo_oracle(m, m.params, p1, 6))
+    assert np.array_equal(r2, solo_oracle(m, m.params, p2, 6))
+    assert st["prefix_hits"] == 1 and st["prefix_misses"] == 1
+    assert st["prefix_reused_tokens"] == 16
+    # p2 cold would be 3 chunks (8+8+4); warm runs ONLY the tail chunk
+    assert st["prefill_chunks"] - chunks_cold == 1
+    assert st["prefix_cow_forks"] == 0
+    _no_leaked_blocks(st)
+    assert decode_scheduler_threads_alive() == 0
+
+
+def test_full_aligned_hit_reruns_last_chunk_with_cow(paged_path):
+    """A fully-cached, fully-aligned prompt re-runs only its LAST cold
+    chunk for the first-token logits; that chunk's writes into shared
+    pages take copy-on-write forks — and the tokens stay bitwise the
+    cold decode's (same chunk shape, same inputs, private pages)."""
+    m = shared_model()
+    rng = np.random.RandomState(21)
+    p = rng.randint(1, V, size=16).astype(np.int32)   # aligned to 8
+    want = solo_oracle(m, m.params, p, 6)
+    with _sched(m) as sched:
+        a = sched.submit(p, 6).result(timeout=120)
+        chunks_cold = sched.stats()["prefill_chunks"]
+        b = sched.submit(p, 6).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(a, want) and np.array_equal(b, want)
+    assert st["prefix_hits"] == 1
+    # honest accounting: the rerun chunk's 8 tokens are re-computed,
+    # so only the first chunk's 8 count as reused
+    assert st["prefix_reused_tokens"] == 8
+    assert st["prefill_chunks"] - chunks_cold == 1    # rerun tail only
+    # the rerun chunk spans blocks 2,3 of the adopted prefix -> 2 forks
+    assert st["prefix_cow_forks"] == 2
+    _no_leaked_blocks(st)
+
+
+def test_warm_hit_after_defrag_bitwise(paged_path):
+    """Defrag moves the SHARED prefix pages; a later hit adopts the
+    moved pages through the remapped index and decodes bitwise."""
+    m = shared_model()
+    rng = np.random.RandomState(22)
+    prefix = rng.randint(1, V, size=16).astype(np.int32)
+    p1 = _prefix_plus(rng, prefix, 4)
+    p2 = _prefix_plus(rng, prefix, 6)
+    with _sched(m) as sched:
+        sched.submit(p1, 4).result(timeout=120)
+        # churn scatters ids, then repack with the cache resident
+        for n in (9, 5, 12):
+            sched.submit(rng.randint(1, V, size=n), 3).result(timeout=120)
+        sched.defrag()
+        time.sleep(0.05)              # let the step boundary run it
+        r2 = sched.submit(p2, 6).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(r2, solo_oracle(m, m.params, p2, 6))
+    assert st["prefix_hits"] >= 1
+    _no_leaked_blocks(st)
+
+
+def test_eviction_under_sharing_and_backpressure():
+    """A pool sized so the cache's resident prefixes must be partially
+    evicted to admit new work: admission reclaims ONLY unreferenced
+    entries, requests still serve bitwise, and the pool never leaks."""
+    m = shared_model()
+    rng = np.random.RandomState(23)
+    prompts = [rng.randint(1, V, size=20).astype(np.int32)
+               for _ in range(3)]
+    # each request needs ceil(28/4)=7 blocks; pool of 11 holds ONE
+    # request + part of one registered prefix at a time
+    with _sched(m, num_blocks=12, max_seq_len=32) as sched:
+        outs = [sched.submit(p, 8).result(timeout=120) for p in prompts]
+        st = sched.stats()
+    for p, r in zip(prompts, outs):
+        assert np.array_equal(r, solo_oracle(m, m.params, p, 8))
+    assert st["prefix"]["evictions"] > 0
+    _no_leaked_blocks(st)
+
+
+def test_shared_prefix_resident_once():
+    """The storage gate: concurrent requests over one system prompt
+    share ONE copy of its blocks (serve/prefix gauges + ledger)."""
+    obs.enable()
+    try:
+        m = shared_model()
+        rng = np.random.RandomState(24)
+        prefix = rng.randint(1, V, size=24).astype(np.int32)  # 6 blocks
+        with _sched(m, max_slots=4) as sched:
+            sched.submit(_prefix_plus(rng, prefix, 3), 4).result(
+                timeout=120)
+            futs = [sched.submit(_prefix_plus(rng, prefix, 3), 12)
+                    for _ in range(3)]
+            # while the swarm decodes, the prefix pages must be SHARED
+            peak_shared = 0
+            for _ in range(200):
+                peak_shared = max(peak_shared, sched.kv.shared_blocks())
+                if all(f.done() for f in futs):
+                    break
+                time.sleep(0.005)
+            [f.result(timeout=120) for f in futs]
+            st = sched.stats()
+        # hit_align=8: 24-token prefix -> 24 reusable tokens = 6 blocks
+        assert st["prefix_hits"] == 3
+        assert st["prefix_reused_tokens"] == 3 * 24
+        assert peak_shared >= 6, \
+            f"prefix must be resident once and SHARED (saw {peak_shared})"
+        reg = obs.registry()
+        assert reg.get("serve/prefix_hits").value == 3
+        assert reg.get("serve/prefix_reused_tokens").value == 72
+        assert reg.get("serve/prefix_shared_blocks").value >= 0
+        _no_leaked_blocks(st)
+    finally:
+        obs.disable()
+
+
+def test_no_cross_version_reuse_after_swap():
+    """Reuse is keyed on (tokens, version): after a hot swap the same
+    prompt MISSES (old pages describe old params) and decodes bitwise
+    under the new version."""
+    m = shared_model()
+    m2 = _model(pos_encoding="rope", num_kv_heads=2)
+    rng = np.random.RandomState(25)
+    p = rng.randint(1, V, size=16).astype(np.int32)
+    with _sched(m) as sched:
+        a = sched.submit(p, 6).result(timeout=120)
+        sched.swap(m2.params, m2.state)
+        b = sched.submit(p, 6).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(a, solo_oracle(m, m.params, p, 6))
+    assert np.array_equal(b, solo_oracle(m, m2.params, p, 6))
+    assert st["prefix_hits"] == 0 and st["prefix_misses"] == 2
+    _no_leaked_blocks(st)
+
+
+def test_warm_hit_with_speculative_fast_path():
+    """Prefix adoption composes with speculative decoding: a COLD solo
+    request rides the spec fast path as before, but a WARM hit skipped
+    the draft model's prefill along with the target's — its draft KV
+    over the adopted region is garbage, so the scheduler routes hit
+    requests through the normal bucketed step (spec proposals from a
+    garbage cache would be noise: all cost, no acceptance). Tokens are
+    bitwise the target's greedy decode on both paths."""
+    m = _model()                      # sinusoidal/MHA variant
+    rng = np.random.RandomState(26)
+    p = rng.randint(1, V, size=16).astype(np.int32)
+    want = solo_oracle(m, m.params, p, 10)
+    with _sched(m, draft_model=m, spec_k=3) as sched:
+        a = sched.submit(p, 10).result(timeout=120)
+        rounds_cold = sched.stats()["spec_rounds"]
+        b = sched.submit(p, 10).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(a, want) and np.array_equal(b, want)
+    assert rounds_cold > 0, "the cold request must ride the spec path"
+    assert st["spec_rounds"] == rounds_cold, \
+        "a warm hit must NOT spec-decode over a garbage draft cache"
+    assert st["prefix_hits"] == 1
+    _no_leaked_blocks(st)
+
+
+def test_prefix_disabled_is_prior_behavior():
+    m = shared_model()
+    rng = np.random.RandomState(27)
+    p = rng.randint(1, V, size=16).astype(np.int32)
+    with _sched(m, prefix_cache=False) as sched:
+        a = sched.submit(p, 5).result(timeout=120)
+        b = sched.submit(p, 5).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(a, b)
+    assert np.array_equal(a, solo_oracle(m, m.params, p, 5))
+    assert st["prefix"] is None and st["prefix_hits"] == 0
+    assert st["kv"]["blocks_in_use"] == 0
+    assert sched.cached_prefix_tokens(p) == 0
+
+
+def test_probe_and_shutdown_paths_drain_to_zero():
+    from bigdl_tpu.serving import EngineStopped
+    m = shared_model()
+    rng = np.random.RandomState(28)
+    p = rng.randint(1, V, size=16).astype(np.int32)
+    # drain=True path
+    sched = _sched(m).start()
+    sched.submit(p, 4).result(timeout=120)
+    assert sched.cached_prefix_tokens(p) == 16        # probe, no metrics
+    assert sched.cached_prefix_tokens(rng.randint(1, V, size=16)) == 0
+    assert sched.stats()["prefix_hits"] == 0          # peek stayed silent
+    sched.shutdown(drain=True)
+    assert sched.kv.stats()["blocks_in_use"] == 0
+    # drain=False path with cache entries AND in-flight work
+    sched = _sched(m)
+    sched.submit(p, 30)
+    sched.start()
+    time.sleep(0.05)
+    sched.shutdown(drain=False)
+    assert sched.kv.stats()["blocks_in_use"] == 0
+    assert decode_scheduler_threads_alive() == 0
+    with pytest.raises(EngineStopped):
+        sched.submit(p, 2)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from bigdl_tpu import observability as obs
 from bigdl_tpu.models.transformer_lm import TransformerLM
+from serving_helpers import no_leaked_blocks, solo_oracle as _oracle
 from bigdl_tpu.serving import (DeadlineExceeded, DecodeScheduler,
                                KVCacheOOM, PagedKVCache, QueueFull,
                                blocks_for_tokens,
@@ -56,29 +57,12 @@ def shared_model():
 
 
 def solo_oracle(model, params, prompt, max_new, chunk=CHUNK, eos_id=None):
-    """The same request decoded ALONE through dense decode_chunk
-    (greedy), duplicated to 2 rows (the scheduler's gemm M-class) with
-    the scheduler's own prefill chunking."""
-    prompt = np.asarray(prompt, np.int32)
-    caches = model.init_cache(2, MAXLEN, jnp.float32)
-    step = jax.jit(lambda toks, pos, c: model.decode_chunk(
-        params, toks, pos, c))
-    tok = None
-    for s, real, padded in prefill_schedule(prompt.size, chunk):
-        toks = np.zeros((2, padded), np.int32)
-        toks[:, :real] = prompt[s:s + real]
-        lg, caches = step(jnp.asarray(toks), jnp.int32(s), caches)
-        if s + real == prompt.size:
-            tok = int(np.asarray(lg)[0, real - 1].argmax())
-    out = [tok]
-    pos = int(prompt.size)
-    while len(out) < max_new and (eos_id is None or out[-1] != eos_id):
-        lg, caches = step(jnp.asarray([[tok], [tok]], np.int32),
-                          jnp.int32(pos), caches)
-        tok = int(np.asarray(lg)[0, 0].argmax())
-        out.append(tok)
-        pos += 1
-    return np.asarray(out, np.int32)
+    return _oracle(model, params, prompt, max_new, chunk=chunk,
+                   maxlen=MAXLEN, eos_id=eos_id)
+
+
+def _no_leaked_blocks(st):
+    no_leaked_blocks(st)
 
 
 def _sched(model, **kw):
@@ -185,7 +169,7 @@ def test_continuous_batching_bitwise_solo_oracle(paged_path):
     for i, (pr, mn) in enumerate(zip(prompts, maxnews)):
         want = solo_oracle(m, m.params, pr, mn)
         assert np.array_equal(results[i], want), f"request {i} diverged"
-    assert st["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(st)
     assert decode_scheduler_threads_alive() == 0
 
 
@@ -202,7 +186,7 @@ def test_eos_finishes_early_and_frees_blocks():
         st = sched.stats()
     assert np.array_equal(got, want)
     assert got.size < 20 and got[-1] == eos
-    assert st["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(st)
 
 
 def test_deadline_eviction_partial_prefix_bitwise():
@@ -226,7 +210,7 @@ def test_deadline_eviction_partial_prefix_bitwise():
         partial = partial[:60]  # oracle computed 60 — compare the prefix
     assert np.array_equal(partial, want[:partial.size])
     assert st["timeouts"] == 1
-    assert st["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(st)
 
 
 def test_hot_swap_never_mixes_versions():
@@ -268,7 +252,7 @@ def test_speculative_fast_path_bitwise_and_fewer_rounds(paged_path):
     assert st["spec_rounds"] > 0
     assert st["spec_accepted"] >= st["spec_rounds"]  # perfect draft
     assert st["decode_steps"] < 12                   # fewer than 1/token
-    assert st["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(st)
 
 
 def test_spec_path_yields_to_batch():
@@ -344,7 +328,7 @@ def test_kv_defrag_repacks_and_preserves_decode(paged_path):
     spy()
     assert np.array_equal(out, solo_oracle(m, m.params, pr, 30))
     assert st["defrags"] >= 0 and sched.kv.frag_blocks() <= frag_before
-    assert st["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(st)
 
 
 def test_admission_backpressure_on_block_exhaustion():
@@ -363,7 +347,7 @@ def test_admission_backpressure_on_block_exhaustion():
         st = sched.stats()
     assert np.array_equal(r1, solo_oracle(m, m.params, p1, 8))
     assert np.array_equal(r2, solo_oracle(m, m.params, p2, 8))
-    assert st["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(st)
 
 
 def test_kv_gauges_exported():
@@ -423,7 +407,7 @@ def test_rejection_and_typed_errors():
     sched.start(warmup=False)
     sched.shutdown(drain=True)
     assert sched.stats()["completed"] == 2
-    assert sched.stats()["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(sched.stats())
     assert decode_scheduler_threads_alive() == 0
 
 
@@ -438,7 +422,7 @@ def test_shutdown_no_drain_fails_typed_and_frees():
     for f in futs:
         if f.exception() is not None:
             assert isinstance(f.exception(), EngineStopped)
-    assert sched.stats()["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(sched.stats())
     assert decode_scheduler_threads_alive() == 0
     with pytest.raises(EngineStopped):
         sched.submit(np.arange(1, 4), 2)
@@ -478,7 +462,7 @@ def test_static_admission_is_whole_request_batching():
         st = sched.stats()
     for p, r in zip(prompts, results):
         assert np.array_equal(r, solo_oracle(m, m.params, p, 6))
-    assert st["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(st)
 
 
 # ---------------------------------------------------------------------------
@@ -592,4 +576,4 @@ def test_concurrent_submitters():
     for i, (p, mn) in enumerate(plans):
         assert np.array_equal(results[i], solo_oracle(m, m.params, p, mn))
     assert st["completed"] == len(plans)
-    assert st["kv"]["blocks_in_use"] == 0
+    _no_leaked_blocks(st)

@@ -404,3 +404,76 @@ def test_router_metrics_recorded():
     assert reg.get("serve/router_latency_ms_tight") is not None
     assert reg.get("serve/router_latency_ms_bulk") is not None
     assert reg.get("serve/router_queue_wait_ms_tight") is not None
+
+
+# -- prefix-affinity dispatch (ISSUE 12) -----------------------------------
+
+
+def _lm_replicas(n=2):
+    from bigdl_tpu.models.transformer_lm import TransformerLM
+    from bigdl_tpu.serving import DecodeScheduler
+    m = TransformerLM(vocab_size=48, hidden_size=32, num_heads=4,
+                      filter_size=64, num_layers=2, max_len=128)
+    m.ensure_initialized()
+    return [DecodeScheduler(m, max_slots=4, block_size=4, max_seq_len=64,
+                            prefill_chunk=8, name=f"lm{i}")
+            for i in range(n)]
+
+
+def test_prefix_affinity_follows_the_cache():
+    """KV-cache-aware routing: after one replica serves (and registers)
+    a shared prefix, later requests carrying that prefix are dispatched
+    to THAT replica — its admission skips the prefix's prefill — and
+    the per-replica prefix summary rides stats()."""
+    rng = np.random.RandomState(5)
+    prefix = rng.randint(1, 48, size=16).astype(np.int32)
+
+    def prompt():
+        return np.concatenate(
+            [prefix, rng.randint(1, 48, size=3).astype(np.int32)])
+
+    with Router(_lm_replicas()) as r:
+        first = r.submit(prompt(), max_new_tokens=4)
+        first.result(timeout=60)
+        seeded = first.trace["router"]["replica"]
+        futs = [r.submit(prompt(), max_new_tokens=4) for _ in range(4)]
+        [f.result(timeout=60) for f in futs]
+        st = r.stats()
+    assert st["affinity_hits"] == 4
+    for f in futs:
+        assert f.trace["router"]["replica"] == seeded, \
+            "prefix-affine requests must follow the cache"
+    # the replica summary exposes the affinity signal next to the load
+    assert st["replicas"][seeded]["prefix"]["entries"] >= 4
+    hits = sum(rep["prefix"].get("entries", 0) > 0
+               for rep in st["replicas"].values())
+    assert hits == 1, "the prefix must be resident on ONE replica"
+
+
+def test_prefix_affinity_disabled_and_slack_bypass():
+    """prefix_affinity=False routes as before (round-robin spreads the
+    identical prompts); affinity_slack=-1 makes every affine pick
+    bypass to least-loaded (the starvation guard's extreme setting),
+    counted in affinity_bypassed."""
+    rng = np.random.RandomState(6)
+    prefix = rng.randint(1, 48, size=16).astype(np.int32)
+
+    def prompt():
+        return np.concatenate(
+            [prefix, rng.randint(1, 48, size=3).astype(np.int32)])
+
+    with Router(_lm_replicas(), prefix_affinity=False) as r:
+        futs = [r.submit(prompt(), max_new_tokens=3) for _ in range(4)]
+        [f.result(timeout=60) for f in futs]
+        st = r.stats()
+    assert st["affinity_hits"] == 0 and st["affinity_bypassed"] == 0
+    replicas = {f.trace["router"]["replica"] for f in futs}
+    assert len(replicas) == 2, "round-robin must spread without affinity"
+
+    with Router(_lm_replicas(), affinity_slack=-1) as r:
+        r.submit(prompt(), max_new_tokens=3).result(timeout=60)
+        futs = [r.submit(prompt(), max_new_tokens=3) for _ in range(3)]
+        [f.result(timeout=60) for f in futs]
+        st = r.stats()
+    assert st["affinity_hits"] == 0
+    assert st["affinity_bypassed"] == 3

@@ -79,12 +79,28 @@ HOT_FUNCS = {
         "_loop", "_admit", "_advance_prefill", "_step_all", "_step_group",
         "_spec_round", "_evict_expired", "_emit", "_finish", "_release",
         "submit", "warmup", "_put", "_sampling_args",
+        # prefix-reuse admission path (ISSUE 12): the chain lookup,
+        # warm-plan construction and suffix registration are pure host
+        # hashing/bookkeeping at every step boundary
+        "_prefix_plan", "_register_prefix", "cached_prefix_tokens",
     },
     # block ledger: admission-control bookkeeping runs between decode
     # steps and must stay pure host state (device pages are functional
-    # handles — only defrag, a rare explicit operation, touches them)
+    # handles — defrag and the copy-on-write fork, both explicit rare
+    # operations, are the only page-touching paths and they issue
+    # transfers without ever BLOCKING on one)
     "bigdl_tpu/serving/kv_cache.py": {
         "ensure_capacity", "free", "block_table", "can_allocate",
+        "adopt", "retain", "release", "fork_blocks", "block_refs",
+        "owner_blocks",
+    },
+    # prefix cache: content-addressed index over the ledger — digest
+    # walks and LRU bookkeeping inside the admission loop (and under
+    # router dispatch threads via peek); a sync here would stall every
+    # admission on the box
+    "bigdl_tpu/serving/prefix_cache.py": {
+        "lookup", "peek", "insert", "evict", "chain_keys", "_walk",
+        "_on_remap",
     },
     # router hot loop: pure host routing — a sync here would stall
     # EVERY class queue; the replicas' own batcher threads do the
@@ -93,6 +109,9 @@ HOT_FUNCS = {
     "bigdl_tpu/serving/router.py": {
         "_route_loop", "_drr_round", "_dispatch_one", "_on_inner_done",
         "_failover", "_drain_replica", "submit",
+        # prefix-affinity pick: N digest-walk probes per dispatch —
+        # host hashing only, never a device value
+        "_affinity_pick",
     },
     # mesh dispatch path: the sharded version load (publish, on the
     # swapping caller's thread) issues device transfers but must never
