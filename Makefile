@@ -17,7 +17,7 @@ NPROC ?= 4
 SHELL := /bin/bash
 
 .PHONY: test test-slow test-serial test-examples tier1 check-no-sync \
-	serve-smoke obs-smoke fault-smoke perf-gate kernels-smoke
+	serve-smoke obs-smoke fault-smoke perf-gate kernels-smoke chaos-smoke
 test:
 	$(PYTEST) tests/ -q -n $(NPROC) --dist loadfile
 
@@ -26,7 +26,7 @@ test:
 # the sync-point lint so an un-annotated float()/block_until_ready in the
 # hot loop fails before the 15-minute suite starts, and on the serving
 # smoke so a broken engine fails in seconds, not mid-suite.
-tier1: check-no-sync perf-gate kernels-smoke serve-smoke obs-smoke fault-smoke
+tier1: check-no-sync perf-gate kernels-smoke serve-smoke obs-smoke fault-smoke chaos-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 check-no-sync:
@@ -86,6 +86,16 @@ obs-smoke:
 fault-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
 		python tools/fault_smoke.py
+
+# Chaos campaign over the SERVING tier (docs/RESILIENCE.md "Serving
+# faults"): >= 20 seeded faults across >= 5 injection sites — transient
+# storm absorbed by bitwise step replay, an injected replica death
+# recovered KV-preservingly through the router (none lost, recovered
+# tokens bitwise the uninterrupted run), injected ledger corruption
+# quarantined by the auditor with a structured event + crash bundle.
+chaos-smoke:
+	timeout -k 10 300 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
+		python tools/chaos_smoke.py
 
 test-slow:
 	BIGDL_TPU_SLOW=1 $(PYTEST) tests/ -q -n $(NPROC) --dist loadfile

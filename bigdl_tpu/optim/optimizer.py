@@ -44,6 +44,7 @@ from .. import observability as obs
 from ..observability import cluster as _cluster
 from ..observability import flight as _flight
 from ..observability import health as _health
+from ..parallel import chaos as _chaos
 from ..parallel.failure import (FaultPolicy, HeartbeatLost, TrainingHalted,
                                 PERMANENT, TRANSIENT, classify_failure,
                                 probe_mesh, _run_with_timeout)
@@ -96,6 +97,7 @@ def _atomic_pickle(path, payload):
     writer reusing the same tmp path, and concurrent writers (two
     optimizers sharing a checkpoint dir) never interleave into one
     file. Failed writes remove their tmp — no litter accumulates."""
+    _chaos.maybe_fire("checkpoint/write")
     import tempfile
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(

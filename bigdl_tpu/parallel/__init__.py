@@ -14,6 +14,8 @@ from .ring_attention import ring_attention
 from .failure import (probe_mesh, MeshProbeResult, Heartbeat, HeartbeatLost,
                       StragglerMonitor, TransientDeviceError, TrainingHalted,
                       FaultPolicy, classify_failure, TRANSIENT, PERMANENT)
+from . import chaos
+from .chaos import ChaosError, ChaosPlan
 from .elastic import (ElasticRunner, find_latest_checkpoint,
                       data_parallel_factory)
 from .pipeline import gpipe, stack_stage_params, unstack_stage_params

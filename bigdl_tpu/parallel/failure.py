@@ -136,6 +136,14 @@ class FaultPolicy:
     def record_success(self) -> None:
         self.consecutive = 0
 
+    def reset(self) -> None:
+        """Start a fresh unit of work. The consecutive budget is meant
+        to bound retries of ONE dispatch unit; a consumer that SURVIVES
+        an exhausted budget (the serving batcher fails the batch and
+        moves on — unlike the trainer, whose run ends) must reset, or
+        the tripped fuse would deny every later unit its retry."""
+        self.consecutive = 0
+
 
 class TrainingHalted(RuntimeError):
     """Tier-1 remediation verdict: training stopped ITSELF — checkpoint
@@ -355,6 +363,18 @@ class Heartbeat:
 
         With ``timeout_s``, a hung or failed exchange raises
         :class:`HeartbeatLost` instead of stalling forever."""
+        # chaos site. An injected fault surfaces the way a REAL
+        # exchange failure does — as HeartbeatLost — so the trainer's
+        # remediation tier (which types on HeartbeatLost, not on the
+        # transport error underneath) handles the drill exactly like
+        # the fault it simulates; a wedge rule sleeps here and pages
+        # the prober's watchdog beacon instead.
+        try:
+            _chaos.maybe_fire("heartbeat/beat")
+        except Exception as e:  # noqa: BLE001 — typed re-surface
+            raise HeartbeatLost(
+                f"injected heartbeat fault: {type(e).__name__}: {e}") \
+                from e
         self.beat_no += 1
         now = time.monotonic()
         if (self.expected_interval_s is not None
@@ -466,3 +486,10 @@ class StragglerMonitor:
             if pid not in flagged:
                 del self._consecutive[pid]  # re-arm: one clean report
         return rep
+
+
+# imported LAST: chaos.py imports this module's taxonomy, so a top-of-
+# file import would be circular — by this point every name chaos needs
+# exists, and beat()'s disarmed cost stays the documented single
+# module-global read instead of a per-call sys.modules lookup
+from . import chaos as _chaos  # noqa: E402

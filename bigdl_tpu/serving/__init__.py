@@ -60,8 +60,10 @@ from .decode_scheduler import (DecodeScheduler, LMRequest,
                                decode_scheduler_threads_alive,
                                prefill_schedule)
 from .router import PriorityClass, Router, router_threads_alive
-# the transient-failure classification is SHARED with the trainer's
-# FaultPolicy (parallel/failure.py): a batch whose compiled forward
-# fails with a transient device error is re-dispatched once before its
-# futures fail (see docs/RESILIENCE.md)
+# the transient-failure classification AND the retry budget are SHARED
+# with the trainer (parallel/failure.FaultPolicy): the engine's batch
+# retry, the scheduler's bitwise step replay and the router's
+# KV-preserving failover all branch on classify_failure — and the
+# parallel/chaos.py fault-injection plane drills every one of those
+# seams (docs/RESILIENCE.md "Serving faults", `make chaos-smoke`)
 from ..parallel.failure import TransientDeviceError  # noqa: F401

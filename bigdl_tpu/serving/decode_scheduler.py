@@ -72,6 +72,9 @@ from ..observability import cluster as _cluster
 from ..observability import flight as _flight
 from ..observability import health as _health
 from ..optim.predictor import bucket_for
+from ..parallel import chaos as _chaos
+from ..parallel.failure import (FaultPolicy, TransientDeviceError,
+                                classify_failure, TRANSIENT)
 from .batching import (DeadlineExceeded, EngineStopped, QueueFull,
                        ServeFuture)
 from .kv_cache import KVCacheOOM, PagedKVCache, blocks_for_tokens
@@ -84,7 +87,7 @@ _STAT_KEYS = ("submitted", "completed", "rejected", "timeouts",
               "decode_steps", "prefill_chunks", "tokens", "swaps",
               "spec_rounds", "spec_accepted", "defrags",
               "prefix_hits", "prefix_misses", "prefix_reused_tokens",
-              "prefix_cow_forks")
+              "prefix_cow_forks", "step_replays", "kv_corruptions")
 
 
 def _pow2_bucket(n: int, cap: int, floor: int = 2) -> int:
@@ -221,6 +224,31 @@ class DecodeScheduler:
     name : replica name — per-replica watchdog beacon
         (``serving/decode_scheduler[<name>]``) for Router health
         integration.
+    fault_policy : the Tier-2 retry budget for the compiled-step
+        dispatch path (the serving analog of
+        ``Optimizer.set_fault_policy``, one policy surface shared with
+        :class:`~.engine.ServingEngine`'s batch retry). Every decode
+        group / prefill chunk / speculative round snapshots the
+        host-side step state (page handles + per-row counters) BEFORE
+        dispatching; a failure classified TRANSIENT restores the
+        snapshot, backs off, and replays the identical dispatch — the
+        operands are immutable and the pages functional, so a replayed
+        step is bitwise the step a fault-free run takes. PERMANENT
+        failures (and an exhausted budget) kill the loop: a crash
+        bundle with per-request triage lands, and every in-flight
+        request fails typed :class:`EngineStopped` carrying its
+        already-generated tokens on ``.partial`` — the splice point
+        for the Router's KV-preserving failover. Default: one
+        immediate retry (``FaultPolicy(max_restarts=1,
+        backoff_base_s=0)``); pass ``FaultPolicy(max_restarts=0)`` to
+        disable replay.
+    audit_every : loop passes between KV-ledger audits
+        (:meth:`audit`; 0 disables the cadence — shutdown still
+        audits). A violation QUARANTINES the ledger instead of crashing
+        the loop: a ``health/kv_corruption`` event + crash bundle land
+        once, and admission stops creating NEW shared state (prefix
+        lookups/registrations bypass) while in-flight traffic keeps
+        draining.
     """
 
     def __init__(self, model, *, max_slots: int = 8, block_size: int = 16,
@@ -237,7 +265,9 @@ class DecodeScheduler:
                  prefix_cache: bool = True,
                  prefix_cache_entries: Optional[int] = None,
                  mesh=None, placement=None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 fault_policy: Optional[FaultPolicy] = None,
+                 audit_every: int = 256):
         if model.mode != "lm":
             raise ValueError("DecodeScheduler serves LM-mode models")
         if max_slots < 2:
@@ -348,6 +378,14 @@ class DecodeScheduler:
         self.stall_deadline_s = stall_deadline_s
         self._beacon = _health.NULL_BEACON
         self._snap_writer = _cluster.default_writer()
+        # Tier-2 replay: default is the engine's historical one-shot
+        # immediate retry, now expressed through the shared policy
+        self.fault_policy = (fault_policy if fault_policy is not None
+                             else FaultPolicy(max_restarts=1,
+                                              backoff_base_s=0.0))
+        self.audit_every = int(audit_every)
+        self._audit_tick = 0
+        self._quarantined = False
 
     def _build_step(self, model, name):
         """The ONE compiled paged decode step: next-token choices for
@@ -551,24 +589,10 @@ class DecodeScheduler:
         self._beacon.close()
         # hard stop (or a dead scheduler): fail whatever is left, free
         # its blocks — a client must never hang and a block never leak
-        leftovers = list(self._active) + list(self._prefilling)
-        self._active.clear()
-        self._prefilling.clear()
-        while True:
-            try:
-                leftovers.append(self._q.get_nowait())
-            except queue.Empty:
-                break
-        leftovers.extend(self._backlog)
-        self._backlog.clear()
-        for r in leftovers:
-            self._release(r)
-            if not r.future.done():
-                try:
-                    r.future.set_exception(EngineStopped(
-                        "scheduler shut down before completion"))
-                except Exception:
-                    pass
+        self._abandon_inflight("scheduler shut down before completion")
+        # the shutdown audit: the ledger must be consistent at the end
+        # of every run (violations quarantine + bundle, never raise)
+        self._audit("shutdown")
         # every owner is gone — drop the prefix cache's pins so the
         # shared pages return too (the kv_blocks_in_use -> 0 leak gate
         # holds on every shutdown path, sharing included)
@@ -698,6 +722,7 @@ class DecodeScheduler:
         out["active"] = len(self._active)
         out["prefilling"] = len(self._prefilling)
         out["active_version"] = self.registry.active_version
+        out["quarantined"] = self._quarantined
         out["kv"] = self.kv.stats()
         out["prefix"] = (self.prefix.stats() if self.prefix is not None
                          else None)
@@ -710,8 +735,10 @@ class DecodeScheduler:
         aligned down to ``hit_align``, so the router never steers a
         request toward a fragment admission will discard. Pure host
         work (a digest walk) — safe to call from router dispatch
-        threads; 0 with the cache disabled."""
-        if self.prefix is None:
+        threads; 0 with the cache disabled (or the ledger
+        quarantined — the router must not steer toward a cache
+        admission will refuse to adopt from)."""
+        if self.prefix is None or self._quarantined:
             return 0
         mv = self.registry.current()
         if mv is None:
@@ -719,18 +746,203 @@ class DecodeScheduler:
         t = self.prefix.peek(prompt_ids, mv.version)
         return t - t % self.hit_align
 
+    # -- transient step replay (Tier-2, ISSUE 13) ------------------------
+
+    def _snapshot_step_state(self, rows):
+        """Host-side snapshot of everything ONE compiled step group can
+        mutate, taken BEFORE the dispatch: the functional page handles
+        of both pools (the compiled step returns NEW handles — holding
+        the old ones IS the rollback) and the per-row decode counters.
+        Pure reference/int copies — no device touch, no allocation
+        proportional to model size."""
+        return (self.kv.pages(),
+                self.draft_kv.pages() if self.draft_kv is not None
+                else None,
+                [(r, r.pos, r.steps, len(r.generated), r.pf_i)
+                 for r in rows])
+
+    def _restore_step_state(self, snap):
+        pages, dpages, rows = snap
+        self.kv.set_pages(pages)
+        if dpages is not None:
+            self.draft_kv.set_pages(dpages)
+        for r, pos, steps, ngen, pf_i in rows:
+            r.pos, r.steps, r.pf_i = pos, steps, pf_i
+            del r.generated[ngen:]
+
+    def _replay_group(self, stage, rows, fn):
+        """Dispatch ``fn`` under the fault policy: a failure classified
+        into the policy's retry classes restores the pre-dispatch
+        snapshot, backs off (injectable sleep — fault drills run at
+        full speed), and replays. The operand arrays are immutable and
+        the snapshot restores the exact page handles, so a replayed
+        group is BITWISE the group a fault-free run dispatches — the
+        serving analog of the trainer's superstep replay. Failures
+        outside the budget/classes propagate to :meth:`_die` (crash
+        bundle + typed in-flight failures)."""
+        pol = self.fault_policy
+        snap = self._snapshot_step_state(rows)
+        while True:
+            try:
+                out = fn()
+            except BaseException as e:  # noqa: BLE001 — classify, maybe replay
+                cls = classify_failure(e)
+                self._restore_step_state(snap)
+                if pol is None or self._stop.is_set() \
+                        or not pol.should_retry(cls):
+                    raise
+                pol.record_failure()
+                self._bump("step_replays")
+                if obs.enabled():
+                    obs.counter("serve/step_replays").inc()
+                _health.emit("serve_step_replay", stage=stage,
+                             failure_class=cls, attempt=pol.consecutive,
+                             rids=[r.rid for r in rows],
+                             error=f"{type(e).__name__}: {e}")
+                delay = pol.backoff_s()
+                if delay > 0:
+                    pol.sleep(delay)
+                continue
+            if pol is not None:
+                pol.record_success()
+            return out
+
+    # -- KV ledger auditor (ISSUE 13) ------------------------------------
+
+    def audit(self) -> dict:
+        """Run the ledger invariant checker over the target pool (with
+        the prefix cache's exact pin map) and the draft pool. Pure host
+        work at a quiesced point — the scheduler thread runs it on the
+        ``audit_every`` cadence and at shutdown; callers may run it any
+        time the loop is not mid-dispatch. Returns the merged
+        :meth:`PagedKVCache.audit` report."""
+        pins = (self.prefix.pinned_blocks() if self.prefix is not None
+                else {})
+        rep = self.kv.audit(prefix_pins=pins)
+        if self.draft_kv is not None:
+            drep = self.draft_kv.audit(prefix_pins={})
+            rep = {"ok": rep["ok"] and drep["ok"],
+                   "violations": rep["violations"]
+                   + [f"draft: {v}" for v in drep["violations"]],
+                   "blocks": rep["blocks"] + drep["blocks"],
+                   "owners": rep["owners"] + drep["owners"]}
+        return rep
+
+    def _audit(self, where: str) -> dict:
+        """Cadence/shutdown audit: a violation QUARANTINES instead of
+        crashing — serving a corrupt ledger read-only beats killing
+        every in-flight client, but creating NEW shared state in it
+        (prefix adoption, registration) would spread the corruption, so
+        that stops. One ``health/kv_corruption`` event + crash bundle
+        land on the FIRST detection; later audits just count."""
+        rep = self.audit()
+        if rep["ok"]:
+            return rep
+        first = not self._quarantined
+        self._quarantined = True
+        if first:
+            # one corruption episode = ONE count on both surfaces (the
+            # stats key and the obs counter stay in lockstep, like
+            # every other stat here); later cadence audits of the same
+            # quarantined ledger change nothing
+            self._bump("kv_corruptions")
+            if obs.enabled():
+                obs.counter("serve/kv_corruptions").inc()
+            _health.emit("kv_corruption", component=self.beacon_name,
+                         where=where, n_violations=len(rep["violations"]),
+                         violations=rep["violations"][:8])
+            if obs.enabled():
+                _flight.dump_crash_bundle(error=None, context={
+                    "component": "serving/decode_scheduler",
+                    "event": "kv_corruption", "where": where,
+                    "violations": rep["violations"][:32],
+                    "requests": self._triage()})
+        return rep
+
     # -- scheduler loop --------------------------------------------------
 
     def _run(self):
         try:
             self._loop()
         except BaseException as e:  # noqa: BLE001 — post-mortem, then die
-            if obs.enabled():
-                _flight.dump_crash_bundle(error=e, context={
-                    "component": "serving/decode_scheduler",
-                    "stats": {k: v for k, v in self.stats().items()
-                              if k != "kv"}})
+            self._die(e)
             raise
+
+    def _triage(self):
+        """Per-request state for the crash bundle: who was in flight,
+        how far along, and what it held — the table
+        ``tools/flight_report.py`` renders as the post-mortem's
+        in-flight section."""
+        out = []
+
+        def add(r, stage):
+            out.append({"rid": r.rid, "stage": stage,
+                        "prompt_len": int(r.prompt.size),
+                        "tokens": len(r.generated),
+                        "kv_blocks": self.kv.owned(r.rid),
+                        "version": r.version})
+
+        for r in self._active:
+            add(r, "decode")
+        for r in self._prefilling:
+            add(r, "prefill")
+        for r in self._backlog:
+            add(r, "backlog")
+        return out
+
+    def _die(self, error):
+        """Loop death (a PERMANENT dispatch fault, or an exhausted
+        replay budget): land the crash bundle WITH per-request triage,
+        then fail every in-flight request typed — active/prefilling
+        requests carry the tokens they already generated on
+        ``exc.partial``, which is what lets a Router failover re-seed a
+        survivor with ``prompt + partial`` instead of losing the decode
+        state — and return every block so the ledger drains."""
+        if obs.enabled():
+            _flight.dump_crash_bundle(error=error, context={
+                "component": "serving/decode_scheduler",
+                "failure_class": classify_failure(error),
+                "requests": self._triage(),
+                "stats": {k: v for k, v in self.stats().items()
+                          if k not in ("kv", "prefix")}})
+        with self._cond:
+            self._closed = True
+        self._abandon_inflight(
+            f"decode scheduler died: {type(error).__name__}: {error}")
+        if self.prefix is not None:
+            self.prefix.clear()
+        self._beacon.close()
+
+    def _abandon_inflight(self, msg: str):
+        """Gather every request the scheduler still holds (active,
+        prefilling, backlogged, queued), release their resources, and
+        fail each typed :class:`EngineStopped` with the generated
+        prefix attached on ``.partial`` — the Router's KV-preserving
+        splice point. Both death paths (shutdown's hard-stop cleanup
+        and :meth:`_die`) share this, so the partial-carrying contract
+        cannot drift between them."""
+        leftovers = list(self._active) + list(self._prefilling) \
+            + list(self._backlog)
+        self._active.clear()
+        self._prefilling.clear()
+        self._backlog.clear()
+        while True:
+            try:
+                leftovers.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        for r in leftovers:
+            partial = np.asarray(r.generated, np.int32)
+            self._release(r)
+            if not r.future.done():
+                exc = EngineStopped(msg)
+                # these tokens are real — bitwise the uninterrupted
+                # run's prefix — so a failover can resume from them
+                exc.partial = partial
+                try:
+                    r.future.set_exception(exc)
+                except Exception:
+                    pass
 
     def _loop(self):
         """The iteration-level loop: every pass is one step boundary —
@@ -751,11 +963,27 @@ class DecodeScheduler:
             self._evict_expired()
             if self._defrag_wanted.is_set():
                 self._defrag_wanted.clear()
-                n = self.kv.defrag()
-                if self.draft_kv is not None:
-                    n += self.draft_kv.defrag()
-                if n:
-                    self._bump("defrags")
+                try:
+                    n = self.kv.defrag()
+                    if self.draft_kv is not None:
+                        n += self.draft_kv.defrag()
+                except Exception as e:  # noqa: BLE001 — transient = skip
+                    # a TRANSIENT page-copy failure aborts the repack
+                    # with the ledger untouched — skip the round (the
+                    # next defrag() request retries) rather than kill
+                    # every in-flight generation over an optimization
+                    if classify_failure(e) != TRANSIENT:
+                        raise
+                    _health.emit("serve_defrag_skipped",
+                                 error=f"{type(e).__name__}: {e}")
+                else:
+                    if n:
+                        self._bump("defrags")
+            if self.audit_every > 0:
+                self._audit_tick += 1
+                if self._audit_tick >= self.audit_every:
+                    self._audit_tick = 0
+                    self._audit("cadence")
             if self._closed and not self._active and not self._prefilling \
                     and not self._backlog and self._q.empty():
                 break
@@ -852,14 +1080,17 @@ class DecodeScheduler:
                         # time would then OOM mid-flight (the invariant
                         # this whole block exists to uphold)
                         forked = self.kv.fork_blocks(req.rid, fork_idxs)
-                except KVCacheOOM:
+                except (KVCacheOOM, TransientDeviceError):
                     # undo the adoption and any partial growth — a
                     # deferred request must leave the ledger untouched
                     self.kv.free(req.rid)
                     raise
-            except KVCacheOOM:
+            except (KVCacheOOM, TransientDeviceError):
                 # backpressure: leave it queued — eviction will free
-                # blocks and the next boundary retries
+                # blocks and the next boundary retries. A TRANSIENT
+                # fault in the admission transaction (an injected
+                # cow-fork/evict failure) takes the same deferral:
+                # the transaction unwound, the request just waits
                 break
             self._backlog.popleft()
             req.slot = self._free_slots.pop()
@@ -910,7 +1141,9 @@ class DecodeScheduler:
         OOM it mid-flight."""
         req.hit_tokens = 0
         req.adopted_n = 0
-        if self.prefix is None:
+        if self.prefix is None or self._quarantined:
+            # a quarantined ledger serves, but adopting shared pages
+            # out of it would spread whatever the auditor caught
             return cold, [], []
         bs = self.kv.block_size
         chain = self.prefix.lookup(req.prompt, version)
@@ -937,12 +1170,21 @@ class DecodeScheduler:
         Blocks already indexed (the adopted prefix, or a concurrent
         twin that registered first) are refreshed, not re-inserted, so
         a shared system prompt stays resident ONCE."""
-        if self.prefix is None:
+        if self.prefix is None or self._quarantined:
             return
         nfull = int(req.prompt.size) // self.kv.block_size
-        if nfull:
+        if not nfull:
+            return
+        try:
             self.prefix.insert(req.prompt, req.version,
                                self.kv.owner_blocks(req.rid)[:nfull])
+        except Exception as e:  # noqa: BLE001 — transient = degrade
+            # a TRANSIENT failure registering the prefix (injected
+            # index fault) costs future hits, never correctness — skip
+            if classify_failure(e) != TRANSIENT:
+                raise
+            _health.emit("prefix_insert_skipped", rid=req.rid,
+                         error=f"{type(e).__name__}: {e}")
 
     def _advance_prefill(self) -> bool:
         """ONE prefill chunk for the head admitted-but-prefilling
@@ -964,26 +1206,36 @@ class DecodeScheduler:
         # copy-on-write inside the admission transaction (_admit)
         toks = np.zeros((1, padded), np.int32)
         toks[0, :real] = req.prompt[s:s + real]
-        with obs.span("serve/prefill", rid=req.rid, chunk=req.pf_i,
-                      of=len(req.chunks), version=req.version):
-            table = self.kv.block_table(req.rid)[None]
-            choices, pages = self._step_jit(
-                mv.params, self.kv.pages(), self._put(toks),
-                self._put(np.asarray([s], np.int32)), self._put(table),
-                *self._sampling_args([req], 1))
-            self.kv.set_pages(pages)
-            if self.draft_kv is not None:
-                dtable = self.draft_kv.block_table(req.rid)[None]
-                _, dpages = self._draft_jit(
-                    self._draft_params(), self.draft_kv.pages(),
-                    self._put(toks), self._put(np.asarray([s], np.int32)),
-                    self._put(dtable), *self._sampling_args((), 1))
-                self.draft_kv.set_pages(dpages)
-            first_tok = None
-            if last:
-                # sync-ok: the first generated token — the client's
-                # TTFT — is exactly this readback
-                first_tok = int(np.asarray(choices)[0, real - 1])
+
+        def dispatch():
+            _chaos.maybe_fire("serving/prefill", tag=self.name)
+            with obs.span("serve/prefill", rid=req.rid, chunk=req.pf_i,
+                          of=len(req.chunks), version=req.version):
+                table = self.kv.block_table(req.rid)[None]
+                choices, pages = self._step_jit(
+                    mv.params, self.kv.pages(), self._put(toks),
+                    self._put(np.asarray([s], np.int32)),
+                    self._put(table), *self._sampling_args([req], 1))
+                dpages = None
+                if self.draft_kv is not None:
+                    dtable = self.draft_kv.block_table(req.rid)[None]
+                    _, dpages = self._draft_jit(
+                        self._draft_params(), self.draft_kv.pages(),
+                        self._put(toks),
+                        self._put(np.asarray([s], np.int32)),
+                        self._put(dtable), *self._sampling_args((), 1))
+                first_tok = None
+                if last:
+                    # sync-ok: the first generated token — the client's
+                    # TTFT — is exactly this readback
+                    first_tok = int(np.asarray(choices)[0, real - 1])
+                return first_tok, pages, dpages
+
+        first_tok, pages, dpages = self._replay_group(
+            "prefill", [req], dispatch)
+        self.kv.set_pages(pages)
+        if dpages is not None:
+            self.draft_kv.set_pages(dpages)
         self._bump("prefill_chunks")
         req.pf_i += 1
         req.prefill_ms += (time.perf_counter_ns() - t0) / 1e6
@@ -1059,16 +1311,21 @@ class DecodeScheduler:
             tables[i] = self.kv.block_table(r.rid)
         mv = rows[0].model_version
         rids = [r.rid for r in rows]
-        with obs.span("serve/decode_step", rids=rids, bucket=bucket,
-                      version=version):
-            choices, pages = self._step_jit(
-                mv.params, self.kv.pages(), self._put(tokens),
-                self._put(positions), self._put(tables),
-                *self._sampling_args(rows, bucket))
-            # sync-ok: the per-step token readback — EOS detection and
-            # per-client streaming both need the ids on host; this is
-            # the one deliberate sync of the decode loop
-            toks = np.asarray(choices)[:, 0]
+
+        def dispatch():
+            _chaos.maybe_fire("serving/scheduler_step", tag=self.name)
+            with obs.span("serve/decode_step", rids=rids, bucket=bucket,
+                          version=version):
+                choices, pages = self._step_jit(
+                    mv.params, self.kv.pages(), self._put(tokens),
+                    self._put(positions), self._put(tables),
+                    *self._sampling_args(rows, bucket))
+                # sync-ok: the per-step token readback — EOS detection
+                # and per-client streaming both need the ids on host;
+                # this is the one deliberate sync of the decode loop
+                return np.asarray(choices)[:, 0], pages
+
+        toks, pages = self._replay_group("decode", rows, dispatch)
         self.kv.set_pages(pages)
         self._bump("decode_steps")
         self._bump("tokens", n)
@@ -1096,33 +1353,42 @@ class DecodeScheduler:
         pos0 = req.pos
         dmv = self._draft_params()
         dtable = self.draft_kv.block_table(req.rid)[None]
-        drafts = []
-        tok = last
-        with obs.span("serve/spec_round", rid=req.rid, k=k,
-                      version=req.version):
-            # k+1 draft steps: the extra step writes d_k's K/V so a
-            # fully-accepted round leaves no cache hole (speculative.py)
-            for i in range(k + 1):
-                choices, dpages = self._draft_jit(
-                    dmv, self.draft_kv.pages(),
-                    jnp.asarray([[tok]], np.int32),
-                    jnp.asarray([pos0 + i], np.int32), jnp.asarray(dtable),
-                    *self._sampling_args((), 1))
-                self.draft_kv.set_pages(dpages)
-                # sync-ok: draft proposals drive the verify chunk's
-                # token ids — the round is host-driven by design
-                tok = int(np.asarray(choices)[0, 0])
-                if i < k:
-                    drafts.append(tok)
-            chunk = np.asarray([[last] + drafts], np.int32)   # (1, k+1)
-            table = self.kv.block_table(req.rid)[None]
-            choices, pages = self._step_jit(
-                req.model_version.params, self.kv.pages(),
-                jnp.asarray(chunk), jnp.asarray([pos0], np.int32),
-                jnp.asarray(table), *self._sampling_args((), 1))
-            self.kv.set_pages(pages)
-            # sync-ok: verify readback — acceptance happens on host
-            target = np.asarray(choices)[0]                    # (k+1,)
+
+        def round_fn():
+            _chaos.maybe_fire("serving/spec_round", tag=self.name)
+            drafts = []
+            tok = last
+            with obs.span("serve/spec_round", rid=req.rid, k=k,
+                          version=req.version):
+                # k+1 draft steps: the extra step writes d_k's K/V so a
+                # fully-accepted round leaves no cache hole
+                # (speculative.py)
+                for i in range(k + 1):
+                    choices, dpages = self._draft_jit(
+                        dmv, self.draft_kv.pages(),
+                        jnp.asarray([[tok]], np.int32),
+                        jnp.asarray([pos0 + i], np.int32),
+                        jnp.asarray(dtable), *self._sampling_args((), 1))
+                    self.draft_kv.set_pages(dpages)
+                    # sync-ok: draft proposals drive the verify chunk's
+                    # token ids — the round is host-driven by design
+                    tok = int(np.asarray(choices)[0, 0])
+                    if i < k:
+                        drafts.append(tok)
+                chunk = np.asarray([[last] + drafts], np.int32)  # (1,k+1)
+                table = self.kv.block_table(req.rid)[None]
+                choices, pages = self._step_jit(
+                    req.model_version.params, self.kv.pages(),
+                    jnp.asarray(chunk), jnp.asarray([pos0], np.int32),
+                    jnp.asarray(table), *self._sampling_args((), 1))
+                self.kv.set_pages(pages)
+                # sync-ok: verify readback — acceptance happens on host
+                return drafts, np.asarray(choices)[0]          # (k+1,)
+
+        # the replay snapshot covers BOTH pools' page handles, so a
+        # transient mid-round (after some draft writes) rolls the whole
+        # round back and replays it from the original pages — bitwise
+        drafts, target = self._replay_group("spec", [req], round_fn)
         j = 0
         while j < k and drafts[j] == int(target[j]):
             j += 1

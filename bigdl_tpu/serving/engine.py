@@ -42,7 +42,8 @@ from ..observability import health as _health
 from ..optim.predictor import bucket_for, pad_leading, shape_buckets, \
     shared_forward
 from ..optim.staging import place_host_value
-from ..parallel.failure import TRANSIENT, classify_failure
+from ..parallel import chaos as _chaos
+from ..parallel.failure import FaultPolicy, classify_failure
 from .batching import (DeadlineExceeded, EngineStopped, QueueFull, Request,
                        ServeFuture, assemble)
 from .registry import ModelRegistry
@@ -88,6 +89,17 @@ class ServingEngine:
     name : replica name — distinguishes this engine's watchdog beacon
         (``serving/batcher[<name>]``) and metrics provenance when N
         replicas serve behind a :class:`~.router.Router`.
+    fault_policy : the Tier-2 retry budget for the batch dispatch —
+        ONE policy surface shared with the trainer
+        (``Optimizer.set_fault_policy``) and the
+        :class:`~.decode_scheduler.DecodeScheduler`'s step replay: max
+        CONSECUTIVE retries, exponential backoff, injectable sleep. A
+        failure classified TRANSIENT (``parallel/failure.
+        classify_failure``) re-dispatches the same batch; anything
+        else — or an exhausted budget — fails the batch's futures and
+        the batcher lives on. Default ``FaultPolicy(max_restarts=1,
+        backoff_base_s=0)`` — the historical one-shot immediate retry;
+        ``FaultPolicy(max_restarts=0)`` disables retry entirely.
     """
 
     def __init__(self, model, *, input_shape: Optional[Sequence[int]] = None,
@@ -98,7 +110,8 @@ class ServingEngine:
                  warmup: bool = True,
                  stall_deadline_s: Optional[float] = None,
                  mesh=None, placement=None, batch_spec=None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 fault_policy: Optional[FaultPolicy] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue < 1:
@@ -161,6 +174,9 @@ class ServingEngine:
         # queue→assemble→dispatch→scatter so the three stage spans and
         # the future's trace dict all name the same request
         self._rids = itertools.count()
+        self.fault_policy = (fault_policy if fault_policy is not None
+                             else FaultPolicy(max_restarts=1,
+                                              backoff_base_s=0.0))
         self.stall_deadline_s = stall_deadline_s
         self._beacon = _health.NULL_BEACON
         # serving processes join the cluster metric view too (same
@@ -435,6 +451,7 @@ class ServingEngine:
         t_fwd_ns = time.perf_counter_ns()
 
         def forward():
+            _chaos.maybe_fire("serving/engine_dispatch", tag=self.name)
             xd = self._place_batch(pad_leading(x, bucket))
             out = self._fwd(mv.params, mv.state, xd)
             # sync-ok: serving result readback — the micro-batch
@@ -442,33 +459,47 @@ class ServingEngine:
             # exactly this result
             return np.asarray(out)
 
+        pol = self.fault_policy
         try:
             with sp:
-                try:
-                    with obs.span("serve/dispatch", rids=rids,
-                                  bucket=bucket, version=mv.version):
-                        host = forward()
-                except BaseException as e:  # noqa: BLE001 — maybe transient
-                    # one-shot replay of a TRANSIENT device failure (the
-                    # classification shared with the trainer's
-                    # FaultPolicy — parallel/failure.classify_failure):
-                    # a dropped tunnel packet should cost the batch one
-                    # re-dispatch, not fail every client in it. One
-                    # attempt only — a batcher that retries in a loop is
-                    # a batcher that head-of-line-blocks the queue.
-                    if classify_failure(e) != TRANSIENT \
-                            or self._stop.is_set():
-                        raise
-                    self._bump("transient_retries")
-                    if obs.enabled():
-                        obs.counter("serve/transient_retries").inc()
-                        _health.emit("serve_retry", bucket=bucket, n=n,
-                                     version=mv.version,
-                                     error=f"{type(e).__name__}: {e}")
-                    with obs.span("serve/retry_dispatch", rids=rids,
-                                  bucket=bucket, version=mv.version):
-                        host = forward()
+                attempt = 0
+                while True:
+                    try:
+                        with obs.span("serve/dispatch" if attempt == 0
+                                      else "serve/retry_dispatch",
+                                      rids=rids, bucket=bucket,
+                                      version=mv.version):
+                            host = forward()
+                        pol.record_success()
+                        break
+                    except BaseException as e:  # noqa: BLE001 — classify
+                        # Tier-2 replay through the ONE shared policy
+                        # surface (parallel/failure.FaultPolicy — the
+                        # trainer's and the decode scheduler's): a
+                        # TRANSIENT failure re-dispatches after backoff,
+                        # max_restarts bounds CONSECUTIVE failures so a
+                        # flaky transport is absorbed but a persistent
+                        # one never head-of-line-blocks the queue
+                        cls = classify_failure(e)
+                        if self._stop.is_set() or not pol.should_retry(cls):
+                            raise
+                        pol.record_failure()
+                        attempt += 1
+                        self._bump("transient_retries")
+                        if obs.enabled():
+                            obs.counter("serve/transient_retries").inc()
+                            _health.emit("serve_retry", bucket=bucket, n=n,
+                                         version=mv.version, attempt=attempt,
+                                         error=f"{type(e).__name__}: {e}")
+                        delay = pol.backoff_s()
+                        if delay > 0:
+                            pol.sleep(delay)
         except BaseException as e:  # noqa: BLE001 — batch fails, batcher lives
+            # THIS batch is done failing; the next batch is a fresh
+            # dispatch unit and gets its own retry budget (without the
+            # reset, one exhausted batch would disable the transient
+            # safety net for every batch after it)
+            pol.reset()
             self._bump("batch_errors")
             if obs.enabled():
                 obs.counter("serve/batch_errors").inc()

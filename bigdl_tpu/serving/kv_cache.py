@@ -55,6 +55,7 @@ bitwise-identical whether it decodes alone or mid-swarm.
 from __future__ import annotations
 
 import threading
+from collections import Counter
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -62,6 +63,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as obs
+from ..parallel import chaos as _chaos
 
 
 class KVCacheOOM(RuntimeError):
@@ -300,6 +302,7 @@ class PagedKVCache:
         forked. Raises :class:`KVCacheOOM` when the free list cannot
         cover the forks — admission control reserves fork headroom
         up front precisely so this never fires mid-flight."""
+        _chaos.maybe_fire("kv/cow_fork")
         moves = []                     # (src_physical, dst_physical)
         forked: List[int] = []
         with self._lock:
@@ -389,7 +392,13 @@ class PagedKVCache:
         the prefix-cache index (remap listeners) follows it, refcount
         untouched. Returns the number of blocks moved (``serve/kv_
         defrag_moves``). Run at a step boundary — tables handed to an
-        in-flight dispatch must not be rewritten under it."""
+        in-flight dispatch must not be rewritten under it.
+
+        The ``kv/page_copy`` chaos site fires BEFORE the ledger lock:
+        an injected fault aborts the repack with the ledger untouched
+        (the scheduler skips the round and retries on the next
+        request)."""
+        _chaos.maybe_fire("kv/page_copy")
         with self._lock:
             live = sorted(self._refs)
             n = len(live)
@@ -423,6 +432,82 @@ class PagedKVCache:
             obs.counter(f"{self.metric_prefix}_defrag_moves").inc(len(moves))
         self._set_gauges()
         return len(moves)
+
+    # -- auditor ---------------------------------------------------------
+
+    def audit(self, prefix_pins: Optional[Dict[int, int]] = None) -> dict:
+        """Ledger invariant checker (ISSUE 13). Pure host work over ONE
+        consistent snapshot of the ledger (taken under the lock, checked
+        outside it); NEVER raises on a violation — the caller decides
+        whether to quarantine (the scheduler does) or crash. Returns
+        ``{"ok", "violations": [str, ...], "blocks": n, "owners": n}``.
+
+        Invariants:
+
+        * **partition** — every physical id 1..num_blocks-1 is on the
+          free list XOR referenced, exactly once; block 0 (the reserved
+          null block) is neither.
+        * **refcount vs owner tables** — a block's table references
+          never exceed its refcount (an excess table entry is aliasing:
+          two owners writing one page without the sharing contract),
+          every table entry points at a live block, and no owner's
+          table references the same physical block twice.
+        * **ownerless pins** (with ``prefix_pins``, the prefix cache's
+          ``pinned_blocks()`` map) — refcount minus table references
+          equals EXACTLY the cache's pins per block, and every pinned
+          block is live: a prefix entry whose page was freed under it
+          would hand garbage KV to the next adopter. Pass ``{}`` for a
+          cache with no ownerless pinner (the speculative draft pool);
+          ``None`` skips the exactness check (refcount may exceed table
+          references by unknown pins).
+        """
+        with self._lock:
+            free = list(self._free)
+            refs = dict(self._refs)
+            owned = {o: list(b) for o, b in self._owned.items()}
+        v: List[str] = []
+        freeset = set(free)
+        if len(freeset) != len(free):
+            dup = sorted(b for b, c in Counter(free).items() if c > 1)
+            v.append(f"free list holds duplicate block ids {dup[:8]}")
+        if 0 in freeset:
+            v.append("reserved null block 0 is on the free list")
+        if 0 in refs:
+            v.append("reserved null block 0 carries a refcount")
+        both = sorted(freeset & set(refs))
+        if both:
+            v.append(f"blocks both free and referenced: {both[:8]}")
+        lost = sorted(set(range(1, self.num_blocks)) - freeset - set(refs))
+        if lost:
+            v.append(f"blocks neither free nor referenced (leaked): "
+                     f"{lost[:8]}")
+        table_refs: Counter = Counter()
+        for owner, blocks in owned.items():
+            dup = sorted(b for b, c in Counter(blocks).items() if c > 1)
+            if dup:
+                v.append(f"owner {owner!r} table aliases block(s) "
+                         f"{dup[:8]}")
+            for b in blocks:
+                table_refs[b] += 1
+                if b not in refs:
+                    v.append(f"owner {owner!r} references dead block {b}")
+        for b in sorted(refs):
+            r = refs[b]
+            t = table_refs.get(b, 0)
+            if r < 1:
+                v.append(f"block {b} has non-positive refcount {r}")
+            if t > r:
+                v.append(f"block {b} aliased: {t} table references "
+                         f"exceed refcount {r}")
+            elif prefix_pins is not None and r - t != prefix_pins.get(b, 0):
+                v.append(f"block {b} refcount {r} != {t} table refs + "
+                         f"{prefix_pins.get(b, 0)} prefix pins")
+        if prefix_pins:
+            dead = sorted(b for b in prefix_pins if b not in refs)
+            if dead:
+                v.append(f"prefix entries pin dead block(s) {dead[:8]}")
+        return {"ok": not v, "violations": v,
+                "blocks": self.num_blocks - 1, "owners": len(owned)}
 
     # -- telemetry -------------------------------------------------------
 

@@ -61,6 +61,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .. import observability as obs
+from ..parallel import chaos as _chaos
 from .kv_cache import PagedKVCache
 
 
@@ -167,7 +168,13 @@ class PrefixCache:
         are the owner's physical ids for chain positions 0..len-1 (the
         scheduler passes its table's head). Entries already present are
         refreshed; new entries retain the owner's page (it becomes
-        shared and read-only). Returns the number of NEW entries."""
+        shared and read-only). Returns the number of NEW entries.
+
+        The ``prefix/insert`` chaos site fires before any index
+        mutation: an injected fault costs the cache one entry, never
+        its consistency (the scheduler degrades to skipping the
+        registration)."""
+        _chaos.maybe_fire("prefix/insert")
         keys = chain_keys(token_ids, self.block_size, version,
                           max_blocks=len(owner_blocks))
         new = 0
@@ -207,6 +214,7 @@ class PrefixCache:
         used first, leaves before parents. Entries some live request
         still adopts (refcount >= 2) are never touched. Returns the
         number of pages actually returned to the free list."""
+        _chaos.maybe_fire("prefix/evict")
         freed = 0
         # batched passes: each pass sweeps the LRU order ONCE and takes
         # every currently-eligible leaf (a per-victim restart would be
@@ -260,6 +268,17 @@ class PrefixCache:
         with self._lock:
             for e in self._entries.values():
                 e.block = remap.get(e.block, e.block)
+
+    def pinned_blocks(self) -> dict:
+        """``{physical_block: pin_count}`` for every resident entry —
+        the ownerless references this cache holds in the ledger, handed
+        to :meth:`PagedKVCache.audit` so the auditor can demand EXACT
+        refcount accounting (refcount == table refs + these pins)."""
+        with self._lock:
+            out: dict = {}
+            for e in self._entries.values():
+                out[e.block] = out.get(e.block, 0) + 1
+            return out
 
     def stats(self) -> dict:
         with self._lock:

@@ -60,10 +60,14 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
+import numpy as np
+
 from .. import observability as obs
 from ..observability import cluster as _cluster
 from ..observability import flight as _flight
 from ..observability import health as _health
+from ..parallel import chaos as _chaos
+from ..parallel.failure import TRANSIENT, classify_failure
 from .batching import (DeadlineExceeded, EngineStopped, QueueFull,
                        ServeFuture)
 
@@ -71,7 +75,13 @@ THREAD_NAME = "bigdl_tpu-serving-router"
 
 _STAT_KEYS = ("submitted", "completed", "rejected", "doomed", "dispatches",
               "failovers", "drains", "rejoins", "deadline_misses",
-              "replica_full", "affinity_hits", "affinity_bypassed")
+              "replica_full", "affinity_hits", "affinity_bypassed",
+              "kv_recoveries", "dispatch_retries")
+
+#: per-request cap on transient-classified submit failures: a transport
+#: that keeps presenting as transient is not transient — past this the
+#: request fails typed instead of park-and-retrying forever
+_MAX_DISPATCH_RETRIES = 32
 
 
 def _metric_cls(name: str) -> str:
@@ -128,18 +138,27 @@ class _ClassQueue:
         self.cls = cls
         self.q: deque = deque()
         self.deficit = 0.0
-        self.ewma_ms: Optional[float] = None  # observed service time
+        # the ADMISSION estimate the doomed check reads: the best
+        # (minimum) per-replica service-time EWMA across currently
+        # HEALTHY replicas — kept per replica (``_Replica.ewma_ms``)
+        # and re-derived on drain/rejoin, so a recovered replica's
+        # pre-stall latencies can never doom tight requests (ISSUE 13)
+        self.ewma_ms: Optional[float] = None
 
 
 class _RouterRequest:
     __slots__ = ("payload", "kw", "klass", "future", "rid", "deadline",
                  "t_enqueue", "t_enqueue_ns", "t_dispatch_ns", "failovers",
-                 "epoch")
+                 "epoch", "recovered", "dispatch_retries")
 
     def __init__(self, payload, kw, klass, rid,
                  deadline_s: Optional[float]):
         self.payload = payload
         self.kw = kw
+        # tokens a dying replica already decoded for this request
+        # (KV-preserving failover splices them into the payload and the
+        # final result — see Router._recover_decode)
+        self.recovered: Optional[np.ndarray] = None
         self.klass = klass
         self.future = ServeFuture()
         self.future.rid = rid
@@ -150,6 +169,7 @@ class _RouterRequest:
         self.deadline = (self.t_enqueue + deadline_s
                          if deadline_s is not None else None)
         self.failovers = 0
+        self.dispatch_retries = 0
         # dispatch epoch: bumped on every failover so a LATE resolution
         # of an abandoned inner future (a drained replica finishing or
         # dying after its work was re-routed) is recognizably stale and
@@ -168,7 +188,7 @@ class _RouterRequest:
 
 class _Replica:
     __slots__ = ("engine", "name", "healthy", "dead", "inflight",
-                 "by_class")
+                 "by_class", "ewma_ms")
 
     def __init__(self, engine, name: str):
         self.engine = engine
@@ -177,6 +197,7 @@ class _Replica:
         self.dead = False            # EngineStopped — no rejoin possible
         self.inflight: set = set()   # _RouterRequest currently submitted
         self.by_class: Dict[str, int] = {}   # outstanding per class
+        self.ewma_ms: Dict[str, float] = {}  # per-class service time
 
     @property
     def beacon_name(self) -> str:
@@ -595,6 +616,7 @@ class Router:
         rem = req.remaining_ms(now)
         for rep in order:
             try:
+                _chaos.maybe_fire("router/dispatch", tag=rep.name)
                 inner = rep.engine.submit(req.payload, deadline_ms=rem,
                                           **req.kw)
             except QueueFull:
@@ -606,6 +628,19 @@ class Router:
                 self._mark_unhealthy(rep, "engine_stopped")
                 continue
             except BaseException as e:  # noqa: BLE001 — fail THIS request
+                if classify_failure(e) == TRANSIENT \
+                        and req.dispatch_retries < _MAX_DISPATCH_RETRIES:
+                    # a transient dispatch-path failure (flaky replica
+                    # transport, injected fault) is worth the NEXT
+                    # replica, not this request's life — bounded per
+                    # request: a transport that NEVER stops presenting
+                    # transient eventually fails the request typed
+                    # instead of park-and-retrying forever
+                    req.dispatch_retries += 1
+                    self._bump("dispatch_retries")
+                    if obs.enabled():
+                        obs.counter("serve/router_dispatch_retries").inc()
+                    continue
                 self._fail(req, e)
                 return True
             with self._lock:
@@ -710,7 +745,6 @@ class Router:
             return
         exc = inner.exception()
         if exc is None:
-            cq = self._classes[req.klass]
             lat_ms = (time.perf_counter_ns() - req.t_enqueue_ns) / 1e6
             # the doomed-at-admission estimate is SERVICE time (dispatch
             # -> done), not end-to-end latency: a backlog inflates queue
@@ -718,36 +752,140 @@ class Router:
             # keep dooming tight requests long after replicas went idle
             svc_ms = ((time.perf_counter_ns() - req.t_dispatch_ns) / 1e6
                       if req.t_dispatch_ns is not None else lat_ms)
-            cq.ewma_ms = (svc_ms if cq.ewma_ms is None
-                          else 0.8 * cq.ewma_ms + 0.2 * svc_ms)
-            req.future.version = getattr(inner, "version", None)
-            trace = dict(getattr(inner, "trace", None) or {})
-            trace["router"] = {"class": req.klass, "replica": rep.name,
-                               "failovers": req.failovers,
-                               "latency_ms": round(lat_ms, 3)}
-            req.future.trace = trace
-            self._bump("completed")
-            if obs.enabled():
-                obs.counter("serve/router_completed").inc()
-                obs.histogram(
-                    f"serve/router_latency_ms_{_metric_cls(req.klass)}",
-                    unit="ms").observe(lat_ms)
-            try:
-                req.future.set_result(inner.result())
-            except Exception:
-                pass
+            with self._lock:
+                prev = rep.ewma_ms.get(req.klass)
+                rep.ewma_ms[req.klass] = (svc_ms if prev is None
+                                          else 0.8 * prev + 0.2 * svc_ms)
+                self._reseed_ewma_locked(req.klass)
+            res = inner.result()
+            if req.recovered is not None:
+                # KV-preserving failover: the survivor only decoded
+                # the CONTINUATION — the client gets the dead replica's
+                # tokens followed by the survivor's, which is bitwise
+                # the uninterrupted stream. A splice that fails (a
+                # result that is not a token vector) must FAIL the
+                # future, never strand it.
+                try:
+                    res = np.concatenate([
+                        req.recovered,
+                        np.asarray(res, np.int32).reshape(-1)])
+                except Exception as e:  # noqa: BLE001 — typed, not stuck
+                    self._fail(req, e)
+                    return
+            self._complete(req, res, replica=rep.name,
+                           base_trace=getattr(inner, "trace", None),
+                           version=getattr(inner, "version", None))
             return
         if isinstance(exc, DeadlineExceeded):
+            # _miss splices req.recovered ahead of the survivor's
+            # continuation partial (_carry_recovered) — one splice
+            # point for every terminal path
             self._miss(req, self._classes[req.klass], str(exc), exc=exc)
             return
         if isinstance(exc, (EngineStopped, QueueFull)) \
                 and not self._stop.is_set() \
                 and req.failovers < self.max_failovers:
+            if self._recover_decode(req, exc):
+                return  # the partial already completed the request
             self._failover(req, rep, reason=type(exc).__name__)
             return
         self._fail(req, exc)
 
+    def _recover_decode(self, req: _RouterRequest, exc) -> bool:
+        """KV-preserving decode recovery (ISSUE 13). A dying
+        :class:`~.decode_scheduler.DecodeScheduler` fails its in-flight
+        requests typed with the tokens it already generated on
+        ``exc.partial``; instead of re-running the whole generation
+        from scratch on a survivor, splice that progress into the
+        request before the failover re-queues it:
+
+        * payload becomes ``prompt + partial`` — the survivor prefills
+          the full token history (a PREFIX HIT where its cache already
+          holds the prompt: the re-prefill collapses to the partial's
+          tail chunks);
+        * ``max_new_tokens`` shrinks by the tokens already produced;
+        * the final result is ``partial + continuation``.
+
+        Greedy decode — and seeded sampling, whose keys derive from
+        (seed, absolute position) in-program — is a pure function of
+        the token history, so the recovered stream is BITWISE the
+        uninterrupted run (the `make chaos-smoke` gate). Host-only
+        bookkeeping — never a device touch. Returns True when the
+        partial already exhausted the budget (the request is resolved
+        here, nothing left to re-dispatch); False falls through to the
+        plain whole-prompt failover."""
+        partial = getattr(exc, "partial", None)
+        if partial is None:
+            return False
+        partial = np.asarray(partial, np.int32).reshape(-1)
+        if partial.size == 0:
+            return False
+        mnt = req.kw.get("max_new_tokens")
+        if mnt is None:
+            return False  # not a decode-shaped request
+        try:
+            payload = np.asarray(req.payload, np.int32).reshape(-1)
+        except (TypeError, ValueError):
+            return False
+        self._bump("kv_recoveries")
+        if obs.enabled():
+            obs.counter("serve/router_kv_recoveries").inc()
+        _health.emit("router_kv_recovery", rid=req.rid,
+                     tokens=int(partial.size))
+        req.recovered = (partial if req.recovered is None
+                         else np.concatenate([req.recovered, partial]))
+        req.payload = np.concatenate([payload, partial])
+        req.kw = dict(req.kw)
+        req.kw["max_new_tokens"] = int(mnt) - int(partial.size)
+        if req.kw["max_new_tokens"] <= 0:
+            # the dead replica had already produced the full budget —
+            # its answer is complete; resolve instead of re-dispatching
+            # a zero-token request (replica=None in the trace: no
+            # survivor served a continuation)
+            self._complete(req, req.recovered, replica=None,
+                           base_trace={"rid": req.rid},
+                           version=getattr(exc, "version", None))
+            return True
+        return False
+
+    def _complete(self, req: _RouterRequest, res, *,
+                  replica: Optional[str], base_trace=None, version=None):
+        """The ONE completion path: attach version + the router trace
+        (with recovery provenance), record the completion metrics, and
+        resolve the future — shared by the normal inner-done success
+        and the full-budget recovery resolve so the provenance surface
+        cannot drift between them."""
+        lat_ms = (time.perf_counter_ns() - req.t_enqueue_ns) / 1e6
+        trace = dict(base_trace or {})
+        trace["router"] = {"class": req.klass, "replica": replica,
+                           "failovers": req.failovers,
+                           "latency_ms": round(lat_ms, 3)}
+        if req.recovered is not None:
+            trace["router"]["recovered_tokens"] = int(req.recovered.size)
+        req.future.version = version
+        req.future.trace = trace
+        self._bump("completed")
+        if obs.enabled():
+            obs.counter("serve/router_completed").inc()
+            obs.histogram(
+                f"serve/router_latency_ms_{_metric_cls(req.klass)}",
+                unit="ms").observe(lat_ms)
+        try:
+            req.future.set_result(res)
+        except Exception:
+            pass
+
     # -- health / failover -----------------------------------------------
+
+    def _reseed_ewma_locked(self, klass: str):
+        """Re-derive one class's admission estimate from the healthy
+        replicas' per-replica EWMAs (min — doom a deadline only when
+        even the BEST live replica can't meet it). Caller holds
+        ``self._lock``."""
+        cq = self._classes[klass]
+        est = [r.ewma_ms[klass] for r in self._replicas
+               if r.healthy and klass in r.ewma_ms]
+        cq.ewma_ms = min(est) if est else None
 
     def _on_health_event(self, event: dict):
         """health-listener hook (runs on the watchdog thread): a
@@ -775,6 +913,11 @@ class Router:
             stranded = list(rep.inflight)
             rep.inflight.clear()
             rep.by_class.clear()
+            # the drained replica's service times leave the admission
+            # estimate with it — the fleet's doomed check must describe
+            # the replicas that can actually serve
+            for k in self._classes:
+                self._reseed_ewma_locked(k)
         self._bump("drains")
         if obs.enabled():
             obs.counter("serve/router_drains").inc()
@@ -791,6 +934,14 @@ class Router:
             if rep.healthy or rep.dead:
                 return
             rep.healthy = True
+            # stale-EWMA dooming fix (ISSUE 13): the pre-stall service
+            # times this replica measured are the latencies of a
+            # machine that just wedged — re-seed from FRESH completions
+            # so a recovered replica cannot doom tight-deadline
+            # requests off its old numbers
+            rep.ewma_ms.clear()
+            for k in self._classes:
+                self._reseed_ewma_locked(k)
         self._bump("rejoins")
         if obs.enabled():
             obs.counter("serve/router_rejoins").inc()
@@ -830,6 +981,8 @@ class Router:
             rep.healthy = False
             if reason == "engine_stopped":
                 rep.dead = True
+            for k in self._classes:
+                self._reseed_ewma_locked(k)
         if was:
             self._bump("drains")
             if obs.enabled():
@@ -846,14 +999,34 @@ class Router:
             obs.counter("serve/router_timeouts").inc()
             obs.counter("serve/router_deadline_miss_"
                         f"{_metric_cls(cq.cls.name)}").inc()
+        if exc is None:
+            exc = DeadlineExceeded(msg)
         try:
-            req.future.set_exception(exc or DeadlineExceeded(msg))
+            req.future.set_exception(self._carry_recovered(req, exc))
         except Exception:
             pass
 
+    def _carry_recovered(self, req: _RouterRequest,
+                         exc: BaseException) -> BaseException:
+        """Terminal failures must not silently drop tokens a dead
+        replica already produced: whatever path fails the request —
+        deadline at the router, exhausted failover budget, a dead
+        fleet — the client's ``exc.partial`` carries the WHOLE stream:
+        the recovered prefix followed by whatever continuation the
+        last replica's own partial holds (an exception without one, or
+        with an empty one, still keeps the prefix). The one splice
+        point for every terminal path — matching the contract the
+        scheduler upholds on its own failure paths."""
+        if req.recovered is not None:
+            tail = getattr(exc, "partial", None)
+            tail = (np.zeros((0,), np.int32) if tail is None
+                    else np.asarray(tail, np.int32).reshape(-1))
+            exc.partial = np.concatenate([req.recovered, tail])
+        return exc
+
     def _fail(self, req: _RouterRequest, exc: BaseException):
         try:
-            req.future.set_exception(exc)
+            req.future.set_exception(self._carry_recovered(req, exc))
         except Exception:
             pass
 

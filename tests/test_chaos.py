@@ -1,0 +1,602 @@
+"""Chaos-hardened serving (ISSUE 13).
+
+The gates: the fault-injection plane is deterministic and zero-cost
+disarmed; transient faults injected at the scheduler's dispatch seams
+are absorbed by the step-replay tier with tokens BITWISE the fault-free
+run — over the dense AND the Pallas paged-attention path (trace spies
+assert which one served); a PERMANENT fault kills the loop with a
+triaged crash bundle and typed in-flight failures carrying the
+generated prefix; the Router recovers those failures KV-preservingly
+(``prompt + partial`` re-dispatch, recovered streams bitwise, none
+lost); the ledger auditor quarantines injected corruption with a
+structured event instead of crashing the loop; and the ServingEngine's
+batch retry now rides the same FaultPolicy surface as everything else.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import observability as obs
+from bigdl_tpu.models.transformer_lm import TransformerLM
+from bigdl_tpu.observability import health as _health
+from bigdl_tpu.parallel import chaos
+from bigdl_tpu.parallel.chaos import ChaosError, ChaosPlan, Rule
+from bigdl_tpu.parallel.failure import (FaultPolicy, Heartbeat,
+                                        TransientDeviceError, TRANSIENT,
+                                        PERMANENT, classify_failure)
+from bigdl_tpu.serving import (DecodeScheduler, EngineStopped,
+                               PagedKVCache, Router, ServingEngine,
+                               decode_scheduler_threads_alive)
+from serving_helpers import no_leaked_blocks, solo_oracle as _oracle
+
+V, H = 48, 32
+MAXLEN = 256
+CHUNK = 8
+
+
+def _model(**kw):
+    cfg = dict(vocab_size=V, hidden_size=H, num_heads=4, filter_size=64,
+               num_layers=2, max_len=MAXLEN)
+    cfg.update(kw)
+    m = TransformerLM(**cfg)
+    m.ensure_initialized()
+    return m
+
+
+_shared = {}
+
+
+def shared_model():
+    if "m" not in _shared:
+        _shared["m"] = _model(pos_encoding="rope", num_kv_heads=2)
+    return _shared["m"]
+
+
+def solo_oracle(model, prompt, max_new, eos_id=None):
+    return _oracle(model, model.params, prompt, max_new, chunk=CHUNK,
+                   maxlen=MAXLEN, eos_id=eos_id)
+
+
+def _sched(model, **kw):
+    cfg = dict(max_slots=4, block_size=4, max_seq_len=96,
+               prefill_chunk=CHUNK)
+    cfg.update(kw)
+    return DecodeScheduler(model, **cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    chaos.disarm()
+    _health.reset()
+    obs.registry().reset()
+    obs.disable()
+
+
+@pytest.fixture(params=["dense", "kernel"])
+def paged_path(request, monkeypatch):
+    """The kernel-agnostic matrix (ISSUE 13 satellite): every
+    fault-recovery gate must hold whether decode runs the dense gather
+    or the Pallas paged-attention kernel."""
+    if request.param == "kernel":
+        monkeypatch.setenv("BIGDL_TPU_PAGED_ATTN", "interpret")
+    else:
+        monkeypatch.delenv("BIGDL_TPU_PAGED_ATTN", raising=False)
+    return request.param
+
+
+def _spy_guard(paged_path):
+    from bigdl_tpu.kernels import paged_attention as pk
+    before = pk.trace_count()
+
+    def check():
+        if paged_path == "kernel":
+            assert pk.trace_count() > before, \
+                "kernel arm served without tracing the Pallas path"
+        else:
+            assert pk.trace_count() == before
+    return check
+
+
+# ---------------------------------------------------------------------------
+# the injection plane itself
+# ---------------------------------------------------------------------------
+
+def test_disarmed_is_noop_and_stats_empty():
+    chaos.disarm()
+    assert not chaos.armed()
+    chaos.maybe_fire("serving/scheduler_step")   # must not raise
+    assert chaos.stats() == {} and chaos.fires() == []
+
+
+def test_rule_schedules_nth_every_max_fires_tag():
+    chaos.arm({"sites": {
+        "a": [{"kind": "transient", "nth": 2}],
+        "b": [{"kind": "transient", "every": 2, "max_fires": 2}],
+        "c": [{"kind": "transient", "nth": 1, "tag": "r1"}],
+    }})
+    fired = []
+    for i in range(4):
+        try:
+            chaos.maybe_fire("a")
+        except TransientDeviceError:
+            fired.append(i)
+    assert fired == [1], "nth=2 fires exactly on the second call"
+    fired = []
+    for i in range(8):
+        try:
+            chaos.maybe_fire("b")
+        except TransientDeviceError:
+            fired.append(i)
+    assert fired == [1, 3], "every=2 fires twice then hits max_fires"
+    chaos.maybe_fire("c", tag="r0")          # wrong tag: no match
+    with pytest.raises(TransientDeviceError):
+        chaos.maybe_fire("c", tag="r1")      # r1's FIRST matching call
+    st = chaos.stats()
+    assert st["fires"] == 4
+    assert st["by_site"] == {"a": 1, "b": 2, "c": 1}
+    assert chaos.sites_fired() == ["a", "b", "c"]
+
+
+def test_rule_kinds_classify_and_wedge_sleeps():
+    assert classify_failure(ChaosError("chaos: x")) == PERMANENT
+    assert classify_failure(TransientDeviceError("x")) == TRANSIENT
+    chaos.arm({"sites": {
+        "p": [{"kind": "permanent", "nth": 1}],
+        "w": [{"kind": "wedge", "nth": 1, "wedge_s": 0.08}],
+    }})
+    with pytest.raises(ChaosError):
+        chaos.maybe_fire("p")
+    t0 = time.monotonic()
+    chaos.maybe_fire("w")                    # sleeps, never raises
+    assert time.monotonic() - t0 >= 0.07
+
+
+def test_prob_schedule_is_seeded_deterministic():
+    def pattern(seed):
+        chaos.arm({"seed": seed, "sites": {
+            "s": [{"kind": "transient", "prob": 0.5}]}})
+        out = []
+        for i in range(32):
+            try:
+                chaos.maybe_fire("s")
+            except TransientDeviceError:
+                out.append(i)
+        return out
+
+    a, b, c = pattern(11), pattern(11), pattern(12)
+    assert a == b, "same seed, same schedule"
+    assert 0 < len(a) < 32
+    assert a != c
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError, match="kind"):
+        Rule(kind="sideways", nth=1)
+    with pytest.raises(ValueError, match="exactly one"):
+        Rule(nth=1, every=2)
+    with pytest.raises(ValueError, match="exactly one"):
+        Rule()
+    with pytest.raises(ValueError, match="prob"):
+        Rule(prob=1.5)
+    with pytest.raises(ValueError, match="wedge_s"):
+        Rule(kind="wedge", nth=1)
+    with pytest.raises(ValueError, match="unknown rule keys"):
+        Rule.from_dict({"kind": "transient", "nth": 1, "bogus": 3})
+    with pytest.raises(TypeError):
+        chaos.arm(42)
+
+
+def test_arm_from_env_plan_file(tmp_path, monkeypatch):
+    plan = tmp_path / "plan.json"
+    plan.write_text('{"seed": 3, "sites": {"heartbeat/beat": '
+                    '[{"kind": "transient", "nth": 1}]}}')
+    monkeypatch.setenv("BIGDL_TPU_CHAOS", str(plan))
+    assert chaos.arm_from_env() is not None
+    assert chaos.armed()
+    with pytest.raises(TransientDeviceError):
+        chaos.maybe_fire("heartbeat/beat")
+    chaos.disarm()
+    # malformed plans stay DISARMED, loudly — never take the process down
+    plan.write_text("{not json")
+    assert chaos.arm_from_env() is None
+    assert not chaos.armed()
+
+
+def test_heartbeat_and_checkpoint_sites(tmp_path):
+    from bigdl_tpu.parallel.failure import HeartbeatLost
+    chaos.arm({"sites": {
+        "heartbeat/beat": [{"kind": "transient", "nth": 1}],
+        "checkpoint/write": [{"kind": "transient", "nth": 1}],
+    }})
+    # an injected heartbeat fault surfaces the way a REAL exchange
+    # failure does — typed HeartbeatLost, which is what the trainer's
+    # remediation tier handles (a raw transport error would crash the
+    # loop around the remediation instead of through it)
+    with pytest.raises(HeartbeatLost, match="injected heartbeat fault"):
+        Heartbeat().beat()
+    from bigdl_tpu.optim.optimizer import _atomic_pickle
+    ck = tmp_path / "ck.bin"
+    with pytest.raises(TransientDeviceError):
+        _atomic_pickle(str(ck), {"x": 1})
+    assert not ck.exists(), "a failed write must leave no file"
+    _atomic_pickle(str(ck), {"x": 1})        # rule exhausted: succeeds
+    assert ck.exists()
+
+
+# ---------------------------------------------------------------------------
+# transient step replay (the Tier-2 analog for decode)
+# ---------------------------------------------------------------------------
+
+def test_transient_step_replay_bitwise(paged_path):
+    """Faults injected at the decode-step AND prefill seams are
+    absorbed by replay; every request's tokens stay bitwise the solo
+    oracle — on the dense and the Pallas kernel path alike."""
+    m = shared_model()
+    rng = np.random.RandomState(31)
+    plans = [(rng.randint(1, V, size=n).astype(np.int32), mn)
+             for n, mn in ((5, 8), (11, 6), (17, 7))]
+    chaos.arm({"sites": {
+        "serving/scheduler_step": [
+            {"kind": "transient", "every": 3, "max_fires": 3}],
+        "serving/prefill": [{"kind": "transient", "nth": 2}],
+    }})
+    spy = _spy_guard(paged_path)
+    with _sched(m, fault_policy=FaultPolicy(max_restarts=2,
+                                            backoff_base_s=0.0)) as sched:
+        futs = [sched.submit(p, mn) for p, mn in plans]
+        got = [np.asarray(f.result(timeout=120)) for f in futs]
+        st = sched.stats()
+    spy()
+    assert st["step_replays"] >= 2, f"faults not absorbed: {st}"
+    for i, (p, mn) in enumerate(plans):
+        assert np.array_equal(got[i], solo_oracle(m, p, mn)), \
+            f"request {i} diverged under replay"
+    no_leaked_blocks(st)
+    assert sched.audit()["ok"]
+    assert decode_scheduler_threads_alive() == 0
+
+
+def test_spec_round_replay_bitwise():
+    """The speculative fast path replays as ONE unit: a transient
+    mid-round rolls both pools back and the round reruns bitwise."""
+    m = _model()   # sinusoidal/MHA variant, target as its own draft
+    rng = np.random.RandomState(32)
+    pr = rng.randint(1, V, size=9).astype(np.int32)
+    want = solo_oracle(m, pr, 10)
+    chaos.arm({"sites": {
+        "serving/spec_round": [{"kind": "transient", "nth": 2}]}})
+    with _sched(m, draft_model=m, spec_k=3) as sched:
+        got = np.asarray(sched.submit(pr, 10).result(timeout=120))
+        st = sched.stats()
+    assert np.array_equal(got, want)
+    assert st["step_replays"] >= 1 and st["spec_rounds"] > 0
+    no_leaked_blocks(st)
+
+
+def test_admission_transient_defers_then_serves_bitwise():
+    """A transient fault inside the admission transaction (the CoW
+    fork of a fully-cached prompt) unwinds the transaction and defers
+    the request — the next boundary retries and the warm tokens stay
+    bitwise."""
+    m = shared_model()
+    rng = np.random.RandomState(33)
+    pr = rng.randint(1, V, size=16).astype(np.int32)   # hit_align-ed
+    want = solo_oracle(m, pr, 8)
+    chaos.arm({"sites": {
+        "kv/cow_fork": [{"kind": "transient", "nth": 1}]}})
+    with _sched(m) as sched:
+        first = np.asarray(sched.submit(pr, 8).result(timeout=120))
+        warm = np.asarray(sched.submit(pr, 8).result(timeout=120))
+        st = sched.stats()
+    assert np.array_equal(first, want) and np.array_equal(warm, want)
+    assert st["prefix_hits"] == 1, "the warm request must still hit"
+    assert st["prefix_cow_forks"] >= 1, "the retried fork must land"
+    assert chaos.stats()["by_site"].get("kv/cow_fork") == 1
+    no_leaked_blocks(st)
+
+
+def test_replay_budget_exhausted_dies_with_triaged_bundle(
+        tmp_path, monkeypatch):
+    """A persistent 'transient' exhausts the budget: the loop dies, a
+    crash bundle with per-request triage lands, and the in-flight
+    future fails typed EngineStopped carrying the generated prefix —
+    bitwise the oracle's — on ``.partial``."""
+    monkeypatch.setenv("BIGDL_TPU_FLIGHT_DIR", str(tmp_path))
+    obs.enable()
+    m = shared_model()
+    rng = np.random.RandomState(34)
+    pr = rng.randint(1, V, size=6).astype(np.int32)
+    want = solo_oracle(m, pr, 20)
+    chaos.arm({"sites": {
+        "serving/scheduler_step": [
+            {"kind": "transient", "every": 1}]}})   # never stops
+    sched = _sched(m, fault_policy=FaultPolicy(max_restarts=1,
+                                               backoff_base_s=0.0))
+    sched.start(warmup=False)
+    fut = sched.submit(pr, 20)
+    exc = fut.exception(timeout=120)
+    assert isinstance(exc, EngineStopped)
+    partial = np.asarray(exc.partial, np.int32)
+    assert partial.size >= 1, "the prefill token was already emitted"
+    assert np.array_equal(partial, want[:partial.size]), \
+        "the partial must be a bitwise prefix of the solo decode"
+    sched.shutdown()
+    st = sched.stats()
+    assert st["kv"]["blocks_in_use"] == 0
+    assert sched.audit()["ok"]
+    # the bundle carries the triage table and flight_report renders it
+    bundles = sorted(p for p in os.listdir(tmp_path)
+                     if p.startswith("flight_"))
+    assert bundles, "no crash bundle landed"
+    import json
+    with open(tmp_path / bundles[-1]) as f:
+        bundle = json.load(f)
+    reqs = bundle["context"]["requests"]
+    assert any(r["stage"] == "decode" and r["tokens"] >= 1
+               and r["kv_blocks"] >= 1 for r in reqs), reqs
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import flight_report
+    text = flight_report.render(bundle)
+    assert "in-flight requests at loop death" in text
+    assert "stage=decode" in text
+
+
+def test_permanent_fault_never_retries():
+    m = shared_model()
+    chaos.arm({"sites": {
+        "serving/scheduler_step": [{"kind": "permanent", "nth": 1}]}})
+    sched = _sched(m).start(warmup=False)
+    fut = sched.submit(np.arange(1, 8, dtype=np.int32), 10)
+    assert isinstance(fut.exception(timeout=120), EngineStopped)
+    sched.shutdown()
+    assert sched.stats()["step_replays"] == 0, \
+        "PERMANENT must not burn the replay budget"
+    assert decode_scheduler_threads_alive() == 0
+
+
+# ---------------------------------------------------------------------------
+# the KV ledger auditor
+# ---------------------------------------------------------------------------
+
+def test_audit_clean_on_legit_ledger():
+    m = shared_model()
+    kv = PagedKVCache(m, num_blocks=17, block_size=4,
+                      max_blocks_per_seq=4)
+    kv.ensure_capacity("a", 16)
+    kv.ensure_capacity("b", 8)
+    shared = kv.owner_blocks("a")[:2]
+    kv.retain(shared)                       # cache-style pins
+    kv.adopt("c", shared)                   # a second table referent
+    rep = kv.audit(prefix_pins={shared[0]: 1, shared[1]: 1})
+    assert rep["ok"], rep["violations"]
+    assert rep["owners"] == 3
+    kv.free("c"), kv.free("b"), kv.free("a")
+    kv.release(shared)
+    rep = kv.audit(prefix_pins={})
+    assert rep["ok"] and kv.blocks_in_use() == 0
+
+
+def test_audit_flags_every_violation_class():
+    m = shared_model()
+
+    def fresh():
+        kv = PagedKVCache(m, num_blocks=9, block_size=4,
+                          max_blocks_per_seq=4)
+        kv.ensure_capacity("a", 8)
+        return kv
+
+    kv = fresh()                             # free-list duplicate
+    with kv._lock:
+        kv._free.append(kv._free[-1])
+    assert any("duplicate" in v for v in kv.audit()["violations"])
+
+    kv = fresh()                             # free AND referenced
+    with kv._lock:
+        kv._refs[kv._free[0]] = 1
+    assert any("both free and referenced" in v
+               for v in kv.audit()["violations"])
+
+    kv = fresh()                             # leaked: in neither set
+    with kv._lock:
+        b = kv._free.pop()
+    assert any("leaked" in v for v in kv.audit()["violations"])
+
+    kv = fresh()                             # aliasing: tables > refcount
+    with kv._lock:
+        kv._owned["z"] = [kv._owned["a"][0]]
+    assert any("aliased" in v for v in kv.audit()["violations"])
+
+    kv = fresh()                             # dup within one table
+    with kv._lock:
+        kv._owned["a"].append(kv._owned["a"][0])
+    assert any("table aliases" in v for v in kv.audit()["violations"])
+
+    kv = fresh()                             # dead prefix pin
+    assert any("dead block" in v
+               for v in kv.audit(prefix_pins={7: 1})["violations"])
+
+    kv = fresh()                             # pin-count mismatch
+    assert any("prefix pins" in v
+               for v in kv.audit(
+                   prefix_pins={kv.owner_blocks("a")[0]: 1})["violations"])
+
+
+def test_scheduler_quarantines_corruption_and_keeps_serving(
+        tmp_path, monkeypatch):
+    """The observe→act loop for the ledger: injected corruption fires
+    ``health/kv_corruption`` + a bundle ONCE, quarantines (prefix
+    adoption and the affinity probe go dark) — and the loop keeps
+    serving, bitwise."""
+    monkeypatch.setenv("BIGDL_TPU_FLIGHT_DIR", str(tmp_path))
+    obs.enable()
+    m = shared_model()
+    rng = np.random.RandomState(35)
+    pr = rng.randint(1, V, size=16).astype(np.int32)
+    want = solo_oracle(m, pr, 8)
+    events = []
+    sched = _sched(m, audit_every=2).start(warmup=False)
+    with _health.listen(lambda e: events.append(e)):
+        assert np.array_equal(
+            np.asarray(sched.submit(pr, 8).result(timeout=120)), want)
+        assert sched.cached_prefix_tokens(pr) >= 16
+        with sched.kv._lock:                 # corrupt under the loop
+            phantom = sched.kv._free[0]
+            sched.kv._refs[phantom] = 1
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline \
+                and not sched.stats()["quarantined"]:
+            time.sleep(0.05)
+        st = sched.stats()
+        assert st["quarantined"] and st["kv_corruptions"] >= 1
+        corr = [e for e in events if e["kind"] == "health/kv_corruption"]
+        assert corr and corr[0]["n_violations"] >= 1
+        # alive + correct, but no NEW shared state out of a corrupt pool
+        f = sched.submit(pr, 8)
+        assert np.array_equal(np.asarray(f.result(timeout=120)), want)
+        assert f.trace["prefix_hit_tokens"] == 0
+        assert sched.cached_prefix_tokens(pr) == 0
+        with sched.kv._lock:                 # repair, then clean drain
+            sched.kv._refs.pop(phantom, None)
+    assert any(p.startswith("flight_") for p in os.listdir(tmp_path)), \
+        "the corruption must land a bundle"
+    sched.shutdown()
+    assert sched.stats()["kv"]["blocks_in_use"] == 0
+    assert decode_scheduler_threads_alive() == 0
+
+
+# ---------------------------------------------------------------------------
+# engine FaultPolicy (the upgraded one-shot retry)
+# ---------------------------------------------------------------------------
+
+def _engine(model, **kw):
+    kw.setdefault("input_shape", (4,))
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 1.0)
+    return ServingEngine(model, **kw)
+
+
+def test_engine_fault_policy_absorbs_consecutive_transients():
+    from bigdl_tpu.nn import Linear
+    m = Linear(4, 3)
+    m.ensure_initialized()
+    sleeps = []
+    pol = FaultPolicy(max_restarts=3, backoff_base_s=0.01,
+                      sleep=sleeps.append)
+    chaos.arm({"sites": {"serving/engine_dispatch": [
+        {"kind": "transient", "every": 1, "max_fires": 2}]}})
+    with _engine(m, fault_policy=pol) as eng:
+        out = eng.predict(np.ones((4,), np.float32), timeout=30)
+        st = eng.stats()
+    assert out is not None and out.shape == (3,)
+    assert st["transient_retries"] == 2, st
+    assert sleeps == [0.01, 0.02], "exponential backoff, injectable"
+    assert st["batch_errors"] == 0
+
+
+def test_engine_fault_policy_budget_exhausts_typed():
+    from bigdl_tpu.nn import Linear
+    m = Linear(4, 3)
+    m.ensure_initialized()
+    chaos.arm({"sites": {"serving/engine_dispatch": [
+        {"kind": "transient", "every": 1}]}})
+    with _engine(m, fault_policy=FaultPolicy(max_restarts=1,
+                                             backoff_base_s=0.0)) as eng:
+        fut = eng.submit(np.ones((4,), np.float32))
+        assert isinstance(fut.exception(timeout=30),
+                          TransientDeviceError)
+        # the next batch is a FRESH dispatch unit: the exhausted
+        # budget reset with the failed batch, so a single isolated
+        # flake is still absorbed (one exhausted batch must not
+        # disable the safety net for every batch after it)
+        chaos.arm({"sites": {"serving/engine_dispatch": [
+            {"kind": "transient", "nth": 1}]}})
+        assert eng.predict(np.ones((4,), np.float32),
+                           timeout=30) is not None
+        chaos.disarm()                     # the batcher must have lived
+        assert eng.predict(np.ones((4,), np.float32),
+                           timeout=30) is not None
+        assert eng.stats()["transient_retries"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# KV-preserving failover through the router
+# ---------------------------------------------------------------------------
+
+def _lm_replicas(model, n=2):
+    return [_sched(model, name=f"lm{i}") for i in range(n)]
+
+
+def test_router_kv_preserving_failover_bitwise(paged_path):
+    """An injected PERMANENT fault kills replica lm0 mid-decode; its
+    in-flight requests re-dispatch carrying prompt + generated tokens
+    and complete on lm1 — every stream bitwise the uninterrupted run,
+    none lost, none double-answered, both ledgers drained."""
+    m = shared_model()
+    rng = np.random.RandomState(36)
+    plans = [(rng.randint(1, V, size=sz).astype(np.int32), 10, {})
+             for sz in (7, 12, 9, 15)]
+    plans.append((rng.randint(1, V, size=8).astype(np.int32), 10,
+                  dict(temperature=0.8, top_p=0.9, seed=55)))
+    want = []
+    with _sched(m) as ref:
+        for p, mn, kw in plans:
+            want.append(np.asarray(
+                ref.submit(p, mn, **kw).result(timeout=120)))
+    chaos.arm({"sites": {"serving/scheduler_step": [
+        {"kind": "permanent", "nth": 2, "tag": "lm0"}]}})
+    spy = _spy_guard(paged_path)
+    replicas = _lm_replicas(m)
+    for r in replicas:
+        r.start(warmup=False)
+    with Router(replicas) as router:
+        futs = [router.submit(p, max_new_tokens=mn, **kw)
+                for p, mn, kw in plans]
+        got = [np.asarray(f.result(timeout=180)) for f in futs]
+        st = router.stats()
+    spy()
+    for i, w in enumerate(want):
+        assert np.array_equal(got[i], w), \
+            f"request {i}: failover broke the stream " \
+            f"(want {w}, got {got[i]})"
+    assert st["completed"] == len(plans), f"lost requests: {st}"
+    assert st["kv_recoveries"] >= 1, \
+        f"no KV-preserving recovery exercised: {st}"
+    recovered = [f for f in futs
+                 if f.trace.get("router", {}).get("recovered_tokens")]
+    assert recovered, "at least one future must carry recovery provenance"
+    for r in replicas:
+        assert r.stats()["kv"]["blocks_in_use"] == 0
+        assert r.audit()["ok"]
+    assert decode_scheduler_threads_alive() == 0
+
+
+def test_recover_decode_full_budget_resolves_without_redispatch():
+    """When the dead replica had already produced the whole budget, the
+    recovery resolves the client from the partial alone — re-dispatching
+    a zero-token request would be a wasted prefill AND a validation
+    error."""
+    m = shared_model()
+    router = Router(_lm_replicas(m), manage_replicas=False)
+    fut = router.submit(np.arange(1, 9, dtype=np.int32),
+                        max_new_tokens=4)
+    req = router._classes["default"].q[0]
+    exc = EngineStopped("replica died")
+    exc.partial = np.asarray([5, 6, 7, 8], np.int32)
+    assert router._recover_decode(req, exc) is True
+    assert np.array_equal(fut.result(timeout=5),
+                          np.asarray([5, 6, 7, 8], np.int32))
+    assert router.stats()["kv_recoveries"] == 1
+    # requests without a partial (or an empty one) fall through to the
+    # plain whole-prompt failover untouched
+    exc2 = EngineStopped("x")
+    router.submit(np.arange(1, 9, dtype=np.int32), max_new_tokens=4)
+    req3 = router._classes["default"].q[-1]
+    assert router._recover_decode(req3, exc2) is False   # no partial
+    exc2.partial = np.zeros((0,), np.int32)
+    assert router._recover_decode(req3, exc2) is False   # empty partial
+    router.shutdown(drain=False)

@@ -279,6 +279,40 @@ def test_replica_engine_stopped_fails_over():
         e.shutdown()
 
 
+def test_rejoin_resets_service_ewma_no_stale_dooming():
+    """Regression (ISSUE 13): the service-time EWMA is kept PER REPLICA
+    and re-seeded on drain/rejoin — a recovered replica's pre-stall
+    latencies must not keep dooming tight-deadline requests. Before the
+    fix the class-level EWMA survived the drain/rejoin round-trip and a
+    100ms deadline kept failing at admission against wedge-era
+    numbers."""
+    model = _model()
+    with _router(model, n=1) as r:
+        for i in range(3):
+            r.submit(_x(i)).result(timeout=10)
+        rep = r._replicas[0]
+        with r._lock:
+            # a wedge-era estimate: every completion took ~5s
+            rep.ewma_ms["default"] = 5000.0
+            r._reseed_ewma_locked("default")
+        assert r._classes["default"].ewma_ms == 5000.0
+        with pytest.raises(DeadlineExceeded, match="unmeetable"):
+            r.submit(_x(), deadline_ms=100.0)
+        # the stall watchdog drains the replica, then it recovers
+        r._drain_replica(rep, reason="stall")
+        assert r._classes["default"].ewma_ms is None, \
+            "a drained replica's numbers must leave the estimate"
+        r._rejoin_replica(rep)
+        assert rep.ewma_ms == {}, "rejoin re-seeds from fresh completions"
+        # the same deadline now ADMITS and completes on the recovered
+        # replica — no stale dooming
+        out = r.submit(_x(1), deadline_ms=1000.0).result(timeout=10)
+        assert out is not None
+        assert r.stats()["doomed"] == 1
+        assert r._classes["default"].ewma_ms is not None, \
+            "fresh completions re-seed the estimate"
+
+
 # -- fleet hot swap --------------------------------------------------------
 
 

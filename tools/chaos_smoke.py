@@ -1,0 +1,289 @@
+#!/usr/bin/env python3
+"""Seeded chaos campaign over the serving tier (ISSUE 13) — wired as
+``make chaos-smoke``, a tier-1 prerequisite beside ``fault-smoke``.
+
+The campaign arms ``parallel/chaos`` plans over the REAL injection
+seams and enforces the recovery guarantees end to end:
+
+1. **Transient storm → bitwise replay.** Mixed LM traffic (shared
+   prefixes, CoW forks, a seeded sampled request) under injected
+   transient faults at the decode-step, prefill, cow-fork,
+   prefix-insert/evict and page-copy seams: every request must
+   complete with tokens BITWISE-equal to the fault-free run, the
+   replay counter must show the faults were absorbed (not dodged), and
+   the ledger must drain + audit clean.
+2. **Replica death mid-decode → KV-preserving failover.** A 2-replica
+   router fleet; an injected PERMANENT fault kills replica r0's decode
+   loop mid-generation (plus transient faults at the router-dispatch
+   and r1-step seams for good measure). The dying scheduler fails its
+   in-flight requests typed with their generated prefix attached; the
+   router splices ``prompt + partial`` and completes them on r1 —
+   every request answered exactly once, recovered streams bitwise the
+   uninterrupted run, r1's prefix cache turning the re-prefill into a
+   hit, both ledgers drained and audit-clean.
+3. **Ledger corruption → audit quarantine.** A live scheduler's ledger
+   is corrupted under it; the cadence audit must fire a
+   ``health/kv_corruption`` event + crash bundle, QUARANTINE (new
+   admissions stop adopting shared state, prefix probes go dark) and
+   KEEP SERVING — the next request still completes bitwise.
+
+Campaign-wide gates: >= 20 injected faults across >= 5 distinct sites,
+zero lost / double-answered requests, ``kv_blocks_in_use`` -> 0 on
+every pool, ``audit()`` clean at every shutdown.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_WORK = tempfile.mkdtemp(prefix="bigdl_chaos_smoke_")
+os.environ["BIGDL_TPU_FLIGHT_DIR"] = os.path.join(_WORK, "flight")
+
+import numpy as np  # noqa: E402
+
+from bigdl_tpu import observability as obs  # noqa: E402
+from bigdl_tpu.models.transformer_lm import TransformerLM  # noqa: E402
+from bigdl_tpu.observability import health as _health  # noqa: E402
+from bigdl_tpu.parallel import chaos  # noqa: E402
+from bigdl_tpu.parallel.failure import (FaultPolicy,  # noqa: E402
+                                        TransientDeviceError)
+from bigdl_tpu.serving import DecodeScheduler, Router  # noqa: E402
+
+V = 48
+RNG = np.random.RandomState(20260804)
+ALL_FIRES = []          # accumulated across phases (arm() resets)
+
+
+def _model():
+    m = TransformerLM(vocab_size=V, hidden_size=32, num_heads=4,
+                      filter_size=64, num_layers=2, max_len=128,
+                      pos_encoding="rope", num_kv_heads=2)
+    m.ensure_initialized()
+    return m
+
+
+def _sched(model, **kw):
+    cfg = dict(max_slots=4, block_size=4, max_seq_len=96, prefill_chunk=8)
+    cfg.update(kw)
+    return DecodeScheduler(model, **cfg)
+
+
+def _collect(sched, plans, defrag_at=None):
+    """Submit every (prompt, max_new, kw) plan, return per-plan token
+    arrays (requests run CONCURRENTLY — the batch-mix-independence
+    contract is part of what the campaign leans on)."""
+    futs = []
+    for i, (prompt, max_new, kw) in enumerate(plans):
+        futs.append(sched.submit(prompt, max_new, **kw))
+        if defrag_at is not None and i == defrag_at:
+            sched.defrag()
+    return [np.asarray(f.result(timeout=180)) for f in futs]
+
+
+def _traffic_plans():
+    """The mixed matrix: a shared 16-token prefix served twice exactly
+    (the second is the fully-cached-aligned CoW-fork case), prefix+
+    suffix variants, plain prompts, one seeded sampled request."""
+    prefix = RNG.randint(1, V, size=16).astype(np.int32)
+    plans = [
+        (prefix.copy(), 10, {}),
+        (prefix.copy(), 8, {}),                     # full hit -> CoW fork
+        (np.concatenate([prefix,
+                         RNG.randint(1, V, size=5).astype(np.int32)]),
+         12, {}),
+        (np.concatenate([prefix,
+                         RNG.randint(1, V, size=9).astype(np.int32)]),
+         10, {}),
+        (RNG.randint(1, V, size=12).astype(np.int32), 14, {}),
+        (RNG.randint(1, V, size=22).astype(np.int32), 9, {}),
+        (RNG.randint(1, V, size=7).astype(np.int32), 10,
+         dict(temperature=0.9, top_p=0.9, seed=123)),
+        (RNG.randint(1, V, size=18).astype(np.int32), 12, {}),
+    ]
+    return plans
+
+
+def _drain_and_audit(sched, who):
+    st = sched.stats()
+    assert st["kv"]["blocks_in_use"] == 0, \
+        f"{who}: {st['kv']['blocks_in_use']} blocks leaked"
+    rep = sched.audit()
+    assert rep["ok"], f"{who}: post-shutdown audit dirty: " \
+                      f"{rep['violations']}"
+
+
+def _bank_fires():
+    ALL_FIRES.extend(chaos.fires())
+    chaos.disarm()
+
+
+def main():
+    obs.enable()
+    t0 = time.time()
+    model = _model()
+    plans = _traffic_plans()
+
+    # ---- fault-free reference (one scheduler serves both phases) ----
+    ref = _sched(model, prefix_cache_entries=6).start(warmup=False)
+    reference = _collect(ref, plans)
+    ref.shutdown()
+    _drain_and_audit(ref, "reference")
+
+    # ---- phase 1: transient storm -> bitwise replay -----------------
+    chaos.arm({"seed": 13, "sites": {
+        "serving/scheduler_step": [
+            {"kind": "transient", "every": 3, "max_fires": 5}],
+        "serving/prefill": [
+            {"kind": "transient", "every": 4, "max_fires": 3}],
+        "kv/cow_fork": [{"kind": "transient", "nth": 1}],
+        "prefix/insert": [
+            {"kind": "transient", "every": 2, "max_fires": 2}],
+        "prefix/evict": [{"kind": "transient", "nth": 1}],
+        "kv/page_copy": [{"kind": "transient", "nth": 1}],
+    }})
+    s1 = _sched(model, prefix_cache_entries=6,
+                fault_policy=FaultPolicy(max_restarts=2,
+                                         backoff_base_s=0.0))
+    s1.start(warmup=False)
+    got = _collect(s1, plans, defrag_at=4)
+    s1.shutdown()
+    st1 = s1.stats()
+    fires1 = chaos.stats()
+    _bank_fires()
+    for i, (want, have) in enumerate(zip(reference, got)):
+        assert np.array_equal(want, have), \
+            f"phase 1: request {i} diverged under transient replay"
+    assert st1["step_replays"] >= 3, \
+        f"phase 1: faults were not absorbed by replay ({st1})"
+    assert fires1["fires"] >= 8, f"phase 1: too few injections {fires1}"
+    _drain_and_audit(s1, "phase 1")
+
+    # ---- phase 2: replica death -> KV-preserving failover -----------
+    warm = plans[0][0]                     # the shared 16-token prefix
+    fleet_plans = [
+        (np.concatenate([warm,
+                         RNG.randint(1, V, size=3).astype(np.int32)]),
+         12, {}) for _ in range(5)
+    ] + [(RNG.randint(1, V, size=9).astype(np.int32), 12,
+          dict(temperature=0.8, top_p=0.9, seed=77))]
+    ref2 = _sched(model).start(warmup=False)
+    want2 = _collect(ref2, fleet_plans)
+    ref2.shutdown()
+
+    r0 = _sched(model, name="r0").start(warmup=False)
+    r1 = _sched(model, name="r1").start(warmup=False)
+    # warm BOTH replicas' prefix caches with the shared prefix, so the
+    # survivor's re-prefill of a recovered request is a prefix HIT
+    for rep_s in (r0, r1):
+        rep_s.submit(warm, 4).result(timeout=120)
+    chaos.arm({"seed": 17, "sites": {
+        "serving/scheduler_step": [
+            {"kind": "permanent", "nth": 3, "tag": "r0"},
+            {"kind": "transient", "every": 5, "max_fires": 2,
+             "tag": "r1"}],
+        "router/dispatch": [
+            {"kind": "transient", "every": 3, "max_fires": 3}],
+    }})
+    router = Router([r0, r1])
+    with router:
+        futs = [router.submit(p, max_new_tokens=mn, **kw)
+                for p, mn, kw in fleet_plans]
+        got2 = [np.asarray(f.result(timeout=180)) for f in futs]
+    st2 = router.stats()
+    fires2 = chaos.stats()
+    _bank_fires()
+    for i, (want, have) in enumerate(zip(want2, got2)):
+        assert np.array_equal(want, have), \
+            f"phase 2: request {i} not bitwise across failover " \
+            f"(want {want}, got {have})"
+    assert st2["completed"] == len(fleet_plans), \
+        f"phase 2: lost requests ({st2})"
+    assert st2["kv_recoveries"] >= 1, \
+        f"phase 2: no KV-preserving recovery happened ({st2})"
+    assert st2["failovers"] >= 1
+    assert r1.stats()["prefix_hits"] >= 1, \
+        "phase 2: the survivor never hit its prefix cache"
+    assert fires2["by_site"].get("serving/scheduler_step", 0) >= 1
+    _drain_and_audit(r0, "phase 2 r0")
+    _drain_and_audit(r1, "phase 2 r1")
+
+    # ---- phase 3: ledger corruption -> audit quarantine -------------
+    events = []
+    s3 = _sched(model, audit_every=2).start(warmup=False)
+    with _health.listen(lambda e: events.append(e)):
+        s3.submit(plans[0][0], 6).result(timeout=120)
+        # corrupt the ledger under the live loop: a phantom refcount on
+        # a block that is still on the free list (disjointness broken);
+        # _free[0] is the LAST block allocation would pop, so ongoing
+        # traffic cannot legitimize the corruption by reusing the id
+        with s3.kv._lock:
+            phantom = s3.kv._free[0]
+            s3.kv._refs[phantom] = 1
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not s3.stats()["quarantined"]:
+            time.sleep(0.05)
+        st3 = s3.stats()
+        assert st3["quarantined"], "phase 3: cadence audit never fired"
+        assert any(e["kind"] == "health/kv_corruption" for e in events), \
+            "phase 3: no structured corruption event"
+        # quarantined but ALIVE: the loop keeps serving, bitwise, with
+        # prefix adoption disabled (no new shared state in a corrupt
+        # ledger) and the affinity probe dark
+        f = s3.submit(plans[0][0], 10)
+        out = np.asarray(f.result(timeout=120))
+        assert np.array_equal(out, reference[0])
+        assert f.trace["prefix_hit_tokens"] == 0, \
+            "phase 3: a quarantined ledger must not adopt shared pages"
+        assert s3.cached_prefix_tokens(plans[0][0]) == 0
+        # repair before shutdown so the drain gate is meaningful
+        with s3.kv._lock:
+            s3.kv._refs.pop(phantom, None)
+    bundles = [f for f in os.listdir(os.environ["BIGDL_TPU_FLIGHT_DIR"])
+               if f.startswith("flight_") and f.endswith(".json")]
+    assert bundles, "phase 3: no crash bundle landed for the corruption"
+    s3.shutdown()
+    _drain_and_audit(s3, "phase 3")
+
+    # ---- phase 4: the long tail of the site catalog -----------------
+    chaos.arm({"sites": {
+        "heartbeat/beat": [{"kind": "transient", "every": 1,
+                            "max_fires": 3}],
+        "checkpoint/write": [{"kind": "transient", "nth": 1}],
+    }})
+    from bigdl_tpu.parallel.failure import Heartbeat, HeartbeatLost
+    hb = Heartbeat()
+    for _ in range(3):
+        try:
+            hb.beat()
+        except HeartbeatLost:
+            pass   # injected faults surface as the real exchange failure
+    from bigdl_tpu.optim.optimizer import _atomic_pickle
+    ck = os.path.join(_WORK, "chaos_ck.bin")
+    try:
+        _atomic_pickle(ck, {"x": 1})
+    except TransientDeviceError:
+        pass
+    assert not os.path.exists(ck), \
+        "phase 4: a failed checkpoint write must leave no file"
+    _bank_fires()
+
+    # ---- campaign-wide gates ----------------------------------------
+    sites = sorted({f["site"] for f in ALL_FIRES})
+    assert len(ALL_FIRES) >= 20, \
+        f"campaign too small: {len(ALL_FIRES)} faults ({sites})"
+    assert len(sites) >= 5, f"campaign too narrow: {sites}"
+    print(f"chaos_smoke: ok in {time.time() - t0:.1f}s — "
+          f"{len(ALL_FIRES)} faults injected across {len(sites)} sites "
+          f"({', '.join(sites)}); {st1['step_replays']} transient step "
+          f"replays bitwise, {st2['kv_recoveries']} KV-preserving "
+          f"recoveries across replica death (0 lost), ledger corruption "
+          f"quarantined with bundle + clean drain")
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,13 @@ HOT_FUNCS = {
         # warm-plan construction and suffix registration are pure host
         # hashing/bookkeeping at every step boundary
         "_prefix_plan", "_register_prefix", "cached_prefix_tokens",
+        # transient step replay + ledger auditor (ISSUE 13): the
+        # per-dispatch snapshot is reference/int copies, the restore
+        # swaps page HANDLES, and the audit is pure ledger arithmetic —
+        # none may grow a device sync (the replay guard wraps the hot
+        # dispatch of every decode step)
+        "_snapshot_step_state", "_restore_step_state", "_replay_group",
+        "audit", "_audit", "_triage",
     },
     # block ledger: admission-control bookkeeping runs between decode
     # steps and must stay pure host state (device pages are functional
@@ -93,6 +100,9 @@ HOT_FUNCS = {
         "ensure_capacity", "free", "block_table", "can_allocate",
         "adopt", "retain", "release", "fork_blocks", "block_refs",
         "owner_blocks",
+        # the invariant checker runs on the scheduler cadence — one
+        # consistent host snapshot, never a page read
+        "audit",
     },
     # prefix cache: content-addressed index over the ledger — digest
     # walks and LRU bookkeeping inside the admission loop (and under
@@ -100,7 +110,7 @@ HOT_FUNCS = {
     # admission on the box
     "bigdl_tpu/serving/prefix_cache.py": {
         "lookup", "peek", "insert", "evict", "chain_keys", "_walk",
-        "_on_remap",
+        "_on_remap", "pinned_blocks",
     },
     # router hot loop: pure host routing — a sync here would stall
     # EVERY class queue; the replicas' own batcher threads do the
@@ -112,6 +122,10 @@ HOT_FUNCS = {
         # prefix-affinity pick: N digest-walk probes per dispatch —
         # host hashing only, never a device value
         "_affinity_pick",
+        # KV-preserving failover splice: numpy concatenation of host
+        # int arrays on the inner-done callback path (runs on replica
+        # threads between THEIR dispatches)
+        "_recover_decode", "_reseed_ewma_locked", "_complete",
     },
     # mesh dispatch path: the sharded version load (publish, on the
     # swapping caller's thread) issues device transfers but must never
@@ -123,6 +137,11 @@ HOT_FUNCS = {
     # never touch a device value (a sync here would serialize every
     # warmup/first-shape compile behind a readback)
     "bigdl_tpu/parallel/flash.py": {"paged_attention", "paged_mode"},
+    # fault-injection plane (ISSUE 13): maybe_fire sits on EVERY hot
+    # seam above — disarmed it must stay one module-global read, armed
+    # it is host bookkeeping + a typed raise/sleep, never a device
+    # touch
+    "bigdl_tpu/parallel/chaos.py": {"maybe_fire"},
     "bigdl_tpu/kernels/paged_attention.py": {"paged_decode_attention"},
     "bigdl_tpu/nn/attention.py": {"decode_paged", "_paged_gather_attend"},
 }

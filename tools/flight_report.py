@@ -46,12 +46,27 @@ def render(bundle: dict, max_events: int = 40, show_spans: bool = False):
     else:
         lines.append("\nerror: none recorded (manual dump?)")
 
-    ctx = bundle.get("context") or {}
+    ctx = dict(bundle.get("context") or {})
+    requests = ctx.pop("requests", None)
     if ctx:
         lines.append("context: " + _fmt_fields(ctx, skip=()))
     env = bundle.get("env") or {}
     if env:
         lines.append("env: " + _fmt_fields(env, skip=()))
+
+    if requests:
+        # per-request triage (serving crash bundles): who was in
+        # flight, how far along, and what it held at loop death
+        lines.append(f"\nin-flight requests at loop death "
+                     f"({len(requests)}):")
+        for rq in requests:
+            lines.append(
+                f"  rid={rq.get('rid', '?'):<5} "
+                f"stage={rq.get('stage', '?'):<8} "
+                f"prompt_len={rq.get('prompt_len', '?'):<5} "
+                f"tokens={rq.get('tokens', '?'):<5} "
+                f"kv_blocks={rq.get('kv_blocks', '?'):<4} "
+                f"version={rq.get('version', '?')}")
 
     events = bundle.get("events") or []
     t_end = events[-1].get("t", 0.0) if events else 0.0
