@@ -68,6 +68,45 @@ class SpecStats(NamedTuple):
     accepted: jnp.ndarray        # draft tokens accepted by the target
 
 
+def batched_acceptance(drafts, choices, eligible):
+    """PER-ROW greedy acceptance for one batched speculative round —
+    the serving scheduler's schedule (``serving/decode_scheduler.py``),
+    where every row keeps its OWN acceptance length instead of the
+    lockstep ``min`` the fixed-shape ``speculative_generate`` loop
+    takes (the scheduler holds per-row position counters host-side, so
+    rows are free to advance unevenly).
+
+    drafts: (B, k) int32 — the draft's proposals per row;
+    choices: (B, k+1) int32 — the target's own per-position token
+    choices from the ONE chunked verify forward (``choices[:, i]`` is
+    the target's pick after consuming ``[last, d_1..d_i]``);
+    eligible: (B,) bool — rows NOT speculating this round (sampled
+    rows riding the dispatch masked to one real token, padded slots)
+    are forced to acceptance 0 so they emit exactly ``choices[:, 0]``.
+
+    Returns ``(accept_len (B,), emit (B, k+1))``: row ``b`` emits
+    ``emit[b, :accept_len[b]+1]`` — its accepted draft prefix plus the
+    target's own choice at the first divergence (the bonus token on a
+    fully-accepted round). Output-preserving by construction: every
+    emitted token is one of the TARGET's choices (accepted drafts
+    equal them by definition of acceptance). Runs in-program (jitted
+    by the scheduler) so one readback carries both the lengths and the
+    tokens."""
+    drafts = drafts.astype(jnp.int32)
+    choices = choices.astype(jnp.int32)
+    k = drafts.shape[1]
+    match = (drafts == choices[:, :k]).astype(jnp.int32)
+    j = jnp.cumprod(match, axis=1).sum(axis=1)          # (B,)
+    j = jnp.where(eligible, j, 0)
+    bonus = jnp.take_along_axis(choices, j[:, None], axis=1)  # (B, 1)
+    dpad = jnp.concatenate(
+        [drafts, jnp.zeros((drafts.shape[0], 1), jnp.int32)], axis=1)
+    idx = jnp.arange(k + 1)[None, :]
+    emit = jnp.where(idx < j[:, None], dpad,
+                     jnp.where(idx == j[:, None], bonus, 0))
+    return j, emit
+
+
 def speculative_generate(model, params, draft_model, draft_params,
                          prompt_ids, max_new_tokens: int, k: int = 4,
                          temperature: float = 0.0, rng=None,

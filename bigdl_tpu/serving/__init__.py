@@ -34,8 +34,9 @@ design pays (the overhead 1805.08430 "RPC Considered Harmful" measures).
   at decode-step boundaries over ONE compiled paged step; chunked
   prefill admission, per-request version pinning for hot swap,
   per-request temperature/top-p sampling under seeded key streams,
-  optional speculative fast path (docs/SERVING.md "Continuous
-  batching").
+  optional BATCHED speculative decoding — every greedy row drafts and
+  verifies per round with per-row acceptance (docs/SERVING.md
+  "Speculative decoding (batched)").
 * ``router`` — :class:`Router`: N engine replicas behind SLO-aware
   dispatch — priority-class weighted-fair queues, deadline-aware
   placement (tight deadlines to the least-loaded replica,

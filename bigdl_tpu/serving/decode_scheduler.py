@@ -43,8 +43,18 @@ keeps it to completion — swap() takes effect for later admissions, and
 each dispatch serves exactly one version group, so no dispatch (and no
 request continuation) ever mixes versions. Speculative decoding
 (nn/speculative.py's draft-propose / chunk-verify pattern) rides the
-same paged step as an opt-in fast path whenever exactly one request is
-active — the regime where lockstep acceptance actually pays.
+same paged step BATCHED across the whole version group (ISSUE 14):
+every greedy row drafts ``spec_k`` tokens in ``spec_k+1`` batched
+paged draft steps, ONE chunked verify (``S = spec_k+1`` per row — a
+shape ``decode_paged`` and the Pallas kernel already serve) scores
+them all, and per-row acceptance lengths (``nn.speculative.
+batched_acceptance``, computed in-program) advance each row
+independently — rollback is the host-side per-row position counter
+(rejected positions hold garbage the position-masked attention never
+reads and the next round's writes overwrite; target and draft pools
+stay in lockstep). Rows that cannot speculate — sampled rows, whose
+acceptance rule is argmax-match — ride the SAME verify dispatch
+masked to one real token, so a mixed batch still costs one program.
 
 Per-request telemetry rides the PR-5 rid machinery: ``serve/prefill``
 and ``serve/decode_step`` spans carry rids, and every future leaves
@@ -85,7 +95,8 @@ THREAD_NAME = "bigdl_tpu-serving-decode-scheduler"
 
 _STAT_KEYS = ("submitted", "completed", "rejected", "timeouts",
               "decode_steps", "prefill_chunks", "tokens", "swaps",
-              "spec_rounds", "spec_accepted", "defrags",
+              "spec_rounds", "spec_accepted", "spec_row_rounds",
+              "spec_fallbacks", "defrags",
               "prefix_hits", "prefix_misses", "prefix_reused_tokens",
               "prefix_cow_forks", "step_replays", "kv_corruptions")
 
@@ -129,7 +140,8 @@ class LMRequest:
                  "t_first_ns", "t_done_ns", "prefill_ms", "version",
                  "model_version", "slot", "pos", "generated", "steps",
                  "chunks", "pf_i", "temperature", "top_p", "seed",
-                 "hit_tokens", "adopted_n")
+                 "hit_tokens", "adopted_n", "draft_pos", "spec_rounds",
+                 "spec_accepted")
 
     def __init__(self, prompt, max_new_tokens, eos_id, deadline_s, rid,
                  temperature: float = 0.0, top_p: float = 1.0,
@@ -161,6 +173,12 @@ class LMRequest:
         self.pf_i = 0              # next prefill chunk to run
         self.hit_tokens = 0        # prefix-cache hit length (tokens)
         self.adopted_n = 0         # shared blocks adopted at admission
+        self.draft_pos = 0         # draft-cache write frontier (tokens);
+        #                            < pos means the draft trails the
+        #                            target and needs a catch-up prefill
+        #                            before its next speculative round
+        self.spec_rounds = 0       # speculative rounds this row rode
+        self.spec_accepted = 0     # draft tokens the target accepted
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -182,8 +200,16 @@ class DecodeScheduler:
         sizes the pool so every slot can hold a full ``max_seq_len``
         sequence; shrink it to exercise admission backpressure.
     prefill_chunk : chunked-prefill piece size (pow-2, >= 2).
-    draft_model : optional LM sharing the vocab — enables the greedy
-        speculative fast path when exactly one request is active.
+    draft_model : optional LM sharing the vocab — arms BATCHED greedy
+        speculative decoding: at every step boundary, EVERY greedy row
+        of a version group drafts ``spec_k`` tokens (batched paged
+        draft steps) and one chunked verify dispatch scores the whole
+        group, advancing each row by its own acceptance length
+        (docs/SERVING.md "Speculative decoding (batched)"). Sampled
+        rows ride the same verify masked to one real token;
+        sampled-MAJORITY groups and boundaries with a prompt
+        mid-prefill fall back to the plain step
+        (``serve/spec_fallbacks``).
     admission : ``"continuous"`` (iteration-level — the point of this
         class) or ``"static"`` (whole-request batching: a batch admits
         only when the previous one fully drained — the bench baseline).
@@ -359,6 +385,11 @@ class DecodeScheduler:
         self._step_jit = self._build_step(model, "serve/decode_step")
         self._draft_jit = (self._build_step(draft_model, "serve/draft_step")
                            if draft_model is not None else None)
+        # per-row acceptance lengths computed IN-PROGRAM: one readback
+        # per spec round carries (accept_len, emitted tokens) for the
+        # whole batch (nn/speculative.py)
+        from ..nn.speculative import batched_acceptance
+        self._accept_jit = jax.jit(batched_acceptance)
         self.static_wait_ms = float(static_wait_ms)
         self.max_queue = int(max_queue)
         self._q: queue.Queue = queue.Queue(maxsize=self.max_queue)
@@ -542,10 +573,26 @@ class DecodeScheduler:
         for s in shapes_upto(self.prefill_chunk):
             drive(self._step_jit, self.kv, 1, s)
         if self.draft_model is not None:
-            drive(self._draft_jit, self.draft_kv, 1, 1)
+            # batched speculation touches every (bucket, S) pair: the
+            # draft steps and the S=spec_k+1 verify run at EVERY decode
+            # bucket (the whole version group rides one round), and the
+            # draft's (1, s) prefill/catch-up shapes mirror the
+            # target's chunk schedule
+            for b in shapes_upto(self.max_slots):
+                drive(self._draft_jit, self.draft_kv, b, 1)
+                drive(self._step_jit, self.kv, b, self.spec_k + 1)
+                # the in-program acceptance schedule compiles per
+                # bucket too — live traffic must add zero compiles
+                # (operands ride _put like every live dispatch, so the
+                # warmed placement matches; today mesh+draft is refused
+                # and _put is a plain transfer, but the invariant must
+                # survive a future mesh-served spec path)
+                jax.block_until_ready(self._accept_jit(  # sync-ok: warmup
+                    self._put(np.zeros((b, self.spec_k), np.int32)),
+                    self._put(np.zeros((b, self.spec_k + 1), np.int32)),
+                    self._put(np.zeros((b,), bool))))
             for s in shapes_upto(self.prefill_chunk):
                 drive(self._draft_jit, self.draft_kv, 1, s)
-            drive(self._step_jit, self.kv, 1, self.spec_k + 1)
         return self
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -758,16 +805,17 @@ class DecodeScheduler:
         return (self.kv.pages(),
                 self.draft_kv.pages() if self.draft_kv is not None
                 else None,
-                [(r, r.pos, r.steps, len(r.generated), r.pf_i)
-                 for r in rows])
+                [(r, r.pos, r.steps, len(r.generated), r.pf_i,
+                  r.draft_pos) for r in rows])
 
     def _restore_step_state(self, snap):
         pages, dpages, rows = snap
         self.kv.set_pages(pages)
         if dpages is not None:
             self.draft_kv.set_pages(dpages)
-        for r, pos, steps, ngen, pf_i in rows:
+        for r, pos, steps, ngen, pf_i, draft_pos in rows:
             r.pos, r.steps, r.pf_i = pos, steps, pf_i
+            r.draft_pos = draft_pos
             del r.generated[ngen:]
 
     def _replay_group(self, stage, rows, fn):
@@ -1038,6 +1086,10 @@ class DecodeScheduler:
                 self._backlog.popleft()
                 self._expire(req)
                 continue
+            # spec_over is PER SLOT: under batched speculation every
+            # active row (sampled ones included — they ride the verify
+            # dispatch masked to one real token, whose padded lanes
+            # still write k+1 positions) may overshoot by spec_k+1
             spec_over = (self.spec_k + 1) if self.draft_model is not None \
                 else 0
             worst = max(
@@ -1217,7 +1269,13 @@ class DecodeScheduler:
                     self._put(np.asarray([s], np.int32)),
                     self._put(table), *self._sampling_args([req], 1))
                 dpages = None
-                if self.draft_kv is not None:
+                if self.draft_kv is not None and req.hit_tokens == 0:
+                    # warm prefix-HIT requests skip the draft prefill
+                    # with the target's (the adopted region was never
+                    # prefilled here) — the draft catches up LAZILY on
+                    # the row's first speculative round instead
+                    # (_draft_catchup), so a warm hit keeps its spec
+                    # eligibility
                     dtable = self.draft_kv.block_table(req.rid)[None]
                     _, dpages = self._draft_jit(
                         self._draft_params(), self.draft_kv.pages(),
@@ -1236,6 +1294,7 @@ class DecodeScheduler:
         self.kv.set_pages(pages)
         if dpages is not None:
             self.draft_kv.set_pages(dpages)
+            req.draft_pos = s + real
         self._bump("prefill_chunks")
         req.pf_i += 1
         req.prefill_ms += (time.perf_counter_ns() - t0) / 1e6
@@ -1243,6 +1302,22 @@ class DecodeScheduler:
             return True
         self._prefilling.popleft()
         self._register_prefix(req)
+        # the admission reservation covered the PREFILL's padded chunk
+        # tail (prefill_padded_end), which can exceed the generation
+        # phase's exact need — return the padding-only tail blocks to
+        # the pool now (per-row ledger truncate, refcount-aware: the
+        # adopted prefix sits at the table HEAD and is untouched).
+        # Nothing re-grows this row's tables afterwards — decode/spec
+        # writes are bounded by keep (verify tops out at
+        # pos + spec_k < keep, catch-up clamps to the owned capacity) —
+        # so the no-mid-flight-OOM invariant keeps holding while
+        # backlogged admissions see the reclaimed blocks immediately.
+        spec_over = (self.spec_k + 1) if self.draft_model is not None \
+            else 0
+        keep = int(req.prompt.size) + req.max_new_tokens + spec_over
+        self.kv.truncate(req.rid, keep)
+        if self.draft_kv is not None:
+            self.draft_kv.truncate(req.rid, keep)
         req.pos = int(req.prompt.size)
         req.t_first_ns = time.perf_counter_ns()
         self._bump("tokens")
@@ -1280,22 +1355,33 @@ class DecodeScheduler:
         for r in self._active:
             groups.setdefault(r.version, []).append(r)
         for version, rows in list(groups.items()):
-            if (self.draft_model is not None and len(self._active) == 1
-                    and len(rows) == 1 and not self._prefilling
-                    and rows[0].temperature <= 0.0
-                    and rows[0].hit_tokens == 0):
-                # truly alone (and greedy — the draft-propose/verify
-                # acceptance rule is argmax-match): a multi-token spec
-                # burst must not delay a joining request's interleaved
-                # prefill chunks. PREFIX-HIT requests skip the draft
-                # model's prefill along with the target's, so the draft
-                # KV over the adopted region is garbage — its proposals
-                # would be noise and every spec round a net loss; hit
-                # requests ride the normal bucketed step instead
-                # (tokens identical either way — spec is
-                # output-preserving).
-                self._spec_round(rows[0])
+            n_elig = sum(1 for r in rows if r.temperature <= 0.0)
+            if self.draft_model is not None and n_elig >= 1 \
+                    and 2 * n_elig >= len(rows) and not self._prefilling:
+                # a GREEDY-MAJORITY group with no prompt mid-prefill
+                # rides ONE batched speculative round — greedy rows
+                # draft+verify spec_k tokens, sampled rows (argmax-match
+                # acceptance cannot apply) ride the same verify dispatch
+                # masked to one real token. Two deliberate guards: a
+                # sampled-majority group steps plain (each sampled row
+                # advances 1 token per round, so a lone greedy row must
+                # not tax the majority spec_k+2 dispatches per token),
+                # and a multi-token spec burst must not delay a joining
+                # request's interleaved prefill chunks (the PR-8 rule;
+                # the resulting draft-cache lag is repaid by
+                # _draft_catchup on the next round). Spec is
+                # output-preserving, so tokens are bitwise the plain
+                # step's either way.
+                self._spec_step(version, rows)
             else:
+                if self.draft_model is not None and rows:
+                    # armed but not speculating this boundary (sampled
+                    # majority, or prefill-interleave protection):
+                    # plain step, counted so operators can see
+                    # speculation capacity going unused
+                    self._bump("spec_fallbacks")
+                    if obs.enabled():
+                        obs.counter("serve/spec_fallbacks").inc()
                 self._step_group(version, rows)
         return True
 
@@ -1339,73 +1425,159 @@ class DecodeScheduler:
             obs.histogram("serve/decode_occupancy").observe(n / bucket)
             obs.gauge("serve/active_slots").set(len(self._active))
 
-    def _spec_round(self, req):
-        """Greedy speculative fast path (single active request): the
-        draft proposes ``spec_k`` tokens one paged step at a time, the
-        target verifies all of them (+1 bonus) in ONE chunked paged
-        forward, and the longest matching prefix is emitted — exactly
-        nn/speculative.py's schedule, host-driven so the request can
-        still leave (and others join) at every round boundary. Output-
-        preserving: the emitted tokens are the target's own greedy
-        choices (the correctness gate covers this path too)."""
-        k = self.spec_k
-        last = req.generated[-1]
-        pos0 = req.pos
-        dmv = self._draft_params()
+    def _draft_catchup(self, req, dparams):
+        """Bring one row's draft cache level with its target cache:
+        re-prefill positions ``draft_pos..pos-1`` from the tokens the
+        row already holds (prompt + generated — all host-resident), in
+        the prefill chunk shapes warmup compiled. Two callers leave a
+        row trailing: a warm prefix HIT (its draft prefill was skipped
+        along with the target's — this is the lazy re-prefill that
+        restores spec eligibility, ISSUE 14 satellite) and plain decode
+        steps taken while the row was spec-ineligible company or a
+        prompt was mid-prefill. The tail chunk's pow-2 padding halves
+        until it fits the row's OWNED draft capacity (shrunk to the
+        exact generation need once its prefill-padding tail was
+        truncated), so a padded write can never run past the row's
+        block table."""
+        seq = np.concatenate([req.prompt,
+                              np.asarray(req.generated, np.int32)])
         dtable = self.draft_kv.block_table(req.rid)[None]
+        cap = min(self.max_seq_len,
+                  self.draft_kv.owned(req.rid) * self.draft_kv.block_size)
+        while req.draft_pos < req.pos:
+            real = min(self.prefill_chunk, req.pos - req.draft_pos)
+            padded = _pow2_bucket(real, self.prefill_chunk)
+            while req.draft_pos + padded > cap:
+                padded >>= 1
+            real = min(real, padded)
+            toks = np.zeros((1, padded), np.int32)
+            toks[0, :real] = seq[req.draft_pos:req.draft_pos + real]
+            _, dpages = self._draft_jit(
+                dparams, self.draft_kv.pages(), self._put(toks),
+                self._put(np.asarray([req.draft_pos], np.int32)),
+                self._put(dtable), *self._sampling_args((), 1))
+            self.draft_kv.set_pages(dpages)
+            req.draft_pos += real
+
+    def _spec_step(self, version, rows):
+        """ONE batched speculative round for a whole version group
+        (ISSUE 14 — the generalization of the PR-8 solo fast path):
+
+        1. eligible rows (greedy) that trail the draft cache catch up
+           (:meth:`_draft_catchup`);
+        2. ``spec_k+1`` BATCHED paged draft steps propose per-row draft
+           chains — the token feed stays device-resident (each step
+           consumes the previous step's choices), so the draft phase
+           adds ZERO readbacks; the extra (k+1)-th step writes d_k's
+           K/V so a fully-accepted round leaves no draft-cache hole
+           (nn/speculative.py); ineligible rows ride the draft steps
+           against the null table (their draft cache is never touched);
+        3. ONE chunked verify — the same compiled paged step at
+           ``S = spec_k+1`` — scores every row's ``[last, d_1..d_k]``;
+        4. per-row acceptance lengths come back from the in-program
+           ``batched_acceptance`` schedule in a single readback, and
+           each row emits its accepted prefix + the target's own choice
+           at the divergence (ineligible rows: acceptance 0 — exactly
+           their plain one-token step, bitwise).
+
+        Rollback is positional: row ``b`` advances ``pos`` by
+        ``j_b + 1`` while the round wrote ``spec_k+1`` positions —
+        rejected positions hold garbage that position-masked paged
+        attention never reads and the next round's (or plain step's)
+        writes overwrite, in BOTH pools (``draft_pos`` snaps to ``pos``
+        so the pools stay in lockstep). Admission already reserved the
+        ``spec_k+1`` overshoot per slot (``spec_over``), so the round's
+        writes can never OOM. Output-preserving: every emitted token is
+        the target's own choice at its position — the bitwise gate in
+        tests/test_serving_lm.py holds per row across any batch mix."""
+        k = self.spec_k
+        n = len(rows)
+        bucket = bucket_for(max(n, 2), self.max_slots)
+        elig = np.zeros((bucket,), bool)
+        last = np.zeros((bucket, 1), np.int32)
+        positions = np.zeros((bucket,), np.int32)
+        dpositions = np.zeros((bucket,), np.int32)
+        tables = np.zeros((bucket, self.kv.max_blocks_per_seq), np.int32)
+        dtables = np.zeros((bucket, self.draft_kv.max_blocks_per_seq),
+                           np.int32)
+        for i, r in enumerate(rows):
+            elig[i] = r.temperature <= 0.0
+            last[i, 0] = r.generated[-1]
+            positions[i] = r.pos
+            tables[i] = self.kv.block_table(r.rid)
+        mv = rows[0].model_version
+        rids = [r.rid for r in rows]
+        dparams = self._draft_params()
+        samp = self._sampling_args(rows, bucket)
+        greedy = self._sampling_args((), bucket)
 
         def round_fn():
             _chaos.maybe_fire("serving/spec_round", tag=self.name)
-            drafts = []
-            tok = last
-            with obs.span("serve/spec_round", rid=req.rid, k=k,
-                          version=req.version):
-                # k+1 draft steps: the extra step writes d_k's K/V so a
-                # fully-accepted round leaves no cache hole
-                # (speculative.py)
+            with obs.span("serve/spec_round", rids=rids, k=k,
+                          bucket=bucket, version=version):
+                for i, r in enumerate(rows):
+                    if elig[i] and r.draft_pos < r.pos:
+                        self._draft_catchup(r, dparams)
+                    if elig[i]:
+                        # fetched AFTER catch-up — tables are stable
+                        # within a round, but keep one read order
+                        dtables[i] = self.draft_kv.block_table(r.rid)
+                        dpositions[i] = r.pos
+                tok = self._put(last)
+                last_dev = tok
+                dtab_dev = self._put(dtables)
+                drafts = []
                 for i in range(k + 1):
                     choices, dpages = self._draft_jit(
-                        dmv, self.draft_kv.pages(),
-                        jnp.asarray([[tok]], np.int32),
-                        jnp.asarray([pos0 + i], np.int32),
-                        jnp.asarray(dtable), *self._sampling_args((), 1))
+                        dparams, self.draft_kv.pages(), tok,
+                        self._put(dpositions + i), dtab_dev, *greedy)
                     self.draft_kv.set_pages(dpages)
-                    # sync-ok: draft proposals drive the verify chunk's
-                    # token ids — the round is host-driven by design
-                    tok = int(np.asarray(choices)[0, 0])
+                    tok = choices
                     if i < k:
-                        drafts.append(tok)
-                chunk = np.asarray([[last] + drafts], np.int32)  # (1,k+1)
-                table = self.kv.block_table(req.rid)[None]
-                choices, pages = self._step_jit(
-                    req.model_version.params, self.kv.pages(),
-                    jnp.asarray(chunk), jnp.asarray([pos0], np.int32),
-                    jnp.asarray(table), *self._sampling_args((), 1))
+                        drafts.append(choices)
+                drafts_c = jnp.concatenate(drafts, axis=1)   # (B, k)
+                chunk = jnp.concatenate([last_dev, drafts_c], axis=1)
+                vchoices, pages = self._step_jit(
+                    mv.params, self.kv.pages(), chunk,
+                    self._put(positions), self._put(tables), *samp)
                 self.kv.set_pages(pages)
-                # sync-ok: verify readback — acceptance happens on host
-                return drafts, np.asarray(choices)[0]          # (k+1,)
+                j, emit = self._accept_jit(drafts_c, vchoices,
+                                           self._put(elig))
+                # sync-ok: the per-round readback — acceptance lengths
+                # + emitted tokens drive EOS/budget bookkeeping on host
+                return jax.device_get((j, emit))
 
-        # the replay snapshot covers BOTH pools' page handles, so a
-        # transient mid-round (after some draft writes) rolls the whole
-        # round back and replays it from the original pages — bitwise
-        drafts, target = self._replay_group("spec", [req], round_fn)
-        j = 0
-        while j < k and drafts[j] == int(target[j]):
-            j += 1
-        emitted = drafts[:j] + [int(target[j])]
-        req.pos = pos0 + j + 1
-        req.steps += 1
+        # the replay snapshot covers BOTH pools' page handles and every
+        # row's (pos, draft_pos, generated) — a transient anywhere in
+        # the round (catch-up, draft burst, verify) rolls the whole
+        # round back and replays it bitwise
+        j, emit = self._replay_group("spec", rows, round_fn)
         self._bump("decode_steps")
         self._bump("spec_rounds")
-        self._bump("spec_accepted", j)
-        self._bump("tokens", len(emitted))
+        nrow = nacc = ntok = 0
+        for i, r in enumerate(rows):
+            ji = int(j[i])
+            r.pos += ji + 1
+            r.steps += 1
+            if elig[i]:
+                r.draft_pos = r.pos
+                r.spec_rounds += 1
+                r.spec_accepted += ji
+                nrow += 1
+                nacc += ji
+                if obs.enabled():
+                    obs.histogram("serve/spec_accepted_len").observe(ji)
+            for t in emit[i, :ji + 1]:
+                ntok += 1
+                if self._emit(r, int(t)):
+                    break
+        self._bump("spec_row_rounds", nrow)
+        self._bump("spec_accepted", nacc)
+        self._bump("tokens", ntok)
         if obs.enabled():
             obs.counter("serve/spec_rounds").inc()
-            obs.counter("serve/spec_accepted").inc(j)
-            obs.counter("serve/lm_tokens").inc(len(emitted))
-        for t in emitted:
-            if self._emit(req, t):
-                break
+            obs.counter("serve/spec_accepted").inc(nacc)
+            obs.counter("serve/lm_tokens").inc(ntok)
 
     # -- eviction / completion -------------------------------------------
 
@@ -1460,6 +1632,8 @@ class DecodeScheduler:
             "tokens": n,
             "version": req.version,
             "prefix_hit_tokens": req.hit_tokens,
+            "spec_rounds": req.spec_rounds,
+            "spec_accepted": req.spec_accepted,
         }
         self._bump("completed")
         if obs.enabled():

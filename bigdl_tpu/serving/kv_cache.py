@@ -293,6 +293,42 @@ class PagedKVCache:
         self._set_gauges()
         return len(blocks)
 
+    def truncate(self, owner, keep_tokens: int) -> int:
+        """Drop the TAIL of ``owner``'s table past ``keep_tokens``
+        positions — the ledger half of a per-row rollback. The batched
+        speculative path rolls a row back POSITIONALLY (the host-side
+        position counter retreats to the accepted length and the next
+        round's writes overwrite the rejected pages — no device work),
+        keeping its worst-case reservation intact so later rounds can
+        never OOM mid-flight; ``truncate`` is the complementary
+        primitive for callers that want the overshoot capacity BACK
+        (e.g. shrinking a finished-early row before handing its slot
+        over). Refcount-aware like :meth:`free`: private tail blocks
+        return to the free list, shared ones just lose this owner's
+        reference. Returns the number of table entries dropped;
+        idempotent past the current allocation."""
+        keep = (blocks_for_tokens(keep_tokens, self.block_size)
+                if keep_tokens > 0 else 0)
+        returned = 0
+        with self._lock:
+            have = self._owned.get(owner)
+            if have is None or len(have) <= keep:
+                return 0
+            tail = have[keep:]
+            del have[keep:]
+            for b in reversed(tail):
+                r = self._refs.get(b, 0)
+                if r <= 1:
+                    self._refs.pop(b, None)
+                    self._free.append(b)
+                    returned += 1
+                else:
+                    self._refs[b] = r - 1
+        if returned and obs.enabled():
+            obs.counter(f"{self.metric_prefix}_frees").inc(returned)
+        self._set_gauges()
+        return len(tail)
+
     def fork_blocks(self, owner, idxs: Sequence[int]) -> List[int]:
         """COPY-ON-WRITE: replace the given logical indices of
         ``owner``'s table with private copies wherever the current

@@ -90,12 +90,24 @@ class ModelRegistry:
         return place_with_specs(tree, self.mesh, specs)
 
     def publish(self, params, state=None, version: Optional[str] = None,
-                activate: bool = False) -> str:
+                activate: bool = False, transform=None) -> str:
         """Load a new version (device placement happens HERE, on the
         calling thread — the background-load half of a hot swap; sharded
         onto the registry's mesh when it has one) and optionally
         activate it. Returns the version id (auto-assigned ``v<n>`` when
-        not given)."""
+        not given).
+
+        ``transform`` — optional ``params -> params`` callable run
+        exactly ONCE, here on the publishing thread, BEFORE placement:
+        a declared derivation (``quantization.lm.quantize_lm_params``
+        for a weight-only int8/int4 serving version, a dtype cast, a
+        LoRA merge) becomes registry policy instead of a convention
+        every publishing call site must remember. The stored version
+        holds the TRANSFORMED params; swap semantics are unchanged
+        (activation stays a pointer flip, in-flight batches keep the
+        version they pinned)."""
+        if transform is not None:
+            params = transform(params)
         placed = ModelVersion("", self._place_tree(params, self._param_specs),
                               self._place_tree(state, self._state_specs))
         with self._lock:

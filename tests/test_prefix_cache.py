@@ -373,28 +373,37 @@ def test_no_cross_version_reuse_after_swap():
     _no_leaked_blocks(st)
 
 
-def test_warm_hit_with_speculative_fast_path():
-    """Prefix adoption composes with speculative decoding: a COLD solo
-    request rides the spec fast path as before, but a WARM hit skipped
-    the draft model's prefill along with the target's — its draft KV
-    over the adopted region is garbage, so the scheduler routes hit
-    requests through the normal bucketed step (spec proposals from a
-    garbage cache would be noise: all cost, no acceptance). Tokens are
-    bitwise the target's greedy decode on both paths."""
+def test_warm_hit_speculates_after_lazy_draft_catchup():
+    """Prefix adoption composes with speculative decoding (ISSUE 14
+    satellite — the PR-12 cost-only carve-out is gone): a warm HIT
+    skipped the draft model's prefill along with the target's, so the
+    scheduler lazily re-prefills the draft over the adopted region on
+    the row's first spec round (`_draft_catchup`) instead of losing
+    spec eligibility forever. Warm tokens are bitwise the cold run's,
+    the warm request DOES ride spec rounds, and with a perfect draft
+    its acceptance is as total as the cold run's (the catch-up rebuilt
+    a correct draft cache — garbage proposals would zero it)."""
     m = _model()                      # sinusoidal/MHA variant
     rng = np.random.RandomState(26)
     p = rng.randint(1, V, size=16).astype(np.int32)
     want = solo_oracle(m, m.params, p, 10)
     with _sched(m, draft_model=m, spec_k=3) as sched:
         a = sched.submit(p, 10).result(timeout=120)
-        rounds_cold = sched.stats()["spec_rounds"]
-        b = sched.submit(p, 10).result(timeout=120)
+        st_cold = sched.stats()
+        fut = sched.submit(p, 10)
+        b = fut.result(timeout=120)
         st = sched.stats()
     assert np.array_equal(a, want) and np.array_equal(b, want)
-    assert rounds_cold > 0, "the cold request must ride the spec path"
-    assert st["spec_rounds"] == rounds_cold, \
-        "a warm hit must NOT spec-decode over a garbage draft cache"
+    assert st_cold["spec_rounds"] > 0, "cold must ride the spec path"
+    assert st["spec_rounds"] > st_cold["spec_rounds"], \
+        "a warm hit must speculate too (lazy draft catch-up)"
     assert st["prefix_hits"] == 1
+    warm_rounds = st["spec_rounds"] - st_cold["spec_rounds"]
+    warm_accept = st["spec_accepted"] - st_cold["spec_accepted"]
+    assert warm_accept == 3 * warm_rounds, \
+        "perfect draft after catch-up must accept every proposal"
+    assert fut.trace["spec_rounds"] == warm_rounds
+    assert fut.trace["spec_accepted"] == warm_accept
     _no_leaked_blocks(st)
 
 

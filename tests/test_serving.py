@@ -233,6 +233,60 @@ def test_registry_publish_activate_retire():
     assert reg.publish({"w": np.ones(2)}) == "v3"  # auto skips taken ids
 
 
+def test_registry_publish_transform_runs_once_on_publish():
+    """ISSUE 14 satellite: publish(..., transform=) runs the declared
+    param derivation exactly ONCE, on the publishing thread, before
+    placement — a derived (e.g. quantized) version is registry policy.
+    Swap semantics are unchanged: activation is still a pointer flip
+    and untransformed versions are untouched."""
+    reg = ModelRegistry()
+    calls = []
+
+    def double(params):
+        calls.append(threading.get_ident())
+        return jax.tree_util.tree_map(lambda a: a * 2, params)
+
+    v0 = reg.publish({"w": np.ones(2)}, version="plain", activate=True)
+    v1 = reg.publish({"w": np.ones(2)}, version="derived",
+                     transform=double)
+    assert calls == [threading.get_ident()], \
+        "transform must run exactly once, on the publishing thread"
+    # the stored version holds the TRANSFORMED params; the active
+    # version is untouched until activation (a pointer flip)
+    assert np.array_equal(np.asarray(reg.get(v1).params["w"]),
+                          np.full(2, 2.0))
+    assert reg.active_version == v0
+    reg.activate(v1)
+    assert np.array_equal(np.asarray(reg.current().params["w"]),
+                          np.full(2, 2.0))
+    assert len(calls) == 1, "activation must not re-run the transform"
+    # rollback still works and never re-derives
+    reg.activate(v0)
+    assert np.array_equal(np.asarray(reg.current().params["w"]),
+                          np.ones(2))
+    assert len(calls) == 1
+
+
+def test_registry_publish_transform_quantize_serves():
+    """The motivating derivation: quantization.lm.quantize_lm_params as
+    a publish transform — the stored version's block matmul weights are
+    QuantizedWeight pytrees and the quantized params still drive the
+    model's generate path (the weight-only int8 serving plumb)."""
+    from bigdl_tpu.models.transformer_lm import TransformerLM
+    from bigdl_tpu.quantization.lm import QuantizedWeight, quantize_lm_params
+    m = TransformerLM(vocab_size=32, hidden_size=16, num_heads=2,
+                      filter_size=32, num_layers=1, max_len=32)
+    m.ensure_initialized()
+    reg = ModelRegistry()
+    v = reg.publish(m.params, version="int8", activate=True,
+                    transform=quantize_lm_params)
+    qp = reg.get(v).params
+    assert isinstance(qp["block0"]["attn"]["wq"], QuantizedWeight)
+    prompt = np.asarray([[1, 2, 3]], np.int32)
+    out = np.asarray(m.generate(qp, prompt, 4))
+    assert out.shape == (1, 7)
+
+
 def test_hot_swap_mid_traffic_never_mixes_versions():
     m = _tiny_model()
     zero_params = jax.tree_util.tree_map(lambda a: a * 0, m.params)

@@ -73,11 +73,14 @@ HOT_FUNCS = {
     # continuous-batching decode loop: a stray sync between decode steps
     # stalls EVERY active generation, not one request — the deliberate
     # ones are the per-step token readback (EOS detection), the
-    # first-token readback in prefill, the spec round's draft/verify
-    # readbacks, and the warmup precompile block
+    # first-token readback in prefill, the batched spec round's single
+    # acceptance readback (the draft burst itself is device-resident —
+    # a sync inside it would serialize every proposal), and the warmup
+    # precompile block
     "bigdl_tpu/serving/decode_scheduler.py": {
         "_loop", "_admit", "_advance_prefill", "_step_all", "_step_group",
-        "_spec_round", "_evict_expired", "_emit", "_finish", "_release",
+        "_spec_step", "_draft_catchup", "_evict_expired", "_emit",
+        "_finish", "_release",
         "submit", "warmup", "_put", "_sampling_args",
         # prefix-reuse admission path (ISSUE 12): the chain lookup,
         # warm-plan construction and suffix registration are pure host
@@ -99,7 +102,7 @@ HOT_FUNCS = {
     "bigdl_tpu/serving/kv_cache.py": {
         "ensure_capacity", "free", "block_table", "can_allocate",
         "adopt", "retain", "release", "fork_blocks", "block_refs",
-        "owner_blocks",
+        "owner_blocks", "truncate",
         # the invariant checker runs on the scheduler cadence — one
         # consistent host snapshot, never a page read
         "audit",
