@@ -11,13 +11,17 @@
 # unmarked smoke-size test). Serial total ~18 min of XLA compiles on
 # one core (measured; `make test` with 4 oversubscribed workers on that
 # same 1-core box: 23.5 min); a 4-core box lands around ~5-6 min with
-# `make test`, a 2-core box inside 10 min with NPROC=2.
+# `make test`, a 2-core box inside 10 min with NPROC=2. The tier1
+# pytest budget is 1800 s: the suite crossed ~25 min serial when the
+# fleet tier's subprocess-spawning tests landed (PR 15), whose two
+# heaviest drills are @slow — `make fleet-smoke` covers them in tier1.
 PYTEST ?= python -m pytest
 NPROC ?= 4
 SHELL := /bin/bash
 
 .PHONY: test test-slow test-serial test-examples tier1 check-no-sync \
-	serve-smoke obs-smoke fault-smoke perf-gate kernels-smoke chaos-smoke
+	serve-smoke obs-smoke fault-smoke perf-gate kernels-smoke chaos-smoke \
+	fleet-smoke
 test:
 	$(PYTEST) tests/ -q -n $(NPROC) --dist loadfile
 
@@ -26,8 +30,8 @@ test:
 # the sync-point lint so an un-annotated float()/block_until_ready in the
 # hot loop fails before the 15-minute suite starts, and on the serving
 # smoke so a broken engine fails in seconds, not mid-suite.
-tier1: check-no-sync perf-gate kernels-smoke serve-smoke obs-smoke fault-smoke chaos-smoke
-	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+tier1: check-no-sync perf-gate kernels-smoke serve-smoke obs-smoke fault-smoke chaos-smoke fleet-smoke
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1800 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 check-no-sync:
 	python tools/check_no_sync.py
@@ -96,6 +100,16 @@ fault-smoke:
 chaos-smoke:
 	timeout -k 10 300 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
 		python tools/chaos_smoke.py
+
+# Cross-process fleet drill (docs/SERVING.md "Fleet serving"): 1 router
+# + 2 replica agent processes + 1 prefill specialist under mixed load
+# with an injected agent kill mid-decode and an injected death
+# mid-handoff — asserts zero lost requests, every stream bitwise the
+# monolithic single-process scheduler (recovered + handed-off streams
+# included), and kv_blocks_in_use -> 0 in every surviving process.
+fleet-smoke:
+	timeout -k 10 900 env JAX_PLATFORMS=cpu BENCH_METRICS_OUT='' \
+		python tools/fleet_smoke.py
 
 test-slow:
 	BIGDL_TPU_SLOW=1 $(PYTEST) tests/ -q -n $(NPROC) --dist loadfile

@@ -740,6 +740,266 @@ def main_lm(smoke: bool):
           f"({warm_ratio}x)")
 
 
+# --------------------------------------------------------------- fleet
+
+def _spawn_fleet_agent(fleet_dir, name, role, idx, params_path,
+                       model_cfg, sched_cfg):
+    """One replica agent subprocess (python -m bigdl_tpu.serving.fleet)."""
+    import subprocess
+    cfg = {"fleet_dir": fleet_dir, "name": name, "role": role,
+           "beat_s": 0.2, "process_index": idx, "model": model_cfg,
+           "params_path": params_path, "scheduler": dict(sched_cfg)}
+    path = os.path.join(fleet_dir, f"cfg_{name}.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    env.pop("BIGDL_TPU_CHAOS", None)
+    # agent output goes to FILES, not pipes: nobody drains a pipe while
+    # the agent runs, so a chatty agent (jax warnings, death
+    # tracebacks) would block on the ~64 KB pipe buffer and wedge
+    log = open(os.path.join(fleet_dir, f"agent_{name}.log"), "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "bigdl_tpu.serving.fleet", path],
+        stdout=log, stderr=subprocess.STDOUT, cwd=repo, env=env)
+
+
+def _drive_fleet(submit_fn, plan, drain=None):
+    """Closed-loop drive of one fleet/router arm: returns
+    (tokens_per_s, outputs keyed (client, request), ttft list)."""
+    import threading as _t
+    n_clients = len(plan)
+    total = [0] * n_clients
+    outputs, ttfts = {}, []
+    lock = _t.Lock()
+
+    def client(i):
+        for j, (prompt, max_new) in enumerate(plan[i]):
+            fut = submit_fn(prompt, max_new)
+            out = fut.result(timeout=600)
+            with lock:
+                total[i] += int(np.asarray(out).size)
+                outputs[(i, j)] = np.asarray(out)
+                tr = fut.trace or {}
+                if tr.get("ttft_ms") is not None:
+                    ttfts.append(tr["ttft_ms"])
+
+    dt = _client_pool(n_clients, client)
+    if drain is not None:
+        drain(timeout=120.0)
+    return sum(total) / dt, outputs, ttfts
+
+
+def bench_serving_fleet(n_clients, n_requests, max_slots, n_long,
+                        smoke=False):
+    """ISSUE 15: the cross-process arms.
+
+    Arm A — single-process Router over 2 in-process scheduler replicas
+    (the PR-9 configuration) at a closed-loop offered load.
+    Arm B — the SAME load through a 2-process fleet (agents in their own
+    processes, framed-socket dispatch, file-heartbeat health). The
+    tokens must match arm A bitwise (process transparency); tokens/s
+    lands as ``serving_fleet_tokens_per_s`` with the fleet/local ratio.
+    On a contended CPU box the ratio mostly measures transport + IPC
+    tax — the bands are wide; the on-chip numbers are deferred exactly
+    like PR 11's kernel arm.
+    Arm C — disaggregation: a steady short-request stream rides the
+    decode fleet while a burster submits long prompts, once DIRECT
+    (decode replicas pay the long prefills at their step boundaries)
+    and once through the PREFILL POOL (a specialist prefills, KV hands
+    off, decode admission takes the warm hit). The short stream's p99
+    TTFT ratio (direct/pool) is the insulation number.
+    """
+    from bigdl_tpu.serving import (DecodeScheduler, DisaggregatedFleet,
+                                   FleetMonitor, RemoteReplica, Router,
+                                   wait_for_members)
+    import pickle
+    import tempfile
+    model_cfg = dict(vocab_size=128, hidden_size=64, num_heads=4,
+                     filter_size=128, num_layers=2, max_len=512)
+    sched_cfg = dict(max_slots=max_slots, block_size=16,
+                     max_seq_len=384, prefill_chunk=16)
+    model = _build_lm_model()
+    plan = _lm_workload(n_clients, n_requests, 512)
+
+    # -- arm A: single-process 2-replica router
+    local = [DecodeScheduler(model, name=f"L{i}", **sched_cfg)
+             for i in range(2)]
+    rA = Router(local, name="local").start()
+    thr_local, out_local, _ = _drive_fleet(
+        lambda p, mn: rA.submit(p, max_new_tokens=mn), plan, rA.drain)
+    rA.shutdown()
+
+    # -- arm B: the same router logic over a 2-process fleet
+    fd = tempfile.mkdtemp(prefix="bench_fleet_")
+    params_path = os.path.join(fd, "params.pkl")
+    import jax
+    with open(params_path, "wb") as f:
+        pickle.dump(jax.tree_util.tree_map(np.asarray, model.params), f)
+    procs = [
+        _spawn_fleet_agent(fd, "f0", "replica", 1, params_path,
+                           model_cfg, sched_cfg),
+        _spawn_fleet_agent(fd, "f1", "replica", 2, params_path,
+                           model_cfg, sched_cfg),
+        _spawn_fleet_agent(fd, "fp", "prefill", 3, params_path,
+                           model_cfg, sched_cfg),
+    ]
+    docs = wait_for_members(fd, ["f0", "f1", "fp"], timeout_s=600)
+    by = {d["name"]: d for d in docs}
+    reps = [RemoteReplica(by["f0"], fleet_dir=fd),
+            RemoteReplica(by["f1"], fleet_dir=fd)]
+    rpf = RemoteReplica(by["fp"], fleet_dir=fd).start()
+    rB = Router(reps, name="fleet", max_failovers=4).start()
+    mon = FleetMonitor(reps + [rpf], fleet_dir=fd, every_s=0.25,
+                       stale_s=15.0).start()
+    thr_fleet, out_fleet, _ = _drive_fleet(
+        lambda p, mn: rB.submit(p, max_new_tokens=mn), plan, rB.drain)
+    match = (len(out_local) == len(out_fleet)
+             and all(np.array_equal(out_local[k], out_fleet[k])
+                     for k in out_local))
+
+    # -- arm C: decode-p99 insulation from long-prompt prefill bursts
+    rng = np.random.RandomState(7)
+    nshort = max(2, n_clients - 1)
+    short_plan = [[(rng.randint(1, 128, size=int(rng.randint(4, 13))
+                                ).astype(np.int32), 8)
+                   for _ in range(n_requests)] for _ in range(nshort)]
+    # DISTINCT long prompts per arm: the direct arm's prefills register
+    # in the decode replicas' prefix caches, so re-using one list would
+    # hand the pool arm warm hits it never earned — the insulation
+    # ratio must measure the handoff, not cache warmth from arm 1
+    def _mk_longs():
+        return [rng.randint(1, 128, size=int(rng.randint(160, 241))
+                            ).astype(np.int32) for _ in range(n_long)]
+
+    dis = DisaggregatedFleet(rB, [rpf], reps)
+
+    def burst_and_drive(long_submit, longs):
+        import threading as _t
+        stop = _t.Event()
+
+        def burster():
+            i = 0
+            while not stop.is_set() and i < len(longs):
+                try:
+                    long_submit(longs[i]).result(timeout=600)
+                except Exception:
+                    pass
+                i += 1
+
+        bt = _t.Thread(target=burster, daemon=True)
+        bt.start()
+        _, _, ttfts = _drive_fleet(
+            lambda p, mn: rB.submit(p, max_new_tokens=mn), short_plan)
+        stop.set()
+        bt.join(timeout=600)
+        return ttfts
+
+    ttft_direct = burst_and_drive(
+        lambda p: rB.submit(p, max_new_tokens=8), _mk_longs())
+    ttft_pool = burst_and_drive(
+        lambda p: dis.submit(p, max_new_tokens=8), _mk_longs())
+    dst = dis.stats()
+
+    # clean teardown: fleet drains, agents exit 0
+    rpf.shutdown()
+    rB.shutdown()
+    mon.stop()
+    codes = []
+    for p in procs:
+        try:
+            codes.append(p.wait(timeout=180))
+        except Exception:  # noqa: BLE001
+            p.kill()
+            codes.append(None)
+
+    p99_direct = _pct(ttft_direct, 0.99)
+    p99_pool = _pct(ttft_pool, 0.99)
+    total = n_clients * n_requests
+    lines = [{
+        "metric": "serving_fleet_tokens_per_s",
+        "value": round(thr_fleet, 1), "unit": "tok/s",
+        "clients": n_clients, "requests": total,
+        "processes": 2, "backend": "cpu",
+    }, {
+        "metric": "serving_fleet_local_tokens_per_s",
+        "value": round(thr_local, 1), "unit": "tok/s",
+        "clients": n_clients, "requests": total, "backend": "cpu",
+    }, {
+        "metric": "serving_fleet_vs_local",
+        "value": round(thr_fleet / max(thr_local, 1e-9), 3), "unit": "x",
+        "backend": "cpu",
+        "note": "cross-process fleet vs in-process 2-replica router at "
+                "the same offered load (CPU box: transport+IPC tax)",
+    }, {
+        # process transparency is a CORRECTNESS claim: every fleet
+        # response bitwise the in-process router's (1.0 or fail)
+        "metric": "serving_fleet_token_match",
+        "value": 1.0 if match else 0.0, "unit": "frac",
+        "requests": total, "backend": "cpu",
+    }, {
+        "metric": "serving_fleet_disagg_short_ttft_p99_ms",
+        "value": round(p99_pool, 2), "unit": "ms",
+        "handoffs": dst["handoffs"], "backend": "cpu",
+    }, {
+        "metric": "serving_fleet_disagg_direct_short_ttft_p99_ms",
+        "value": round(p99_direct, 2), "unit": "ms", "backend": "cpu",
+    }, {
+        "metric": "serving_fleet_disagg_ttft_insulation",
+        "value": round(p99_direct / max(p99_pool, 1e-9), 2), "unit": "x",
+        "handoffs": dst["handoffs"], "long_prompts": n_long,
+        "backend": "cpu",
+        "note": "short-stream p99 TTFT, long bursts direct vs through "
+                "the prefill pool (>1 = the pool insulated decode)",
+    }]
+    return lines, dst, codes
+
+
+def main_fleet(smoke: bool):
+    n_clients = int(os.environ.get("SERVE_FLEET_CLIENTS",
+                                   2 if smoke else 4))
+    n_requests = int(os.environ.get("SERVE_FLEET_REQUESTS",
+                                    2 if smoke else 4))
+    max_slots = int(os.environ.get("SERVE_FLEET_SLOTS", 4))
+    n_long = int(os.environ.get("SERVE_FLEET_LONGS", 2 if smoke else 6))
+    lines, dst, codes = bench_serving_fleet(n_clients, n_requests,
+                                            max_slots, n_long,
+                                            smoke=smoke)
+    for line in lines:
+        print(json.dumps(line), flush=True)
+    _merge_metrics_dump(lines)
+    by_metric = {l["metric"]: l for l in lines}
+    failures = []
+    # gates that hold at EVERY scale, smoke included
+    if by_metric["serving_fleet_token_match"]["value"] != 1.0:
+        failures.append("fleet responses diverged from the in-process "
+                        "router (serving_fleet_token_match < 1.0)")
+    if dst["handoffs"] < 1:
+        failures.append("the pool sub-arm never handed off a prefix")
+    if dst["handoff_failed"]:
+        failures.append(f"{dst['handoff_failed']} handoffs failed on a "
+                        "healthy fleet")
+    if any(c != 0 for c in codes):
+        failures.append(f"agent exit codes {codes} (expected clean 0s)")
+    if failures:
+        print("bench_serving --fleet: FAIL — " + "; ".join(failures),
+              file=sys.stderr)
+        raise SystemExit(1)
+    print(f"bench_serving --fleet: ok — fleet "
+          f"{by_metric['serving_fleet_tokens_per_s']['value']} tok/s vs "
+          f"local {by_metric['serving_fleet_local_tokens_per_s']['value']}"
+          f" tok/s ({by_metric['serving_fleet_vs_local']['value']}x), "
+          f"tokens bitwise == in-process; disagg short p99 TTFT "
+          f"{by_metric['serving_fleet_disagg_short_ttft_p99_ms']['value']}"
+          f"ms pooled vs "
+          f"{by_metric['serving_fleet_disagg_direct_short_ttft_p99_ms']['value']}"
+          f"ms direct (insulation "
+          f"{by_metric['serving_fleet_disagg_ttft_insulation']['value']}x,"
+          f" {dst['handoffs']} handoffs)")
+
+
 def _run_router_arm(model, submit, tight_rps, bulk_rps, duration_s,
                     tight_ms, bulk_ms, n_gen=4):
     """One OPEN-LOOP mixed-class run: fixed-rate generators offer
@@ -1040,6 +1300,8 @@ def main():
         return main_lm(smoke)
     if "--router" in sys.argv:
         return main_router(smoke)
+    if "--fleet" in sys.argv:
+        return main_fleet(smoke)
     n_clients = int(os.environ.get("SERVE_CLIENTS", 4 if smoke else 16))
     n_requests = int(os.environ.get("SERVE_REQUESTS", 4 if smoke else 32))
     max_batch = int(os.environ.get("SERVE_MAX_BATCH", n_clients))

@@ -94,6 +94,21 @@ class MetricSnapshotWriter:
             else int(process_index)
         self._last = 0.0
         self.writes = 0
+        self._sections: Dict[str, object] = {}
+
+    def add_section(self, name: str, fn) -> None:
+        """Attach a named extra section to every snapshot this writer
+        produces: ``fn()`` is called per write and its dict lands in the
+        doc under ``name`` (the serving fleet agent publishes its
+        queue-depth/inflight/prefix-summary/active-version section this
+        way — the router's remote load/health signal rides the SAME
+        files the cluster merge already reads). A raising provider is
+        skipped for that write — telemetry never takes down the run."""
+        if name in ("schema", "written_at", "pid", "process_index",
+                    "step", "metrics", "final", "snapshot_file"):
+            raise ValueError(f"section name {name!r} collides with a "
+                             "core snapshot field")
+        self._sections[name] = fn
 
     @property
     def enabled(self) -> bool:
@@ -110,9 +125,15 @@ class MetricSnapshotWriter:
             self._last = now
         return self.write(step=step)
 
-    def write(self, step: Optional[int] = None) -> Optional[str]:
+    def write(self, step: Optional[int] = None,
+              final: bool = False) -> Optional[str]:
         """Unconditional snapshot write (atomic tmp+rename). Never
-        raises — telemetry must not take down the run."""
+        raises — telemetry must not take down the run. ``final=True``
+        is the TERMINAL write a cleanly-exiting process lands: the
+        merge then knows this process FINISHED — its snapshot going
+        stale afterwards is retirement, not a wedge — and the
+        straggler/suspect-dead attribution skips it (a finished process
+        used to read exactly like a dead one)."""
         try:
             os.makedirs(self._dir, exist_ok=True)
             path = snapshot_path(self._dir, self._idx)
@@ -122,8 +143,14 @@ class MetricSnapshotWriter:
                 "pid": os.getpid(),
                 "process_index": self._idx,
                 "step": step,
+                "final": bool(final),
                 "metrics": _metrics.registry().snapshot(),
             }
+            for name, fn in self._sections.items():
+                try:
+                    doc[name] = fn()
+                except Exception:  # noqa: BLE001 — telemetry only
+                    _LOG.exception("snapshot section %r failed", name)
             tmp = f"{path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
                 json.dump(_flight._json_safe(doc), f, default=str,
@@ -201,9 +228,15 @@ def aggregate(directory: Optional[str] = None,
             "snapshot_age_s": round(max(0.0, now - s.get("written_at", now)),
                                     3),
             "snapshot_file": s.get("snapshot_file"),
+            "final": bool(s.get("final", False)),
         })
+    # finished (final:true) processes are retired, not slow: their
+    # frozen means must not distort the LIVE cluster's median/skew
+    # either — several fast finishers dragging the median down would
+    # falsely push a healthy live process over the straggler ratio
     times = sorted(r["step_time_mean_s"] for r in rows
-                   if isinstance(r["step_time_mean_s"], (int, float))
+                   if not r["final"]
+                   and isinstance(r["step_time_mean_s"], (int, float))
                    and r["step_time_mean_s"] > 0)
     skew = None
     median = None
@@ -214,6 +247,12 @@ def aggregate(directory: Optional[str] = None,
         slowest = times[-1]
         skew = slowest / median if median > 0 else None
         for r in rows:
+            if r["final"]:
+                # a cleanly-finished process (terminal final:true
+                # snapshot) is retired, not slow: its frozen mean and
+                # ever-growing heartbeat age would otherwise read as a
+                # suspect-dead straggler forever (ISSUE 15 satellite)
+                continue
             st = r["step_time_mean_s"]
             if isinstance(st, (int, float)) and median > 0 and \
                     st > STRAGGLER_RATIO * median:

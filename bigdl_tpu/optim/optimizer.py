@@ -1119,9 +1119,11 @@ class BaseOptimizer:
         finally:
             if self._snap_writer.enabled and obs.enabled():
                 # terminal snapshot: the cluster merge must see this
-                # process's END state, not its last cadence tick
+                # process's END state, not its last cadence tick —
+                # final=True so a finished process never reads as a
+                # suspect-dead straggler once its snapshot goes stale
                 self._snap_writer.write(
-                    step=self.optim_method.state.get("neval"))
+                    step=self.optim_method.state.get("neval"), final=True)
             self._step_beacon.close()
             self._step_beacon = _health.NULL_BEACON
             self._live_state = None

@@ -22,6 +22,9 @@ site                          where it fires
 ``router/dispatch``           Router replica submit
 ``checkpoint/write``          optimizer ``_atomic_pickle`` snapshot write
 ``heartbeat/beat``            failure.Heartbeat.beat exchange
+``fleet/agent_beat``          fleet.ReplicaAgent membership beat loop
+``fleet/transport``           fleet transport client send
+``fleet/handoff``             fleet prefill-export / decode-adopt KV handoff
 ============================  ==============================================
 
 — with **seeded, deterministic schedules** (nth-call, every-k,
@@ -98,6 +101,9 @@ SITES = (
     "router/dispatch",
     "checkpoint/write",
     "heartbeat/beat",
+    "fleet/agent_beat",
+    "fleet/transport",
+    "fleet/handoff",
 )
 
 

@@ -420,6 +420,75 @@ class Heartbeat:
         return stale
 
 
+class FileHeartbeat:
+    """File-based liveness for processes OUTSIDE one jax.distributed
+    job — the serving fleet's membership signal (``serving/fleet.py``).
+
+    :class:`Heartbeat` needs a coordination channel every participant
+    shares; independent replica processes on one machine have none, but
+    they share a filesystem. Each member ``beat()``s by atomically
+    rewriting ONE file (tmp + rename, the crash-bundle discipline) with
+    an incrementing counter, a wall-clock stamp, and the caller's
+    payload (the fleet agent puts its serving section there); anyone
+    can :meth:`read` a member's file and judge :meth:`age_s` — a stale
+    or missing file is the lost-heartbeat signal, exactly the semantics
+    ``Heartbeat.beat()`` derives from a stalled counter. A member that
+    finishes CLEANLY writes ``final: true`` (optionally ``dead: true``
+    for a crash-with-last-words), so a monitor can tell "exited" from
+    "wedged" — the same distinction the cluster aggregate's
+    straggler/suspect-dead join needs (``cluster.write_aggregate``)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.beat_no = 0
+
+    def beat(self, payload: Optional[Dict] = None, *,
+             final: bool = False) -> Dict:
+        """Atomic rewrite of the member file; returns the written doc.
+        Never raises — liveness reporting must not take the member
+        down (a failed write just leaves the previous beat in place,
+        which reads as a late beat, the honest signal)."""
+        import os
+        self.beat_no += 1
+        doc = dict(payload or {})
+        doc.update(beat=self.beat_no, written_at=time.time(),
+                   pid=os.getpid())
+        if final:
+            doc["final"] = True
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            tmp = f"{self.path}.{os.getpid()}.tmp"
+            import json
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, self.path)
+            if obs.enabled():
+                obs.counter("failure/file_beats").inc()
+        except OSError:
+            _LOG.exception("file heartbeat write failed: %s", self.path)
+        return doc
+
+    @staticmethod
+    def read(path: str) -> Optional[Dict]:
+        """The member's latest doc, or None for missing/half-written
+        files (a dying peer's torn write reads as absent, like the
+        snapshot merge)."""
+        import json
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def age_s(doc: Optional[Dict], now: Optional[float] = None) -> float:
+        """Seconds since the doc's beat; ``inf`` for no doc."""
+        if not doc or not isinstance(doc.get("written_at"), (int, float)):
+            return float("inf")
+        return max(0.0, (time.time() if now is None else now)
+                   - doc["written_at"])
+
+
 class StragglerMonitor:
     """Per-host step-time collection + straggler flagging (the metric Spark's
     speculation uses, over the jax.distributed channel instead of the Spark

@@ -61,6 +61,16 @@ from .decode_scheduler import (DecodeScheduler, LMRequest,
                                decode_scheduler_threads_alive,
                                prefill_schedule)
 from .router import PriorityClass, Router, router_threads_alive
+# cross-process fleet tier (ISSUE 15): replica agents in other
+# processes behind the SAME Router — membership/health over beaten
+# files, tensors over a framed local-socket transport, disaggregated
+# prefill/decode pools with content-key-verified KV handoff
+# (docs/SERVING.md "Fleet serving", `make fleet-smoke`)
+from .transport import (TransportClient, TransportClosed,
+                        TransportServer, transport_threads_alive)
+from .fleet import (DisaggregatedFleet, FleetMonitor, KVHandoffError,
+                    RemoteReplica, ReplicaAgent, discover,
+                    fleet_threads_alive, read_member, wait_for_members)
 # the transient-failure classification AND the retry budget are SHARED
 # with the trainer (parallel/failure.FaultPolicy): the engine's batch
 # retry, the scheduler's bitwise step replay and the router's

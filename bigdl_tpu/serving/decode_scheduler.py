@@ -292,6 +292,7 @@ class DecodeScheduler:
                  prefix_cache_entries: Optional[int] = None,
                  mesh=None, placement=None,
                  name: Optional[str] = None,
+                 tags=(),
                  fault_policy: Optional[FaultPolicy] = None,
                  audit_every: int = 256):
         if model.mode != "lm":
@@ -322,6 +323,11 @@ class DecodeScheduler:
         self.sampling_seed = int(sampling_seed)
         self.spec_k = int(spec_k)
         self.name = name
+        # capability labels the Router's class→replica affinity matches
+        # against PriorityClass(replica_tags=...) — e.g. an
+        # int8-published replica tags itself "int8" so bulk traffic can
+        # pin to it while tight traffic rides the f32 fleet
+        self.tags = tuple(tags)
         self.beacon_name = ("serving/decode_scheduler" if name is None
                             else f"serving/decode_scheduler[{name}]")
         self.mesh = mesh

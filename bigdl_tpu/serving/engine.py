@@ -110,7 +110,7 @@ class ServingEngine:
                  warmup: bool = True,
                  stall_deadline_s: Optional[float] = None,
                  mesh=None, placement=None, batch_spec=None,
-                 name: Optional[str] = None,
+                 name: Optional[str] = None, tags=(),
                  fault_policy: Optional[FaultPolicy] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -128,6 +128,9 @@ class ServingEngine:
         self._warmup_on_start = warmup
         self._fwd = shared_forward(model)
         self.name = name
+        # Router class→replica affinity labels (PriorityClass
+        # replica_tags= matches any-of against these)
+        self.tags = tuple(tags)
         self.beacon_name = ("serving/batcher" if name is None
                             else f"serving/batcher[{name}]")
         self.mesh = mesh

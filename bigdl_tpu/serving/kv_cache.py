@@ -118,6 +118,13 @@ class PagedKVCache:
         self.max_seq_len = self.max_blocks_per_seq * self.block_size
         kvh = attn._kvh()
         d = model.hidden_size // attn.num_heads
+        # page geometry, exported so a cross-process KV handoff can be
+        # validated against the RECEIVING pool before any page lands
+        # (serving/fleet.py refuses a mismatched handoff typed)
+        self.kv_heads = int(kvh)
+        self.head_dim = int(d)
+        self.n_layers = len(model.blocks)
+        self.page_dtype = jnp.dtype(dtype)
 
         def _zeros():
             z = jnp.zeros((num_blocks, kvh, block_size, d), dtype)
@@ -235,12 +242,16 @@ class PagedKVCache:
 
     def retain(self, blocks: Sequence[int]):
         """Ownerless references (the prefix cache pinning its entries'
-        pages): refcount +1 each, no table."""
+        pages): refcount +1 each, no table. All-or-nothing: a dead
+        block in the list refuses the WHOLE retain before any count
+        moves (a partial retain would pin the earlier blocks forever —
+        nobody holds a handle to release them)."""
         with self._lock:
-            for b in blocks:
-                b = int(b)
+            ids = [int(b) for b in blocks]
+            for b in ids:
                 if self._refs.get(b, 0) < 1:
                     raise ValueError(f"cannot retain free block {b}")
+            for b in ids:
                 self._refs[b] += 1
         self._set_gauges()
 
@@ -372,6 +383,113 @@ class PagedKVCache:
             obs.counter(f"{self.metric_prefix}_cow_forks").inc(len(moves))
         self._set_gauges()
         return forked
+
+    # -- cross-process handoff (ISSUE 15) --------------------------------
+
+    def geometry(self) -> dict:
+        """The page-shape contract two pools must agree on before a
+        handoff: per-layer pages are ``(n, kv_heads, block_size,
+        head_dim)`` of ``page_dtype`` across ``n_layers`` layers."""
+        return {"n_layers": self.n_layers, "kv_heads": self.kv_heads,
+                "block_size": self.block_size, "head_dim": self.head_dim,
+                "dtype": np.dtype(self.page_dtype).str}
+
+    def export_blocks(self, owner=None, blocks=None):
+        """Host-fetch the K/V pages behind ``owner``'s table (or an
+        explicit physical-block list — the prefix cache's chain) for a
+        cross-process handoff: per layer one ``(n, kvH, bs, D)`` pair of
+        numpy arrays, in logical order. The ids and page HANDLES are
+        captured together under the ledger lock, so a concurrent defrag
+        (which swaps in new page handles after moving data) cannot tear
+        the view — the captured handles still hold every byte the
+        captured ids name. The device fetch itself happens outside the
+        lock; exporting dead blocks is refused. Returns
+        ``(block_ids, [(k_np, v_np), ...])``."""
+        with self._lock:
+            if blocks is None:
+                if owner is None:
+                    raise ValueError("export_blocks needs owner= or "
+                                     "blocks=")
+                ids = list(self._owned.get(owner, ()))
+            else:
+                ids = [int(b) for b in blocks]
+            for b in ids:
+                if self._refs.get(b, 0) < 1:
+                    raise ValueError(
+                        f"cannot export dead block {b} — the handle "
+                        "outlived its page")
+            pages = list(self._pages)
+        if not ids:
+            return [], []
+        idx = jnp.asarray(ids, jnp.int32)
+        out = []
+        for k, v in pages:
+            # deliberate host fetch: the handoff's one data-plane hop —
+            # raw page bytes, no per-element serialization
+            out.append((np.asarray(jax.device_get(k[idx])),
+                        np.asarray(jax.device_get(v[idx]))))
+        return ids, out
+
+    def adopt_serialized(self, owner, layers) -> List[int]:
+        """The receiving half of a handoff: allocate fresh private
+        blocks for ``owner`` and write the transferred pages into them
+        (one scatter dispatch per layer). ``layers`` is
+        ``export_blocks``'s ``[(k_np, v_np), ...]``; geometry is
+        validated against THIS pool before any ledger mutation, and the
+        allocation is all-or-nothing (:class:`KVCacheOOM` leaves the
+        ledger untouched) — admission-grade discipline for pages that
+        arrived over a wire. Returns the new physical ids, in logical
+        order, refcounted to ``owner`` (hand them to
+        ``PrefixCache.insert`` to make the prefix adoptable, then
+        ``free(owner)`` — exactly the post-prefill registration flow)."""
+        geo = self.geometry()
+        if len(layers) != geo["n_layers"]:
+            raise ValueError(
+                f"handoff geometry mismatch: {len(layers)} layers vs "
+                f"this pool's {geo['n_layers']}")
+        n = None
+        want = (geo["kv_heads"], geo["block_size"], geo["head_dim"])
+        for li, (k, v) in enumerate(layers):
+            k, v = np.asarray(k), np.asarray(v)
+            if k.shape != v.shape or k.ndim != 4 or k.shape[1:] != want:
+                raise ValueError(
+                    f"handoff geometry mismatch at layer {li}: "
+                    f"k{k.shape}/v{v.shape} vs (n, {want[0]}, {want[1]}, "
+                    f"{want[2]})")
+            if n is None:
+                n = int(k.shape[0])
+            elif int(k.shape[0]) != n:
+                raise ValueError("handoff layers disagree on block count")
+        if not n:
+            return []
+        # host→device transfer OUTSIDE the ledger lock (the symmetric
+        # discipline to export_blocks' fetch): a multi-MB handoff must
+        # not stall every concurrent admission/alloc/free on this
+        # replica for the transfer's duration. Only the free-list pop
+        # and the page-handle swap run in-lock.
+        dev = [(jnp.asarray(lk, self.page_dtype),
+                jnp.asarray(lv, self.page_dtype)) for lk, lv in layers]
+        with self._lock:
+            if self._owned.get(owner):
+                raise ValueError(f"adopt_serialized owner {owner!r} "
+                                 "already holds blocks")
+            if n > len(self._free):
+                raise KVCacheOOM(
+                    f"handoff needs {n} blocks, {len(self._free)} free")
+            ids = [self._free.pop() for _ in range(n)]
+            for b in ids:
+                self._refs[b] = 1
+            self._owned[owner] = list(ids)
+            dst = jnp.asarray(ids, jnp.int32)
+            self._pages = [
+                (k.at[dst].set(dk), v.at[dst].set(dv))
+                for (k, v), (dk, dv) in zip(self._pages, dev)]
+            in_use = self.num_blocks - 1 - len(self._free)
+            self._high_water = max(self._high_water, in_use)
+        if obs.enabled():
+            obs.counter(f"{self.metric_prefix}_allocs").inc(n)
+        self._set_gauges()
+        return ids
 
     def block_table(self, owner) -> np.ndarray:
         """``owner``'s (max_blocks_per_seq,) int32 physical-block table,

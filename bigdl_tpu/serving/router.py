@@ -108,23 +108,41 @@ class PriorityClass:
         (2 keeps replicas pipelined) leaves the replica queues nearly
         empty for the tight tier, which is what bounds tight latency
         to ~2 batch cycles under full bulk overload.
+    replica_tags : class→replica affinity for HETEROGENEOUS fleets
+        (ISSUE 15 satellite, the direction-4b stepping stone): when
+        set, requests of this class dispatch ONLY to replicas whose
+        ``tags`` (``DecodeScheduler(tags=...)`` /
+        ``ServingEngine(tags=...)`` / a fleet member's membership tags)
+        intersect this set — e.g. bulk traffic pinned to
+        int8-published replicas while tight traffic rides the f32
+        fleet. Composes with least-loaded/deadline placement,
+        prefix-affinity, and ``depth_limit`` (all operate on the
+        tag-filtered candidate set); ``None`` keeps the class
+        fleet-wide. The router validates at construction that at least
+        one replica carries each demanded tag set.
     """
 
     def __init__(self, name: str, weight: int = 1,
                  default_deadline_ms: Optional[float] = None,
                  max_queue: int = 1024,
-                 depth_limit: Optional[int] = None):
+                 depth_limit: Optional[int] = None,
+                 replica_tags: Optional[Sequence[str]] = None):
         if weight < 1:
             raise ValueError(f"weight must be >= 1, got {weight}")
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if depth_limit is not None and depth_limit < 1:
             raise ValueError(f"depth_limit must be >= 1, got {depth_limit}")
+        if replica_tags is not None and not replica_tags:
+            raise ValueError("replica_tags must name at least one tag "
+                             "(None means any replica)")
         self.name = name
         self.weight = int(weight)
         self.default_deadline_ms = default_deadline_ms
         self.max_queue = int(max_queue)
         self.depth_limit = depth_limit
+        self.replica_tags = (frozenset(replica_tags)
+                             if replica_tags is not None else None)
 
     def __repr__(self):
         return (f"PriorityClass({self.name!r}, weight={self.weight}, "
@@ -188,11 +206,12 @@ class _RouterRequest:
 
 class _Replica:
     __slots__ = ("engine", "name", "healthy", "dead", "inflight",
-                 "by_class", "ewma_ms")
+                 "by_class", "ewma_ms", "tags")
 
     def __init__(self, engine, name: str):
         self.engine = engine
         self.name = name
+        self.tags = frozenset(getattr(engine, "tags", ()) or ())
         self.healthy = True
         self.dead = False            # EngineStopped — no rejoin possible
         self.inflight: set = set()   # _RouterRequest currently submitted
@@ -269,6 +288,13 @@ class Router:
         for c in classes:
             if c.name in self._classes:
                 raise ValueError(f"duplicate class {c.name!r}")
+            if c.replica_tags is not None and not any(
+                    r.tags & c.replica_tags for r in self._replicas):
+                raise ValueError(
+                    f"class {c.name!r} demands replica_tags "
+                    f"{sorted(c.replica_tags)} but no replica carries "
+                    f"any of them (replica tags: "
+                    f"{ {r.name: sorted(r.tags) for r in self._replicas} })")
             self._classes[c.name] = _ClassQueue(c)
         self.max_failovers = int(max_failovers)
         self.fail_fast_factor = float(fail_fast_factor)
@@ -588,14 +614,21 @@ class Router:
             self._miss(req, cq, "deadline passed while queued at router")
             return True
         limit = cq.cls.depth_limit
+        tags = cq.cls.replica_tags
         with self._lock:
-            healthy = [r for r in self._replicas if r.healthy]
+            # class→replica affinity first: a tagged class only ever
+            # sees its tag-matching replicas — least-loaded, deadline,
+            # depth_limit and prefix-affinity all compose on the
+            # filtered set
+            eligible = (self._replicas if tags is None else
+                        [r for r in self._replicas if r.tags & tags])
+            healthy = [r for r in eligible if r.healthy]
             if limit is not None:
                 healthy = [r for r in healthy
                            if r.by_class.get(req.klass, 0) < limit]
         if not healthy:
             with self._lock:
-                all_dead = all(r.dead for r in self._replicas)
+                all_dead = all(r.dead for r in eligible)
             if self._stop.is_set() or all_dead:
                 # a drained replica may rejoin (park and wait); a DEAD
                 # fleet never will — parking would hang every client

@@ -340,9 +340,11 @@ def test_crash_bundle_carries_programs():
 
 # ------------------------------------------------- cluster metrics
 
-def _write_snapshot(d, idx, step_time_mean, hb_age=0.5, step=100):
+def _write_snapshot(d, idx, step_time_mean, hb_age=0.5, step=100,
+                    final=False):
     """A per-process snapshot file in the writer's exact schema."""
     doc = {
+        "final": final,
         "schema": cluster.SNAPSHOT_SCHEMA,
         "written_at": time.time(),
         "pid": 1000 + idx,
@@ -404,6 +406,55 @@ def test_rank0_aggregation_attributes_injected_straggler(tmp_path):
     assert cluster.latest_aggregate(d) == out
     # headline numbers mirrored for the local exporters
     assert obs.registry().gauge("cluster/stragglers").value == 1
+
+
+def test_finished_process_not_attributed_as_suspect_dead(tmp_path):
+    """ISSUE 15 satellite: a replica process that exited CLEANLY writes
+    a terminal ``final: true`` snapshot — its step-time mean freezes
+    and its heartbeat age grows forever, which used to read exactly
+    like a wedged process. The aggregate must attribute the WEDGED
+    writer (no final marker, slow, stale heartbeat) and skip the
+    finished one."""
+    d = str(tmp_path)
+    _write_snapshot(d, 0, 0.010)
+    _write_snapshot(d, 3, 0.011)
+    # finished: slow-looking frozen mean + very stale heartbeat, but
+    # terminal final:true — retired, not dying
+    _write_snapshot(d, 1, 0.060, hb_age=500.0, final=True)
+    # wedged: same signature WITHOUT the final marker — a real suspect
+    _write_snapshot(d, 2, 0.060, hb_age=500.0)
+    view = cluster.aggregate(d)
+    assert view["n_processes"] == 4
+    by_idx = {r["process_index"]: r for r in view["processes"]}
+    assert by_idx[1]["final"] is True and by_idx[2]["final"] is False
+    assert [s["process_index"] for s in view["stragglers"]] == [2]
+    assert view["stragglers"][0]["suspect_dead"] is True
+
+    # the writer's own terminal write carries the marker
+    w = cluster.MetricSnapshotWriter(every_s=3600, directory=d,
+                                     process_index=7)
+    w.write(step=9, final=True)
+    snaps = {s["process_index"]: s for s in cluster.read_snapshots(d)}
+    assert snaps[7]["final"] is True
+    assert snaps[0].get("final", False) is False
+
+
+def test_snapshot_writer_extra_sections(tmp_path):
+    """MetricSnapshotWriter.add_section: a registered provider's dict
+    lands in every snapshot under its name (the fleet agent's
+    ``serving`` section rides this); a raising provider is skipped, a
+    core-field collision is refused."""
+    w = cluster.MetricSnapshotWriter(every_s=3600, directory=str(tmp_path),
+                                     process_index=3)
+    w.add_section("serving", lambda: {"queue_depth": 4,
+                                      "active_version": "v1"})
+    w.add_section("broken", lambda: 1 / 0)
+    with pytest.raises(ValueError, match="collides"):
+        w.add_section("metrics", dict)
+    w.write(step=1)
+    snap = cluster.read_snapshots(str(tmp_path))[0]
+    assert snap["serving"] == {"queue_depth": 4, "active_version": "v1"}
+    assert "broken" not in snap
 
 
 def test_cluster_report_tool_round_trip(tmp_path):

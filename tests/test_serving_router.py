@@ -511,3 +511,65 @@ def test_prefix_affinity_disabled_and_slack_bypass():
         st = r.stats()
     assert st["affinity_hits"] == 0
     assert st["affinity_bypassed"] == 3
+
+
+# -- class -> replica affinity (ISSUE 15 satellite) -------------------------
+
+
+def test_class_replica_tags_pin_dispatch():
+    """PriorityClass(replica_tags=...) pins a class's traffic to
+    tag-matching replicas (the heterogeneous-fleet lever: bulk traffic
+    on int8-published replicas, tight on f32), composing with the
+    untagged classes' fleet-wide routing and with depth_limit."""
+    model = _model()
+    engines = _engines(model, 3)
+    engines[0].tags = ("int8", "bulk-ok")
+    engines[1].tags = ("f32",)
+    engines[2].tags = ("f32",)
+    classes = [PriorityClass("bulk", replica_tags=("int8",), weight=1,
+                             depth_limit=2),
+               PriorityClass("tight", replica_tags=("f32",), weight=8),
+               PriorityClass("any")]
+    with Router(engines, classes=classes) as r:
+        futs = {"bulk": [], "tight": [], "any": []}
+        for i in range(6):
+            futs["bulk"].append(r.submit(_x(i), klass="bulk"))
+            futs["tight"].append(r.submit(_x(i), klass="tight"))
+            futs["any"].append(r.submit(_x(i), klass="any"))
+        for fs in futs.values():
+            for f in fs:
+                f.result(timeout=30)
+    for f in futs["bulk"]:
+        assert f.trace["router"]["replica"] == "r0", \
+            "bulk (int8-tagged) must pin to the int8 replica"
+    for f in futs["tight"]:
+        assert f.trace["router"]["replica"] in ("r1", "r2"), \
+            "tight (f32-tagged) must never ride the int8 replica"
+    served_any = {f.trace["router"]["replica"] for f in futs["any"]}
+    assert len(served_any) >= 2, "untagged classes stay fleet-wide"
+
+
+def test_class_replica_tags_validated_and_typed_when_tag_fleet_dead():
+    """A class demanding a tag nobody carries is a construction error;
+    a tagged class whose whole tag-fleet is DEAD fails its requests
+    typed instead of parking them forever (untagged traffic flows on)."""
+    model = _model()
+    with pytest.raises(ValueError, match="replica_tags"):
+        Router(_engines(model, 2),
+               classes=[PriorityClass("bulk", replica_tags=("int8",))])
+    with pytest.raises(ValueError, match="at least one tag"):
+        PriorityClass("bulk", replica_tags=())
+
+    engines = _engines(model, 2)
+    engines[0].tags = ("int8",)
+    classes = [PriorityClass("bulk", replica_tags=("int8",)),
+               PriorityClass("default")]
+    with Router(engines, classes=classes) as r:
+        # kill the int8 replica: its engine stops -> marked DEAD on the
+        # next dispatch attempt; bulk then fails typed, default flows
+        engines[0].shutdown(drain=False)
+        f = r.submit(_x(0), klass="bulk")
+        with pytest.raises(EngineStopped):
+            f.result(timeout=30)
+        ok = r.submit(_x(1), klass="default").result(timeout=30)
+        assert ok is not None

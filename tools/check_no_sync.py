@@ -106,6 +106,27 @@ HOT_FUNCS = {
         # the invariant checker runs on the scheduler cadence — one
         # consistent host snapshot, never a page read
         "audit",
+        # cross-process handoff primitives (ISSUE 15): export's ONE
+        # deliberate page fetch is jax.device_get (the handoff's data
+        # hop); adopt issues scatter transfers without blocking
+        "export_blocks", "adopt_serialized",
+    },
+    # fleet transport (ISSUE 15): framed send/recv on router dispatch
+    # and agent reply paths — pure socket/bytes work, a device touch
+    # here would stall every in-flight fleet request on the connection
+    "bigdl_tpu/serving/transport.py": {
+        "request_async", "_send_frame", "_recv_frame", "_recv_loop",
+        "pack_arrays", "unpack_arrays",
+    },
+    # fleet layer (ISSUE 15): the agent's beat loop runs on a cadence
+    # next to a live engine; RemoteReplica.submit runs inside the
+    # router's dispatch loop; the export/adopt handlers run on
+    # transport threads between the engine's dispatches — all host
+    # bookkeeping (export's page fetch lives in kv_cache.export_blocks)
+    "bigdl_tpu/serving/fleet.py": {
+        "_beat_loop", "_serving_section", "_member_doc", "submit",
+        "_export_prefix", "_adopt_prefix", "_op_submit",
+        "cached_prefix_tokens", "_handoff",
     },
     # prefix cache: content-addressed index over the ledger — digest
     # walks and LRU bookkeeping inside the admission loop (and under

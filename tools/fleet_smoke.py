@@ -1,0 +1,234 @@
+#!/usr/bin/env python3
+"""Fleet-serving smoke (ISSUE 15): 1 router process + 2 replica agent
+processes + 1 prefill specialist, mixed load, seeded chaos.
+
+The drill, end to end on CPU:
+
+* spawn agents ``r0``/``r1`` (full replicas) and ``pf`` (prefill
+  specialist) as REAL subprocesses sharing one pickled param set;
+* drive a mixed load through the Router + DisaggregatedFleet front:
+  short greedy chats, seeded-sampled requests, and long prompts whose
+  prefill hands off ``pf -> decode replica`` as exported KV pages;
+* inject ONE agent kill mid-decode (a permanent chaos fault in r0's
+  scheduler step — its in-flight requests fail typed with partials,
+  the agent converts that into whole-process death) and ONE
+  mid-handoff death (a permanent ``fleet/handoff`` fault in pf);
+* assert: ZERO lost requests (every future resolves with a result),
+  every token stream BITWISE the monolithic single-process scheduler
+  (recovered streams included), at least one handoff landed AND at
+  least one degraded, the killed agents exited with the death code,
+  and ``kv_blocks_in_use`` drained to 0 in every surviving process
+  (the monolithic oracle included).
+
+Seconds-to-minutes on CPU; wired into tier-1 as ``make fleet-smoke``.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+V = 48
+MODEL = dict(vocab_size=V, hidden_size=32, num_heads=4, filter_size=64,
+             num_layers=2, max_len=256)
+SCHED = dict(max_slots=4, block_size=4, max_seq_len=96, prefill_chunk=8)
+
+
+def spawn(fleet_dir, name, params_path, *, role="replica", chaos=None,
+          idx=1):
+    cfg = {"fleet_dir": fleet_dir, "name": name, "role": role,
+           "beat_s": 0.15, "process_index": idx, "model": MODEL,
+           "params_path": params_path, "scheduler": dict(SCHED),
+           "observability": True}
+    if chaos:
+        cfg["chaos"] = chaos
+    path = os.path.join(fleet_dir, f"cfg_{name}.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    env.pop("BIGDL_TPU_CHAOS", None)
+    # log FILES, not pipes: nothing drains a pipe while the agent runs,
+    # so a chatty agent (death tracebacks, chaos logging) would block
+    # on the ~64 KB pipe buffer and wedge the drill
+    log = open(os.path.join(fleet_dir, f"agent_{name}.log"), "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "bigdl_tpu.serving.fleet", path],
+        stdout=log, stderr=subprocess.STDOUT, cwd=REPO, env=env)
+
+
+def _agent_log(fleet_dir, name):
+    try:
+        with open(os.path.join(fleet_dir, f"agent_{name}.log")) as f:
+            return f.read()
+    except OSError:
+        return "<unreadable>"
+
+
+def main():
+    import jax
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu.models.transformer_lm import TransformerLM
+    from bigdl_tpu.serving import (DecodeScheduler, DisaggregatedFleet,
+                                   FleetMonitor, RemoteReplica, Router,
+                                   wait_for_members)
+
+    obs.enable()
+    t0 = time.time()
+    fd = tempfile.mkdtemp(prefix="fleet_smoke_")
+    model = TransformerLM(**MODEL)
+    model.ensure_initialized()
+    params_path = os.path.join(fd, "params.pkl")
+    with open(params_path, "wb") as f:
+        pickle.dump(jax.tree_util.tree_map(np.asarray, model.params), f)
+
+    # the monolithic oracle: ONE single-process scheduler, same params
+    oracle = DecodeScheduler(model, name="mono", **SCHED).start()
+
+    # pf dies PERMANENTLY on its 4th handoff call (death mid-handoff);
+    # r0 spawns pre-armed to die at its 12th decode-group dispatch —
+    # deterministically mid-load (warmup never rides the chaos seam)
+    procs = {
+        "r0": spawn(fd, "r0", params_path, idx=1,
+                    chaos={"sites": {"serving/scheduler_step": [
+                        {"kind": "permanent", "nth": 12}]}}),
+        "r1": spawn(fd, "r1", params_path, idx=2),
+        "pf": spawn(fd, "pf", params_path, role="prefill", idx=3,
+                    chaos={"seed": 7, "sites": {"fleet/handoff": [
+                        {"kind": "permanent", "nth": 4}]}}),
+    }
+    try:
+        docs = wait_for_members(fd, ["r0", "r1", "pf"], timeout_s=300)
+    except TimeoutError as e:
+        for p in procs.values():
+            p.kill()
+        print(f"fleet_smoke: FAIL — {e}", file=sys.stderr)
+        for n in procs:
+            log = _agent_log(fd, n)
+            print(f"--- {n} log ---\n{log[-2000:]}", file=sys.stderr)
+        return 1
+    by = {d["name"]: d for d in docs}
+    reps = [RemoteReplica(by["r0"], fleet_dir=fd),
+            RemoteReplica(by["r1"], fleet_dir=fd)]
+    rpf = RemoteReplica(by["pf"], fleet_dir=fd).start()
+    router = Router(reps, max_failovers=4).start()
+    monitor = FleetMonitor(reps + [rpf], fleet_dir=fd, every_s=0.1,
+                           stale_s=10.0).start()
+    dis = DisaggregatedFleet(router, [rpf], reps)
+
+    rng = np.random.RandomState(0)
+    plan = []   # (kind, prompt, max_new, sampling kwargs)
+    for i in range(6):
+        plan.append(("short", rng.randint(1, V, size=int(
+            rng.randint(4, 17))).astype(np.int32), 16, {}))
+    for i in range(2):
+        plan.append(("sampled", rng.randint(1, V, size=int(
+            rng.randint(6, 20))).astype(np.int32), 10,
+            {"temperature": 0.8, "top_p": 0.9, "seed": 100 + i}))
+    for i in range(5):
+        plan.append(("long", rng.randint(1, V, size=int(
+            rng.randint(33, 53))).astype(np.int32), 10, {}))
+
+    want = [oracle.generate(p, mn, **kw) for _, p, mn, kw in plan]
+
+    futs = []
+    for kind, p, mn, kw in plan:
+        if kind == "long":
+            futs.append(dis.submit(p, max_new_tokens=mn, **kw))
+        else:
+            futs.append(router.submit(p, max_new_tokens=mn, **kw))
+
+    got, lost = [], 0
+    for f in futs:
+        try:
+            got.append(f.result(timeout=600))
+        except Exception as e:  # noqa: BLE001 — accounting
+            lost += 1
+            got.append(f"LOST: {type(e).__name__}: {e}")
+
+    failures = []
+    if lost:
+        failures.append(f"{lost} requests lost")
+    mismatch = sum(1 for w, g in zip(want, got)
+                   if not (isinstance(g, np.ndarray)
+                           and np.array_equal(w, g)))
+    if mismatch:
+        failures.append(f"{mismatch}/{len(plan)} streams not bitwise "
+                        "the monolithic scheduler")
+    rst = router.stats()
+    dst = dis.stats()
+    if rst["completed"] != len(plan):
+        failures.append(f"completed {rst['completed']} != {len(plan)}")
+    if dst["handoffs"] < 1:
+        failures.append(f"no handoff landed: {dst}")
+    if dst["handoff_failed"] + dst["handoff_refused"] < 1:
+        failures.append("the injected mid-handoff death never degraded "
+                        f"a request: {dst}")
+
+    # survivor drains clean: its ledger empties (remote shutdown reply)
+    r1_blocks = None
+    try:
+        meta, _ = reps[1]._request("shutdown", {"drain": True},
+                                   timeout=300)
+        r1_blocks = meta["kv_blocks_in_use"]
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"r1 clean shutdown failed: {e}")
+    if r1_blocks not in (0,):
+        failures.append(f"r1 kv_blocks_in_use {r1_blocks} != 0")
+    router.shutdown()
+    monitor.stop()
+    rpf.close()
+    oracle.shutdown()
+    ost = oracle.stats()
+    if ost["kv"]["blocks_in_use"] != 0:
+        failures.append("oracle leaked KV blocks")
+
+    codes = {}
+    for n, p in procs.items():
+        try:
+            codes[n] = p.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            codes[n] = None
+            failures.append(f"agent {n} hung at exit")
+    # r0 died by injection mid-decode, pf died mid-handoff; r1 drained
+    # clean — the exact exit codes are part of the drill
+    if codes.get("r1") != 0:
+        failures.append(f"r1 exit {codes.get('r1')} != 0")
+    if codes.get("pf") != 86:
+        failures.append(f"pf exit {codes.get('pf')} != 86 (death code)")
+    if codes.get("r0") != 86:
+        failures.append(f"r0 exit {codes.get('r0')} != 86 (death code)")
+
+    recov = rst.get("kv_recoveries", 0)
+    if recov < 1:
+        failures.append("r0's death recovered no partials — the "
+                        "KV-preserving splice never engaged")
+    summary = (f"{len(plan)} requests ({dst['handoffs']} handoffs, "
+               f"{dst['handoff_failed'] + dst['handoff_refused']} "
+               f"degraded), {rst['failovers']} failovers, "
+               f"{recov} KV recoveries, exits {codes}, "
+               f"{time.time() - t0:.1f}s")
+    if failures:
+        print("fleet_smoke: FAIL — " + "; ".join(failures),
+              file=sys.stderr)
+        print("  " + summary, file=sys.stderr)
+        for n in procs:
+            log = _agent_log(fd, n)
+            print(f"--- agent {n} log (tail) ---\n{log[-1500:]}",
+                  file=sys.stderr)
+        return 1
+    print(f"fleet_smoke: ok — {summary}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
