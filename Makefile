@@ -31,7 +31,7 @@ test:
 # hot loop fails before the 15-minute suite starts, and on the serving
 # smoke so a broken engine fails in seconds, not mid-suite.
 tier1: check-no-sync perf-gate kernels-smoke serve-smoke obs-smoke fault-smoke chaos-smoke fleet-smoke
-	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1800 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 2100 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 check-no-sync:
 	python tools/check_no_sync.py

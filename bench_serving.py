@@ -626,6 +626,171 @@ def bench_serving_lm_prefix(n_clients, n_requests, prefix_len, max_slots):
     return lines, st
 
 
+def bench_serving_lm_spill(n_requests, max_slots, smoke):
+    """Host-tier arm (ISSUE 18). Phase 1 seeds N distinct prefixes and
+    measures their cold TTFTs, then EVICTS every chain — with the host
+    pool underneath, eviction spills the pages to host RAM instead of
+    dropping the bytes. Phase 2 revisits every prefix: the lookup
+    refills the spilled chain through the ordinary warm-hit path (a
+    second-chance hit, one batched adopt for the whole chain), so the
+    headline is hit-after-spill TTFT over cold TTFT — the refill must
+    beat re-running the prefill it replaces. Phase 3 runs a DISJOINT
+    prefix rotation closed-loop (each client cycles its own prefixes,
+    so a revisit never finds a concurrent twin's resident chain) over
+    a device pool deliberately too small for the working set —
+    admission pressure evicts chains LIVE — twice: once with the host
+    tier under it (evictions spill, revisits refill) and once without
+    (evictions drop the bytes, revisits re-prefill) — decode tokens/s
+    with swap traffic over tokens/s without the tier. Swaps ride step
+    boundaries (the compiled step never blocks on one), so the tier
+    must hold near-parity here — on the CPU backend the stager's
+    gather and the refill transfer share the ONE device queue with
+    decode, so parity is the floor of the TPU case, where swap traffic
+    is DMA alongside compute."""
+    from bigdl_tpu.serving import DecodeScheduler, blocks_for_tokens
+    from bigdl_tpu.serving.kv_cache import SPILL_PENDING
+    model = _build_lm_model()
+    rng = np.random.RandomState(7)
+    bs = 16
+    n_prefixes = 6
+    prefix_len = 64 if smoke else 448     # block-aligned: the registered
+    chain = prefix_len // bs              # chain IS the shared prefix
+    prefixes = [rng.randint(1, 128, size=prefix_len).astype(np.int32)
+                for _ in range(n_prefixes)]
+    sfx = lambda: rng.randint(1, 128, size=8).astype(np.int32)  # noqa: E731
+    worst = blocks_for_tokens(prefix_len + 8 + 16, bs)
+    # TTFT pair runs UNCONSTRAINED (all chains + in-flight requests fit:
+    # the measured revisits isolate refill vs re-prefill, with no
+    # admission-pressure eviction noise); phase 3 runs the tight pool
+    roomy_blocks = 1 + n_prefixes * chain + 2 * worst
+    # tight pool holds 2 of the 6 chains: each phase-3 client rotates 3
+    # disjoint prefixes, so the pool keeps spilling the coldest chain
+    # and refilling it two requests later — steady churn, not a
+    # 100%-miss antagonist
+    tight_blocks = 1 + 2 * chain + 2 * worst
+    host_blocks = 2 * n_prefixes * chain + 16
+
+    def settle_spills(sched, deadline_s=30.0):
+        """Spills are async: wait for every spilled handle to stage so a
+        revisit's refill can't race its own fetch (a PENDING handle is a
+        deliberate miss, not a wait — see KVSwapManager.refill)."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            with sched.prefix._lock:
+                pending = [h for h, _ in sched.prefix._spilled.values()
+                           if h.state == SPILL_PENDING]
+            if not pending:
+                return
+            time.sleep(0.005)
+
+    with _paged_attn_env("off"):
+        sched = DecodeScheduler(
+            model, max_slots=max_slots, block_size=bs,
+            max_seq_len=prefix_len + 64, prefill_chunk=16,
+            num_blocks=roomy_blocks, host_blocks=host_blocks)
+        with sched:
+            cold_ttfts, hit_ttfts = [], []
+            for p in prefixes:               # phase 1: clean cold TTFTs
+                fut = sched.submit(np.concatenate([p, sfx()]), 8)
+                fut.result(timeout=300)
+                cold_ttfts.append(fut.trace["ttft_ms"])
+            # spill EVERY chain (LRU eviction → host tier), then one
+            # throwaway revisit: the first refill pays the staging
+            # ring's build + compile, which is warmup, not swap cost
+            sched.prefix.evict(n_prefixes * chain)
+            settle_spills(sched)
+            fut = sched.submit(np.concatenate([prefixes[0], sfx()]), 8)
+            fut.result(timeout=300)
+            for p in prefixes[1:]:           # phase 2: second-chance hits
+                settle_spills(sched)
+                h0 = sched.stats()["prefix"]["hits_after_spill"]
+                fut = sched.submit(np.concatenate([p, sfx()]), 8)
+                fut.result(timeout=300)
+                if sched.stats()["prefix"]["hits_after_spill"] > h0:
+                    hit_ttfts.append(fut.trace["ttft_ms"])
+            sched.drain(timeout=60.0)
+            st = sched.stats()
+
+    def thr_arm(**sched_kw):                 # phase 3: decode under churn
+        thr_reqs = 4 if smoke else 12
+        plan = []
+        for i in range(2):   # client i rotates its OWN 3 prefixes
+            reqs = []
+            for j in range(thr_reqs):
+                p = prefixes[3 * i + j % 3]
+                reqs.append((np.concatenate([p, sfx()]), 16))
+            plan.append(reqs)
+        with _paged_attn_env("off"):
+            s = DecodeScheduler(model, max_slots=max_slots, block_size=bs,
+                                max_seq_len=prefix_len + 64,
+                                prefill_chunk=16, **sched_kw)
+            total = [0] * len(plan)
+            with s:
+                def client(i):
+                    for p, mn in plan[i]:
+                        out = s.submit(p, mn).result(timeout=300)
+                        total[i] += int(out.size)
+                dt = _client_pool(len(plan), client)
+                s.drain(timeout=60.0)
+                stt = s.stats()
+        return sum(total) / dt, stt
+
+    thr_base, st_base = thr_arm(num_blocks=tight_blocks)  # tier OFF:
+    #   evictions drop bytes, every rotation revisit re-prefills
+    thr_sp, st_sp = thr_arm(num_blocks=tight_blocks,
+                            host_blocks=host_blocks)
+    cold_p50, hit_p50 = _pct(cold_ttfts, 0.5), _pct(hit_ttfts, 0.5)
+    ratio = hit_p50 / max(cold_p50, 1e-9)
+    swap_bytes = (st["host"]["swap_out_bytes"]
+                  + st_sp["host"]["swap_out_bytes"])
+    lines = [{
+        "metric": "serving_lm_spill_cold_ttft_p50_ms",
+        "value": round(cold_p50, 2), "unit": "ms",
+        "prefix_len": prefix_len, "backend": "cpu",
+    }, {
+        "metric": "serving_lm_spill_hit_ttft_p50_ms",
+        "value": round(hit_p50, 2), "unit": "ms",
+        "hits_after_spill": st["prefix"]["hits_after_spill"],
+        "spills": st["prefix"]["spills"], "backend": "cpu",
+    }, {
+        # the headline: a refill from host RAM must undercut the prefill
+        # it replaces (lower=better; < 1.0 is the acceptance bar on
+        # measured runs)
+        "metric": "serving_lm_spill_hit_ttft_ratio",
+        "value": round(ratio, 3), "unit": "x",
+        "hits_after_spill": st["prefix"]["hits_after_spill"],
+        "swap_failures": st["host"]["swap_failures"], "backend": "cpu",
+    }, {
+        "metric": "serving_lm_kv_swap_out_bytes",
+        "value": int(swap_bytes), "unit": "bytes",
+        "swap_in_bytes": int(st["host"]["swap_in_bytes"]
+                             + st_sp["host"]["swap_in_bytes"]),
+        "backend": "cpu",
+    }, {
+        "metric": "serving_lm_spill_tokens_per_s",
+        "value": round(thr_sp, 1), "unit": "tok/s",
+        "num_blocks": tight_blocks, "host_blocks": host_blocks,
+        "spills": st_sp["prefix"]["spills"], "backend": "cpu",
+    }, {
+        "metric": "serving_lm_nospill_tokens_per_s",
+        "value": round(thr_base, 1), "unit": "tok/s",
+        "num_blocks": tight_blocks, "backend": "cpu",
+    }, {
+        # decode throughput over the SAME tight pool, with the host
+        # tier vs without it: the tier converts the rotation's
+        # re-prefills into boundary-scheduled refills. Near-parity
+        # (~0.95x) is the CPU bar — the stager's gather and the refill
+        # transfer share the single CPU device queue with decode, so
+        # the swap bandwidth that is free DMA on a TPU is contended
+        # compute here; the gate floors the ratio against collapse and
+        # the baseline pins the measured band
+        "metric": "serving_lm_spill_tokens_per_s_ratio",
+        "value": round(thr_sp / max(thr_base, 1e-9), 2), "unit": "x",
+        "backend": "cpu",
+    }]
+    return lines, st, st_sp, st_base
+
+
 def main_lm(smoke: bool):
     n_clients = int(os.environ.get("SERVE_LM_CLIENTS", 3 if smoke else 8))
     n_requests = int(os.environ.get("SERVE_LM_REQUESTS", 2 if smoke else 4))
@@ -643,6 +808,9 @@ def main_lm(smoke: bool):
     pf_lines, st_p = bench_serving_lm_prefix(n_clients, n_requests,
                                              prefix_len, max_slots)
     lines += pf_lines
+    sl_lines, st_sl, st_sl_thr, st_sl_base = bench_serving_lm_spill(
+        n_requests, max_slots, smoke)
+    lines += sl_lines
     for line in lines:
         print(json.dumps(line), flush=True)
     _merge_metrics_dump(lines)
@@ -651,7 +819,9 @@ def main_lm(smoke: bool):
     total = n_clients * n_requests
     for name, st in (("continuous", st_c), ("static", st_s),
                      ("kernel", st_k), ("spec", st_sp),
-                     ("spec-plain", st_spp), ("prefix", st_p)):
+                     ("spec-plain", st_spp), ("prefix", st_p),
+                     ("spill", st_sl), ("spill-thr", st_sl_thr),
+                     ("spill-base", st_sl_base)):
         if st["timeouts"]:
             failures.append(f"{st['timeouts']} {name} requests timed out")
         leaked = (st["kv"]["blocks_in_use"]
@@ -687,6 +857,20 @@ def main_lm(smoke: bool):
     # warm numbers below are cold numbers wearing the wrong label
     if hit_rate <= 0.0:
         failures.append("shared-prefix arm never hit the prefix cache")
+    # the spill arm's PROVENANCE gates hold at every scale, smoke
+    # included: the tier must actually have spilled (bytes crossed to
+    # host), a revisit must have come back as a second-chance hit (or
+    # the "hit" TTFTs are cold numbers wearing the wrong label), and
+    # no swap may have failed on a healthy run
+    spill_hits = by_metric["serving_lm_spill_hit_ttft_ratio"][
+        "hits_after_spill"]
+    if by_metric["serving_lm_kv_swap_out_bytes"]["value"] <= 0:
+        failures.append("spill arm never swapped a block to host RAM")
+    if spill_hits <= 0:
+        failures.append("spill arm never served a hit-after-spill")
+    if by_metric["serving_lm_spill_hit_ttft_ratio"]["swap_failures"]:
+        failures.append("spill arm recorded swap failures on a "
+                        "fault-free run")
     if not smoke:
         # ISSUE 8 acceptance: continuous batching must beat whole-
         # request batching on BOTH axes (the smoke run is a plumbing
@@ -710,6 +894,26 @@ def main_lm(smoke: bool):
         if spec_ratio <= 1.0:
             failures.append(f"batched-spec tokens/s ratio {spec_ratio}x "
                             "<= 1x vs plain continuous batching")
+        # ISSUE 18 acceptance: a refill from host RAM must undercut the
+        # prefill it replaces (the latency headline), and under the
+        # same too-small pool the tier must hold near-parity decode
+        # throughput — the floor guards against the swap machinery
+        # collapsing the decode loop, while the PERF_BASELINE pin
+        # tracks the measured band (on this CPU bench the stager's
+        # gather and the refill transfer contend with decode for the
+        # one device queue; on a TPU they ride DMA)
+        spill_ratio = by_metric["serving_lm_spill_hit_ttft_ratio"]["value"]
+        if spill_ratio >= 1.0:
+            failures.append(f"hit-after-spill/cold TTFT ratio "
+                            f"{spill_ratio}x >= 1x (the refill lost to "
+                            "the prefill it replaces)")
+        thr_ratio = by_metric["serving_lm_spill_tokens_per_s_ratio"][
+            "value"]
+        if thr_ratio <= 0.7:
+            failures.append(f"decode tokens/s with the host tier "
+                            f"{thr_ratio}x <= 0.7x vs the same pool "
+                            "without it (swap churn is stalling the "
+                            "decode loop, not just paying transfer)")
     if failures:
         print("bench_serving --lm: FAIL — " + "; ".join(failures),
               file=sys.stderr)
@@ -737,7 +941,12 @@ def main_lm(smoke: bool):
           f"{by_metric['serving_lm_prefix_warm_ttft_p50_ms']['value']}ms "
           f"vs cold "
           f"{by_metric['serving_lm_prefix_cold_ttft_ms']['value']}ms "
-          f"({warm_ratio}x)")
+          f"({warm_ratio}x); spill arm {spill_hits} hits-after-spill, "
+          f"hit/cold TTFT "
+          f"{by_metric['serving_lm_spill_hit_ttft_ratio']['value']}x, "
+          f"decode under churn "
+          f"{by_metric['serving_lm_spill_tokens_per_s_ratio']['value']}x "
+          f"vs tier-off")
 
 
 # --------------------------------------------------------------- fleet

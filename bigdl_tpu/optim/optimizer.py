@@ -1985,7 +1985,7 @@ class DistriOptimizer(BaseOptimizer):
                  end_trigger=None, batch_size: int = 32, mesh=None,
                  parameter_mode: str = "replicated",
                  compress: str = "none", wire_dtype: str = "none",
-                 sparse_embedding: bool = False):
+                 sparse_embedding="auto"):
         """``compress`` / ``wire_dtype``: ZeRO-1 gradient-wire knobs
         (``parallel.allreduce`` module docstring) — ``compress`` is the
         legacy wire-dtype psum, ``wire_dtype`` the fp32-master-
@@ -2002,14 +2002,22 @@ class DistriOptimizer(BaseOptimizer):
         (H+1) < vocab * H`` elements, every other leaf (and an
         embedding whose batch would not win) rides the dense ``pmean``.
         Replicated parameter mode only — ZeRO-1's flat-vector wire has
-        no per-layer seam."""
+        no per-layer seam.
+
+        The default ``"auto"`` selects the wire by itself whenever it
+        applies SAFELY — replicated mode, the model input is a leading
+        ``LookupTable``'s ids, no ``w_regularizer`` on it — and rides
+        the ordinary dense path otherwise. Pass ``True`` to make the
+        selection a CONTRACT (a model the wire cannot serve is a typed
+        refusal instead of a silent fallback), ``False`` to force the
+        dense wire off entirely."""
         super().__init__(model, training_set, criterion, optim_method,
                          end_trigger, batch_size)
         from ..parallel.mesh import get_default_mesh
         self.mesh = mesh or get_default_mesh()
         if "data" not in self.mesh.axis_names:
             raise ValueError("DistriOptimizer mesh needs a 'data' axis")
-        if sparse_embedding and parameter_mode != "replicated":
+        if sparse_embedding is True and parameter_mode != "replicated":
             raise ValueError(
                 "sparse_embedding selects per-LAYER gradient wires — "
                 "ZeRO-1 ships one flat vector and has no per-layer "
@@ -2017,7 +2025,7 @@ class DistriOptimizer(BaseOptimizer):
         self.parameter_mode = parameter_mode
         self.compress = compress
         self.wire_dtype = wire_dtype
-        self.sparse_embedding = bool(sparse_embedding)
+        self.sparse_embedding = sparse_embedding
         self._arp = None
         self._flat = None
 
@@ -2163,6 +2171,25 @@ class DistriOptimizer(BaseOptimizer):
                 "sparse wire")
         return path, emb.n_index
 
+    def _sparse_embedding_enabled(self) -> bool:
+        """Resolve the ``sparse_embedding`` knob into a build decision.
+        ``True``/``False`` are explicit; ``"auto"`` picks the per-layer
+        wire exactly when ``_sparse_embedding_path`` would accept the
+        model under replicated mode, and falls back to the dense path
+        otherwise — the typed refusals stay reserved for the explicit
+        opt-in, where a silent fallback would hide a misconfiguration
+        the caller paid to rule out."""
+        se = self.sparse_embedding
+        if se == "auto":
+            if self.parameter_mode != "replicated":
+                return False
+            try:
+                self._sparse_embedding_path()
+            except ValueError:
+                return False
+            return True
+        return bool(se)
+
     def _build_sparse_step(self):
         """The per-layer gradient-wire path (sparse_embedding=True):
         an EXPLICIT shard_map data-parallel step — unlike the default
@@ -2266,7 +2293,7 @@ class DistriOptimizer(BaseOptimizer):
 
     def _build_step(self):
         if self.parameter_mode != "zero1":
-            if self.sparse_embedding:
+            if self._sparse_embedding_enabled():
                 return self._build_sparse_step()
             return super()._build_step()
 

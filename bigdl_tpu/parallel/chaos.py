@@ -17,6 +17,8 @@ site                          where it fires
 ``serving/engine_dispatch``   ServingEngine micro-batch forward
 ``kv/page_copy``              PagedKVCache.defrag page move
 ``kv/cow_fork``               PagedKVCache.fork_blocks copy-on-write
+``kv/swap_out``               KVSwapManager host-RAM spill fetch (stager)
+``kv/swap_in``                KVSwapManager refill verify + adopt
 ``prefix/insert``             PrefixCache.insert (index registration)
 ``prefix/evict``              PrefixCache.evict (reclaim under pressure)
 ``router/dispatch``           Router replica submit
@@ -96,6 +98,8 @@ SITES = (
     "serving/engine_dispatch",
     "kv/page_copy",
     "kv/cow_fork",
+    "kv/swap_out",
+    "kv/swap_in",
     "prefix/insert",
     "prefix/evict",
     "router/dispatch",

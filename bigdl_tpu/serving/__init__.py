@@ -55,7 +55,9 @@ from .batching import (QueueFull, DeadlineExceeded, EngineStopped,
                        ServeFuture, Request, assemble)
 from .registry import ModelRegistry, ModelVersion
 from .engine import ServingEngine, serving_threads_alive, THREAD_NAME
-from .kv_cache import KVCacheOOM, PagedKVCache, blocks_for_tokens
+from .kv_cache import (HostKVPool, HostPoolOOM, KVCacheOOM, KVSwapManager,
+                       PagedKVCache, blocks_for_tokens,
+                       kv_swap_threads_alive)
 from .prefix_cache import PrefixCache, chain_keys
 from .decode_scheduler import (DecodeScheduler, LMRequest,
                                decode_scheduler_threads_alive,
@@ -70,7 +72,8 @@ from .transport import (TransportClient, TransportClosed,
                         TransportServer, transport_threads_alive)
 from .fleet import (DisaggregatedFleet, FleetMonitor, KVHandoffError,
                     RemoteReplica, ReplicaAgent, discover,
-                    fleet_threads_alive, read_member, wait_for_members)
+                    fleet_threads_alive, read_member, wait_for_members,
+                    warm_replica)
 # the transient-failure classification AND the retry budget are SHARED
 # with the trainer (parallel/failure.FaultPolicy): the engine's batch
 # retry, the scheduler's bitwise step replay and the router's

@@ -87,7 +87,8 @@ from ..parallel.failure import (FaultPolicy, TransientDeviceError,
                                 classify_failure, TRANSIENT)
 from .batching import (DeadlineExceeded, EngineStopped, QueueFull,
                        ServeFuture)
-from .kv_cache import KVCacheOOM, PagedKVCache, blocks_for_tokens
+from .kv_cache import (SPILL_PENDING, KVCacheOOM, KVSwapManager,
+                       PagedKVCache, blocks_for_tokens)
 from .prefix_cache import PrefixCache
 from .registry import ModelRegistry
 
@@ -98,7 +99,8 @@ _STAT_KEYS = ("submitted", "completed", "rejected", "timeouts",
               "spec_rounds", "spec_accepted", "spec_row_rounds",
               "spec_fallbacks", "defrags",
               "prefix_hits", "prefix_misses", "prefix_reused_tokens",
-              "prefix_cow_forks", "step_replays", "kv_corruptions")
+              "prefix_cow_forks", "step_replays", "kv_corruptions",
+              "preemptions", "resumes", "resume_recomputes")
 
 
 def _pow2_bucket(n: int, cap: int, floor: int = 2) -> int:
@@ -141,11 +143,11 @@ class LMRequest:
                  "model_version", "slot", "pos", "generated", "steps",
                  "chunks", "pf_i", "temperature", "top_p", "seed",
                  "hit_tokens", "adopted_n", "draft_pos", "spec_rounds",
-                 "spec_accepted")
+                 "spec_accepted", "priority", "swap_handle", "resume_seq")
 
     def __init__(self, prompt, max_new_tokens, eos_id, deadline_s, rid,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed: int = 0):
+                 seed: int = 0, priority: int = 0):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -179,6 +181,10 @@ class LMRequest:
         #                            before its next speculative round
         self.spec_rounds = 0       # speculative rounds this row rode
         self.spec_accepted = 0     # draft tokens the target accepted
+        self.priority = int(priority)  # preemption class (higher wins)
+        self.swap_handle = None    # HostKVHandle while preempted-to-host
+        self.resume_seq = None     # host tokens to re-prefill when the
+        #                            swap degraded to recompute
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -275,6 +281,21 @@ class DecodeScheduler:
         once, and admission stops creating NEW shared state (prefix
         lookups/registrations bypass) while in-flight traffic keeps
         draining.
+    host_blocks : size of the host-RAM KV paging tier (ISSUE 18) in
+        BLOCKS; 0 (default) disables it. When armed, prefix-cache
+        evictions SPILL to host RAM instead of dropping (a later lookup
+        refills — the second chance) and the scheduler gains swap-based
+        preemption. All swaps are scheduled at step boundaries and
+        staged asynchronously — the compiled step never blocks on a
+        transfer (docs/SERVING.md "KV memory hierarchy").
+    preempt : allow swap-based preemption (needs ``host_blocks``):
+        when admission of a higher-``priority`` request hits block
+        pressure, the lowest-priority decoding request's pages swap
+        out, it re-enters the backlog, and re-admission refills and
+        resumes BITWISE (the PR-13 snapshotted-handles argument; a
+        failed stage degrades to recompute from host-resident tokens —
+        never corrupt). ``False`` keeps spill/refill but never
+        interrupts a running request.
     """
 
     def __init__(self, model, *, max_slots: int = 8, block_size: int = 16,
@@ -294,7 +315,9 @@ class DecodeScheduler:
                  name: Optional[str] = None,
                  tags=(),
                  fault_policy: Optional[FaultPolicy] = None,
-                 audit_every: int = 256):
+                 audit_every: int = 256,
+                 host_blocks: int = 0,
+                 preempt: bool = True):
         if model.mode != "lm":
             raise ValueError("DecodeScheduler serves LM-mode models")
         if max_slots < 2:
@@ -365,13 +388,20 @@ class DecodeScheduler:
                                block_size=block_size,
                                max_blocks_per_seq=mbs,
                                sharding=page_sharding)
+        # host-RAM paging tier (ISSUE 18): one async staging pipeline
+        # under the device pool, shared by the prefix cache's second
+        # chance and swap-based preemption
+        self.kv_swap = (KVSwapManager(self.kv, host_blocks, tag=name)
+                        if host_blocks > 0 else None)
+        self.preempt_enabled = bool(preempt) and self.kv_swap is not None
         # prefix reuse aligns to max(chunk, block): hits leave the cold
         # schedule's remaining chunks intact (same compiled shapes, same
         # inputs — the bitwise contract; both are powers of two, so the
         # smaller always divides the larger)
         self.hit_align = max(self.prefill_chunk, int(block_size))
         self.prefix = (PrefixCache(self.kv,
-                                   max_entries=prefix_cache_entries)
+                                   max_entries=prefix_cache_entries,
+                                   swap=self.kv_swap)
                        if prefix_cache else None)
         self.draft_model = draft_model
         self.draft_kv = None
@@ -599,6 +629,11 @@ class DecodeScheduler:
                     self._put(np.zeros((b,), bool))))
             for s in shapes_upto(self.prefill_chunk):
                 drive(self._draft_jit, self.draft_kv, 1, s)
+        if self.kv_swap is not None:
+            # the stager's bucketed gathers compile too — paying one on
+            # the staging thread under live traffic stalls every spill
+            # behind it (the second-chance window closes PENDING)
+            self.kv_swap.warmup()
         return self
 
     def drain(self, timeout: Optional[float] = None) -> bool:
@@ -651,6 +686,13 @@ class DecodeScheduler:
         # holds on every shutdown path, sharing included)
         if self.prefix is not None:
             self.prefix.clear()
+        # ... and the host tier drains with it: _release settled every
+        # preempted handle, prefix.clear() every spilled one, so the
+        # stager has nothing live left — stop it (the wedged path above
+        # returns early and leaves the daemon thread; the stall
+        # watchdog owns that failure mode)
+        if self.kv_swap is not None:
+            self.kv_swap.shutdown()
 
     def __enter__(self):
         return self.start()
@@ -665,7 +707,8 @@ class DecodeScheduler:
                deadline_ms: Optional[float] = None,
                eos_id="default", temperature: float = 0.0,
                top_p: float = 1.0,
-               seed: Optional[int] = None) -> ServeFuture:
+               seed: Optional[int] = None,
+               priority: int = 0) -> ServeFuture:
         """Enqueue ONE generation request: ``prompt_ids`` (1-D int) →
         future resolving to the GENERATED ids (np.int32, prompt
         excluded). Raises :class:`QueueFull` / typed rejection
@@ -678,7 +721,13 @@ class DecodeScheduler:
         ``top_p`` under a per-request key stream: ``seed`` pins the
         stream explicitly (same seed ⇒ same tokens, regardless of
         batch mix); when None, the seed derives deterministically from
-        the scheduler's ``sampling_seed`` and this request's rid."""
+        the scheduler's ``sampling_seed`` and this request's rid.
+
+        ``priority`` is the preemption class (default 0): with the host
+        tier armed, admission of a higher-priority request under block
+        pressure may swap a lower-priority DECODING request out to host
+        RAM; the victim resumes bitwise when blocks free up. Equal
+        priorities never preempt each other."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if temperature < 0:
@@ -709,7 +758,7 @@ class DecodeScheduler:
         req = LMRequest(prompt, max_new_tokens, eid,
                         ms / 1000.0 if ms is not None else None,
                         rid, temperature=temperature, top_p=top_p,
-                        seed=seed)
+                        seed=seed, priority=priority)
         try:
             with self._cond:
                 if self._closed:
@@ -779,6 +828,8 @@ class DecodeScheduler:
         out["kv"] = self.kv.stats()
         out["prefix"] = (self.prefix.stats() if self.prefix is not None
                          else None)
+        out["host"] = (self.kv_swap.stats() if self.kv_swap is not None
+                       else None)
         return out
 
     def cached_prefix_tokens(self, prompt_ids) -> int:
@@ -965,6 +1016,8 @@ class DecodeScheduler:
             f"decode scheduler died: {type(error).__name__}: {error}")
         if self.prefix is not None:
             self.prefix.clear()
+        if self.kv_swap is not None:
+            self.kv_swap.shutdown()
         self._beacon.close()
 
     def _abandon_inflight(self, msg: str):
@@ -1092,6 +1145,15 @@ class DecodeScheduler:
                 self._backlog.popleft()
                 self._expire(req)
                 continue
+            if req.swap_handle is not None or req.resume_seq is not None:
+                # a preempted request resumes through refill-before-
+                # resume, never ordinary admission (its decode state is
+                # on the host tier, not in its prompt). Deferring keeps
+                # it at the head — FIFO, so resumption cannot starve
+                # behind a stream of fresh arrivals.
+                if not self._resume_preempted(req):
+                    break
+                continue
             # spec_over is PER SLOT: under batched speculation every
             # active row (sampled ones included — they ride the verify
             # dispatch masked to one real token, whose padded lanes
@@ -1143,12 +1205,19 @@ class DecodeScheduler:
                     # deferred request must leave the ledger untouched
                     self.kv.free(req.rid)
                     raise
-            except (KVCacheOOM, TransientDeviceError):
+            except (KVCacheOOM, TransientDeviceError) as e:
                 # backpressure: leave it queued — eviction will free
                 # blocks and the next boundary retries. A TRANSIENT
                 # fault in the admission transaction (an injected
                 # cow-fork/evict failure) takes the same deferral:
-                # the transaction unwound, the request just waits
+                # the transaction unwound, the request just waits.
+                # Under REAL block pressure a higher-priority arrival
+                # may instead swap a lower-priority decoding request
+                # out to the host tier and retry immediately (ISSUE
+                # 18) — admission stops deferring when spilling a
+                # victim frees enough blocks.
+                if isinstance(e, KVCacheOOM) and self._try_preempt(req):
+                    continue
                 break
             self._backlog.popleft()
             req.slot = self._free_slots.pop()
@@ -1244,6 +1313,139 @@ class DecodeScheduler:
             _health.emit("prefix_insert_skipped", rid=req.rid,
                          error=f"{type(e).__name__}: {e}")
 
+    # -- swap-based preemption (ISSUE 18) --------------------------------
+
+    def _try_preempt(self, for_req) -> bool:
+        """Admission hit block pressure: swap the cheapest
+        lower-priority DECODING request out to the host tier so
+        ``for_req`` can admit now instead of deferring. The victim's
+        pages snapshot at this boundary (the stager fetches them
+        asynchronously — immutable functional handles, so freeing the
+        device blocks immediately is safe), it re-enters the backlog
+        right behind the request it yielded to, and re-admission
+        refills and resumes bitwise. Returns True when a victim was
+        preempted (the caller retries admission in the same pass)."""
+        if not self.preempt_enabled:
+            return False
+        cands = [r for r in self._active if r.priority < for_req.priority]
+        if not cands:
+            return False
+        # lowest priority first; among equals the fewest owned blocks —
+        # the cheapest swap that relieves the pressure
+        victim = min(cands, key=lambda r: (r.priority,
+                                           self.kv.owned(r.rid)))
+        blocks = self.kv.owner_blocks(victim.rid)
+        if not blocks:
+            return False
+        h = self.kv_swap.spill(blocks, tag="preempt")
+        if h is None and self.prefix is not None \
+                and self.prefix.drop_spilled(len(blocks)):
+            # host pressure: a running request's decode state outranks
+            # cold spilled prefix chains — drop the coldest and retry
+            h = self.kv_swap.spill(blocks, tag="preempt")
+        if h is None:
+            return False
+        victim.swap_handle = h
+        # the snapshot keeps the bytes alive for the stager — the
+        # device blocks return to the free list at THIS boundary
+        self.kv.free(victim.rid)
+        if self.draft_kv is not None:
+            self.draft_kv.free(victim.rid)
+        victim.draft_pos = 0
+        self._active.remove(victim)
+        self._free_slots.append(victim.slot)
+        victim.slot = None
+        # behind the head request it yielded to; model version stays
+        # pinned — the resumed stream must finish on the params it
+        # started with
+        self._backlog.insert(1, victim)
+        self._bump("preemptions")
+        if obs.enabled():
+            obs.counter("serve/preemptions").inc()
+        _flight.record("serve/preempt", rid=victim.rid,
+                       for_rid=for_req.rid, blocks=len(blocks))
+        return True
+
+    def _resume_preempted(self, req) -> bool:
+        """Refill-before-resume for the backlog head: land the
+        preempted request's host pages back in the device pool and
+        return it to the running batch — its decode continues from the
+        exact position it was interrupted at, bitwise (the refilled
+        pages are digest-verified copies of the snapshotted handles —
+        the PR-13 replay argument). A stage still in flight, a full
+        device pool, or a full draft pool DEFERS (False — retry next
+        boundary); a failed/corrupt stage DEGRADES to re-prefilling the
+        host-resident tokens through the ordinary chunk schedule (the
+        router-failover recompute precedent — per-position KV is
+        bitwise stable across chunkings). Returns True when the request
+        left the backlog (resumed or recomputing)."""
+        spec_over = (self.spec_k + 1) if self.draft_model is not None \
+            else 0
+        keep = int(req.prompt.size) + req.max_new_tokens + spec_over
+        h = req.swap_handle
+        if h is not None:
+            if h.state == SPILL_PENDING:
+                return False   # stage in flight — next boundary
+            need = h.n_blocks
+            if not self.kv.can_allocate(need) and self.prefix is not None \
+                    and not self._quarantined:
+                self.prefix.evict(need - self.kv.blocks_free())
+            if not self.kv.can_allocate(need):
+                return False
+            if self.draft_kv is not None and not self.draft_kv.can_allocate(
+                    blocks_for_tokens(keep, self.kv.block_size)):
+                return False
+            try:
+                ids = self.kv_swap.refill(req.rid, h)
+            except KVCacheOOM:
+                return False   # handle intact — roomier boundary retries
+            if ids is not None:
+                req.swap_handle = None
+                # single-threaded admission: the can_allocate pre-check
+                # above guarantees this growth cannot OOM
+                if self.draft_kv is not None:
+                    self.draft_kv.ensure_capacity(req.rid, keep)
+                self._backlog.popleft()
+                req.slot = self._free_slots.pop()
+                self._active.append(req)
+                self._bump("resumes")
+                if obs.enabled():
+                    obs.counter("serve/resumes").inc()
+                return True
+            # stage failed/corrupt (handle settled by the manager):
+            # recompute from the host-resident tokens — the KV for
+            # positions [0, pos) re-prefills chunk-by-chunk, then
+            # decode continues exactly where it stopped
+            req.swap_handle = None
+            req.resume_seq = np.concatenate(
+                [req.prompt,
+                 np.asarray(req.generated, np.int32)])[:req.pos]
+        seq = req.resume_seq
+        worst = max(prefill_padded_end(seq.size, self.prefill_chunk),
+                    keep)
+        need = blocks_for_tokens(worst, self.kv.block_size)
+        if not self.kv.can_allocate(need) and self.prefix is not None \
+                and not self._quarantined:
+            self.prefix.evict(need - self.kv.blocks_free())
+        if not self.kv.can_allocate(need) or (
+                self.draft_kv is not None
+                and not self.draft_kv.can_allocate(need)):
+            return False   # resume_seq persists — retry stays here
+        self.kv.ensure_capacity(req.rid, worst)
+        if self.draft_kv is not None:
+            self.draft_kv.ensure_capacity(req.rid, worst)
+        req.chunks = prefill_schedule(seq.size, self.prefill_chunk)
+        req.pf_i = 0
+        self._backlog.popleft()
+        req.slot = self._free_slots.pop()
+        self._prefilling.append(req)
+        self._bump("resume_recomputes")
+        if obs.enabled():
+            obs.counter("serve/resume_recomputes").inc()
+        _health.emit("kv_swap_recompute", rid=req.rid,
+                     tokens=int(seq.size))
+        return True
+
     def _advance_prefill(self) -> bool:
         """ONE prefill chunk for the head admitted-but-prefilling
         request (FIFO), interleaved with the running batch's decode
@@ -1258,12 +1460,18 @@ class DecodeScheduler:
         t0 = time.perf_counter_ns()
         s, real, padded = req.chunks[req.pf_i]
         last = req.pf_i == len(req.chunks) - 1
+        # a preempted request whose swap stage failed re-prefills its
+        # host-resident prompt+generated tokens (resume_seq) through
+        # this same chunk machinery; the first-token readback/emit is
+        # skipped — its next token comes from the ordinary decode step
+        resumed = req.resume_seq is not None
+        src = req.resume_seq if resumed else req.prompt
         # write-safety invariant: every block this chunk touches is
         # PRIVATE — warm suffix chunks start past the adopted prefix,
         # and the rerun-last-chunk case's shared blocks were forked
         # copy-on-write inside the admission transaction (_admit)
         toks = np.zeros((1, padded), np.int32)
-        toks[0, :real] = req.prompt[s:s + real]
+        toks[0, :real] = src[s:s + real]
 
         def dispatch():
             _chaos.maybe_fire("serving/prefill", tag=self.name)
@@ -1289,7 +1497,7 @@ class DecodeScheduler:
                         self._put(np.asarray([s], np.int32)),
                         self._put(dtable), *self._sampling_args((), 1))
                 first_tok = None
-                if last:
+                if last and not resumed:
                     # sync-ok: the first generated token — the client's
                     # TTFT — is exactly this readback
                     first_tok = int(np.asarray(choices)[0, real - 1])
@@ -1324,6 +1532,16 @@ class DecodeScheduler:
         self.kv.truncate(req.rid, keep)
         if self.draft_kv is not None:
             self.draft_kv.truncate(req.rid, keep)
+        if resumed:
+            # recompute complete: KV for [0, pos) is rebuilt (bitwise —
+            # per-position KV is chunking-stable), decode picks up with
+            # generated[-1] at pos exactly as if never interrupted. No
+            # first-token emit, no TTFT restamp — the client already
+            # has these tokens.
+            req.pos = int(req.resume_seq.size)
+            req.resume_seq = None
+            self._active.append(req)
+            return True
         req.pos = int(req.prompt.size)
         req.t_first_ns = time.perf_counter_ns()
         self._bump("tokens")
@@ -1654,8 +1872,10 @@ class DecodeScheduler:
             pass
 
     def _release(self, req):
-        """Return every engine resource a request holds: its slot and
-        its KV blocks (both caches). Safe to call twice."""
+        """Return every engine resource a request holds: its slot, its
+        KV blocks (both caches), and any host-tier reservation a
+        preemption left behind (the host pool must drain to 0 at every
+        shutdown path, like the device pool). Safe to call twice."""
         if req in self._active:
             self._active.remove(req)
         if req in self._prefilling:
@@ -1666,6 +1886,10 @@ class DecodeScheduler:
         self.kv.free(req.rid)
         if self.draft_kv is not None:
             self.draft_kv.free(req.rid)
+        if req.swap_handle is not None:
+            self.kv_swap.discard(req.swap_handle)
+            req.swap_handle = None
+        req.resume_seq = None
         req.model_version = None
 
     # -- internals -------------------------------------------------------

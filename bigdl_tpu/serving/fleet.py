@@ -1273,6 +1273,50 @@ class DisaggregatedFleet:
 _swap_ids = itertools.count(1)
 
 
+def warm_replica(source: RemoteReplica, target: RemoteReplica,
+                 prompts, *, timeout_s: float = 300.0) -> Dict:
+    """Warm a (re)joining replica's prefix cache from a peer: for each
+    prompt, export ``source``'s aligned prefix chain and adopt it on
+    ``target`` through the ordinary content-key-verified handoff — the
+    new replica's first requests for warmed prompts take the PR-12
+    warm-hit path instead of each paying a cold prefill.
+
+    The host tier (ISSUE 18) is what makes the SOURCE side cheap: a
+    chain the source evicted under block pressure lives on in its host
+    pool, so the export's lookup REFILLS it (a second-chance hit)
+    rather than re-running the prefill — warming a peer from a busy
+    replica costs swap-ins, not recompute. Failure discipline is
+    per-prompt degrade, the fleet's usual: a refused adopt (version
+    skew, block pressure on the target) or a dying export skips THAT
+    prompt and moves on — warming is an optimization pass, it must
+    never take a joining replica down.
+
+    Returns ``{"warmed", "tokens", "skipped", "failed"}`` counts.
+    Administrative path (replica join/rebalance) — not a hot loop."""
+    out = {"warmed": 0, "tokens": 0, "skipped": 0, "failed": 0}
+    for p in prompts:
+        try:
+            meta, arrays = source.prefill_export(p, timeout=timeout_s)
+            if meta.get("tokens", 0) <= 0:
+                out["skipped"] += 1      # shorter than the alignment
+                continue
+            target.adopt_prefix(
+                {"version": meta["version"], "keys": meta["keys"],
+                 "geometry": meta["geometry"],
+                 "digest": meta["digest"]},
+                arrays, timeout=timeout_s)
+            out["warmed"] += 1
+            out["tokens"] += int(meta["tokens"])
+        except Exception as e:  # noqa: BLE001 — per-prompt degrade
+            out["failed"] += 1
+            _LOG.warning("warm_replica: prompt skipped (%s: %s)",
+                         type(e).__name__, e)
+    if obs.enabled():
+        obs.counter("serve/fleet_warm_prompts").inc(out["warmed"])
+        obs.counter("serve/fleet_warm_tokens").inc(out["tokens"])
+    return out
+
+
 def fleet_threads_alive() -> int:
     """Live agent/monitor threads (tests assert 0 after shutdown)."""
     return sum(1 for t in threading.enumerate() if t.is_alive()

@@ -54,6 +54,8 @@ bitwise-identical whether it decodes alone or mid-swarm.
 """
 from __future__ import annotations
 
+import hashlib
+import queue
 import threading
 from collections import Counter
 from typing import Callable, Dict, List, Optional, Sequence
@@ -63,13 +65,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as obs
+from ..observability import health as _health
 from ..parallel import chaos as _chaos
+from ..parallel.failure import TransientDeviceError
 
 
 class KVCacheOOM(RuntimeError):
     """The free list cannot cover a requested allocation. Typed so the
     scheduler's admission control can defer (keep the request queued)
     rather than fail it."""
+
+
+class HostPoolOOM(RuntimeError):
+    """The host block pool cannot cover a spill reservation. Typed so
+    spill call sites DEGRADE — drop the coldest spilled chains, or skip
+    the spill entirely (eviction then discards pages exactly like the
+    pre-tier behavior) — instead of failing the admission path over an
+    optimization."""
 
 
 def blocks_for_tokens(tokens: int, block_size: int) -> int:
@@ -430,7 +442,27 @@ class PagedKVCache:
                         np.asarray(jax.device_get(v[idx]))))
         return ids, out
 
-    def adopt_serialized(self, owner, layers) -> List[int]:
+    def snapshot_blocks(self, blocks):
+        """The deferred-fetch half of :meth:`export_blocks`: capture
+        ``(ids, page_handles)`` atomically under the ledger lock and
+        return WITHOUT fetching. Pages are functional arrays, so the
+        captured handles keep holding every byte the captured ids name
+        even after the blocks are freed and rewritten by later decode
+        steps — the same no-tear argument export_blocks makes against a
+        concurrent defrag. This is what lets the swap tier free device
+        blocks at the boundary where the spill is DECIDED while the
+        staging thread performs the actual host fetch at leisure: the
+        compiled step never waits on a swap-out."""
+        with self._lock:
+            ids = [int(b) for b in blocks]
+            for b in ids:
+                if self._refs.get(b, 0) < 1:
+                    raise ValueError(
+                        f"cannot snapshot dead block {b} — spill must be "
+                        "decided while the pages are still referenced")
+            return ids, list(self._pages)
+
+    def adopt_serialized(self, owner, layers, *, stage=None) -> List[int]:
         """The receiving half of a handoff: allocate fresh private
         blocks for ``owner`` and write the transferred pages into them
         (one scatter dispatch per layer). ``layers`` is
@@ -441,7 +473,14 @@ class PagedKVCache:
         arrived over a wire. Returns the new physical ids, in logical
         order, refcounted to ``owner`` (hand them to
         ``PrefixCache.insert`` to make the prefix adoptable, then
-        ``free(owner)`` — exactly the post-prefill registration flow)."""
+        ``free(owner)`` — exactly the post-prefill registration flow).
+
+        ``stage`` optionally replaces the default host→device placement
+        (``jnp.asarray`` per layer) with a caller-provided
+        ``f(k_np, v_np) -> (k_dev, v_dev)`` — the swap tier routes the
+        transfer through ``native.HostStagingRing``'s reusable staging
+        buffers so a refill-heavy workload doesn't pay a fresh pinned
+        allocation per swap-in."""
         geo = self.geometry()
         if len(layers) != geo["n_layers"]:
             raise ValueError(
@@ -462,13 +501,31 @@ class PagedKVCache:
                 raise ValueError("handoff layers disagree on block count")
         if not n:
             return []
+        # pad the transfer AND the scatter to the next power-of-two
+        # bucket (padding rows scatter into the reserved garbage block
+        # 0, like a padded decode slot's writes): refill/handoff sizes
+        # vary per boundary, and the scatter compiles per distinct row
+        # count — ON THE SCHEDULER THREAD, stalling every active decode
+        # for the compile. Bucketed, O(log pool) shapes exist total and
+        # KVSwapManager.warmup() pre-pays them.
+        npdt = np.dtype(self.page_dtype)
+        pad = _gather_bucket(n) - n
+        if pad:
+            zrow = np.zeros((pad,) + want, npdt)
+            layers = [(np.concatenate([np.asarray(lk, npdt), zrow]),
+                       np.concatenate([np.asarray(lv, npdt), zrow]))
+                      for lk, lv in layers]
         # host→device transfer OUTSIDE the ledger lock (the symmetric
         # discipline to export_blocks' fetch): a multi-MB handoff must
         # not stall every concurrent admission/alloc/free on this
         # replica for the transfer's duration. Only the free-list pop
         # and the page-handle swap run in-lock.
-        dev = [(jnp.asarray(lk, self.page_dtype),
-                jnp.asarray(lv, self.page_dtype)) for lk, lv in layers]
+        if stage is None:
+            dev = [(jnp.asarray(lk, self.page_dtype),
+                    jnp.asarray(lv, self.page_dtype)) for lk, lv in layers]
+        else:
+            dev = [stage(np.asarray(lk, npdt), np.asarray(lv, npdt))
+                   for lk, lv in layers]
         with self._lock:
             if self._owned.get(owner):
                 raise ValueError(f"adopt_serialized owner {owner!r} "
@@ -480,7 +537,7 @@ class PagedKVCache:
             for b in ids:
                 self._refs[b] = 1
             self._owned[owner] = list(ids)
-            dst = jnp.asarray(ids, jnp.int32)
+            dst = jnp.asarray(ids + [0] * pad, jnp.int32)
             self._pages = [
                 (k.at[dst].set(dk), v.at[dst].set(dv))
                 for (k, v), (dk, dv) in zip(self._pages, dev)]
@@ -691,3 +748,581 @@ class PagedKVCache:
         obs.gauge(f"{pre}_shared_blocks").set(s["shared_blocks"])
         obs.gauge(f"{pre}_high_water").set(s["high_water"])
         obs.gauge(f"{pre}_frag_blocks").set(self.frag_blocks())
+
+
+# -- host-RAM paging tier (ISSUE 18) -------------------------------------
+
+#: HostKVHandle lifecycle. PENDING means the staging fetch is still in
+#: flight on the swap thread; READY means the page bytes are resident in
+#: host RAM; FAILED means the fetch died (consumers recompute); FREED
+#: means the reservation is back in the pool (refilled or dropped).
+SPILL_PENDING = "pending"
+SPILL_READY = "ready"
+SPILL_FAILED = "failed"
+SPILL_FREED = "freed"
+
+SWAP_THREAD_NAME = "bigdl_tpu-kv-swap-stager"
+
+
+class HostKVHandle:
+    """One spilled segment: ``n_blocks`` pages captured from the device
+    pool and staged to host RAM by the swap thread. The handle is the
+    ONLY name for the host bytes — whoever holds it (a spilled prefix
+    entry, a preempted request) owns the reservation and must settle it
+    exactly once: a successful :meth:`KVSwapManager.refill` or a
+    :meth:`KVSwapManager.discard`. State transitions are owned by
+    :class:`HostKVPool` under its lock; reading ``state`` without the
+    lock is a benign race (a PENDING→READY flip observed late just
+    defers the refill to the next step boundary)."""
+
+    __slots__ = ("n_blocks", "tag", "state", "layers", "digest", "nbytes")
+
+    def __init__(self, n_blocks: int, tag=None):
+        self.n_blocks = int(n_blocks)
+        self.tag = tag
+        self.state = SPILL_PENDING
+        self.layers = None   # [(k_np, v_np), ...] per layer, once READY
+        self.digest = None   # blake2b over the fetched page bytes
+        self.nbytes = 0
+
+
+class HostKVPool:
+    """Host-RAM block accounting under the device ledger: a fixed budget
+    of ``num_blocks`` spill slots (each holds one device page per layer,
+    so a slot's bytes = ``n_layers * 2 * kvH * block_size * D *
+    itemsize``). Same drain discipline as the device pool — every
+    shutdown path must return ``blocks_in_use`` to 0, and the spill
+    tests gate on it. Reservation happens at spill DECISION time (before
+    the async fetch lands), so the pool can never be oversubscribed by
+    in-flight stages."""
+
+    def __init__(self, num_blocks: int,
+                 metric_prefix: str = "serve/kv_host"):
+        if num_blocks < 1:
+            raise ValueError(f"host pool needs >= 1 block, got "
+                             f"{num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.metric_prefix = metric_prefix
+        self._lock = threading.Lock()
+        self._in_use = 0
+        self._spills = 0
+        self._set_gauges()
+
+    def alloc(self, n_blocks: int, tag=None) -> HostKVHandle:
+        """Reserve ``n_blocks`` spill slots. Raises :class:`HostPoolOOM`
+        (ledger untouched) when the budget can't cover it — callers
+        degrade, never fail."""
+        n = int(n_blocks)
+        if n < 1:
+            raise ValueError(f"spill needs >= 1 block, got {n}")
+        with self._lock:
+            free = self.num_blocks - self._in_use
+            if n > free:
+                raise HostPoolOOM(
+                    f"spill needs {n} host blocks, {free} free of "
+                    f"{self.num_blocks}")
+            self._in_use += n
+            self._spills += 1
+            h = HostKVHandle(n, tag)
+        self._set_gauges()
+        return h
+
+    def store(self, handle: HostKVHandle, layers, digest) -> bool:
+        """Swap-thread side: land the fetched pages. Returns False when
+        the handle was freed/failed while the fetch was in flight — the
+        bytes are discarded (the reservation already went back)."""
+        nbytes = sum(int(k.nbytes) + int(v.nbytes) for k, v in layers)
+        with self._lock:
+            if handle.state != SPILL_PENDING:
+                return False
+            handle.layers = layers
+            handle.digest = digest
+            handle.nbytes = nbytes
+            handle.state = SPILL_READY
+        return True
+
+    def payload(self, handle: HostKVHandle):
+        """``(layers, digest)`` when READY, else None. Does NOT free —
+        the device-side refill may still hit :class:`KVCacheOOM` and
+        retry at a roomier boundary."""
+        with self._lock:
+            if handle.state != SPILL_READY:
+                return None
+            return handle.layers, handle.digest
+
+    def fail(self, handle: HostKVHandle):
+        """Swap-thread side: the fetch died. PENDING→FAILED, the
+        reservation goes back; consumers observe FAILED and recompute."""
+        with self._lock:
+            if handle.state != SPILL_PENDING:
+                return
+            handle.state = SPILL_FAILED
+            handle.layers = None
+            self._in_use -= handle.n_blocks
+        self._set_gauges()
+
+    def free(self, handle: HostKVHandle) -> int:
+        """Settle a handle (refilled, dropped, or its owner died) and
+        return its reservation. Idempotent across every terminal state;
+        returns the number of blocks actually returned."""
+        with self._lock:
+            if handle.state not in (SPILL_PENDING, SPILL_READY):
+                return 0
+            handle.state = SPILL_FREED
+            handle.layers = None
+            n = handle.n_blocks
+            self._in_use -= n
+        self._set_gauges()
+        return n
+
+    def blocks_in_use(self) -> int:
+        with self._lock:
+            return self._in_use
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "host_blocks_total": self.num_blocks,
+                "host_blocks_in_use": self._in_use,
+                "host_blocks_free": self.num_blocks - self._in_use,
+                "host_spills": self._spills,
+            }
+
+    def _set_gauges(self):
+        if not obs.enabled():
+            return
+        with self._lock:
+            in_use = self._in_use
+        pre = self.metric_prefix
+        obs.gauge(f"{pre}_blocks_total").set(self.num_blocks)
+        obs.gauge(f"{pre}_blocks_in_use").set(in_use)
+        obs.gauge(f"{pre}_blocks_free").set(self.num_blocks - in_use)
+
+
+def _pages_digest(layers) -> bytes:
+    """Content hash over fetched page bytes — the refill re-verifies it
+    before adopting, the same end-to-end integrity argument the PR-15
+    handoff makes over the wire (here the 'wire' is host RAM dwell)."""
+    h = hashlib.blake2b(digest_size=16)
+    for k, v in layers:
+        h.update(np.ascontiguousarray(k).tobytes())
+        h.update(np.ascontiguousarray(v).tobytes())
+    return h.digest()
+
+
+def _gather_bucket(n: int) -> int:
+    """Next power-of-two at or above ``n`` — the stager's gather shapes
+    are padded to these buckets so XLA compiles O(log pool) gather
+    programs total instead of one per distinct eviction-sweep size."""
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class KVSwapManager:
+    """Async host-RAM staging pipeline under ONE :class:`PagedKVCache`
+    (ISSUE 18).
+
+    **Swap-out never blocks the decode loop.** The caller — always a
+    step-boundary path on the scheduler thread — captures ``(ids, page
+    handles)`` under the ledger lock (:meth:`PagedKVCache.snapshot_blocks`,
+    the deferred-fetch half of ``export_blocks``) and hands the fetch to
+    this manager's staging thread. The captured handles are immutable
+    functional arrays, so the fetch stays bitwise-correct even after the
+    device blocks are freed and rewritten by later decode steps — the
+    same no-tear argument ``export_blocks`` makes against a concurrent
+    defrag. The caller may therefore release the device blocks at the
+    SAME boundary the spill is decided.
+
+    **Swap-in runs on the scheduler thread at a step boundary** but only
+    ISSUES transfers — host→device through ``native.HostStagingRing``'s
+    reusable staging buffers into ``adopt_serialized``'s scatter — and
+    never blocks on one (the adopt discipline; the ring's reuse fence is
+    its one annotated sync, paid at most once per in-flight slot).
+
+    **Fault semantics** (docs/RESILIENCE.md): the ``kv/swap_out`` and
+    ``kv/swap_in`` chaos sites fire on the respective paths. A TRANSIENT
+    fault replays once — captured handles / host bytes are immutable, so
+    the retry is bitwise. Anything past that DEGRADES: the spill is
+    dropped (a spilled prefix chain becomes a future cold miss; a
+    preempted request recomputes from its host-resident tokens) and a
+    ``kv_swap_failed`` health event lands. A swap failure never corrupts
+    KV and never takes serving down."""
+
+    def __init__(self, kv: PagedKVCache, host_blocks: int, *, tag=None):
+        self.kv = kv
+        self.tag = tag
+        self.pool = HostKVPool(
+            host_blocks, metric_prefix=f"{kv.metric_prefix}_host")
+        self._q: "queue.Queue" = queue.Queue()
+        self._stats_lock = threading.Lock()
+        self._out_bytes = 0
+        self._in_bytes = 0
+        self._failures = 0
+        self._ring = None
+        self._ring_blocks = 0
+        self._thread = threading.Thread(
+            target=self._worker, name=SWAP_THREAD_NAME, daemon=True)
+        self._thread.start()
+
+    def warmup(self, max_bucket: int = 32):
+        """Pre-pay every bucketed swap compile BEFORE live traffic:
+        the stager's gathers (:meth:`_fetch`), the refill's staging-
+        ring build + host→device transfer (:meth:`_stage`), and the
+        adopt scatter — one compile per power-of-two bucket. A first-
+        spill gather compile on the staging thread competes with the
+        decode loop and stalls staging for a large fraction of a bursty
+        workload (every spill stays PENDING exactly when second-chance
+        lookups want it READY); a first-refill scatter compile runs ON
+        the scheduler thread and stalls every active decode. Called
+        from the scheduler's warmup; safe to call any time."""
+        with self.kv._lock:
+            pages = list(self.kv._pages)
+        if not pages:
+            return
+        k, v = pages[0]
+        buckets = []
+        b = 1
+        while b <= min(max_bucket, self.kv.num_blocks):
+            buckets.append(b)
+            b <<= 1
+        row = tuple(k.shape[1:])
+        npdt = np.dtype(self.kv.page_dtype)
+        # largest first: the staging ring sizes to the largest refill
+        # seen and rebuilds on growth — warming descending builds ONCE
+        for b in reversed(buckets):
+            idx = jnp.asarray([0] * b, jnp.int32)
+            # deliberate warmup fetches/transfers — no traffic yet
+            jax.device_get(k[idx])
+            jax.device_get(v[idx])
+            z = np.zeros((b,) + row, npdt)
+            dk, dv = self._stage(z, z)
+            # the scatter compile, against the real page arrays; the
+            # result is dropped (all rows target the garbage block)
+            jax.device_get(k.at[idx].set(dk)[0, 0, 0, 0])
+            jax.device_get(v.at[idx].set(dv)[0, 0, 0, 0])
+
+    # -- swap-out (spill) ------------------------------------------------
+
+    def spill(self, blocks, tag=None) -> Optional[HostKVHandle]:
+        """Boundary op, scheduler thread: reserve host slots and enqueue
+        the async fetch of ``blocks``. Returns the PENDING handle, or
+        None when the host pool can't cover it (caller degrades — drop
+        the pages exactly like the pre-tier behavior). The caller may
+        free/release the device blocks immediately after this returns;
+        the snapshot keeps the bytes alive for the stager."""
+        out = self.spill_many([blocks], tag=tag)
+        return out[0] if out else None
+
+    def spill_many(self, groups, tag=None):
+        """Batched :meth:`spill`: one handle PER GROUP of blocks, but
+        ONE snapshot and ONE stager job — the fetch gathers every
+        group's pages in a single device read instead of one dispatch
+        per group. An eviction sweep spills per-leaf (one-block groups,
+        so the second-chance index keeps per-key granularity); fetching
+        them one at a time would pay a device round-trip per block.
+        Returns one handle (or None on host-pool exhaustion — that
+        group degrades to a plain drop) per group, in order."""
+        plans = []      # (handle, start, n) into the flat id list
+        flat: List[int] = []
+        handles: List[Optional[HostKVHandle]] = []
+        for blocks in groups:
+            ids = [int(b) for b in blocks]
+            if not ids:
+                handles.append(None)
+                continue
+            try:
+                h = self.pool.alloc(len(ids), tag if tag is not None
+                                    else self.tag)
+            except HostPoolOOM:
+                handles.append(None)
+                continue
+            plans.append((h, len(flat), len(ids)))
+            flat += ids
+            handles.append(h)
+        if plans:
+            snap_ids, pages = self.kv.snapshot_blocks(flat)
+            self._q.put((plans, snap_ids, pages))
+        return handles
+
+    def _worker(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            plans, ids, pages = job
+            try:
+                self._fetch(plans, ids, pages)
+            except Exception:  # noqa: BLE001 — a swap must never kill
+                for h, _s, _n in plans:
+                    self._note_failure(h, "out", "unexpected stager error")
+
+    def _fetch(self, plans, ids, pages):
+        live = [(h, s, n) for h, s, n in plans
+                if h.state == SPILL_PENDING]
+        if not live:
+            return  # freed while queued — skip the fetch entirely
+        # pad the gather to the next power-of-two bucket: eviction
+        # sweeps vary in size every boundary, and a shape-specialized
+        # gather compile per DISTINCT sweep size would stall the stager
+        # for hundreds of ms apiece while decode traffic is live (the
+        # padding rows are never read back — every plan's (start, n)
+        # indexes the original prefix). warmup() pre-pays the buckets.
+        take = list(ids)
+        take += [take[0]] * (_gather_bucket(len(take)) - len(take))
+        idx = jnp.asarray(take, jnp.int32)
+        last = None
+        flat = None
+        for _attempt in (0, 1):
+            try:
+                _chaos.maybe_fire("kv/swap_out", tag=live[0][0].tag)
+                # deliberate host fetch — the swap-out data hop, on the
+                # staging thread so the decode loop never waits on it;
+                # one gather covers every handle in the job
+                flat = [(np.asarray(jax.device_get(k[idx])),
+                         np.asarray(jax.device_get(v[idx])))
+                        for k, v in pages]
+                break
+            except TransientDeviceError as e:
+                last = e  # replay: immutable handles → bitwise retry
+                continue
+            except Exception as e:  # noqa: BLE001 — degrade, never die
+                last = e
+                break
+        if flat is None:
+            for h, _s, _n in live:
+                self._note_failure(h, "out", repr(last))
+            return
+        stored = 0
+        for h, s, n in live:
+            # own copies per handle: a stored slice must not pin the
+            # whole job's gather in host RAM past its siblings' frees
+            layers = [(np.ascontiguousarray(k[s:s + n]),
+                       np.ascontiguousarray(v[s:s + n]))
+                      for k, v in flat]
+            if self.pool.store(h, layers, _pages_digest(layers)):
+                stored += h.nbytes
+        if stored:
+            with self._stats_lock:
+                self._out_bytes += stored
+            if obs.enabled():
+                obs.counter(f"{self.kv.metric_prefix}"
+                            "_swap_out_bytes").inc(stored)
+
+    def _note_failure(self, h: HostKVHandle, direction: str, error: str):
+        self.pool.fail(h)
+        with self._stats_lock:
+            self._failures += 1
+        if obs.enabled():
+            obs.counter(
+                f"{self.kv.metric_prefix}_swap_failures").inc()
+        _health.emit("kv_swap_failed", direction=direction,
+                     blocks=h.n_blocks, tag=str(h.tag), error=error)
+
+    # -- swap-in (refill) ------------------------------------------------
+
+    def refill(self, owner, handle: HostKVHandle) -> Optional[List[int]]:
+        """Boundary op, scheduler thread: verify and adopt a READY
+        handle's pages into fresh device blocks for ``owner``. On
+        success the host reservation returns to the pool and the new
+        physical ids come back (refcounted to ``owner``, private).
+        Returns None when the handle cannot serve — fetch still in
+        flight, failed, digest mismatch, or an injected permanent fault
+        — and the caller degrades (second-chance miss / recompute); in
+        every None case except PENDING the handle is settled here.
+        Raises :class:`KVCacheOOM` with the handle INTACT when the
+        device pool can't fit: the refill retries at a roomier
+        boundary."""
+        got = self.pool.payload(handle)
+        if got is None:
+            if handle.state == SPILL_PENDING:
+                return None  # stage in flight — try again next boundary
+            self.pool.free(handle)  # failed/freed: settle and degrade
+            return None
+        layers, digest = got
+        last = None
+        for _attempt in (0, 1):
+            try:
+                _chaos.maybe_fire("kv/swap_in", tag=handle.tag)
+                if _pages_digest(layers) != digest:
+                    raise RuntimeError(
+                        f"host page digest mismatch over "
+                        f"{handle.n_blocks} blocks")
+                ids = self.kv.adopt_serialized(owner, layers,
+                                               stage=self._stage)
+                with self._stats_lock:
+                    self._in_bytes += handle.nbytes
+                if obs.enabled():
+                    obs.counter(f"{self.kv.metric_prefix}"
+                                "_swap_in_bytes").inc(handle.nbytes)
+                self.pool.free(handle)
+                return ids
+            except KVCacheOOM:
+                raise  # handle intact — retry when blocks free up
+            except TransientDeviceError as e:
+                last = e  # replay: host bytes immutable → bitwise retry
+                continue
+            except Exception as e:  # noqa: BLE001 — degrade, never die
+                last = e
+                break
+        self._note_failure(handle, "in", repr(last))
+        self.pool.free(handle)  # fail() was a no-op on a READY handle
+        return None
+
+    def refill_many(self, owner, handles):
+        """Batched :meth:`refill`: verify and adopt the longest clean
+        LEADING run of READY handles in ONE adopt — one scatter dispatch
+        per layer instead of one per handle. A chain refill is the hot
+        case (the prefix cache spills per-leaf, so a second-chance hit
+        walks N one-block handles); adopting them one at a time pays N
+        functional page-array updates where the batch pays one.
+
+        Returns ``(ids, consumed, dropped)``: ``ids`` are the new
+        physical blocks covering ``handles[:consumed]`` in logical
+        order (split by each handle's ``n_blocks``), and the next
+        ``dropped`` handles after the run were SETTLED here (fetch
+        failed, digest mismatch, injected permanent fault) — the caller
+        forgets those; anything later is untouched (e.g. still staging)
+        and retries at the next boundary. Raises :class:`KVCacheOOM`
+        with every handle intact when even a clamped run cannot fit."""
+        run, run_layers = [], []
+        dropped = 0
+        last = None
+        for h in handles:
+            got = self.pool.payload(h)
+            if got is None:
+                if h.state != SPILL_PENDING:
+                    self.pool.free(h)  # failed/freed: settle and degrade
+                    dropped = 1
+                break
+            layers, digest = got
+            ok = False
+            for _attempt in (0, 1):
+                try:
+                    _chaos.maybe_fire("kv/swap_in", tag=h.tag)
+                    if _pages_digest(layers) != digest:
+                        raise RuntimeError(
+                            f"host page digest mismatch over "
+                            f"{h.n_blocks} blocks")
+                    ok = True
+                    break
+                except TransientDeviceError as e:
+                    last = e  # replay: host bytes immutable → bitwise
+                    continue
+                except Exception as e:  # noqa: BLE001 — degrade
+                    last = e
+                    break
+            if not ok:
+                self._note_failure(h, "in", repr(last))
+                self.pool.free(h)
+                dropped = 1
+                break
+            run.append(h)
+            run_layers.append(layers)
+        if not run:
+            return None, 0, dropped
+        # clamp to what the device pool can plausibly hold so the
+        # all-or-nothing adopt degrades to a PARTIAL chain refill under
+        # pressure (the per-handle path's behavior) instead of deferring
+        # the whole run; adopt re-checks under its own lock and still
+        # raises on a lost race
+        free = self.kv.blocks_free()
+        while run and sum(h.n_blocks for h in run) > free:
+            run.pop()
+            run_layers.pop()
+            dropped = 0  # the settled handle no longer borders the run;
+            #              its key is swept by a later lookup's state walk
+        if not run:
+            raise KVCacheOOM(
+                f"refill needs {handles[0].n_blocks} blocks, {free} free")
+        cat = [tuple(np.concatenate([ls[li][half] for ls in run_layers])
+                     for half in (0, 1))
+               for li in range(len(run_layers[0]))]
+        for _attempt in (0, 1):
+            try:
+                ids = self.kv.adopt_serialized(owner, cat,
+                                               stage=self._stage)
+                break
+            except KVCacheOOM:
+                raise  # every handle intact — retry at a roomier boundary
+            except TransientDeviceError as e:
+                last = e  # immutable bytes → bitwise replay
+                continue
+            except Exception as e:  # noqa: BLE001 — degrade, never die
+                last = e
+                ids = None
+                break
+        else:
+            ids = None
+        if ids is None:
+            for h in run:  # the whole run degrades, later handles keep
+                self._note_failure(h, "in", repr(last))
+                self.pool.free(h)
+            return None, 0, len(run) + dropped
+        nbytes = 0
+        for h in run:
+            nbytes += h.nbytes
+            self.pool.free(h)
+        with self._stats_lock:
+            self._in_bytes += nbytes
+        if obs.enabled():
+            obs.counter(f"{self.kv.metric_prefix}"
+                        "_swap_in_bytes").inc(nbytes)
+        return ids, len(run), dropped
+
+    def _stage(self, lk: np.ndarray, lv: np.ndarray):
+        """Host→device placement for adopt_serialized: route the pages
+        through a reusable ``HostStagingRing`` (the input pipeline's
+        pinned-buffer discipline) instead of a fresh allocation per
+        refill — under churn the refill path re-lands pages every few
+        boundaries, exactly the per-batch cost the ring exists to
+        amortize. The ring is sized to the largest refill seen and
+        rebuilt on growth."""
+        from ..native import HostStagingRing
+        n = int(lk.shape[0])
+        if self._ring is None or n > self._ring_blocks:
+            cap = max(n, self._ring_blocks)
+            shape = (cap,) + tuple(lk.shape[1:])
+            self._ring = HostStagingRing(shape, lk.dtype, shape, lv.dtype)
+            self._ring_blocks = cap
+        kb, vb = self._ring.acquire()
+        kb[:n] = lk
+        vb[:n] = lv
+        return self._ring.to_device(kb[:n], vb[:n])
+
+    # -- lifecycle -------------------------------------------------------
+
+    def discard(self, handle: HostKVHandle) -> int:
+        """Drop a handle without refilling (its owner gave up — request
+        cancelled, chain re-inserted fresh). Idempotent; returns the
+        host blocks returned."""
+        return self.pool.free(handle)
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            out = {
+                "swap_out_bytes": self._out_bytes,
+                "swap_in_bytes": self._in_bytes,
+                "swap_failures": self._failures,
+            }
+        out.update(self.pool.stats())
+        return out
+
+    def shutdown(self, timeout: float = 10.0):
+        """Stop the staging thread. Jobs still queued behind the
+        sentinel fail their handles (their owners are gone by the time
+        the scheduler reaches here — the drain gates check the pool hits
+        0 regardless of stage completion order)."""
+        self._q.put(None)
+        self._thread.join(timeout)
+        while True:
+            try:
+                job = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if job is not None:
+                self.pool.fail(job[0])
+
+
+def kv_swap_threads_alive() -> int:
+    """Live swap-stager threads (tests gate this at 0 after shutdown)."""
+    return sum(1 for t in threading.enumerate()
+               if t.name == SWAP_THREAD_NAME and t.is_alive())

@@ -39,6 +39,16 @@ Lifecycle:
   (refcount == 1, the cache's own pin). Interior entries with present
   children are skipped — evicting mid-chain would strand descendants
   unreachable while their pages stay pinned.
+* **spill / second chance** (ISSUE 18) — with a
+  :class:`~.kv_cache.KVSwapManager` attached, eviction is no longer a
+  KV funeral: each victim's page spills to the host tier (async — the
+  decision and the device-block release happen at the boundary, the
+  fetch on the swap thread) and its key moves to a SECOND-CHANCE index.
+  A later lookup that walks off the resident chain into spilled keys
+  refills them (host→device adopt, content-digest-verified — the PR-15
+  handoff argument, so the hit stays bitwise) and takes the ordinary
+  warm-hit path. A spill that cannot stage degrades to exactly the
+  pre-tier drop.
 * **defrag** — the cache registers a remap listener with the ledger, so
   a repack that moves a shared page updates the index in the same
   critical section as the owners' tables.
@@ -62,7 +72,9 @@ import numpy as np
 
 from .. import observability as obs
 from ..parallel import chaos as _chaos
-from .kv_cache import PagedKVCache
+from .kv_cache import (SPILL_FAILED, SPILL_FREED, SPILL_PENDING,
+                       SPILL_READY, KVCacheOOM, PagedKVCache,
+                       TransientDeviceError)
 
 
 def chain_keys(token_ids, block_size: int, version: str,
@@ -107,58 +119,192 @@ class PrefixCache:
         bounds the cache only by the block pool itself (eviction then
         happens on admission pressure via :meth:`evict`).
     metric_prefix : the ``serve/prefix`` namespace.
+    swap : optional :class:`~.kv_cache.KVSwapManager` — arms the
+        host-RAM second chance: evicted chains spill instead of
+        dropping, spilled keys refill on the next lookup. ``None``
+        keeps the exact pre-tier behavior.
     """
 
     def __init__(self, kv: PagedKVCache, *,
                  max_entries: Optional[int] = None,
-                 metric_prefix: str = "serve/prefix"):
+                 metric_prefix: str = "serve/prefix",
+                 swap=None):
         self.kv = kv
         self.block_size = kv.block_size
         self.max_entries = max_entries
         self.metric_prefix = metric_prefix
+        self.swap = swap
         self._entries: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        # second-chance index: key -> (HostKVHandle, depth), insertion
+        # order = spill recency (drop_spilled reclaims from the front)
+        self._spilled: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._lock = threading.Lock()
         self._evictions = 0
+        self._spills = 0
+        self._refills = 0
+        self._hits_after_spill = 0
         kv.add_remap_listener(self._on_remap)
 
     # -- lookup ----------------------------------------------------------
 
-    def _walk(self, token_ids, version: str, touch: bool) -> List[int]:
+    def _walk(self, token_ids, version: str, touch: bool):
         """Digest the chain INCREMENTALLY, stopping at the first absent
         entry — a probe that misses at the root costs one blake2b, not
         one per prompt block (the router fans N of these out per
         dispatch, and misses dominate on every replica but the
-        holder)."""
+        holder). Returns ``(blocks, parent_key, prev_digest, stop_i,
+        n)`` — the digest state at the stop point lets the second-chance
+        continuation keep hashing without rewalking."""
         toks = np.asarray(token_ids, np.int32).reshape(-1)
         n = toks.size // self.block_size
         prev = version.encode() + b"\x00" + str(self.block_size).encode()
+        parent: Optional[bytes] = None
         blocks: List[int] = []
+        i = 0
         with self._lock:
-            for i in range(n):
+            while i < n:
                 h = hashlib.blake2b(prev, digest_size=16)
                 h.update(toks[i * self.block_size:
                               (i + 1) * self.block_size].tobytes())
-                prev = h.digest()
-                e = self._entries.get(prev)
+                key = h.digest()
+                e = self._entries.get(key)
                 if e is None:
                     break
                 if touch:
-                    self._entries.move_to_end(prev)
+                    self._entries.move_to_end(key)
                 blocks.append(e.block)
-        return blocks
+                parent = key
+                prev = key
+                i += 1
+        return blocks, parent, prev, i, toks
+
+    def _next_key(self, prev: bytes, toks: np.ndarray, i: int) -> bytes:
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(toks[i * self.block_size:
+                      (i + 1) * self.block_size].tobytes())
+        return h.digest()
 
     def lookup(self, token_ids, version: str) -> List[int]:
         """Longest cached chain for this prompt: the physical block ids
         of every consecutive present entry from the root (possibly
         empty). Touches the matched entries (LRU recency) — this is the
-        admission path."""
-        return self._walk(token_ids, version, touch=True)
+        admission path.
+
+        With a swap manager attached the walk continues past the
+        resident chain into the SECOND-CHANCE index: each consecutive
+        spilled key whose stage is READY refills (digest-verified adopt
+        into a fresh block, re-pinned by the cache) and extends the hit
+        — the caller sees an ordinary warm hit. A key still staging
+        defers to the next lookup (cold path this time, never a block);
+        a failed stage degrades to a miss and drops the key."""
+        blocks, parent, prev, i, toks = self._walk(
+            token_ids, version, touch=True)
+        if self.swap is None:
+            return blocks
+        n = toks.size // self.block_size
+        run = []                       # consecutive READY (key, handle, depth)
+        while i < n:
+            key = self._next_key(prev, toks, i)
+            with self._lock:
+                got = self._spilled.get(key)
+            if got is None:
+                break
+            handle, depth = got
+            state = handle.state   # benign race: PENDING seen late just
+            if state == SPILL_PENDING:   # defers to the next lookup
+                break
+            if state in (SPILL_FAILED, SPILL_FREED):
+                with self._lock:
+                    self._spilled.pop(key, None)
+                self.swap.discard(handle)
+                break
+            run.append((key, handle, depth))
+            prev = key
+            i += 1
+        if run:
+            blocks += self._refill_run(run, parent, blocks)
+        return blocks
+
+    def _refill_run(self, run, parent: Optional[bytes],
+                    protect: Sequence[int]) -> List[int]:
+        """Land a consecutive run of spilled pages back in the device
+        pool with ONE batched adopt (``KVSwapManager.refill_many`` —
+        one scatter per layer for the whole chain, not one per block)
+        and re-insert their entries (cache-pinned, shared/read-only —
+        exactly the state eviction took them from). Returns the
+        refilled physical blocks, possibly a leading partial run when
+        the device pool is tight (deferred tail handles stay spilled)
+        or empty when the refill must fully defer or degrade.
+
+        Under block pressure the refill makes its own room: the
+        COLDEST unreferenced resident entries are evicted (spilling to
+        host — a straight swap of cold pages for the warm chain being
+        revisited). ``protect`` — the resident head this run extends —
+        is pinned for the duration so the trade can never cannibalize
+        the chain it serves."""
+        need = sum(h.n_blocks for _, h, _ in run)
+        short = need - self.kv.blocks_free()
+        if short > 0:
+            self.kv.retain(protect)
+            try:
+                self.evict(short)
+            except (KVCacheOOM, TransientDeviceError):
+                pass       # injected evict fault: refill defers below
+            finally:
+                self.kv.release(protect)
+        tmp = ("prefix-refill", run[0][0])
+        try:
+            ids, consumed, dropped = self.swap.refill_many(
+                tmp, [h for _, h, _ in run])
+        except KVCacheOOM:
+            return []          # handles intact — retry at a roomier boundary
+        for key, _h, _d in run[consumed:consumed + dropped]:
+            with self._lock:   # settled by the manager: forget the keys
+                self._spilled.pop(key, None)
+        if not consumed:
+            return []
+        # convert the refill owner's table refs into the cache's
+        # ownerless pins (retain-then-free — the insert flow's discipline)
+        self.kv.retain(ids)
+        self.kv.free(tmp)
+        with self._lock:
+            for (key, _h, depth), block in zip(run[:consumed], ids):
+                self._spilled.pop(key, None)
+                e = _Entry(key, parent, int(block), depth)
+                self._entries[key] = e
+                if parent is not None:
+                    p = self._entries.get(parent)
+                    if p is not None:
+                        p.children += 1
+                self._refills += 1
+                parent = key
+            self._hits_after_spill += 1
+        if obs.enabled():
+            obs.counter(f"{self.metric_prefix}_hits_after_spill").inc()
+            obs.counter(f"{self.metric_prefix}_refills").inc(consumed)
+        self._set_gauges()
+        return [int(b) for b in ids]
 
     def peek(self, token_ids, version: str) -> int:
         """Router-affinity probe: cached prefix length in TOKENS for
-        this prompt, without touching recency or metrics."""
-        return len(self._walk(token_ids, version, touch=False)) \
-            * self.block_size
+        this prompt, without touching recency or metrics. Counts the
+        resident chain PLUS consecutive spilled keys already staged
+        READY — a refillable chain is as routable as a resident one."""
+        blocks, _parent, prev, i, toks = self._walk(
+            token_ids, version, touch=False)
+        hit = len(blocks)
+        if self.swap is not None:
+            n = toks.size // self.block_size
+            while i < n:
+                key = self._next_key(prev, toks, i)
+                with self._lock:
+                    got = self._spilled.get(key)
+                if got is None or got[0].state != SPILL_READY:
+                    break
+                hit += 1
+                prev = key
+                i += 1
+        return hit * self.block_size
 
     # -- insert ----------------------------------------------------------
 
@@ -178,6 +324,7 @@ class PrefixCache:
         keys = chain_keys(token_ids, self.block_size, version,
                           max_blocks=len(owner_blocks))
         new = 0
+        stale = []
         with self._lock:
             parent: Optional[bytes] = None
             for i, k in enumerate(keys):
@@ -198,8 +345,16 @@ class PrefixCache:
                     self._entries[parent].children += 1
                 parent = k
                 new += 1
+                # a fresh resident copy supersedes any spilled one
+                # (same key = same content, so nothing is lost — the
+                # host reservation just comes back)
+                old = self._spilled.pop(k, None)
+                if old is not None:
+                    stale.append(old[0])
             over = (len(self._entries) - self.max_entries
                     if self.max_entries is not None else 0)
+        for h in stale:
+            self.swap.discard(h)
         if over > 0:
             self.evict(over)
         if new:
@@ -238,6 +393,42 @@ class PrefixCache:
                             p.children -= 1
             if not victims:
                 break
+            # second chance (ISSUE 18): spill each victim's page to the
+            # host tier BEFORE releasing the device block — the spill
+            # snapshots (ids, page handles) and the release is then
+            # safe, the functional handles keep the bytes alive for the
+            # stager. Host-pool pressure drops the COLDEST spilled keys
+            # first; if the pool still can't cover it the victim is
+            # dropped exactly like the pre-tier behavior.
+            if self.swap is not None:
+                spilled = 0
+                # one spill_many per sweep: per-victim handles (the
+                # second-chance index stays per-key) but ONE snapshot
+                # and ONE stager fetch for the whole pass — spilling a
+                # chain must not pay a device round-trip per block
+                hs = self.swap.spill_many([[e.block] for e in victims],
+                                          tag="prefix")
+                short = [i for i, h in enumerate(hs) if h is None]
+                if short and self.drop_spilled(len(short)):
+                    again = self.swap.spill_many(
+                        [[victims[i].block] for i in short], tag="prefix")
+                    for i, h in zip(short, again):
+                        hs[i] = h
+                for e, h in zip(victims, hs):
+                    if h is None:
+                        continue
+                    old = None
+                    with self._lock:
+                        old = self._spilled.pop(e.key, None)
+                        self._spilled[e.key] = (h, e.depth)
+                    if old is not None:
+                        self.swap.discard(old[0])
+                    spilled += 1
+                if spilled:
+                    self._spills += spilled
+                    if obs.enabled():
+                        obs.counter(f"{self.metric_prefix}"
+                                    "_spills").inc(spilled)
             self.kv.release([e.block for e in victims])
             freed += len(victims)
             self._evictions += len(victims)
@@ -248,15 +439,40 @@ class PrefixCache:
             self._set_gauges()
         return freed
 
+    def drop_spilled(self, n_blocks: int) -> int:
+        """Reclaim host-pool reservations from the COLDEST spilled keys
+        (front of the second-chance index = oldest spill). Returns the
+        host blocks actually returned. Called under host-pool pressure
+        — by eviction's own spill path and by the scheduler's
+        preemption — so the freshest spills survive longest."""
+        dropped = []
+        got = 0
+        with self._lock:
+            while got < n_blocks and self._spilled:
+                _key, (h, _depth) = self._spilled.popitem(last=False)
+                dropped.append(h)
+                got += h.n_blocks
+        freed = 0
+        for h in dropped:
+            freed += self.swap.discard(h)
+        if dropped:
+            self._set_gauges()
+        return freed
+
     def clear(self) -> int:
         """Release every entry's page (shutdown: the leak gate demands
-        ``kv_blocks_in_use`` drain to zero once the last owner freed).
+        ``kv_blocks_in_use`` drain to zero once the last owner freed)
+        and settle every spilled handle (the HOST pool drains too).
         Returns the entry count dropped."""
         with self._lock:
             entries = list(self._entries.values())
             self._entries.clear()
+            spilled = [h for h, _d in self._spilled.values()]
+            self._spilled.clear()
         for e in entries:
             self.kv.release([e.block])
+        for h in spilled:
+            self.swap.discard(h)
         self._set_gauges()
         return len(entries)
 
@@ -285,11 +501,16 @@ class PrefixCache:
             n = len(self._entries)
             depth = max((e.depth + 1 for e in self._entries.values()),
                         default=0)
+            spilled = len(self._spilled)
         return {
             "entries": n,
             "max_chain_blocks": depth,
             "evictions": self._evictions,
             "shared_blocks": self.kv.shared_blocks(),
+            "spilled_entries": spilled,
+            "spills": self._spills,
+            "refills": self._refills,
+            "hits_after_spill": self._hits_after_spill,
         }
 
     def __len__(self):
@@ -302,3 +523,6 @@ class PrefixCache:
         pre = self.metric_prefix
         obs.gauge(f"{pre}_entries").set(len(self))
         obs.gauge(f"{pre}_shared_blocks").set(self.kv.shared_blocks())
+        with self._lock:
+            spilled = len(self._spilled)
+        obs.gauge(f"{pre}_spilled_entries").set(spilled)

@@ -75,7 +75,8 @@ def _clean():
     obs.disable()
 
 
-@pytest.fixture(params=["dense", "kernel"])
+@pytest.fixture(params=["dense",
+                        pytest.param("kernel", marks=pytest.mark.slow)])
 def paged_path(request, monkeypatch):
     """The kernel-agnostic matrix (ISSUE 13 satellite): every
     fault-recovery gate must hold whether decode runs the dense gather
@@ -259,6 +260,7 @@ def test_transient_step_replay_bitwise(paged_path):
     assert decode_scheduler_threads_alive() == 0
 
 
+@pytest.mark.slow
 def test_spec_round_replay_bitwise():
     """The speculative fast path replays as ONE unit: a transient
     mid-round rolls both pools back and the round reruns bitwise."""

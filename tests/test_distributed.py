@@ -668,3 +668,38 @@ def test_sparse_embedding_rejects_zero1_and_unembedded_models():
                           mesh=data_parallel_mesh(8), sparse_embedding=True)
     with pytest.raises(ValueError, match="regulariz"):
         opt._sparse_embedding_path()
+
+
+def test_sparse_embedding_auto_selection_and_escape_hatch():
+    """ISSUE 18 satellite: the default ``sparse_embedding="auto"``
+    selects the per-layer wire by itself exactly when the explicit
+    opt-in would be accepted — and silently rides the dense path (no
+    typed refusal) when the model has no leading LookupTable, the
+    embedding is regularized, or the run is ZeRO-1. ``False`` is the
+    explicit-off escape hatch."""
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+
+    def mk(model, **kw):
+        return DistriOptimizer(model, _mnist_ds(), nn.ClassNLLCriterion(),
+                               SGD(), max_iteration(1), batch_size=64,
+                               mesh=data_parallel_mesh(8), **kw)
+
+    emb_model = nn.Sequential()
+    emb_model.add(nn.LookupTable(64, 8))
+    emb_model.add(nn.Squeeze(2))
+    opt = mk(emb_model)
+    assert opt.sparse_embedding == "auto"
+    assert opt._sparse_embedding_enabled(), \
+        "auto must select the wire for a clean leading-LookupTable model"
+    assert not mk(emb_model,
+                  sparse_embedding=False)._sparse_embedding_enabled()
+    # not applicable -> auto degrades silently where True refuses typed
+    assert not mk(LeNet5(10))._sparse_embedding_enabled()
+    reg_model = nn.Sequential()
+    reg_model.add(nn.LookupTable(64, 8, w_regularizer=L2Regularizer(1e-4)))
+    reg_model.add(nn.Squeeze(2))
+    assert not mk(reg_model)._sparse_embedding_enabled()
+    # zero1 under auto: the ctor accepts and the dense flat wire runs
+    # (only the EXPLICIT True is the per-layer-seam contract violation)
+    opt = mk(emb_model, parameter_mode="zero1")
+    assert not opt._sparse_embedding_enabled()

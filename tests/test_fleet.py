@@ -36,7 +36,9 @@ from bigdl_tpu.serving import (DecodeScheduler, DisaggregatedFleet,
                                RemoteReplica, ReplicaAgent, Router,
                                TransportClient, TransportServer,
                                transport_threads_alive, wait_for_members)
-from bigdl_tpu.serving.fleet import fleet_threads_alive, read_member
+from bigdl_tpu.serving.fleet import (fleet_threads_alive, read_member,
+                                     warm_replica)
+from bigdl_tpu.serving.kv_cache import SPILL_PENDING
 from bigdl_tpu.serving.transport import (RemoteError, decode_tree,
                                          encode_tree)
 
@@ -281,6 +283,69 @@ def test_corrupt_and_version_skewed_handoff_refused_typed():
     finally:
         pf.shutdown()
         dc.shutdown()
+    assert fleet_threads_alive() == 0
+
+
+def test_warm_replica_refills_spilled_chains_from_source():
+    """``fleet.warm_replica``: a joining replica adopts a peer's prefix
+    chains — INCLUDING chains the peer evicted to its host tier (ISSUE
+    18). The export's lookup takes the second-chance refill instead of
+    re-running the prefill, and the warmed replica's first submit of a
+    warmed prompt is an ordinary warm hit, bitwise the solo decode."""
+    m = _model()
+    fd = tempfile.mkdtemp(prefix="fleet_warm_")
+    src_sched = DecodeScheduler(m, name="ws", host_blocks=32, **SCHED)
+    tgt_sched = DecodeScheduler(m, name="wt", **SCHED)
+    src = ReplicaAgent(src_sched, fleet_dir=fd, name="ws").start()
+    tgt = ReplicaAgent(tgt_sched, fleet_dir=fd, name="wt").start()
+    solo = DecodeScheduler(m, name="wsolo", **SCHED).start()
+    try:
+        ds, dt = wait_for_members(fd, ["ws", "wt"], timeout_s=20)
+        rsrc = RemoteReplica(ds, fleet_dir=fd).start()
+        rtgt = RemoteReplica(dt, fleet_dir=fd).start()
+        rng = np.random.RandomState(21)
+        prompts = [rng.randint(1, V, size=16).astype(np.int32)
+                   for _ in range(4)]
+        for p in prompts:
+            rsrc.submit(p, max_new_tokens=8).result(timeout=120)
+        # push every chain's leaf into the host tier, then wait for the
+        # stager to land the spills (in-process agent: the scheduler is
+        # THIS object) — the warm exports must find settled handles
+        src_sched.prefix.evict(4)
+        st = rsrc.stats()
+        assert st["prefix"]["spills"] == 4 and \
+            st["prefix"]["spilled_entries"] == 4
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with src_sched.prefix._lock:
+                pend = [h for h, _ in src_sched.prefix._spilled.values()
+                        if h.state == SPILL_PENDING]
+            if not pend:
+                break
+            time.sleep(0.01)
+        assert not pend, "spill stage never settled"
+
+        out = warm_replica(rsrc, rtgt, prompts, timeout_s=120)
+        assert out["warmed"] == 4 and out["failed"] == 0, out
+        st = rsrc.stats()
+        assert st["prefix"]["hits_after_spill"] >= 1, \
+            f"warm exports must refill, not recompute: {st['prefix']}"
+        assert rtgt.stats()["prefix"]["entries"] > 0
+
+        # the warmed replica serves the FIRST ask of a warmed prompt
+        # as a warm hit, bitwise the solo decode
+        want = solo.generate(prompts[0], 8)
+        got = rtgt.submit(prompts[0], max_new_tokens=8).result(timeout=120)
+        assert np.array_equal(want, got), \
+            "warmed-replica tokens must be bitwise the solo decode"
+        assert rtgt.stats()["prefix_hits"] >= 1, \
+            "the warmed chain never produced a hit"
+    finally:
+        src.shutdown()
+        tgt.shutdown()
+        solo.shutdown()
+    assert src_sched.stats()["host"]["host_blocks_in_use"] == 0, \
+        "the source's host pool must drain at shutdown"
     assert fleet_threads_alive() == 0
 
 

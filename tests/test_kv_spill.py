@@ -1,0 +1,502 @@
+"""Host-RAM KV paging tier (ISSUE 18): async spill/refill under the
+paged pool, prefix second-chance, and swap-based preemption.
+
+Gate families:
+
+* **Bitwise** — a prefix chain that was evicted-to-host and refilled
+  serves tokens BITWISE identical to the cold solo decode, over the
+  dense AND the Pallas-kernel paged-attention paths; a request
+  preempted to host mid-decode resumes and finishes bitwise too (the
+  refilled pages are digest-verified copies of the snapshotted
+  handles).
+* **Chaos drills** — the ``kv/swap_out`` / ``kv/swap_in`` seams:
+  transient faults replay once and stay bitwise; permanent faults
+  DEGRADE (the spill becomes a future cold miss, a ``kv_swap_failed``
+  health event lands, serving stays up) and never corrupt KV.
+* **Pool hygiene** — host-pool exhaustion degrades a spill to the
+  pre-tier drop; refill under device-block pressure trades the coldest
+  resident entries for the warm chain without cannibalizing the chain
+  it serves; the host pool drains to ZERO at every shutdown path.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.observability import health as _health
+from bigdl_tpu.parallel import chaos
+from bigdl_tpu.parallel.chaos import ChaosPlan, Rule
+from bigdl_tpu.models.transformer_lm import TransformerLM
+from bigdl_tpu.serving import (DecodeScheduler,
+                               decode_scheduler_threads_alive)
+from bigdl_tpu.serving.kv_cache import (SPILL_FAILED, SPILL_FREED,
+                                        SPILL_PENDING, SPILL_READY)
+from serving_helpers import no_leaked_blocks, solo_oracle as _oracle
+
+V, H = 48, 32
+MAXLEN = 256
+CHUNK = 8
+BS = 4          # block_size; hit_align = max(CHUNK, BS) = 8
+
+
+def _model(**kw):
+    cfg = dict(vocab_size=V, hidden_size=H, num_heads=4, filter_size=64,
+               num_layers=2, max_len=MAXLEN)
+    cfg.update(kw)
+    m = TransformerLM(**cfg)
+    m.ensure_initialized()
+    return m
+
+
+_shared = {}
+
+
+def shared_model():
+    if "m" not in _shared:
+        _shared["m"] = _model(pos_encoding="rope", num_kv_heads=2)
+    return _shared["m"]
+
+
+def solo_oracle(model, prompt, max_new):
+    return _oracle(model, model.params, prompt, max_new, chunk=CHUNK,
+                   maxlen=MAXLEN)
+
+
+def _sched(model, **kw):
+    cfg = dict(max_slots=4, block_size=BS, max_seq_len=96,
+               prefill_chunk=CHUNK, host_blocks=32)
+    cfg.update(kw)
+    return DecodeScheduler(model, **cfg)
+
+
+@pytest.fixture(params=["dense", "kernel"])
+def paged_path(request, monkeypatch):
+    if request.param == "kernel":
+        monkeypatch.setenv("BIGDL_TPU_PAGED_ATTN", "interpret")
+    else:
+        monkeypatch.delenv("BIGDL_TPU_PAGED_ATTN", raising=False)
+    return request.param
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.disarm()
+
+
+def _settle(sched, deadline_s=30.0):
+    """Spills are async: poll until no spilled handle is PENDING (a
+    PENDING handle is a deliberate lookup miss, never a wait)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        with sched.prefix._lock:
+            pend = [h for h, _ in sched.prefix._spilled.values()
+                    if h.state == SPILL_PENDING]
+        if not pend:
+            return
+        time.sleep(0.005)
+    raise AssertionError("spill stage never settled")
+
+
+def _drained_host(st):
+    assert st["host"]["host_blocks_in_use"] == 0, \
+        f"host pool leaked: {st['host']}"
+
+
+def _prefix_plus(rng, prefix, n):
+    return np.concatenate([prefix, rng.randint(1, V, size=n).astype(
+        np.int32)])
+
+
+# -- second-chance bitwise --------------------------------------------------
+
+def test_hit_after_spill_bitwise(paged_path):
+    """The tier's core gate: evict a registered chain (pages spill to
+    host), revisit — the lookup refills the spilled chain through the
+    ordinary warm-hit path and the tokens stay BITWISE the cold solo
+    decode's, dense and kernel paths both."""
+    m = shared_model()
+    rng = np.random.RandomState(31)
+    prefix = rng.randint(1, V, size=16).astype(np.int32)   # 4-block chain
+    p1 = _prefix_plus(rng, prefix, 5)
+    p2 = _prefix_plus(rng, prefix, 3)
+    with _sched(m) as sched:
+        r1 = sched.submit(p1, 6).result(timeout=120)
+        n_entries = sched.stats()["prefix"]["entries"]
+        sched.prefix.evict(n_entries)          # whole chain → host tier
+        st = sched.stats()
+        assert st["prefix"]["spilled_entries"] == n_entries
+        assert st["prefix"]["entries"] == 0
+        _settle(sched)
+        r2 = sched.submit(p2, 6).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(r1, solo_oracle(m, p1, 6))
+    assert np.array_equal(r2, solo_oracle(m, p2, 6))
+    # the revisit was a REFILL, not a re-prefill: second-chance hit,
+    # real bytes both directions, no failures
+    assert st["prefix"]["hits_after_spill"] == 1
+    assert st["prefix"]["refills"] >= 4        # the shared 16-token chain
+    assert st["prefix_hits"] == 1
+    assert st["host"]["swap_out_bytes"] > 0
+    assert st["host"]["swap_in_bytes"] > 0
+    assert st["host"]["swap_failures"] == 0
+    no_leaked_blocks(st)
+    _drained_host(sched.stats())
+    assert decode_scheduler_threads_alive() == 0
+
+
+def test_pending_spill_defers_to_cold_path():
+    """A lookup that races its own chain's stage treats PENDING as a
+    MISS (never a wait): the revisit re-prefills, re-registers, and the
+    superseded handle is discarded — the host pool gets its blocks
+    back."""
+    m = shared_model()
+    rng = np.random.RandomState(32)
+    prefix = rng.randint(1, V, size=16).astype(np.int32)
+    with _sched(m) as sched:
+        sched.submit(_prefix_plus(rng, prefix, 5), 4).result(timeout=120)
+        # wedge the stager INSIDE the job — the worker is already parked
+        # in q.get(), so the gate has to sit on the fetch it runs next
+        gate = threading.Event()
+        orig_fetch = sched.kv_swap._fetch
+
+        def gated_fetch(plans, ids, pages):
+            gate.wait(30.0)
+            return orig_fetch(plans, ids, pages)
+        sched.kv_swap._fetch = gated_fetch
+        try:
+            n = sched.stats()["prefix"]["entries"]
+            sched.prefix.evict(n)
+            st = sched.stats()
+            assert st["prefix"]["spilled_entries"] == n
+            r2 = sched.submit(_prefix_plus(rng, prefix, 3), 4).result(
+                timeout=120)
+            st = sched.stats()
+            assert st["prefix"]["hits_after_spill"] == 0   # PENDING = miss
+            assert st["prefix_misses"] == 2
+        finally:
+            gate.set()
+            sched.kv_swap._fetch = orig_fetch
+    assert r2.size == 4  # tokens gated bitwise in test_hit_after_spill
+    _drained_host(sched.stats())
+    assert decode_scheduler_threads_alive() == 0
+
+
+# -- swap-based preemption --------------------------------------------------
+
+def test_preempt_then_resume_bitwise(paged_path):
+    """Admission block pressure swaps the lower-priority decoding
+    request out to host; it resumes from the exact interrupted position
+    and BOTH streams finish bitwise the solo decode's — the refilled
+    pages are digest-verified copies of the snapshot."""
+    m = shared_model()
+    rng = np.random.RandomState(33)
+    pa = rng.randint(1, V, size=24).astype(np.int32)
+    pb = rng.randint(1, V, size=24).astype(np.int32)
+    # pool fits ONE request's worst case (9 blocks) + slack, not two
+    with _sched(m, num_blocks=14, prefix_cache=False) as sched:
+        fa = sched.submit(pa, 24, priority=0)
+        t0 = time.monotonic()
+        while sched.stats()["active"] == 0:    # A decoding, pages owned
+            assert time.monotonic() - t0 < 60
+            time.sleep(0.002)
+        fb = sched.submit(pb, 8, priority=1)
+        rb = fb.result(timeout=120)
+        ra = fa.result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(ra, solo_oracle(m, pa, 24))
+    assert np.array_equal(rb, solo_oracle(m, pb, 8))
+    assert st["preemptions"] >= 1
+    assert st["resumes"] + st["resume_recomputes"] >= 1
+    assert st["host"]["swap_failures"] == 0
+    assert st["kv"]["blocks_in_use"] == 0
+    _drained_host(st)
+    assert decode_scheduler_threads_alive() == 0
+
+
+def test_preempt_swap_out_fault_degrades_to_recompute():
+    """A PERMANENT swap-out fault on the preempted victim's stage: the
+    resume path degrades to re-prefilling the host-resident tokens
+    (``resume_recomputes``), the stream still finishes BITWISE, and a
+    ``kv_swap_failed`` health event lands — a swap failure never
+    corrupts KV and never takes serving down."""
+    m = shared_model()
+    rng = np.random.RandomState(34)
+    pa = rng.randint(1, V, size=24).astype(np.int32)
+    pb = rng.randint(1, V, size=24).astype(np.int32)
+    events = []
+    chaos.arm(ChaosPlan({"kv/swap_out": [Rule(kind="permanent", nth=1,
+                                              tag="preempt")]}))
+    try:
+        with _health.listen(events.append), \
+                _sched(m, num_blocks=14, prefix_cache=False) as sched:
+            fa = sched.submit(pa, 24, priority=0)
+            t0 = time.monotonic()
+            while sched.stats()["active"] == 0:
+                assert time.monotonic() - t0 < 60
+                time.sleep(0.002)
+            fb = sched.submit(pb, 8, priority=1)
+            rb = fb.result(timeout=120)
+            ra = fa.result(timeout=120)
+            st = sched.stats()
+    finally:
+        chaos.disarm()
+    assert np.array_equal(ra, solo_oracle(m, pa, 24))
+    assert np.array_equal(rb, solo_oracle(m, pb, 8))
+    assert st["preemptions"] >= 1
+    assert st["resume_recomputes"] >= 1
+    assert st["host"]["swap_failures"] >= 1
+    assert any(e["kind"] == "health/kv_swap_failed"
+               and e.get("direction") == "out" for e in events)
+    _drained_host(st)
+    assert decode_scheduler_threads_alive() == 0
+
+
+# -- chaos drills on the prefix second-chance path --------------------------
+
+def test_swap_out_transient_replays_bitwise():
+    """A transient fault inside the stager's fetch replays once off the
+    immutable snapshot — the stage lands, the revisit refills, tokens
+    bitwise, zero failures counted."""
+    m = shared_model()
+    rng = np.random.RandomState(35)
+    prefix = rng.randint(1, V, size=16).astype(np.int32)
+    p2 = _prefix_plus(rng, prefix, 3)
+    chaos.arm(ChaosPlan({"kv/swap_out": [Rule(kind="transient", nth=1)]}))
+    with _sched(m) as sched:
+        sched.submit(_prefix_plus(rng, prefix, 5), 4).result(timeout=120)
+        sched.prefix.evict(sched.stats()["prefix"]["entries"])
+        _settle(sched)
+        r2 = sched.submit(p2, 6).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(r2, solo_oracle(m, p2, 6))
+    assert st["prefix"]["hits_after_spill"] == 1
+    assert st["host"]["swap_failures"] == 0
+    assert chaos.stats()["fires"] >= 1
+    _drained_host(sched.stats())
+    assert decode_scheduler_threads_alive() == 0
+
+
+def test_swap_out_permanent_degrades_to_cold_miss():
+    """A permanent stage failure drops the spill: the handle settles
+    FAILED, its host blocks come back, the revisit is an ordinary cold
+    miss (correct tokens, one more prefill) and serving stays up."""
+    m = shared_model()
+    rng = np.random.RandomState(36)
+    prefix = rng.randint(1, V, size=16).astype(np.int32)
+    p2 = _prefix_plus(rng, prefix, 3)
+    # every=1: eviction stages leaf-first, one job per pass — fail ALL
+    # of them so the whole chain degrades, not just the leaf
+    chaos.arm(ChaosPlan({"kv/swap_out": [Rule(kind="permanent",
+                                              every=1)]}))
+    with _sched(m) as sched:
+        sched.submit(_prefix_plus(rng, prefix, 5), 4).result(timeout=120)
+        n = sched.stats()["prefix"]["entries"]
+        sched.prefix.evict(n)
+        t0 = time.monotonic()
+        while True:       # FAILED is a settled state — wait for it
+            with sched.prefix._lock:
+                states = [h.state for h, _ in
+                          sched.prefix._spilled.values()]
+            if all(s != SPILL_PENDING for s in states):
+                break
+            assert time.monotonic() - t0 < 30
+            time.sleep(0.005)
+        assert SPILL_FAILED in states
+        r2 = sched.submit(p2, 6).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(r2, solo_oracle(m, p2, 6))
+    assert st["prefix"]["hits_after_spill"] == 0
+    assert st["prefix_misses"] == 2            # the revisit went cold
+    assert st["host"]["swap_failures"] >= 1
+    _drained_host(sched.stats())
+    assert decode_scheduler_threads_alive() == 0
+
+
+def test_swap_in_transient_replays_bitwise():
+    """A transient fault on the refill path replays once against the
+    immutable host bytes — the second-chance hit still lands,
+    bitwise."""
+    m = shared_model()
+    rng = np.random.RandomState(37)
+    prefix = rng.randint(1, V, size=16).astype(np.int32)
+    p2 = _prefix_plus(rng, prefix, 3)
+    chaos.arm(ChaosPlan({"kv/swap_in": [Rule(kind="transient", nth=1)]}))
+    with _sched(m) as sched:
+        sched.submit(_prefix_plus(rng, prefix, 5), 4).result(timeout=120)
+        sched.prefix.evict(sched.stats()["prefix"]["entries"])
+        _settle(sched)
+        r2 = sched.submit(p2, 6).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(r2, solo_oracle(m, p2, 6))
+    assert st["prefix"]["hits_after_spill"] == 1
+    assert st["host"]["swap_failures"] == 0
+    _drained_host(sched.stats())
+    assert decode_scheduler_threads_alive() == 0
+
+
+def test_swap_in_permanent_degrades_to_cold_miss():
+    """A hard refill failure frees the handle and the lookup degrades
+    to a cold miss — correct tokens, a counted failure, serving up."""
+    m = shared_model()
+    rng = np.random.RandomState(38)
+    prefix = rng.randint(1, V, size=16).astype(np.int32)
+    p2 = _prefix_plus(rng, prefix, 3)
+    chaos.arm(ChaosPlan({"kv/swap_in": [Rule(kind="permanent", nth=1)]}))
+    with _sched(m) as sched:
+        sched.submit(_prefix_plus(rng, prefix, 5), 4).result(timeout=120)
+        sched.prefix.evict(sched.stats()["prefix"]["entries"])
+        _settle(sched)
+        r2 = sched.submit(p2, 6).result(timeout=120)
+        st = sched.stats()
+    assert np.array_equal(r2, solo_oracle(m, p2, 6))
+    assert st["prefix"]["hits_after_spill"] == 0
+    assert st["host"]["swap_failures"] >= 1
+    _drained_host(sched.stats())
+    assert decode_scheduler_threads_alive() == 0
+
+
+# -- pool hygiene -----------------------------------------------------------
+
+def test_host_pool_exhaustion_degrades_spill_to_drop():
+    """With the host pool too small for the chain, the overflow
+    victims degrade to the pre-tier drop (spill returns None) — the
+    eviction still frees the device blocks, nothing crashes, and what
+    DID spill stays refillable."""
+    m = shared_model()
+    rng = np.random.RandomState(39)
+    prefix = rng.randint(1, V, size=16).astype(np.int32)   # 4 blocks
+    with _sched(m, host_blocks=2) as sched:
+        sched.submit(_prefix_plus(rng, prefix, 5), 4).result(timeout=120)
+        n = sched.stats()["prefix"]["entries"]
+        freed = sched.prefix.evict(n)
+        st = sched.stats()
+        assert freed == n                      # device blocks all freed
+        assert 0 < st["prefix"]["spilled_entries"] <= 2
+        _settle(sched)
+        r2 = sched.submit(_prefix_plus(rng, prefix, 3), 6).result(
+            timeout=120)
+        st = sched.stats()
+    assert r2.size == 6
+    no_leaked_blocks(st)
+    _drained_host(sched.stats())
+    assert decode_scheduler_threads_alive() == 0
+
+
+def test_refill_pressure_trades_cold_residents_for_warm_chain():
+    """The second-chance swap under device pressure: refilling a READY
+    spilled tail evicts the COLDEST unreferenced resident entries (they
+    spill to host in turn — a straight trade) while the resident head
+    of the chain being extended is pinned and survives untouched."""
+    from bigdl_tpu.serving.kv_cache import KVSwapManager, PagedKVCache
+    from bigdl_tpu.serving.prefix_cache import PrefixCache
+    m = shared_model()
+    rng = np.random.RandomState(40)
+    tok_c = rng.randint(1, V, size=32).astype(np.int32)    # 8-block chain
+    tok_a = rng.randint(1, V, size=12).astype(np.int32)    # cold bystander
+    kv = PagedKVCache(m, num_blocks=9, block_size=BS, max_blocks_per_seq=16)
+    swap = KVSwapManager(kv, host_blocks=32)
+    pc = PrefixCache(kv, swap=swap)
+    try:
+        kv.ensure_capacity("c", 32)            # all 8 usable blocks
+        pc.insert(tok_c, "v", kv.owner_blocks("c"))
+        kv.free("c")
+        assert pc.evict(4) == 4                # C's tail spills leaf-first
+        kv.ensure_capacity("a", 12)
+        pc.insert(tok_a, "v", kv.owner_blocks("a"))
+        kv.free("a")
+        t0 = time.monotonic()
+        while True:
+            with pc._lock:
+                states = [h.state for h, _ in pc._spilled.values()]
+            if all(s == SPILL_READY for s in states):
+                break
+            assert time.monotonic() - t0 < 30
+            time.sleep(0.005)
+        st = pc.stats()
+        assert st["spilled_entries"] == 4 and st["entries"] == 7
+        assert kv.blocks_free() == 1           # refill of 4 can't fit as-is
+        blocks = pc.lookup(tok_c, "v")         # walk extends into the tail
+        st = pc.stats()
+        assert len(blocks) == 8                # head resident, tail refilled
+        assert st["hits_after_spill"] == 1
+        assert st["refills"] == 4
+        # the room came from trading A's cold chain to host — spilled in
+        # turn, not dropped — and the protected head C0..C3 never moved
+        assert st["spilled_entries"] == 3      # A's entries, now host-side
+        assert st["spills"] == 7               # C's tail (4) + A's trade (3)
+        assert st["entries"] == 8              # C fully resident again
+        assert kv.blocks_free() == 0
+        assert pc.lookup(tok_c, "v") == blocks
+    finally:
+        pc.clear()
+        swap.shutdown()
+    assert swap.pool.stats()["host_blocks_in_use"] == 0
+
+
+def test_refill_many_partial_run_and_handle_settlement():
+    """Unit gates on the batched manager API: a run larger than the
+    free device pool refills a leading PARTIAL run (tail handles stay
+    spilled and refillable later), and freed/consumed handles settle
+    idempotently."""
+    from bigdl_tpu.serving.kv_cache import KVSwapManager, PagedKVCache
+    m = shared_model()
+    kv = PagedKVCache(m, num_blocks=8, block_size=BS, max_blocks_per_seq=16)
+    swap = KVSwapManager(kv, host_blocks=16)
+    try:
+        kv.ensure_capacity("seed", 6 * BS)     # 6 blocks of real pages
+        blocks = kv.owner_blocks("seed")
+        hs = swap.spill_many([[b] for b in blocks], tag="t")
+        assert all(h is not None for h in hs)
+        t0 = time.monotonic()
+        while any(h.state == SPILL_PENDING for h in hs):
+            assert time.monotonic() - t0 < 30
+            time.sleep(0.005)
+        assert all(h.state == SPILL_READY for h in hs)
+        kv.free("seed")                        # pool: 7 free now
+        kv.ensure_capacity("hog", 5 * BS)      # leave 2 free
+        ids, consumed, dropped = swap.refill_many("re", hs)
+        assert consumed == 2 and dropped == 0  # leading partial run
+        assert len(ids) == 2
+        assert [h.state for h in hs[:2]] == [SPILL_FREED, SPILL_FREED]
+        assert all(h.state == SPILL_READY for h in hs[2:])
+        kv.free("re")
+        kv.free("hog")
+        ids2, consumed2, dropped2 = swap.refill_many("re2", hs[2:])
+        assert consumed2 == 4 and dropped2 == 0
+        kv.free("re2")
+        assert swap.pool.stats()["host_blocks_in_use"] == 0
+    finally:
+        swap.shutdown()
+
+
+def test_spill_many_groups_and_host_exhaustion_per_group():
+    """spill_many reserves per GROUP: groups past the pool's capacity
+    degrade to None (pre-tier drop) while earlier groups stage
+    normally — and a group of zero blocks is a None, not a crash."""
+    from bigdl_tpu.serving.kv_cache import KVSwapManager, PagedKVCache
+    m = shared_model()
+    kv = PagedKVCache(m, num_blocks=8, block_size=BS, max_blocks_per_seq=16)
+    swap = KVSwapManager(kv, host_blocks=3)
+    try:
+        kv.ensure_capacity("seed", 6 * BS)
+        blocks = kv.owner_blocks("seed")
+        hs = swap.spill_many([[], [blocks[0], blocks[1]],
+                              [blocks[2]], [blocks[3]]], tag="t")
+        assert hs[0] is None                   # empty group
+        assert hs[1] is not None and hs[1].n_blocks == 2
+        assert hs[2] is not None and hs[2].n_blocks == 1
+        assert hs[3] is None                   # pool exhausted (3 used)
+        t0 = time.monotonic()
+        live = [h for h in hs if h is not None]
+        while any(h.state == SPILL_PENDING for h in live):
+            assert time.monotonic() - t0 < 30
+            time.sleep(0.005)
+        assert all(h.state == SPILL_READY for h in live)
+        for h in live:
+            swap.discard(h)
+        assert swap.pool.stats()["host_blocks_in_use"] == 0
+        kv.free("seed")
+    finally:
+        swap.shutdown()

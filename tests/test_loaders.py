@@ -872,6 +872,7 @@ def test_caffe_innerproduct_spatial_input_roundtrip():
     np.testing.assert_allclose(out, ref, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_exported_graphdef_executes_in_real_tensorflow():
     """save_tf_graph output must not just round-trip through OUR loader —
     real TensorFlow must import AND execute it with identical outputs."""

@@ -8,6 +8,7 @@ from bigdl_tpu import models
 from test_models import _count_params
 
 
+@pytest.mark.slow
 def test_resnet50_forward_tiny():
     m = models.ResNet(class_num=100, depth=50)
     x = np.random.randn(1, 3, 64, 64).astype(np.float32)  # small spatial

@@ -72,7 +72,8 @@ def _no_leaked_blocks(st):
     no_leaked_blocks(st)
 
 
-@pytest.fixture(params=["dense", "kernel"])
+@pytest.fixture(params=["dense",
+                        pytest.param("kernel", marks=pytest.mark.slow)])
 def paged_path(request, monkeypatch):
     if request.param == "kernel":
         monkeypatch.setenv("BIGDL_TPU_PAGED_ATTN", "interpret")

@@ -80,14 +80,20 @@ def _set_paged_path(request, monkeypatch):
     return request.param
 
 
-@pytest.fixture(params=["dense", "kernel"])
+@pytest.fixture(params=["dense",
+                        pytest.param("kernel", marks=pytest.mark.slow)])
 def paged_path(request, monkeypatch):
     """The ISSUE 11 kernel-on/kernel-off matrix: 'kernel' routes
     Attention.decode_paged through the Pallas paged-attention kernel
     (interpret mode on CPU — the identical kernel the TPU compiles);
     'dense' keeps the gathered-view einsum. The solo oracle always
     decodes DENSE (decode_chunk), so the kernel arm asserts the hard
-    claim: kernel tokens are bitwise the dense tokens."""
+    claim: kernel tokens are bitwise the dense tokens.
+
+    The kernel arm rides @slow (tier-1 wall-time budget): kernel decode
+    stays gated in tier-1 by test_kv_spill[kernel], test_serving_mesh's
+    paged-kernel TP test, and the kernels/serve/chaos smokes; `make
+    test-slow` runs the full matrix."""
     return _set_paged_path(request, monkeypatch)
 
 
@@ -270,6 +276,7 @@ def test_speculative_fast_path_bitwise_and_fewer_rounds(paged_path):
     _no_leaked_blocks(st)
 
 
+@pytest.mark.slow
 def test_spec_covers_the_whole_batch():
     """ISSUE 14: speculation is no longer a solo fast path — two
     concurrent greedy requests ride ONE batched spec round per step
@@ -373,6 +380,7 @@ def test_batched_spec_weak_draft_rollback_bitwise(paged_path_heavy):
     _no_leaked_blocks(st)
 
 
+@pytest.mark.slow
 def test_batched_spec_eos_finishes_one_row_mid_round():
     """A row hitting EOS inside a spec round finishes and frees its
     blocks while the other rows keep riding rounds — and the EOS'd
@@ -469,6 +477,7 @@ def test_batched_spec_prefix_hit_kernel_matrix(paged_path_heavy):
     _no_leaked_blocks(st)
 
 
+@pytest.mark.slow
 def test_batched_spec_mixed_sampled_rows_untouched():
     """The mixed-batch gate: sampled rows ride the spec dispatch masked
     to ONE real token — their tokens are bitwise what they draw with no
@@ -701,6 +710,7 @@ def test_ttft_tpot_trace_and_metrics():
         obs.disable()
 
 
+@pytest.mark.slow
 def test_static_admission_is_whole_request_batching():
     """The bench baseline: with admission='static' a second wave only
     admits after the first fully drains — but results stay bitwise."""
@@ -738,6 +748,7 @@ def test_sampling_default_and_temp0_stay_greedy_bitwise():
     assert np.array_equal(_one(m, p, temperature=0.0, seed=99), want)
 
 
+@pytest.mark.slow
 def test_sampling_seeded_reproducible_and_batch_mix_independent():
     """Same seed ⇒ same tokens — alone or sharing the batch with other
     traffic (keys derive from (seed, position) only, the sampling
@@ -791,6 +802,7 @@ def test_sampling_validation_and_greedy_rows_unaffected():
     assert np.array_equal(greedy_out, solo_oracle(m, m.params, p, 8))
 
 
+@pytest.mark.slow
 def test_sampling_skips_speculative_fast_path():
     """The draft-propose/verify acceptance rule is argmax-match —
     a sampling request must ride the normal bucketed step even when it
@@ -811,6 +823,7 @@ def test_sampling_skips_speculative_fast_path():
         "tokens identical with or without a draft model armed"
 
 
+@pytest.mark.slow
 def test_concurrent_submitters():
     """Thread-safety of submit(): many client threads, every result
     bitwise (the closed-loop bench shape at test scale)."""

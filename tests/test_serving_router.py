@@ -484,6 +484,7 @@ def test_prefix_affinity_follows_the_cache():
     assert hits == 1, "the prefix must be resident on ONE replica"
 
 
+@pytest.mark.slow
 def test_prefix_affinity_disabled_and_slack_bypass():
     """prefix_affinity=False routes as before (round-robin spreads the
     identical prompts); affinity_slack=-1 makes every affine pick

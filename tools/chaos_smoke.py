@@ -26,6 +26,14 @@ seams and enforces the recovery guarantees end to end:
    ``health/kv_corruption`` event + crash bundle, QUARANTINE (new
    admissions stop adopting shared state, prefix probes go dark) and
    KEEP SERVING — the next request still completes bitwise.
+4. **Host-tier swap faults → replay/degrade, never corrupt (ISSUE
+   18).** A capacity-constrained scheduler with the host tier armed:
+   transient faults at ``kv/swap_out``/``kv/swap_in`` must be absorbed
+   by the single replay (immutable handles/host bytes — the retry is
+   bitwise) with second-chance hits still served; an injected
+   PERMANENT ``kv/swap_in`` on a preempted request's refill must
+   degrade to recompute-from-host-tokens — every stream stays bitwise
+   the fault-free run, and BOTH pools (device and host) drain to 0.
 
 Campaign-wide gates: >= 20 injected faults across >= 5 distinct sites,
 zero lost / double-answered requests, ``kv_blocks_in_use`` -> 0 on
@@ -53,6 +61,7 @@ from bigdl_tpu.parallel import chaos  # noqa: E402
 from bigdl_tpu.parallel.failure import (FaultPolicy,  # noqa: E402
                                         TransientDeviceError)
 from bigdl_tpu.serving import DecodeScheduler, Router  # noqa: E402
+from bigdl_tpu.serving.kv_cache import SPILL_PENDING  # noqa: E402
 
 V = 48
 RNG = np.random.RandomState(20260804)
@@ -272,6 +281,118 @@ def main():
         "phase 4: a failed checkpoint write must leave no file"
     _bank_fires()
 
+    # ---- phase 5a: host-tier swap faults -> transient replay --------
+    # A capacity-constrained pool turns prefix evictions into host
+    # spills; re-asking the first two prompts forces second-chance
+    # refills. Transient faults on BOTH swap seams must be absorbed by
+    # the manager's single replay (immutable handles / host bytes — the
+    # retry IS bitwise), never surfacing as swap failures.
+    spill_prompts = [RNG.randint(1, V, size=16).astype(np.int32)
+                     for _ in range(4)]
+    spill_plans = [(p, 8, {}) for p in spill_prompts] + \
+                  [(spill_prompts[0].copy(), 8, {}),
+                   (spill_prompts[1].copy(), 8, {})]
+    ref5 = _sched(model).start(warmup=False)
+    want5 = [np.asarray(ref5.submit(p, mn, **kw).result(timeout=120))
+             for p, mn, kw in spill_plans]
+    ref5.shutdown()
+    _drain_and_audit(ref5, "phase 5 reference")
+
+    chaos.arm({"seed": 23, "sites": {
+        "kv/swap_out": [{"kind": "transient", "every": 2,
+                         "max_fires": 3}],
+        "kv/swap_in": [{"kind": "transient", "nth": 1}],
+    }})
+    s5 = _sched(model, num_blocks=13, host_blocks=32).start(warmup=False)
+    got5 = []
+    for i, (p, mn, kw) in enumerate(spill_plans):
+        if i == 4:
+            # the re-asks must find settled handles — wait for the
+            # stager to land every in-flight spill (the decode path
+            # never waits; only this smoke does, to make the
+            # second-chance gate deterministic)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with s5.prefix._lock:
+                    pending = [h for h, _ in s5.prefix._spilled.values()
+                               if h.state == SPILL_PENDING]
+                if not pending:
+                    break
+                time.sleep(0.01)
+        got5.append(np.asarray(s5.submit(p, mn, **kw).result(timeout=120)))
+    st5 = s5.stats()
+    fires5 = chaos.stats()
+    _bank_fires()
+    for i, (want, have) in enumerate(zip(want5, got5)):
+        assert np.array_equal(want, have), \
+            f"phase 5a: request {i} diverged under swap faults"
+    assert st5["prefix"]["spills"] >= 1, \
+        f"phase 5a: block pressure never spilled a chain ({st5['prefix']})"
+    assert st5["prefix"]["hits_after_spill"] >= 1, \
+        f"phase 5a: no second-chance hit was served ({st5['prefix']})"
+    assert st5["host"]["swap_out_bytes"] > 0
+    assert st5["host"]["swap_failures"] == 0, \
+        f"phase 5a: a transient swap fault was not absorbed " \
+        f"({st5['host']})"
+    assert fires5["by_site"].get("kv/swap_out", 0) >= 1, fires5
+    assert fires5["by_site"].get("kv/swap_in", 0) >= 1, fires5
+    s5.shutdown()
+    assert s5.stats()["host"]["host_blocks_in_use"] == 0, \
+        "phase 5a: host pool leaked after shutdown"
+    _drain_and_audit(s5, "phase 5a")
+
+    # ---- phase 5b: poisoned refill -> recompute, bitwise ------------
+    # A high-priority request preempts the decoding low-priority one
+    # (its pages swap out); a PERMANENT fault on the preempt-tagged
+    # refill must degrade to re-prefilling the host-resident tokens —
+    # both streams bitwise, the failure surfaced as health events.
+    p_low = RNG.randint(1, V, size=24).astype(np.int32)
+    p_high = RNG.randint(1, V, size=24).astype(np.int32)
+    ref6 = _sched(model).start(warmup=False)
+    want_low = np.asarray(ref6.submit(p_low, 20).result(timeout=120))
+    want_high = np.asarray(ref6.submit(p_high, 12).result(timeout=120))
+    ref6.shutdown()
+    _drain_and_audit(ref6, "phase 5b reference")
+
+    events5 = []
+    chaos.arm({"seed": 29, "sites": {
+        "kv/swap_in": [{"kind": "permanent", "nth": 1,
+                        "tag": "preempt"}],
+    }})
+    # num_blocks=13 fits exactly one of these requests at a time, so
+    # the high-priority admission can only proceed by preempting
+    s6 = _sched(model, num_blocks=13, host_blocks=64).start(warmup=False)
+    with _health.listen(lambda e: events5.append(e)):
+        f_low = s6.submit(p_low, 20, priority=0)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and s6.stats()["active"] == 0:
+            time.sleep(0.002)   # wait until the victim is DECODING
+        f_high = s6.submit(p_high, 12, priority=5)
+        got_low = np.asarray(f_low.result(timeout=180))
+        got_high = np.asarray(f_high.result(timeout=180))
+    st6 = s6.stats()
+    fires6 = chaos.stats()
+    _bank_fires()
+    assert np.array_equal(got_high, want_high), \
+        "phase 5b: the preempting stream diverged"
+    assert np.array_equal(got_low, want_low), \
+        "phase 5b: the preempted stream is not bitwise after recompute"
+    assert st6["preemptions"] >= 1, f"phase 5b: no preemption ({st6})"
+    assert st6["resume_recomputes"] >= 1, \
+        f"phase 5b: the poisoned refill did not degrade to recompute " \
+        f"({st6})"
+    assert st6["host"]["swap_failures"] >= 1, \
+        f"phase 5b: the permanent fault never surfaced ({st6['host']})"
+    assert any(e["kind"] == "health/kv_swap_failed" for e in events5), \
+        "phase 5b: no structured swap-failure event"
+    assert any(e["kind"] == "health/kv_swap_recompute" for e in events5), \
+        "phase 5b: no structured recompute event"
+    assert fires6["by_site"].get("kv/swap_in", 0) >= 1, fires6
+    s6.shutdown()
+    assert s6.stats()["host"]["host_blocks_in_use"] == 0, \
+        "phase 5b: host pool leaked after shutdown"
+    _drain_and_audit(s6, "phase 5b")
+
     # ---- campaign-wide gates ----------------------------------------
     sites = sorted({f["site"] for f in ALL_FIRES})
     assert len(ALL_FIRES) >= 20, \
@@ -282,7 +403,10 @@ def main():
           f"({', '.join(sites)}); {st1['step_replays']} transient step "
           f"replays bitwise, {st2['kv_recoveries']} KV-preserving "
           f"recoveries across replica death (0 lost), ledger corruption "
-          f"quarantined with bundle + clean drain")
+          f"quarantined with bundle + clean drain, "
+          f"{st5['prefix']['hits_after_spill']} second-chance hits + "
+          f"{st6['resume_recomputes']} poisoned-refill recomputes "
+          f"bitwise under swap faults")
 
 
 if __name__ == "__main__":

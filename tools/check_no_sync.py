@@ -93,6 +93,11 @@ HOT_FUNCS = {
         # dispatch of every decode step)
         "_snapshot_step_state", "_restore_step_state", "_replay_group",
         "audit", "_audit", "_triage",
+        # swap-based preemption (ISSUE 18): both run at step boundaries
+        # inside the admission loop — the spill is a handle snapshot +
+        # enqueue (the fetch is the stager thread's), the resume issues
+        # the refill scatter without blocking on it
+        "_try_preempt", "_resume_preempted",
     },
     # block ledger: admission-control bookkeeping runs between decode
     # steps and must stay pure host state (device pages are functional
@@ -110,6 +115,14 @@ HOT_FUNCS = {
         # deliberate page fetch is jax.device_get (the handoff's data
         # hop); adopt issues scatter transfers without blocking
         "export_blocks", "adopt_serialized",
+        # host-RAM paging tier (ISSUE 18): the boundary-scheduled swap
+        # paths — spill captures handles and enqueues (the fetch lives
+        # on the stager thread, NOT here), refill verifies + adopts
+        # (issues the scatter, never blocks on it), and the staging-
+        # ring placement only copies into reusable host buffers (the
+        # ring's reuse fence is annotated in native/)
+        "snapshot_blocks", "spill", "spill_many", "refill",
+        "refill_many", "_stage",
     },
     # fleet transport (ISSUE 15): framed send/recv on router dispatch
     # and agent reply paths — pure socket/bytes work, a device touch
@@ -135,6 +148,11 @@ HOT_FUNCS = {
     "bigdl_tpu/serving/prefix_cache.py": {
         "lookup", "peek", "insert", "evict", "chain_keys", "_walk",
         "_on_remap", "pinned_blocks",
+        # second-chance paths (ISSUE 18): lookup's spilled-chain
+        # continuation and host-pool pressure relief run inside the
+        # admission loop — host hashing/bookkeeping plus non-blocking
+        # refill dispatch only
+        "_refill_run", "drop_spilled",
     },
     # router hot loop: pure host routing — a sync here would stall
     # EVERY class queue; the replicas' own batcher threads do the
