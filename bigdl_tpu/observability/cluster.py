@@ -197,17 +197,58 @@ def _metric_value(snap: Dict, name: str):
     return v if isinstance(v, (int, float)) else None
 
 
+#: fleet-tier latency histograms surfaced in the merged view (ISSUE
+#: 19 satellite): KV-handoff wall time in the disaggregated pool and
+#: controller spawn-to-register launch latency
+FLEET_HISTOGRAMS = ("serve/fleet_handoff_ms", "serve/fleet_spawn_ms")
+
+
+def _merge_fleet_histograms(snaps: List[Dict]) -> Dict[str, Dict]:
+    """Cross-process merge of the fleet latency histograms: counts sum,
+    means combine count-weighted, max is the max, and the merged "p99"
+    is the worst per-process p99 (conservative — true cross-process
+    quantiles would need the raw samples, which snapshots drop)."""
+    out: Dict[str, Dict] = {}
+    for name in FLEET_HISTOGRAMS:
+        count = 0
+        total = 0.0
+        mx = None
+        p99 = None
+        for s in snaps:
+            m = s.get("metrics", {}).get(name)
+            if not isinstance(m, dict) or m.get("type") != "histogram":
+                continue
+            c = m.get("count") or 0
+            if not c:
+                continue
+            count += c
+            total += m.get("sum") or 0.0
+            if isinstance(m.get("max"), (int, float)):
+                mx = m["max"] if mx is None else max(mx, m["max"])
+            q = (m.get("quantiles") or {}).get("0.99")
+            if isinstance(q, (int, float)):
+                p99 = q if p99 is None else max(p99, q)
+        if count:
+            out[name] = {"count": count, "mean": total / count,
+                         "max": mx, "p99_worst_proc": p99}
+    return out
+
+
 def aggregate(directory: Optional[str] = None,
               now: Optional[float] = None) -> Optional[Dict]:
     """Merge the per-process snapshots into one cluster view:
 
     * per-process rows — step, mean step time, throughput, heartbeat
-      age, snapshot age;
+      age, snapshot age; a serving-fleet process's row also carries a
+      trimmed ``serving`` summary (role, queue depth, inflight, active
+      version) from the section its agent publishes;
     * **step-time skew** — slowest/median mean-step-time ratio across
       processes (the number that says the mesh is dragging);
     * **straggler attribution** — processes above
       ``STRAGGLER_RATIO`` × median, each joined with its heartbeat age
-      (a straggler whose heartbeat is ALSO stale is dying, not slow).
+      (a straggler whose heartbeat is ALSO stale is dying, not slow);
+    * a ``fleet`` section when any process recorded the fleet latency
+      histograms (KV handoff, elastic spawn).
 
     Returns None when there is nothing to merge."""
     snaps = read_snapshots(directory)
@@ -218,7 +259,7 @@ def aggregate(directory: Optional[str] = None,
     for s in snaps:
         step_time = _metric_value(s, "optim/step_time")
         hb_age = _metric_value(s, "failure/last_beat_age_s")
-        rows.append({
+        row = {
             "process_index": s.get("process_index", 0),
             "pid": s.get("pid"),
             "step": s.get("step"),
@@ -229,7 +270,15 @@ def aggregate(directory: Optional[str] = None,
                                     3),
             "snapshot_file": s.get("snapshot_file"),
             "final": bool(s.get("final", False)),
-        })
+        }
+        serving = s.get("serving")
+        if isinstance(serving, dict):
+            row["serving"] = {
+                k: serving.get(k) for k in
+                ("role", "queue_depth", "inflight", "pending",
+                 "active_version")
+                if serving.get(k) is not None}
+        rows.append(row)
     # finished (final:true) processes are retired, not slow: their
     # frozen means must not distort the LIVE cluster's median/skew
     # either — several fast finishers dragging the median down would
@@ -265,7 +314,7 @@ def aggregate(directory: Optional[str] = None,
                         r["heartbeat_age_s"], (int, float))
                     and r["heartbeat_age_s"] > STALE_HEARTBEAT_S,
                 })
-    return {
+    view = {
         "schema": CLUSTER_SCHEMA,
         "written_at": now,
         "n_processes": len(rows),
@@ -274,6 +323,10 @@ def aggregate(directory: Optional[str] = None,
         "stragglers": stragglers,
         "processes": rows,
     }
+    fleet = _merge_fleet_histograms(snaps)
+    if fleet:
+        view["fleet"] = fleet
+    return view
 
 
 def write_aggregate(directory: Optional[str] = None,

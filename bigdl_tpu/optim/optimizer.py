@@ -1501,6 +1501,14 @@ class BaseOptimizer:
         _health.emit("lr_reduced", reason="plateau", neval=state["neval"],
                      multiplier=mult,
                      schedule=type(sched).__name__ if sched else None)
+        if obs.enabled():
+            # under superstep fusion the reduction is applied to the
+            # NEXT group's lr vector (the detection itself came off
+            # this group's batched loss readback) — the instant marks
+            # where the policy acted so the one-group lag is visible
+            obs.counter("optim/lr_reductions").inc()
+            obs.instant("optim/lr_reduced", neval=state["neval"],
+                        multiplier=mult)
 
     def _remediation_tick(self, state, params, opt_state, mstate,
                           events, step_time_s=None):

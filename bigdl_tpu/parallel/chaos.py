@@ -27,6 +27,8 @@ site                          where it fires
 ``fleet/agent_beat``          fleet.ReplicaAgent membership beat loop
 ``fleet/transport``           fleet transport client send
 ``fleet/handoff``             fleet prefill-export / decode-adopt KV handoff
+``fleet/controller_tick``     controller.FleetController reconcile tick
+``fleet/spawn``               controller replica spawn (scale-up launch)
 ============================  ==============================================
 
 — with **seeded, deterministic schedules** (nth-call, every-k,
@@ -108,6 +110,8 @@ SITES = (
     "fleet/agent_beat",
     "fleet/transport",
     "fleet/handoff",
+    "fleet/controller_tick",
+    "fleet/spawn",
 )
 
 

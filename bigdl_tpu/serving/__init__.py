@@ -74,6 +74,14 @@ from .fleet import (DisaggregatedFleet, FleetMonitor, KVHandoffError,
                     RemoteReplica, ReplicaAgent, discover,
                     fleet_threads_alive, read_member, wait_for_members,
                     warm_replica)
+# elastic control plane (ISSUE 19): an SLO-scoring reconcile loop that
+# scales the fleet (spawn/drain under budgets with hysteresis +
+# cooldown), promotes decode replicas to prefill duty under backlog,
+# and prefix-warms joiners — membership changes ride the router's
+# drain/failover machinery, so scaling never loses a request
+# (docs/SERVING.md "Fleet operations")
+from .controller import (FleetController, ScalePolicy,
+                         controller_threads_alive)
 # the transient-failure classification AND the retry budget are SHARED
 # with the trainer (parallel/failure.FaultPolicy): the engine's batch
 # retry, the scheduler's bitwise step replay and the router's

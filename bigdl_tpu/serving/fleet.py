@@ -87,7 +87,8 @@ from .batching import (DeadlineExceeded, EngineStopped, QueueFull,
 from .kv_cache import KVCacheOOM
 from .prefix_cache import chain_keys
 from .transport import (RemoteError, TransportClient, TransportClosed,
-                        TransportServer, decode_tree, encode_tree)
+                        TransportServer, decode_tree, encode_tree,
+                        pick_advertise_host)
 
 _LOG = logging.getLogger("bigdl_tpu.serving.fleet")
 
@@ -220,6 +221,7 @@ class ReplicaAgent:
                  name: Optional[str] = None, role: str = "replica",
                  tags: Sequence[str] = (), beat_s: float = 0.25,
                  host: str = "127.0.0.1", port: int = 0,
+                 advertise_host: Optional[str] = None,
                  snapshot_every_s: Optional[float] = None,
                  process_index: Optional[int] = None):
         if role not in ROLES:
@@ -232,6 +234,13 @@ class ReplicaAgent:
         self.tags = tuple(tags) or tuple(getattr(engine, "tags", ()))
         self.beat_s = float(beat_s)
         self._host, self._port = host, int(port)
+        # the address the MEMBER FILE carries: cross-host peers dial
+        # this, not the bind address. A wildcard bind ("0.0.0.0")
+        # auto-resolves to this host's outbound interface; an explicit
+        # advertise_host wins (NAT/multi-homed boxes). Single-host
+        # fleets keep the loopback default untouched.
+        self.advertise_host = (advertise_host
+                               or pick_advertise_host(host))
         self.server: Optional[TransportServer] = None
         self._hb = FileHeartbeat(member_path(fleet_dir, self.name))
         self._snap = _cluster.MetricSnapshotWriter(
@@ -360,7 +369,7 @@ class ReplicaAgent:
     def _member_doc(self, section: Optional[Dict] = None) -> Dict:
         return {"schema": MEMBER_SCHEMA, "name": self.name,
                 "role": self.role, "tags": list(self.tags),
-                "host": self.server.host if self.server else self._host,
+                "host": self.advertise_host,
                 "port": self.server.port if self.server else self._port,
                 "started_at": self._started_at,
                 "dead": self._dead,
@@ -479,6 +488,30 @@ class ReplicaAgent:
         elif op == "retire":
             self.engine.registry.retire(meta["version"])
             reply({"version": meta["version"]})
+        elif op == "set_role":
+            # the controller's promotion seam: roles are discovery/
+            # routing labels (every scheduler-backed agent serves every
+            # op), so a decode→prefill promotion is a label flip plus
+            # an immediate member-file rewrite — peers discover the new
+            # duty on their next directory read, no engine restart
+            role = meta["role"]
+            if role not in ROLES:
+                raise ValueError(f"role must be one of {ROLES}, "
+                                 f"got {role!r}")
+            old = self.role
+            self.role = role
+            if "tags" in meta:
+                self.tags = tuple(meta["tags"])
+            with self._beat_write:
+                if not (self._finished or self._dead):
+                    self._section = self._serving_section()
+                    self._hb.beat(self._member_doc(self._section))
+            if obs.enabled():
+                obs.instant("serve/fleet_role_flip", agent=self.name,
+                            from_role=old, to_role=role)
+            _health.emit("fleet_role_flip", agent=self.name,
+                         from_role=old, to_role=role)
+            reply({"role": role, "was": old})
         elif op == "prefill_export":
             self._guard_handoff(self._export_prefix, reply, meta, arrays)
         elif op == "adopt_prefix":
@@ -1037,6 +1070,19 @@ class RemoteReplica:
         return self._request("chaos_arm", {"plan": plan},
                              timeout=self._rpc_timeout)
 
+    def set_role(self, role: str, tags: Optional[Sequence[str]] = None):
+        """Flip the remote agent's duty label (controller promotion).
+        The agent rewrites its member file immediately; this handle's
+        ``role``/``tags`` mirror the flip on the ack."""
+        meta: Dict = {"role": role}
+        if tags is not None:
+            meta["tags"] = list(tags)
+        m, _ = self._request("set_role", meta, timeout=self._rpc_timeout)
+        self.role = m["role"]
+        if tags is not None:
+            self.tags = tuple(tags)
+        return m
+
     def _request(self, op, meta=None, arrays=(), timeout=None):
         self._client.connect()
         try:
@@ -1061,7 +1107,17 @@ class FleetMonitor:
     work exactly as if a local stall beacon fired; a member that beats
     again emits ``health/stall_recovered`` and rejoins. A ``final``
     (cleanly drained) member is treated as down without the alarm.
-    One monitor thread per router process; pure host file reads."""
+    One monitor thread per router process; pure host file reads.
+
+    Staleness is CROSS-HOST SAFE: it is judged by beat-COUNTER progress
+    against THIS OBSERVER's monotonic clock — the member's ``beat``
+    counter not advancing for ``stale_s`` observer-seconds is the stall
+    signal, exactly how the in-job ``failure.Heartbeat`` judges peers
+    by counter progress. The member file's wall-clock ``written_at``
+    stamp is never compared against the observer's wall clock, so an
+    agent on a host whose clock is skewed hours off (NTP drift, a VM
+    resume) cannot be false-killed while it is beating perfectly well —
+    and a frozen observer clock cannot hide a genuinely wedged agent."""
 
     def __init__(self, replicas: Sequence[RemoteReplica], *,
                  fleet_dir: str, every_s: float = 0.25,
@@ -1071,8 +1127,31 @@ class FleetMonitor:
         self.every_s = float(every_s)
         self.stale_s = float(stale_s)
         self._up: Dict[str, bool] = {r.name: True for r in self.replicas}
+        # per-member (last beat counter seen, observer-monotonic stamp
+        # of when it last ADVANCED) — the cross-host-safe staleness
+        # state; a member first seen counts as advancing right then
+        self._progress: Dict[str, tuple] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _progress_age_s(self, name: str, doc: Optional[Dict],
+                        now: float) -> float:
+        """Observer-monotonic seconds since ``name``'s beat counter last
+        advanced; ``inf`` for a missing doc (nothing to make progress)."""
+        if doc is None:
+            self._progress.pop(name, None)
+            return float("inf")
+        beat = doc.get("beat")
+        if not isinstance(beat, (int, float)):
+            return float("inf")
+        last = self._progress.get(name)
+        # any counter CHANGE is progress — a restarted agent's counter
+        # resets to 1, and "went backwards" must read as a fresh
+        # incarnation beating, not as ten minutes of silence
+        if last is None or beat != last[0]:
+            self._progress[name] = (beat, now)
+            return 0.0
+        return max(0.0, now - last[1])
 
     def start(self) -> "FleetMonitor":
         self._thread = threading.Thread(target=self._loop,
@@ -1086,12 +1165,30 @@ class FleetMonitor:
         if t is not None:
             t.join(5.0)
 
+    def watch(self, rep: RemoteReplica):
+        """Start monitoring a replica that joined after start() (the
+        controller's scale-up path). Idempotent by name."""
+        if all(r.name != rep.name for r in self.replicas):
+            self.replicas.append(rep)
+        self._up.setdefault(rep.name, True)
+
+    def unwatch(self, name: str):
+        """Stop monitoring a retired replica (scale-down): its member
+        file going final/stale afterwards is retirement, not a stall."""
+        self.replicas = [r for r in self.replicas if r.name != name]
+        self._up.pop(name, None)
+        self._progress.pop(name, None)
+
     def _loop(self):
         while not self._stop.is_set():
             alive = 0
-            for rep in self.replicas:
+            for rep in list(self.replicas):
                 doc = read_member(self.fleet_dir, rep.name)
-                age = FileHeartbeat.age_s(doc)
+                # beat-counter progress vs OUR monotonic clock — never
+                # the member file's wall-clock stamp (cross-host skew
+                # must not false-kill a beating agent)
+                age = self._progress_age_s(rep.name, doc,
+                                           time.monotonic())
                 dead = bool(doc and doc.get("dead"))
                 finished = bool(doc and doc.get("final") and not dead)
                 if (doc is not None and not dead and not finished
@@ -1189,6 +1286,7 @@ class DisaggregatedFleet:
                       None)
             if pf is None:
                 raise EngineStopped("no live prefill specialist")
+            t0 = time.monotonic()
             meta, arrays = pf.prefill_export(
                 sub, timeout=self.handoff_timeout_s)
             if meta.get("tokens", 0) <= 0:
@@ -1213,6 +1311,12 @@ class DisaggregatedFleet:
                 obs.counter("serve/fleet_handoffs").inc()
                 obs.counter("serve/fleet_handoff_tokens").inc(
                     int(meta["tokens"]))
+                # per-hop export→adopt wall time: the number that says
+                # whether the handoff hop is paying for itself against
+                # the decode replica just prefilling locally
+                obs.histogram("serve/fleet_handoff_ms",
+                              unit="ms").observe(
+                    (time.monotonic() - t0) * 1000.0)
         except KVHandoffError as e:
             self._bump("handoff_refused")
             _LOG.warning("KV handoff refused (degrading to plain "
@@ -1223,6 +1327,33 @@ class DisaggregatedFleet:
                 obs.counter("serve/fleet_handoff_failed").inc()
             _LOG.warning("KV handoff failed (%s: %s) — request degrades "
                          "to a plain submit", type(e).__name__, e)
+
+    # -- pool membership (controller scale/promotion) --------------------
+
+    def add_prefill(self, rep: RemoteReplica):
+        """Admit a replica to the prefill pool (promotion lands here
+        AFTER the role flip + any version alignment). List replacement,
+        not append: ``_handoff`` reads the pool without the lock."""
+        with self._lock:
+            if all(p.name != rep.name for p in self.prefill):
+                self.prefill = self.prefill + [rep]
+
+    def remove_prefill(self, name: str) -> Optional[RemoteReplica]:
+        with self._lock:
+            gone = next((p for p in self.prefill if p.name == name), None)
+            self.prefill = [p for p in self.prefill if p.name != name]
+        return gone
+
+    def add_decode(self, rep: RemoteReplica):
+        with self._lock:
+            if all(d.name != rep.name for d in self.decode):
+                self.decode = self.decode + [rep]
+
+    def remove_decode(self, name: str) -> Optional[RemoteReplica]:
+        with self._lock:
+            gone = next((d for d in self.decode if d.name == name), None)
+            self.decode = [d for d in self.decode if d.name != name]
+        return gone
 
     def swap(self, params, state=None,
              version: Optional[str] = None) -> str:
@@ -1332,14 +1463,20 @@ def agent_from_config(cfg: Dict) -> ReplicaAgent:
         {"fleet_dir": ..., "name": "r0", "role": "replica",
          "tags": ["f32"], "beat_s": 0.25, "process_index": 1,
          "observability": true,
+         "host": "0.0.0.0", "port": 0,            # bind address
+         "advertise_host": "10.0.0.7",            # optional override
          "model": {...TransformerLM kwargs...},
          "params_path": "/path/params.pkl",       # optional np pytree
          "scheduler": {...DecodeScheduler kwargs...},
          "chaos": {...chaos plan...}}             # optional
 
-    ``params_path`` (a pickled numpy param tree, written by the parent)
-    pins every process to ONE param set regardless of ambient RNG
-    history — the fleet's bitwise gates depend on it."""
+    ``host`` is the BIND address (``"0.0.0.0"`` for cross-host fleets);
+    the member file advertises ``advertise_host`` — auto-detected from
+    the outbound interface on a wildcard bind — so peers on other hosts
+    sharing the membership directory dial a reachable address, never
+    ``localhost``. ``params_path`` (a pickled numpy param tree, written
+    by the parent) pins every process to ONE param set regardless of
+    ambient RNG history — the fleet's bitwise gates depend on it."""
     from ..models.transformer_lm import TransformerLM
     from .decode_scheduler import DecodeScheduler
 
@@ -1364,6 +1501,9 @@ def agent_from_config(cfg: Dict) -> ReplicaAgent:
         sched, fleet_dir=cfg["fleet_dir"], name=cfg.get("name"),
         role=cfg.get("role", "replica"), tags=cfg.get("tags", ()),
         beat_s=cfg.get("beat_s", 0.25),
+        host=cfg.get("host", "127.0.0.1"),
+        port=cfg.get("port", 0),
+        advertise_host=cfg.get("advertise_host"),
         process_index=cfg.get("process_index"))
 
 

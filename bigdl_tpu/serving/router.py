@@ -76,7 +76,7 @@ THREAD_NAME = "bigdl_tpu-serving-router"
 _STAT_KEYS = ("submitted", "completed", "rejected", "doomed", "dispatches",
               "failovers", "drains", "rejoins", "deadline_misses",
               "replica_full", "affinity_hits", "affinity_bypassed",
-              "kv_recoveries", "dispatch_retries")
+              "kv_recoveries", "dispatch_retries", "joins", "retires")
 
 #: per-request cap on transient-classified submit failures: a transport
 #: that keeps presenting as transient is not transient — past this the
@@ -538,6 +538,98 @@ class Router:
     def healthy_replicas(self) -> List[str]:
         with self._lock:
             return [r.name for r in self._replicas if r.healthy]
+
+    # -- dynamic membership (ISSUE 19) -----------------------------------
+
+    def add_replica(self, engine, name: Optional[str] = None) -> str:
+        """Register one more replica on a RUNNING router (the elastic
+        scale-up path). Same invariants as construction — distinct
+        name, distinct beacon — enforced under the lock; the membership
+        list is REPLACED rather than mutated in place so `swap`'s
+        lock-free iteration sees either the old fleet or the new one,
+        never a half-grown list. When ``manage_replicas``, a router
+        that is already started starts the engine too. Returns the
+        registered replica name."""
+        rname = name or getattr(engine, "name", None)
+        rep = _Replica(engine, rname or "")
+        with self._lock:
+            if self._closed:
+                raise EngineStopped("router is shutting down")
+            if not rep.name:
+                rep.name = f"replica{len(self._replicas)}"
+            if any(r.name == rep.name for r in self._replicas):
+                raise ValueError(f"duplicate replica name {rep.name!r}")
+            bn = rep.beacon_name
+            if bn and bn in self._by_beacon:
+                raise ValueError(
+                    f"replica {rep.name!r} shares the beacon name {bn!r} "
+                    "with an existing replica — health events would be "
+                    "un-attributable")
+            running = self._thread is not None
+        if self.manage_replicas and running:
+            engine.start()
+        with self._lock:
+            self._replicas = self._replicas + [rep]
+            if bn:
+                self._by_beacon[bn] = rep
+            self._any_prefix = self._any_prefix or callable(
+                getattr(engine, "cached_prefix_tokens", None))
+            for k in self._classes:
+                self._reseed_ewma_locked(k)
+        self._bump("joins")
+        if obs.enabled():
+            obs.counter("serve/router_joins").inc()
+            obs.instant("serve/router_join", replica=rep.name)
+        self._wake.set()
+        return rep.name
+
+    def remove_replica(self, name: str):
+        """Deregister a replica (the elastic scale-DOWN path): drain it
+        through the existing drain machinery — its in-flight requests
+        fail over to survivors, no client loses a request — then drop
+        it from rotation. The engine is NOT shut down here even under
+        ``manage_replicas``: retirement sequencing (drain the agent,
+        wait for its queues, then stop it) belongs to the caller, who
+        gets the engine back. Refuses to remove the last replica or to
+        strand a tag-demanding class with zero matching replicas."""
+        with self._lock:
+            rep = next((r for r in self._replicas if r.name == name), None)
+            if rep is None:
+                raise ValueError(f"no replica named {name!r}")
+            if len(self._replicas) == 1:
+                raise ValueError(
+                    "cannot remove the last replica — shut the router "
+                    "down instead")
+            rest = [r for r in self._replicas if r is not rep]
+            for cq in self._classes.values():
+                tags = cq.cls.replica_tags
+                if tags is not None and not any(r.tags & tags
+                                                for r in rest):
+                    raise ValueError(
+                        f"removing {name!r} would leave class "
+                        f"{cq.cls.name!r} (replica_tags {sorted(tags)}) "
+                        "with no eligible replica")
+        # out of rotation first (re-routes its in-flight requests onto
+        # the survivors), THEN deregister — the drain path needs the
+        # replica still resolvable while it strands/fails-over
+        self._drain_replica(rep, reason="retired")
+        with self._lock:
+            rep.dead = True   # a retired replica must never rejoin
+            self._replicas = [r for r in self._replicas if r is not rep]
+            bn = rep.beacon_name
+            if bn and self._by_beacon.get(bn) is rep:
+                del self._by_beacon[bn]
+            self._any_prefix = any(
+                callable(getattr(r.engine, "cached_prefix_tokens", None))
+                for r in self._replicas)
+            for k in self._classes:
+                self._reseed_ewma_locked(k)
+        self._bump("retires")
+        if obs.enabled():
+            obs.counter("serve/router_retires").inc()
+            obs.instant("serve/router_retire", replica=name)
+        self._wake.set()
+        return rep.engine
 
     # -- routing loop ----------------------------------------------------
 

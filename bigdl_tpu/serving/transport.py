@@ -556,3 +556,26 @@ def wait_for_port(host: str, port: int, timeout_s: float = 30.0) -> bool:
         except OSError:
             time.sleep(0.05)
     return False
+
+
+def pick_advertise_host(bind_host: str = "0.0.0.0",
+                        probe: str = "10.255.255.255") -> str:
+    """The address peers should DIAL for a server bound to
+    ``bind_host``. A concrete bind address is already reachable and is
+    returned as-is; a wildcard bind (``0.0.0.0`` / ``::`` / empty) needs
+    the host's outbound interface address — resolved with the classic
+    connected-UDP-socket trick (no packet is sent; the kernel just picks
+    the route to ``probe`` and reports the source address it would use).
+    Falls back to ``127.0.0.1`` on boxes with no route at all, which
+    keeps single-host fleets working offline."""
+    if bind_host not in ("", "0.0.0.0", "::"):
+        return bind_host
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((probe, 1))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"

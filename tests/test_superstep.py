@@ -567,3 +567,62 @@ def test_predictor_superstep_ragged_tail():
     assert want.shape == got.shape == (52, 3)
     assert np.allclose(want, got, rtol=1e-6, atol=1e-7)
     assert stager_threads_alive() == 0
+
+
+# ---------------------------------------------------------------------------
+# superstep × loss-reactive LR (ISSUE 19 satellite — ROADMAP deferred)
+# ---------------------------------------------------------------------------
+
+def test_superstep_plateau_lr_lands_next_group():
+    """Loss-reactive LR under fusion: a plateau detected from a group's
+    batched loss readback is applied at THAT group's boundary, so the
+    very next group's lr vector is already scaled — the reduction
+    reacts within ONE group at K>1, not only at K=1 (the ROADMAP
+    deferral this pins down)."""
+    from bigdl_tpu.observability import health as _health
+    from bigdl_tpu.optim.optimizer import RemediationPolicy
+    engine.set_seed(7)
+    rng = np.random.RandomState(7)
+    x = np.repeat(rng.randn(1, 8).astype(np.float32), 40 * 8, axis=0)
+    y = np.repeat(rng.randn(1, 4).astype(np.float32), 40 * 8, axis=0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    opt = LocalOptimizer(m, (x, y), nn.MSECriterion(),
+                         SGD(learningrate=0.0),  # lr 0: loss is constant
+                         max_iteration(40), batch_size=8)
+    opt.set_superstep(4)
+    opt.set_anomaly_detection(min_points=2, window=8, plateau_window=3,
+                              plateau_rel=1e-7)
+    opt.set_remediation(RemediationPolicy(plateau_lr=True,
+                                          plateau_factor=0.5))
+    # spy: record the remediation scale each group START reads when it
+    # builds its lr vector; marks record how many groups had started
+    # when each lr_reduced event fired (the group boundary that acted)
+    calls, marks = [], []
+    orig = opt.optim_method.current_lr_vector
+
+    def spy(k):
+        calls.append(opt._remediation_lr_scale)
+        return orig(k)
+
+    opt.optim_method.current_lr_vector = spy
+
+    def on_event(ev):
+        if ev.get("kind") == "health/lr_reduced":
+            marks.append(len(calls))
+
+    _health.listeners.append(on_event)
+    try:
+        opt.optimize()
+    finally:
+        _health.listeners.remove(on_event)
+    assert marks, "the constant loss never fired a plateau reduction"
+    assert opt._remediation_lr_scale < 1.0
+    c = marks[0]
+    assert c < len(calls), \
+        "the first reduction fired only after the final group — the " \
+        "one-group reaction is unobservable at this trajectory length"
+    # the group whose losses triggered the reduction ran unscaled...
+    assert calls[c - 1] == pytest.approx(1.0)
+    # ...and the NEXT group's lr vector already carried the reduction
+    assert calls[c] < 1.0
+    assert stager_threads_alive() == 0

@@ -34,10 +34,19 @@ seams and enforces the recovery guarantees end to end:
    PERMANENT ``kv/swap_in`` on a preempted request's refill must
    degrade to recompute-from-host-tokens — every stream stays bitwise
    the fault-free run, and BOTH pools (device and host) drain to 0.
+5. **Control-plane faults → the fleet outlives its controller (ISSUE
+   19).** An elastic fleet under sustained load: a transient
+   ``fleet/spawn`` fault mid-reconcile changes NOTHING (no phantom
+   member, no router join) and the launch is retried after cooldown;
+   a PERMANENT ``fleet/controller_tick`` fault kills the reconcile
+   thread — the DATA PLANE keeps serving bitwise, and a respawned
+   controller ADOPTS the surviving members (plus an out-of-band
+   joiner) from the directory instead of respawning them.
 
-Campaign-wide gates: >= 20 injected faults across >= 5 distinct sites,
-zero lost / double-answered requests, ``kv_blocks_in_use`` -> 0 on
-every pool, ``audit()`` clean at every shutdown.
+Campaign-wide gates: >= 20 injected faults across >= 5 distinct sites
+(the fleet control-plane sites must be among them), zero lost /
+double-answered requests, ``kv_blocks_in_use`` -> 0 on every pool,
+``audit()`` clean at every shutdown.
 """
 from __future__ import annotations
 
@@ -60,7 +69,11 @@ from bigdl_tpu.observability import health as _health  # noqa: E402
 from bigdl_tpu.parallel import chaos  # noqa: E402
 from bigdl_tpu.parallel.failure import (FaultPolicy,  # noqa: E402
                                         TransientDeviceError)
-from bigdl_tpu.serving import DecodeScheduler, Router  # noqa: E402
+from bigdl_tpu.serving import (DecodeScheduler, FleetController,  # noqa: E402
+                               FleetMonitor, RemoteReplica, ReplicaAgent,
+                               Router, ScalePolicy,
+                               controller_threads_alive, wait_for_members)
+from bigdl_tpu.serving.fleet import fleet_threads_alive  # noqa: E402
 from bigdl_tpu.serving.kv_cache import SPILL_PENDING  # noqa: E402
 
 V = 48
@@ -393,11 +406,137 @@ def main():
         "phase 5b: host pool leaked after shutdown"
     _drain_and_audit(s6, "phase 5b")
 
+    # ---- phase 6: control-plane faults -> fleet outlives controller -
+    # In-process elastic fleet (the subprocess flavor lives in
+    # fleet-smoke): a transient fleet/spawn fault mid-reconcile must
+    # change nothing and be retried after cooldown; a permanent
+    # fleet/controller_tick fault kills the reconcile thread — the
+    # data plane keeps serving bitwise and a respawned controller
+    # ADOPTS the members (plus an out-of-band joiner) instead of
+    # respawning them.
+    fd = os.path.join(_WORK, "fleet")
+    os.makedirs(fd, exist_ok=True)
+    agents6 = {}
+
+    def spawn6(name):
+        ag = ReplicaAgent(_sched(model, name=name), fleet_dir=fd,
+                          name=name, beat_s=0.1).start()
+        agents6[name] = ag
+        doc, = wait_for_members(fd, [name], timeout_s=60)
+        return RemoteReplica(doc, fleet_dir=fd).start()
+
+    p6 = [RNG.randint(1, V, size=6 + (i % 9)).astype(np.int32)
+          for i in range(12)]
+    ref7 = _sched(model).start(warmup=False)
+    want6 = [np.asarray(ref7.submit(p, 10).result(timeout=120))
+             for p in p6]
+    ref7.shutdown()
+    _drain_and_audit(ref7, "phase 6 reference")
+
+    r0f = spawn6("c0")
+    router6 = Router([r0f], max_failovers=4).start()
+    mon6 = FleetMonitor([r0f], fleet_dir=fd, every_s=0.1,
+                        stale_s=10.0).start()
+    # the permanent tick fault sits far out (pass 40, ~2s of cadence):
+    # the fail-spawn + cooldown + retried-spawn sequence completes in
+    # the first dozen passes and the extra ticks change nothing once
+    # the fleet is at max budget — so the death is deterministically
+    # AFTER the scale-up, whatever the spawn latency
+    chaos.arm({"seed": 31, "sites": {
+        "fleet/spawn": [{"kind": "transient", "nth": 1}],
+        "fleet/controller_tick": [
+            {"kind": "transient", "every": 4, "max_fires": 2},
+            {"kind": "permanent", "nth": 40}],
+    }})
+    pol6 = ScalePolicy(min_replicas=1, max_replicas=2, queue_high=1.0,
+                       queue_low=0.0, up_ticks=1, down_ticks=10 ** 9,
+                       cooldown_s=0.2)
+    ctl6 = FleetController(router6, mon6, fleet_dir=fd, spawn=spawn6,
+                           policy=pol6, every_s=0.05,
+                           warm_prompts=lambda: p6[:2])
+    try:
+        futs6 = [(i, router6.submit(p6[i], max_new_tokens=10))
+                 for i in range(len(p6))]
+        futs6 += [(i, router6.submit(p6[i], max_new_tokens=10))
+                  for i in range(len(p6))]
+        nxt6 = len(futs6)
+        ctl6.start()
+        # sustained load, topped up in BATCHES: the router hands work
+        # straight to the replica, so its own queues read near-zero —
+        # one request per pass drains faster than it arrives and the
+        # controller (scoring the member-file backlog) correctly never
+        # sees sustained pressure
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline and not (
+                len(router6.stats()["replicas"]) == 2 and ctl6.dead):
+            if sum(router6.stats()["queue_depth"].values()) < 8 \
+                    and len(futs6) < 120:
+                for _ in range(8):
+                    i6 = nxt6 % len(p6)
+                    nxt6 += 1
+                    futs6.append((i6, router6.submit(
+                        p6[i6], max_new_tokens=10)))
+            time.sleep(0.05)
+        cs6 = ctl6.stats()
+        fires7 = chaos.stats()
+        assert ctl6.dead, \
+            f"phase 6: the permanent tick fault never landed ({cs6})"
+        assert len(router6.stats()["replicas"]) == 2, \
+            f"phase 6: the failed spawn was never retried ({cs6})"
+        assert cs6["spawn_failed"] >= 1 and cs6["scale_ups"] >= 1, cs6
+        assert cs6["tick_faults"] >= 1, \
+            f"phase 6: transient tick faults were not absorbed ({cs6})"
+        assert fires7["by_site"].get("fleet/spawn", 0) >= 1, fires7
+        assert fires7["by_site"].get("fleet/controller_tick", 0) >= 1, \
+            fires7
+        for i6, f in futs6:
+            assert np.array_equal(want6[i6],
+                                  np.asarray(f.result(timeout=180))), \
+                f"phase 6: request {i6} diverged across control-plane " \
+                f"faults"
+        # the data plane outlives its controller: a post-death probe
+        # still serves, still bitwise
+        probe = router6.submit(p6[0], max_new_tokens=10).result(
+            timeout=120)
+        assert np.array_equal(want6[0], np.asarray(probe))
+        assert router6.stats()["completed"] == len(futs6) + 1, \
+            f"phase 6: lost requests ({router6.stats()})"
+        # an out-of-band joiner registers itself in the directory only;
+        # the respawned controller must ADOPT it (and not respawn the
+        # members it can already see through the router/monitor)
+        ag2 = ReplicaAgent(_sched(model, name="c2"), fleet_dir=fd,
+                           name="c2", beat_s=0.1).start()
+        agents6["c2"] = ag2
+        wait_for_members(fd, ["c2"], timeout_s=60)
+        ctl7 = FleetController(router6, mon6, fleet_dir=fd,
+                               spawn=spawn6, policy=pol6, name="ctl2")
+        adopted = ctl7.adopt()
+        assert adopted >= 1, "phase 6: the respawned controller " \
+                             "adopted nothing from the directory"
+        assert len(router6.stats()["replicas"]) == 3
+        assert np.array_equal(
+            want6[1], np.asarray(router6.submit(
+                p6[1], max_new_tokens=10).result(timeout=120))), \
+            "phase 6: post-adoption traffic diverged"
+        _bank_fires()
+        router6.shutdown()
+    finally:
+        chaos.disarm()
+        ctl6.stop()
+        for ag in agents6.values():
+            ag.shutdown()
+        mon6.stop()
+    assert fleet_threads_alive() == 0, "phase 6: fleet threads leaked"
+    assert controller_threads_alive() == 0, \
+        "phase 6: controller threads leaked"
+
     # ---- campaign-wide gates ----------------------------------------
     sites = sorted({f["site"] for f in ALL_FIRES})
     assert len(ALL_FIRES) >= 20, \
         f"campaign too small: {len(ALL_FIRES)} faults ({sites})"
     assert len(sites) >= 5, f"campaign too narrow: {sites}"
+    assert {"fleet/spawn", "fleet/controller_tick"} <= set(sites), \
+        f"campaign missed the control-plane sites: {sites}"
     print(f"chaos_smoke: ok in {time.time() - t0:.1f}s — "
           f"{len(ALL_FIRES)} faults injected across {len(sites)} sites "
           f"({', '.join(sites)}); {st1['step_replays']} transient step "
@@ -406,7 +545,9 @@ def main():
           f"quarantined with bundle + clean drain, "
           f"{st5['prefix']['hits_after_spill']} second-chance hits + "
           f"{st6['resume_recomputes']} poisoned-refill recomputes "
-          f"bitwise under swap faults")
+          f"bitwise under swap faults; controller death + spawn fault "
+          f"survived with {cs6['spawn_failed']} retried launches and "
+          f"{adopted} members adopted by the respawned controller")
 
 
 if __name__ == "__main__":

@@ -169,6 +169,18 @@ HOT_FUNCS = {
         # threads between THEIR dispatches)
         "_recover_decode", "_reseed_ewma_locked", "_complete",
     },
+    # elastic control plane (ISSUE 19): the reconcile tick runs on a
+    # cadence BESIDE the data plane — scoring is arithmetic over stats
+    # dicts the replicas already published, scale/promote/victim moves
+    # are socket RPCs + pool bookkeeping, and the prefix warm rides the
+    # existing export/adopt handoff; a device touch here would stall
+    # reconciliation behind a readback and couple control-plane health
+    # to device health
+    "bigdl_tpu/serving/controller.py": {
+        "tick", "_score", "_serving", "_router_size", "_scale_up",
+        "_scale_down", "_pick_victim", "_reconcile_prefill",
+        "_promote", "_demote", "_warm", "adopt", "_register",
+    },
     # mesh dispatch path: the sharded version load (publish, on the
     # swapping caller's thread) issues device transfers but must never
     # BLOCK on one — traffic flows on the active version meanwhile

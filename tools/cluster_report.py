@@ -61,6 +61,28 @@ def render(view, out=sys.stdout):
               f"({s['vs_median']}x median, hb age "
               f"{_fmt(s.get('heartbeat_age_s'), 's', na='n/a')}) "
               f"— {verdict}\n")
+    # serving-fleet rows: processes that publish the agent's "serving"
+    # section (role/queue/inflight/version) plus the merged fleet
+    # latency histograms (KV handoff, elastic spawn) — the one-glance
+    # answer to "is the fleet keeping up and how slow are its moves"
+    serving = [(r.get("process_index", 0), r["serving"])
+               for r in view.get("processes", []) if r.get("serving")]
+    fleet = view.get("fleet") or {}
+    if serving or fleet:
+        w("\nfleet:\n")
+        for idx, s in serving:
+            w(f"  proc {idx}: role={s.get('role', '-')} "
+              f"queue={s.get('queue_depth', '-')} "
+              f"inflight={s.get('inflight', '-')} "
+              f"pending={s.get('pending', '-')} "
+              f"v={s.get('active_version', '-')}\n")
+        for name in ("serve/fleet_handoff_ms", "serve/fleet_spawn_ms"):
+            h = fleet.get(name)
+            if h:
+                w(f"  {name}: n={h.get('count')} "
+                  f"mean={_fmt(h.get('mean'), 'ms')} "
+                  f"p99={_fmt(h.get('p99_worst_proc'), 'ms')} "
+                  f"max={_fmt(h.get('max'), 'ms')}\n")
     ctx = view.get("context")
     if ctx:
         w(f"context: {json.dumps(ctx, default=str)}\n")

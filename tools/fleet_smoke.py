@@ -20,6 +20,14 @@ The drill, end to end on CPU:
   and ``kv_blocks_in_use`` drained to 0 in every surviving process
   (the monolithic oracle included).
 
+A second leg (ISSUE 19) drills the elastic control plane: a
+``FleetController`` grows the fleet 1 -> 2 under sustained load with
+an injected ``fleet/spawn`` fault on the first attempt, is killed by a
+permanent ``fleet/controller_tick`` fault mid-reconcile (the fleet
+keeps serving, bitwise), and a replacement controller adopts the
+surviving members — plus one launched while no controller existed —
+from the membership directory.
+
 Seconds-to-minutes on CPU; wired into tier-1 as ``make fleet-smoke``.
 """
 import json
@@ -71,6 +79,148 @@ def _agent_log(fleet_dir, name):
             return f.read()
     except OSError:
         return "<unreadable>"
+
+
+def elastic_controller_leg(model, oracle):
+    """ISSUE 19: the elastic control-plane drill over REAL subprocess
+    agents, chaos armed in the CONTROLLER process this time:
+
+    * a sustained backlog makes the controller grow 1 -> 2, the FIRST
+      spawn attempt dying on the ``fleet/spawn`` seam (transient) —
+      membership unchanged, the cooldown-gated retry lands;
+    * a permanent ``fleet/controller_tick`` fault then kills the
+      controller thread mid-reconcile — the fleet keeps serving
+      (bitwise the monolithic oracle) with the control plane dead;
+    * a replacement controller ADOPTS the existing members from the
+      membership directory (including an agent launched while no
+      controller existed at all) and the grown fleet serves on.
+
+    Returns (failures, summary fragment)."""
+    import jax
+    from bigdl_tpu.parallel import chaos as _chaos
+    from bigdl_tpu.serving import (FleetController, FleetMonitor,
+                                   RemoteReplica, Router, ScalePolicy,
+                                   wait_for_members)
+    failures = []
+    fd = tempfile.mkdtemp(prefix="fleet_smoke_ctl_")
+    params_path = os.path.join(fd, "params.pkl")
+    with open(params_path, "wb") as f:
+        pickle.dump(jax.tree_util.tree_map(np.asarray, model.params), f)
+    procs = {"c0": spawn(fd, "c0", params_path, idx=1)}
+    try:
+        doc, = wait_for_members(fd, ["c0"], timeout_s=300)
+    except TimeoutError as e:
+        procs["c0"].kill()
+        return [f"elastic: c0 never joined: {e}"], ""
+    rep0 = RemoteReplica(doc, fleet_dir=fd)
+    router = Router([rep0], max_failovers=4).start()
+    mon = FleetMonitor([rep0], fleet_dir=fd, every_s=0.1,
+                       stale_s=10.0).start()
+
+    def ctl_spawn(name):
+        procs[name] = spawn(fd, name, params_path, idx=len(procs) + 1)
+        d, = wait_for_members(fd, [name], timeout_s=300)
+        return RemoteReplica(d, fleet_dir=fd).start()
+
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(1, V, size=int(rng.randint(5, 17))
+                           ).astype(np.int32) for _ in range(20)]
+    want = [oracle.generate(p, 12) for p in prompts]
+    pol = ScalePolicy(min_replicas=1, max_replicas=2, queue_high=1.0,
+                      up_ticks=1, down_ticks=10 ** 9, cooldown_s=0.2)
+    ctl = FleetController(router, mon, fleet_dir=fd, spawn=ctl_spawn,
+                          policy=pol, every_s=0.1,
+                          warm_prompts=lambda: prompts[:2])
+    ctl2 = None
+    adopted = 0
+    fired = 0
+    # the permanent tick fault sits far out (pass 40): the fail-spawn +
+    # cooldown + retried-spawn sequence needs only the first few OVER
+    # ticks, and once the fleet is at max budget the extra ticks change
+    # nothing — so the controller death is deterministically AFTER the
+    # scale-up, however long the subprocess boot takes
+    _chaos.arm({"seed": 5, "sites": {
+        "fleet/spawn": [{"kind": "transient", "nth": 1}],
+        "fleet/controller_tick": [{"kind": "permanent", "nth": 40}]}})
+    try:
+        futs = [(i, router.submit(prompts[i], max_new_tokens=12))
+                for i in range(len(prompts))]
+        nxt = len(futs)
+        ctl.start()
+        # sustained load, topped up in batches — the controller scores
+        # the member-file backlog, and a one-shot burst drains before
+        # the retried spawn can land
+        deadline = time.time() + 420
+        while (len(router.stats()["replicas"]) < 2 or not ctl.dead) \
+                and time.time() < deadline:
+            if sum(router.stats()["queue_depth"].values()) < 6 \
+                    and len(futs) < 300:
+                for _ in range(6):
+                    i = nxt % len(prompts)
+                    nxt += 1
+                    futs.append((i, router.submit(prompts[i],
+                                                  max_new_tokens=12)))
+            time.sleep(0.1)
+        cs = ctl.stats()
+        if len(router.stats()["replicas"]) != 2:
+            failures.append(f"elastic: never scaled to 2: {cs}")
+        if cs["spawn_failed"] < 1:
+            failures.append(
+                f"elastic: the injected spawn fault never fired: {cs}")
+        if not ctl.dead:
+            failures.append("elastic: controller_tick chaos never "
+                            "killed the controller")
+        # data plane alive with the control plane dead: every queued
+        # request resolves bitwise, and fresh traffic still lands
+        bad = sum(1 for i, f in futs
+                  if not np.array_equal(want[i], f.result(timeout=600)))
+        if bad:
+            failures.append(f"elastic: {bad}/{len(futs)} streams not "
+                            "bitwise under scaling + controller death")
+        probe = router.submit(prompts[0], max_new_tokens=12)
+        if not np.array_equal(want[0], probe.result(timeout=600)):
+            failures.append("elastic: post-death traffic diverged")
+        # an agent launched while NO controller exists...
+        procs["c1"] = spawn(fd, "c1", params_path, idx=len(procs) + 1)
+        wait_for_members(fd, ["c1"], timeout_s=300)
+        fired = len(_chaos.fires())
+        _chaos.disarm()
+        # ...is adopted by the REPLACEMENT controller from the files
+        ctl2 = FleetController(router, mon, fleet_dir=fd,
+                               spawn=ctl_spawn, policy=pol,
+                               every_s=0.1, name="ctl2")
+        adopted = ctl2.adopt()
+        if adopted < 1:
+            failures.append(f"elastic: respawned controller adopted "
+                            f"{adopted} members (want >= 1)")
+        nrep = len(router.stats()["replicas"])
+        if nrep != 3:
+            failures.append(f"elastic: fleet after adoption has {nrep} "
+                            "replicas (want 3)")
+        probe = router.submit(prompts[1], max_new_tokens=12)
+        if not np.array_equal(want[1], probe.result(timeout=600)):
+            failures.append("elastic: post-adoption traffic diverged")
+        if fired < 2:
+            failures.append(f"elastic: {fired} chaos fires < 2")
+    finally:
+        _chaos.disarm()
+        ctl.stop()
+        if ctl2 is not None:
+            ctl2.stop()
+        router.shutdown()
+        mon.stop()
+        for n, p in procs.items():
+            try:
+                if p.wait(timeout=120) != 0:
+                    failures.append(f"elastic: agent {n} exit "
+                                    f"{p.returncode} != 0")
+            except subprocess.TimeoutExpired:
+                p.kill()
+                failures.append(f"elastic: agent {n} hung at exit")
+    summary = (f"elastic: 1->2 through an injected spawn fault, "
+               f"controller killed by tick chaos, successor adopted "
+               f"{adopted} ({fired} fires)")
+    return failures, summary
 
 
 def main():
@@ -173,6 +323,11 @@ def main():
         failures.append("the injected mid-handoff death never degraded "
                         f"a request: {dst}")
 
+    # leg 2 (ISSUE 19): the elastic controller drill rides the same
+    # oracle before it shuts down
+    eleg_failures, eleg_summary = elastic_controller_leg(model, oracle)
+    failures.extend(eleg_failures)
+
     # survivor drains clean: its ledger empties (remote shutdown reply)
     r1_blocks = None
     try:
@@ -215,8 +370,8 @@ def main():
     summary = (f"{len(plan)} requests ({dst['handoffs']} handoffs, "
                f"{dst['handoff_failed'] + dst['handoff_refused']} "
                f"degraded), {rst['failovers']} failovers, "
-               f"{recov} KV recoveries, exits {codes}, "
-               f"{time.time() - t0:.1f}s")
+               f"{recov} KV recoveries, exits {codes}; "
+               f"{eleg_summary}; {time.time() - t0:.1f}s")
     if failures:
         print("fleet_smoke: FAIL — " + "; ".join(failures),
               file=sys.stderr)
