@@ -64,7 +64,7 @@ from ..observability import health as _health
 from ..parallel import chaos as _chaos
 from ..parallel.failure import TRANSIENT, classify_failure
 from .fleet import (DisaggregatedFleet, FleetMonitor, RemoteReplica,
-                    discover, warm_replica)
+                    discover, read_member, warm_replica)
 
 _LOG = logging.getLogger("bigdl_tpu.serving.controller")
 
@@ -101,6 +101,11 @@ class ScalePolicy:
     watch_classes: Optional[Set[str]] = None
     #: max prompts warmed into a joining replica (0 disables warming)
     warm_limit: int = 8
+    #: after a drain-retire, the victim's name is held out of adoption
+    #: until its member doc goes terminal or this many seconds pass —
+    #: the shutdown ack races the agent's final beat, and a tick in
+    #: that window must not re-register the retiring replica
+    retire_grace_s: float = 60.0
 
 
 class FleetController:
@@ -153,6 +158,10 @@ class FleetController:
             for p in disagg.prefill:
                 self._members.setdefault(p.name, p)
         self._promoted: Set[str] = set()
+        # name → monotonic stamp of a drain-retire still in flight:
+        # adopt() skips these until the member doc goes terminal (or
+        # the grace period lapses)
+        self._retired: Dict[str, float] = {}
         self._spawn_ids = itertools.count()
         self._up_streak = 0
         self._down_streak = 0
@@ -201,13 +210,24 @@ class FleetController:
         the router/monitor (prefill-role members join the disagg
         prefill pool instead). This is what makes a controller restart
         an ADOPTION, not a respawn storm — the directory is the
-        controller's only durable state. Returns members adopted."""
+        controller's only durable state. A name this controller just
+        drain-retired is held out until its member doc goes terminal
+        (or ``retire_grace_s`` lapses): the agent's shutdown ack races
+        its final beat, and a tick landing in that window must not
+        re-register the retiring replica. Returns members adopted."""
         n = 0
+        now = time.monotonic()
         for doc in discover(self.fleet_dir):
             name = doc["name"]
-            if name in self._members or doc.get("dead") \
-                    or doc.get("final"):
+            if doc.get("dead") or doc.get("final"):
+                self._retired.pop(name, None)   # retirement completed
                 continue
+            if name in self._members:
+                continue
+            if name in self._retired:
+                if now - self._retired[name] < self.policy.retire_grace_s:
+                    continue
+                self._retired.pop(name, None)
             rep = RemoteReplica(doc, fleet_dir=self.fleet_dir)
             try:
                 rep.start()
@@ -327,8 +347,24 @@ class FleetController:
 
     # -- scale -----------------------------------------------------------
 
+    def _next_spawn_name(self) -> str:
+        """The next ``<prefix>N`` no member already claims. The id
+        counter restarts at 0 with every controller incarnation, so a
+        successor that ADOPTED a predecessor's ``auto0`` must not hand
+        that name to its own first spawn — the new agent would clobber
+        the live replica's member file and the healthy original would
+        be falsely retired off the new agent's beats. Skip ids with a
+        tracked member, a retirement in flight, or any existing member
+        file (live, final, or orphaned: the name is taken either way)."""
+        while True:
+            name = f"{self.spawn_prefix}{next(self._spawn_ids)}"
+            if (name not in self._members
+                    and name not in self._retired
+                    and read_member(self.fleet_dir, name) is None):
+                return name
+
     def _scale_up(self):
-        name = f"{self.spawn_prefix}{next(self._spawn_ids)}"
+        name = self._next_spawn_name()
         t0 = time.monotonic()
         try:
             _chaos.maybe_fire("fleet/spawn", tag=name)
@@ -383,6 +419,9 @@ class FleetController:
         if self.disagg is not None:
             self.disagg.remove_decode(victim)
         self._members.pop(victim, None)
+        # hold the name out of adoption until its member doc goes
+        # terminal — the agent acks shutdown BEFORE its final beat
+        self._retired[victim] = time.monotonic()
         self._bump("scale_downs")
         self._down_streak = 0
         self._last_change = time.monotonic()
@@ -447,9 +486,20 @@ class FleetController:
         rep = self._members.get(name)
         if rep is None:
             return
-        pool_vs = {p.active_version() for p in self.disagg.prefill
-                   if p.active_version() is not None}
-        if pool_vs and rep.active_version() not in pool_vs:
+        def _live_version(r):
+            # the FRESH member doc, never the handle cache: an adopted
+            # or idle handle's cached version is seeded at construction
+            # and only refreshed by its own submit acks, so it can stay
+            # None/stale forever and block promotion on phantom skew
+            doc = r.member()
+            if doc is None:
+                return r.active_version()
+            return (doc.get("serving") or {}).get("active_version")
+
+        pool_vs = {v for v in (_live_version(p)
+                               for p in self.disagg.prefill)
+                   if v is not None}
+        if pool_vs and _live_version(rep) not in pool_vs:
             self._bump("version_skew_blocked")
             if obs.enabled():
                 obs.counter("serve/fleet_promotion_skew_blocked").inc()

@@ -1139,7 +1139,11 @@ class FleetMonitor:
         """Observer-monotonic seconds since ``name``'s beat counter last
         advanced; ``inf`` for a missing doc (nothing to make progress)."""
         if doc is None:
-            self._progress.pop(name, None)
+            # a transient read miss (file mid-rewrite) must NOT reset
+            # the staleness clock: keep the (beat, stamp) entry so the
+            # next successful read continues a frozen member's age
+            # instead of re-seeding it at 0. unwatch() is what forgets
+            # a member for good.
             return float("inf")
         beat = doc.get("beat")
         if not isinstance(beat, (int, float)):

@@ -568,14 +568,36 @@ class Router:
             running = self._thread is not None
         if self.manage_replicas and running:
             engine.start()
+        err: Optional[Exception] = None
         with self._lock:
-            self._replicas = self._replicas + [rep]
-            if bn:
-                self._by_beacon[bn] = rep
-            self._any_prefix = self._any_prefix or callable(
-                getattr(engine, "cached_prefix_tokens", None))
-            for k in self._classes:
-                self._reseed_ewma_locked(k)
+            # re-validate: the lock was dropped around engine.start(),
+            # so the router may have closed — or a concurrent add may
+            # have taken the name/beacon — in between
+            if self._closed:
+                err = EngineStopped("router is shutting down")
+            elif any(r.name == rep.name for r in self._replicas):
+                err = ValueError(f"duplicate replica name {rep.name!r}")
+            elif bn and bn in self._by_beacon:
+                err = ValueError(
+                    f"replica {rep.name!r} shares the beacon name {bn!r} "
+                    "with an existing replica — health events would be "
+                    "un-attributable")
+            else:
+                self._replicas = self._replicas + [rep]
+                if bn:
+                    self._by_beacon[bn] = rep
+                self._any_prefix = self._any_prefix or callable(
+                    getattr(engine, "cached_prefix_tokens", None))
+                for k in self._classes:
+                    self._reseed_ewma_locked(k)
+        if err is not None:
+            # undo the start — the engine never entered rotation
+            if self.manage_replicas and running:
+                try:
+                    engine.shutdown(drain=True)
+                except Exception:  # noqa: BLE001 — undo is best-effort
+                    pass
+            raise err
         self._bump("joins")
         if obs.enabled():
             obs.counter("serve/router_joins").inc()

@@ -166,6 +166,27 @@ def test_staleness_is_beat_progress_not_wallclock(tmp_path):
                                101.0) == float("inf")
 
 
+def test_staleness_clock_survives_transient_read_miss(tmp_path):
+    """One unreadable beat (the member file mid-rewrite) must not reset
+    a frozen member's staleness clock: the next successful read
+    continues the age from when the counter last ADVANCED, so a wedged
+    agent cannot have its stall detection deferred by transient read
+    misses."""
+    mon = FleetMonitor([], fleet_dir=str(tmp_path), stale_s=1.0)
+    assert mon._progress_age_s("m", {"beat": 7}, now=50.0) == 0.0
+    assert mon._progress_age_s("m", {"beat": 7},
+                               now=50.4) == pytest.approx(0.4)
+    # transient miss: unknown for the instant, but the entry survives
+    assert mon._progress_age_s("m", None, now=50.5) == float("inf")
+    assert mon._progress_age_s("m", {"beat": 7},
+                               now=51.2) == pytest.approx(1.2)
+    # real counter progress still resets the clock
+    assert mon._progress_age_s("m", {"beat": 8}, now=51.3) == 0.0
+    # unwatch is what forgets the member for good
+    mon.unwatch("m")
+    assert "m" not in mon._progress
+
+
 def test_skewed_wallclock_member_not_false_killed(tmp_path):
     """End-to-end: an agent whose member-file stamps are rewritten two
     hours into the past (a skewed cross-host clock) keeps serving under
@@ -396,6 +417,13 @@ def test_prefill_promotion_relieves_backlog_then_demotes(tmp_path):
             router, mon, fleet_dir=fd,
             spawn=lambda n: pytest.fail("promotion must not spawn"),
             policy=pol, disagg=dis)
+        # the promotion version gate must read the FRESH member docs,
+        # not these handle caches — an adopted or idle handle's cache
+        # is seeded at construction and can stay None/stale forever,
+        # which would block promotion on phantom skew. Poison the
+        # caches to prove the gate no longer consults them.
+        rpf._active_version = "vSTALE-pool"
+        rd0._active_version = "vSTALE-promotee"
         rng = np.random.RandomState(13)
         # backlog: pile slow work straight onto the prefill specialist
         load = [rpf.submit(p, max_new_tokens=24)
@@ -528,6 +556,116 @@ def test_controller_death_keeps_serving_and_respawn_adopts(tmp_path):
             ag.shutdown()
     local.shutdown()
     assert controller_threads_alive() == 0
+    assert fleet_threads_alive() == 0
+
+
+def test_restart_spawn_names_never_collide_with_adopted(tmp_path):
+    """A successor controller's spawn-id counter restarts at 0; its
+    first scale-up must NOT reuse the name of a predecessor-spawned
+    replica it adopted — the new agent would clobber the live replica's
+    member file, be drained as a duplicate, and its final beat would
+    falsely retire the healthy original. Names with a member file still
+    in the directory (live OR final) are skipped too."""
+    fd = str(tmp_path)
+    m = _model()
+    agents = {}
+
+    def spawn(name):
+        ag = ReplicaAgent(DecodeScheduler(m, name=name, **SCHED),
+                          fleet_dir=fd, name=name, beat_s=0.1).start()
+        agents[name] = ag
+        doc, = wait_for_members(fd, [name], timeout_s=60)
+        return RemoteReplica(doc, fleet_dir=fd).start()
+
+    # a PREDECESSOR controller spawned auto0 (still live) and auto1
+    # (retired cleanly — its FINAL member file remains), then died
+    r0 = spawn("auto0")
+    ag1 = ReplicaAgent(DecodeScheduler(m, name="auto1", **SCHED),
+                       fleet_dir=fd, name="auto1", beat_s=0.1).start()
+    wait_for_members(fd, ["auto1"], timeout_s=60)
+    ag1.shutdown()
+    assert read_member(fd, "auto1").get("final")
+    router = Router([r0], max_failovers=4).start()
+    mon = FleetMonitor([r0], fleet_dir=fd, every_s=0.1,
+                       stale_s=10.0).start()
+    pol = ScalePolicy(min_replicas=1, max_replicas=3, up_ticks=99,
+                      down_ticks=99, cooldown_s=0.0)
+    ctl = FleetController(router, mon, fleet_dir=fd, spawn=spawn,
+                          policy=pol)
+    try:
+        assert ctl.adopt() == 0   # auto0 already routed; auto1 is final
+        ctl._scale_up()
+        cs = ctl.stats()
+        assert cs["scale_ups"] == 1 and cs["spawn_failed"] == 0, cs
+        assert "auto2" in agents, \
+            f"spawn must skip taken names auto0/auto1: {sorted(agents)}"
+        assert sorted(router.healthy_replicas()) == ["auto0", "auto2"]
+        # the predecessor replica's member file was never clobbered
+        d0 = read_member(fd, "auto0")
+        assert d0 and not d0.get("dead") and not d0.get("final")
+        assert int(d0["port"]) == r0.port
+        # both serve, bitwise alike
+        rng = np.random.RandomState(23)
+        p = rng.randint(1, V, size=9).astype(np.int32)
+        outs = [router.submit(p, max_new_tokens=6).result(timeout=120)
+                for _ in range(4)]
+        assert all(np.array_equal(outs[0], o) for o in outs)
+        router.shutdown()
+    finally:
+        mon.stop()
+        for ag in agents.values():
+            ag.shutdown()
+    assert fleet_threads_alive() == 0
+
+
+def test_retired_victim_is_not_readopted_mid_drain(tmp_path):
+    """The retiring agent acks its shutdown op BEFORE writing the final
+    member beat; an adopt() landing in that window must not re-register
+    the victim — its name is held out of adoption until its member doc
+    goes terminal."""
+    fd = str(tmp_path)
+    m = _model()
+    agents = {}
+
+    def spawn(name):
+        ag = ReplicaAgent(DecodeScheduler(m, name=name, **SCHED),
+                          fleet_dir=fd, name=name, beat_s=0.05).start()
+        agents[name] = ag
+        doc, = wait_for_members(fd, [name], timeout_s=60)
+        return RemoteReplica(doc, fleet_dir=fd).start()
+
+    seed = spawn("seed0")
+    auto = spawn("auto0")
+    router = Router([seed, auto], max_failovers=4).start()
+    mon = FleetMonitor([seed, auto], fleet_dir=fd, every_s=0.1,
+                       stale_s=10.0).start()
+    pol = ScalePolicy(up_ticks=99, down_ticks=99, cooldown_s=0.0)
+    ctl = FleetController(router, mon, fleet_dir=fd, spawn=spawn,
+                          policy=pol)
+    try:
+        ctl._scale_down()   # prefers the controller-prefixed auto0
+        assert router.healthy_replicas() == ["seed0"]
+        assert ctl.stats()["scale_downs"] == 1
+        # hammer adoption through the ack→final-beat window
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            assert ctl.adopt() == 0, \
+                "retiring member must not be re-adopted"
+            d = read_member(fd, "auto0")
+            if d and (d.get("final") or d.get("dead")):
+                break
+            time.sleep(0.002)
+        else:
+            pytest.fail("auto0 never reached a terminal beat")
+        assert ctl.adopt() == 0   # the terminal doc clears the ledger
+        assert "auto0" not in ctl._retired
+        assert ctl.stats()["adopted"] == 0
+        assert router.healthy_replicas() == ["seed0"]
+        router.shutdown()
+    finally:
+        mon.stop()
+        for ag in agents.values():
+            ag.shutdown()
     assert fleet_threads_alive() == 0
 
 
